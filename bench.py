@@ -1,0 +1,70 @@
+"""Benchmark: FedAvg rounds/sec on FEMNIST-shaped workload (BASELINE.json).
+
+Runs the flagship config — FedAvg-paper CNN, 3400 simulated clients, 10
+sampled per round, batch 20, E=1 (benchmark/README.md:54 setting) — on the
+available device(s) and prints ONE JSON line.
+
+vs_baseline: the reference publishes no throughput numbers
+(BASELINE.json.published = {}); its round latency is bounded below by the
+MPI manager's 0.3 s receive-poll sleep (mpi/com_manager.py:71-78), so we use
+1/0.3 ≈ 3.33 rounds/sec as the reference ceiling for the ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    # FEMNIST-shaped: 3400 clients, ~110 samples each (lognormal sizes)
+    data = load_dataset("femnist", seed=0)
+    cfg = FedAvgConfig(
+        comm_round=30,
+        client_num_in_total=3400,
+        client_num_per_round=10,
+        epochs=1,
+        batch_size=20,
+        lr=0.1,
+        frequency_of_the_test=10_000,  # pure training throughput
+        max_batches=28,  # covers ~[22,550]-sample clients at bs=20
+    )
+    task = classification_task(CNNOriginalFedAvg(only_digits=False))
+    api = FedAvgAPI(data, task, cfg)
+
+    # warmup (compile)
+    api.run_round(0)
+    jax.block_until_ready(api.net.params)
+
+    n_rounds = 30
+    t0 = time.perf_counter()
+    total_samples = 0.0
+    for r in range(1, n_rounds + 1):
+        m = api.run_round(r)
+    jax.block_until_ready(api.net.params)
+    dt = time.perf_counter() - t0
+    total_samples = float(m["count"]) * n_rounds  # last round's count as per-round proxy
+
+    rounds_per_sec = n_rounds / dt
+    baseline_rounds_per_sec = 1.0 / 0.3  # MPI poll-loop lower bound, see docstring
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_femnist_rounds_per_sec",
+                "value": round(rounds_per_sec, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

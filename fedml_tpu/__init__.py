@@ -1,0 +1,30 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+A from-scratch re-design of the capabilities of FedML (forestnoobie/FedML,
+reference layer map in SURVEY.md) for TPU hardware:
+
+- The reference's message-passing round (MPI/gRPC/MQTT point-to-point sends,
+  ``fedml_core/distributed/communication/``) becomes ONE SPMD program over a
+  ``jax.sharding.Mesh``: local client training is a jitted/`shard_map`-ped
+  train step, aggregation is a weighted ``jax.lax.psum`` over ICI.
+- The reference's per-process ClientManager/ServerManager/Trainer machinery
+  (``fedml_core/distributed/{client,server}/``) becomes a thin host-side
+  round driver around jitted collectives.
+- Models are flax.linen modules (reference: torch.nn, ``fedml_api/model/``),
+  optimizers are optax, checkpointing is orbax.
+
+Subpackages
+-----------
+mesh        device mesh + sharding helpers                    (L0)
+collectives tested collective wrappers = the "comm backend"   (L1)
+core        client state, local update, round engine, sampler,
+            partitioner, robust aggregation, topology         (L2)
+models      flax model zoo                                    (L3a)
+data        partitioned dataset loaders (8-tuple contract)    (L3b)
+algorithms  FedAvg, FedOpt, FedProx, FedNova, hierarchical,
+            decentralized, robust, FedDF, SplitNN, VFL,
+            TurboAggregate, FedGKT, FedNAS                    (L4)
+experiments unified CLI launcher                              (L5)
+"""
+
+__version__ = "0.1.0"

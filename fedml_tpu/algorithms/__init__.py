@@ -1,0 +1,13 @@
+"""Federated algorithms (L4).
+
+Each module re-designs one reference algorithm family
+(fedml_api/{distributed,standalone}/<algo>/) as host-driven rounds around ONE
+jitted SPMD program. The reference's six-file pattern (API / Aggregator /
+Trainer / ServerManager / ClientManager / message_define) collapses into a
+config + round function: the managers' message loop is the jit boundary, the
+aggregator is a weighted psum, the trainer is core.local.make_local_update.
+"""
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.fedopt import FedOptAPI
+from fedml_tpu.algorithms.fedprox import FedProxAPI

@@ -1,0 +1,304 @@
+"""FedAvg — the centerpiece algorithm, TPU-first.
+
+Reference behavior being matched (fedml_api/distributed/fedavg/ and
+fedml_api/standalone/fedavg/fedavg_api.py:40-115):
+  per round: sample clients (FedAVGAggregator.client_sampling:89-97)
+  -> each client: local SGD from the global weights (MyModelTrainer.py:19-49)
+  -> server: sample-weighted average of all returned weights
+     (FedAVGAggregator.aggregate:58-87)
+  -> periodic eval on train/test (fedavg_api.py:117-180).
+
+TPU re-design: one round = ONE jitted program.
+  - standalone mode (1 device): clients are a vmapped leading axis — the
+    reference's sequential client loop (fedavg_api.py:56-66) becomes a batched
+    axis so every client's local SGD runs concurrently on the MXU.
+  - distributed mode (mesh): the vmapped block is shard_mapped over the
+    'clients' mesh axis; aggregation is a weighted psum over ICI
+    (replacing the MPI upload/download round, SURVEY.md §2.8).
+The host loop only samples ids, packs data, and logs — no message machinery.
+
+Server update is a hook (identity for FedAvg) so FedOpt/FedNova/robust
+variants reuse this engine (see fedopt.py etc.).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.core.client_data import ClientBatch, FederatedData, batch_global, pack_clients
+from fedml_tpu.core.local import LocalSpec, NetState, Task, make_eval_fn, make_local_update
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+log = logging.getLogger("fedml_tpu.fedavg")
+
+
+@dataclasses.dataclass(frozen=True)
+class FedAvgConfig:
+    """Flag surface parity with the reference argparse
+    (fedml_experiments/distributed/fedavg/main_fedavg.py:48-119)."""
+
+    comm_round: int = 10
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    epochs: int = 1
+    batch_size: int = 32
+    client_optimizer: str = "sgd"  # 'sgd' | 'adam'
+    lr: float = 0.03
+    wd: float = 0.0
+    momentum: float = 0.0
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    max_batches: int | None = None  # static per-client batch budget (B)
+    ci: bool = False  # truncate eval, reference --ci semantics
+    eval_batch_size: int = 256
+
+
+def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
+    """The reference builds torch SGD(momentum, wd) or Adam(wd, amsgrad)
+    per client (MyModelTrainer.py:24-32)."""
+    if cfg.client_optimizer == "sgd":
+        tx = optax.sgd(cfg.lr, momentum=cfg.momentum or None)
+    elif cfg.client_optimizer == "adam":
+        tx = optax.adam(cfg.lr)
+    else:
+        raise ValueError(cfg.client_optimizer)
+    if cfg.wd:
+        tx = optax.chain(optax.add_decayed_weights(cfg.wd), tx)
+    return tx
+
+
+class FedAvgAPI:
+    """Host-side round driver + jitted round program.
+
+    ``mesh=None`` -> single-device (standalone simulation parity).
+    ``mesh=Mesh(..., ('clients',))`` -> SPMD over devices (distributed parity).
+    """
+
+    def __init__(
+        self,
+        dataset: FederatedData,
+        task: Task,
+        config: FedAvgConfig,
+        mesh: Mesh | None = None,
+        server_update: Callable | None = None,
+        server_opt_init: Callable | None = None,
+        client_result_hook: Callable | None = None,
+        post_aggregate_hook: Callable | None = None,
+        local_spec: LocalSpec | None = None,
+    ):
+        self.data = dataset
+        self.task = task
+        self.cfg = config
+        self.mesh = mesh
+        self.rng = jax.random.PRNGKey(config.seed)
+
+        # static per-client batch budget: fixed across rounds so the round
+        # program compiles once (see SURVEY.md §7 "hard parts" (1))
+        counts = [len(v) for v in dataset.train_idx_map.values()]
+        b_needed = int(np.ceil(max(counts) / config.batch_size))
+        self.num_batches = min(config.max_batches or b_needed, b_needed)
+
+        self.local_spec = local_spec or LocalSpec(
+            optimizer=make_client_optimizer(config), epochs=config.epochs
+        )
+        self.local_update = make_local_update(task, self.local_spec)
+        self.eval_fn = make_eval_fn(task)
+
+        # server update hook: (net_old, net_avg, opt_state) -> (net_new, opt_state)
+        self.server_update = server_update or (lambda old, avg, s: (avg, s))
+        self.client_result_hook = client_result_hook  # (net_k, net_global, rng) -> net_k
+        self.post_aggregate_hook = post_aggregate_hook  # (net, rng) -> net
+
+        # init model
+        self.rng, init_key = jax.random.split(self.rng)
+        x_sample = jnp.asarray(dataset.train_x[: config.batch_size])
+        self.net = task.init(init_key, x_sample)
+        self.server_opt_state = server_opt_init(self.net.params) if server_opt_init else ()
+
+        self.round_fn = self._build_round_fn()
+        self._test_cache = None
+        self.history: list[dict] = []
+
+    # ------------------------------------------------------------------ round
+    def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp, hook_key):
+        """Per-shard body: vmap local fits, weighted-aggregate, server update.
+
+        In distributed mode this runs inside shard_map: the leading client
+        axis is this device's slice and the weighted mean is a psum over
+        'clients'. In standalone mode axis_name is None and the weighted mean
+        is local.
+        """
+        K = x.shape[0]
+        nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
+            keys, net, x, y, mask
+        )
+        if self.client_result_hook is not None:
+            hkeys = jax.random.split(hook_key, K)
+            nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
+        return nets, metrics, nsamp
+
+    def _aggregate_and_update(self, net, server_opt_state, nets, metrics, nsamp, post_key):
+        avg = tree_weighted_mean(nets, nsamp)
+        new_net, new_opt = self.server_update(net, avg, server_opt_state)
+        if self.post_aggregate_hook is not None:
+            new_net = self.post_aggregate_hook(new_net, post_key)
+        agg_metrics = {k: jnp.sum(v) for k, v in metrics.items()}
+        return new_net, new_opt, agg_metrics
+
+    def _build_round_fn(self):
+        cfg = self.cfg
+
+        if self.mesh is None:
+
+            @jax.jit
+            def round_fn(rng, net, server_opt_state, batch: ClientBatch):
+                rng, kb, kh, kp = jax.random.split(rng, 4)
+                keys = jax.random.split(kb, batch.x.shape[0])
+                nets, metrics, nsamp = self._round_body(
+                    keys, net, server_opt_state, batch.x, batch.y, batch.mask,
+                    batch.num_samples, kh,
+                )
+                new_net, new_opt, m = self._aggregate_and_update(
+                    net, server_opt_state, nets, metrics, nsamp, kp
+                )
+                return new_net, new_opt, m
+
+            return round_fn
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        ndev = int(np.prod(mesh.devices.shape))
+        if cfg.client_num_per_round % ndev != 0:
+            raise ValueError(
+                f"client_num_per_round={cfg.client_num_per_round} must be a "
+                f"multiple of mesh size {ndev} (pad with zero-weight clients)"
+            )
+
+        def shard_body(keys, net, x, y, mask, nsamp, hook_key):
+            # keys/x/y/mask/nsamp have this device's client slice. The global
+            # net enters replicated but the scan carry becomes device-varying
+            # after the first local step — mark it varying up front (vma rule).
+            net = jax.tree.map(lambda v: jax.lax.pcast(v, axis, to="varying"), net)
+            nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
+                keys, net, x, y, mask
+            )
+            if self.client_result_hook is not None:
+                hkeys = jax.random.split(hook_key, x.shape[0])
+                nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
+            # weighted psum over ICI: numerator and denominator
+            wsum = jax.tree.map(
+                lambda t: jax.lax.psum(jnp.tensordot(nsamp, t, axes=([0], [0])), axis),
+                nets,
+            )
+            den = jax.lax.psum(jnp.sum(nsamp), axis)
+            avg = jax.tree.map(lambda t: t / jnp.maximum(den, 1e-12), wsum)
+            msum = {k: jax.lax.psum(jnp.sum(v), axis) for k, v in metrics.items()}
+            return avg, msum
+
+        smapped = jax.shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+        )
+
+        @jax.jit
+        def round_fn(rng, net, server_opt_state, batch: ClientBatch):
+            rng, kb, kh, kp = jax.random.split(rng, 4)
+            keys = jax.random.split(kb, batch.x.shape[0])
+            avg, metrics = smapped(
+                keys, net, batch.x, batch.y, batch.mask, batch.num_samples, kh
+            )
+            new_net, new_opt = self.server_update(net, avg, server_opt_state)
+            if self.post_aggregate_hook is not None:
+                new_net = self.post_aggregate_hook(new_net, kp)
+            return new_net, new_opt, metrics
+
+        return round_fn
+
+    # ------------------------------------------------------------------ data
+    def _pack_round(self, round_idx: int) -> ClientBatch:
+        cfg = self.cfg
+        ids = sample_clients(
+            round_idx, cfg.client_num_in_total, cfg.client_num_per_round, cfg.seed
+        )
+        cb = pack_clients(
+            self.data, ids, cfg.batch_size, max_batches=self.num_batches,
+            seed=cfg.seed, round_idx=round_idx,
+        )
+        # fixed B across rounds -> single compilation
+        if cb.num_batches < self.num_batches:
+            pad = self.num_batches - cb.num_batches
+            cb = ClientBatch(
+                x=np.concatenate([cb.x, np.zeros((cb.x.shape[0], pad) + cb.x.shape[2:], cb.x.dtype)], 1),
+                y=np.concatenate([cb.y, np.zeros((cb.y.shape[0], pad) + cb.y.shape[2:], cb.y.dtype)], 1),
+                mask=np.concatenate([cb.mask, np.zeros((cb.mask.shape[0], pad, cb.mask.shape[2]), cb.mask.dtype)], 1),
+                num_samples=cb.num_samples,
+            )
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            cb = ClientBatch(
+                x=jax.device_put(cb.x, sh), y=jax.device_put(cb.y, sh),
+                mask=jax.device_put(cb.mask, sh),
+                num_samples=jax.device_put(cb.num_samples, sh),
+            )
+        return cb
+
+    # ------------------------------------------------------------------ train
+    def run_round(self, round_idx: int):
+        cb = self._pack_round(round_idx)
+        self.rng, rk = jax.random.split(self.rng)
+        self.net, self.server_opt_state, metrics = self.round_fn(
+            rk, self.net, self.server_opt_state, cb
+        )
+        return metrics
+
+    def train(self, num_rounds: int | None = None):
+        cfg = self.cfg
+        rounds = num_rounds or cfg.comm_round
+        for r in range(rounds):
+            t0 = time.perf_counter()
+            metrics = self.run_round(r)
+            if (r % cfg.frequency_of_the_test == 0) or (r == rounds - 1):
+                ev = self.evaluate()
+                n = float(max(metrics["count"], 1.0))
+                rec = {
+                    "round": r,
+                    "train_loss": float(metrics["loss_sum"]) / n,
+                    "train_acc": float(metrics["correct"]) / n,
+                    "test_loss": float(ev["loss"]),
+                    "test_acc": float(ev["acc"]),
+                    "round_time": time.perf_counter() - t0,
+                }
+                self.history.append(rec)
+                log.info("round %d: %s", r, rec)
+        return self.net
+
+    # ------------------------------------------------------------------ eval
+    def evaluate(self):
+        """Global test-set eval (the reference evaluates per client over all
+        clients, fedavg_api.py:117-180; on a global-shared test set the two
+        coincide up to weighting)."""
+        if self._test_cache is None:
+            n = len(self.data.test_x)
+            if self.cfg.ci:
+                n = min(n, 512)  # --ci truncation analogue (FedAVGAggregator.py:126-131)
+            self._test_cache = tuple(
+                jnp.asarray(a)
+                for a in batch_global(
+                    self.data.test_x[:n], self.data.test_y[:n], self.cfg.eval_batch_size
+                )
+            )
+        xb, yb, mb = self._test_cache
+        return self.eval_fn(self.net, xb, yb, mb)

@@ -1,0 +1,62 @@
+"""FedOpt — FedAvg + a server-side optimizer (Adaptive Federated Optimization).
+
+Reference: fedml_api/distributed/fedopt/FedOptAggregator.py:70-121 — after the
+weighted average, set pseudo-gradient grad = w_old - w_avg on the global model
+and take one server optimizer step (SGD-momentum / Adam picked by name through
+OptRepo reflection, optrepo.py:25-39; flags --server_optimizer/--server_lr,
+main_fedopt.py:54-60).
+
+Here the pseudo-gradient step is an optax update fused into the round program.
+"""
+
+from __future__ import annotations
+
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.local import NetState
+from fedml_tpu.utils.tree import tree_sub
+
+
+def make_server_optimizer(name: str, lr: float, momentum: float = 0.9):
+    """Name->optax dispatch (the OptRepo analogue; optrepo.py:25-39)."""
+    name = name.lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=momentum or None)
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adagrad":
+        return optax.adagrad(lr)
+    if name == "yogi":
+        # FedYogi (Adaptive Federated Optimization, Reddi et al.)
+        return optax.yogi(lr)
+    raise ValueError(f"unknown server optimizer {name}")
+
+
+class FedOptAPI(FedAvgAPI):
+    def __init__(
+        self,
+        dataset,
+        task,
+        config: FedAvgConfig,
+        mesh=None,
+        server_optimizer: str = "sgd",
+        server_lr: float = 1.0,
+        server_momentum: float = 0.9,
+        **kwargs,
+    ):
+        tx = make_server_optimizer(server_optimizer, server_lr, server_momentum)
+
+        def server_update(old: NetState, avg: NetState, opt_state):
+            # pseudo-gradient points from the average back toward the old
+            # weights (FedOptAggregator.set_model_global_grads:109-121)
+            pseudo_grad = tree_sub(old.params, avg.params)
+            updates, new_state = tx.update(pseudo_grad, opt_state, old.params)
+            new_params = optax.apply_updates(old.params, updates)
+            # non-gradient collections (BN stats) take the plain average
+            return NetState(new_params, avg.extra), new_state
+
+        super().__init__(
+            dataset, task, config, mesh=mesh,
+            server_update=server_update, server_opt_init=tx.init, **kwargs,
+        )

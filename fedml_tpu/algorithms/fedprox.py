@@ -1,0 +1,22 @@
+"""FedProx — FedAvg with a proximal term on the client objective.
+
+Reference: fedml_api/distributed/fedprox/ — whose distributed trainer is
+byte-identical to FedAvg's, i.e. the proximal term is NOT implemented there
+(SURVEY.md §2.2). We implement the published algorithm (Li et al., MLSys'20):
+client loss += mu/2 ||w - w_global||^2, realized in
+core.local.make_local_update via LocalSpec.prox_mu. With mu=0 this is exactly
+FedAvg, matching the reference's de-facto behavior.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig, make_client_optimizer
+from fedml_tpu.core.local import LocalSpec
+
+
+class FedProxAPI(FedAvgAPI):
+    def __init__(self, dataset, task, config: FedAvgConfig, mesh=None, mu: float = 0.1, **kwargs):
+        spec = LocalSpec(
+            optimizer=make_client_optimizer(config), epochs=config.epochs, prox_mu=mu
+        )
+        super().__init__(dataset, task, config, mesh=mesh, local_spec=spec, **kwargs)

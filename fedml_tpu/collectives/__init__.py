@@ -1,0 +1,9 @@
+from fedml_tpu.collectives.ops import (
+    weighted_psum_tree,
+    weighted_mean_tree,
+    all_gather_tree,
+    ppermute_tree,
+    mix_with_topology,
+    psum_tree,
+)
+from fedml_tpu.collectives import finite_field

@@ -1,0 +1,82 @@
+"""Collective wrappers (L1) — the TPU-native "communication backend".
+
+Direct replacement of fedml_core/distributed/communication/ (MPI pickled
+point-to-point sends, mpi/mpi_send_thread.py:27; gRPC JSON messages,
+gRPC/grpc_comm_manager.py:53-74; MQTT pub/sub). The reference implements
+aggregation as N uploads + N downloads of serialized state_dicts through a
+polling receive loop (mpi/com_manager.py:71-78). Here a round's entire
+communication is XLA collectives over ICI, emitted inside shard_map:
+
+  model download (S2C_SYNC)  -> params are replicated; nothing moves
+  model upload + aggregate   -> weighted_psum_tree
+  gossip to neighbors        -> ppermute_tree / mix_with_topology
+  secure aggregation         -> finite_field.psum of coded shares
+
+All functions here take/return pytrees and must be called inside shard_map
+(they use a named mesh axis).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum_tree(tree, axis_name: str = "clients"):
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def weighted_psum_tree(tree, weight, axis_name: str = "clients"):
+    """Sum of ``weight * tree`` over the mesh axis; returns (sum_tree, sum_weight).
+
+    ``weight`` is this shard's scalar weight (e.g. local sample count). The
+    caller divides to get the weighted mean — kept separate so hierarchical /
+    multi-level aggregation can psum numerator and denominator independently.
+    """
+    num = jax.tree.map(lambda x: lax.psum(x * weight, axis_name), tree)
+    den = lax.psum(weight, axis_name)
+    return num, den
+
+
+def weighted_mean_tree(tree, weight, axis_name: str = "clients"):
+    """Sample-weighted average over the mesh axis.
+
+    The SPMD form of the server's weighted model average
+    (reference FedAVGAggregator.aggregate, FedAVGAggregator.py:58-87).
+    """
+    num, den = weighted_psum_tree(tree, weight, axis_name)
+    den = jnp.maximum(den, 1e-12)
+    return jax.tree.map(lambda x: x / den, num)
+
+
+def all_gather_tree(tree, axis_name: str = "clients", axis: int = 0, tiled: bool = False):
+    """Gather every shard's pytree along a new (or existing, if tiled) axis."""
+    return jax.tree.map(lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree)
+
+
+def ppermute_tree(tree, perm, axis_name: str = "clients"):
+    """Point-to-point ring/graph exchange: ``perm`` is [(src, dst), ...].
+
+    The TPU replacement for the decentralized framework's
+    send_result_to_neighbors (decentralized_worker_manager.py:41-46): a
+    topology edge list becomes a ppermute schedule riding ICI.
+    """
+    return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+
+def mix_with_topology(tree, mixing_row, axis_name: str = "clients"):
+    """Weighted neighbor mixing: out_i = sum_j W[i,j] * tree_j.
+
+    ``mixing_row`` is this device's row of the (row-normalized) mixing matrix W
+    produced by a TopologyManager (reference
+    fedml_core/distributed/topology/symmetric_topology_manager.py:21-52).
+    Implemented as all_gather + local contraction — on a small 'clients' axis
+    this is one ICI all-gather, and XLA fuses the contraction. For sparse
+    rings prefer ppermute_tree per edge.
+    """
+    def mix(x):
+        allx = lax.all_gather(x, axis_name, axis=0)  # [n, ...]
+        return jnp.tensordot(mixing_row, allx, axes=([0], [0]))
+
+    return jax.tree.map(mix, tree)

@@ -1,0 +1,10 @@
+from fedml_tpu.core.client_data import ClientBatch, FederatedData, pack_clients
+from fedml_tpu.core.partition import (
+    dirichlet_partition,
+    homo_partition,
+    partition_data,
+    record_data_stats,
+)
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.local import LocalSpec, make_local_update, make_eval_fn
+from fedml_tpu.core.robust import norm_diff_clipping, add_gaussian_noise

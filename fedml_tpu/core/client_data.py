@@ -1,0 +1,144 @@
+"""Fixed-shape packing of ragged per-client data (the XLA-friendly data plane).
+
+The reference hands each client a python DataLoader over its own index subset
+(train_data_local_dict, e.g. fedml_api/data_preprocessing/cifar10/data_loader.py:433+),
+so clients naturally have ragged sample counts. XLA wants static shapes, so a
+round's sampled clients are packed into one dense array block:
+
+  x    [K, B, bs, ...]   K clients, B batches each, bs samples per batch
+  y    [K, B, bs, ...]
+  mask [K, B, bs]        1.0 for real samples, 0.0 for padding
+
+Clients with fewer than B*bs samples are padded; the mask zeroes padded
+samples out of the loss, and a zero-gradient SGD step is a no-op, so a padded
+client takes exactly as many *effective* steps as its real batch count —
+matching the reference's "iterate your own dataloader" semantics for plain
+SGD. True sample counts ride along for exact sample-weighted aggregation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import numpy as np
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ClientBatch:
+    """One round's packed client data. Leaves are arrays with leading dim K."""
+
+    x: Any          # [K, B, bs, ...]
+    y: Any          # [K, B, bs, ...]
+    mask: Any       # [K, B, bs] float32
+    num_samples: Any  # [K] float32 — true (unpadded) counts
+
+    @property
+    def num_clients(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_batches(self) -> int:
+        return self.x.shape[1]
+
+    @property
+    def batch_size(self) -> int:
+        return self.x.shape[2]
+
+
+@dataclasses.dataclass
+class FederatedData:
+    """Host-side federated dataset: global arrays + the client index map.
+
+    Mirrors the reference 8-tuple loader contract
+    (train_data_num, test_data_num, train_data_global, test_data_global,
+    train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+    class_num — e.g. cifar10/data_loader.py:468) in one structure.
+    """
+
+    train_x: np.ndarray
+    train_y: np.ndarray
+    test_x: np.ndarray
+    test_y: np.ndarray
+    train_idx_map: dict[int, np.ndarray]   # client -> indices into train_*
+    test_idx_map: dict[int, np.ndarray] | None
+    class_num: int
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.train_idx_map)
+
+    @property
+    def train_data_local_num_dict(self) -> dict[int, int]:
+        return {c: len(ix) for c, ix in self.train_idx_map.items()}
+
+    def as_eight_tuple(self):
+        """The reference's 8-tuple, for API parity."""
+        return (
+            len(self.train_x),
+            len(self.test_x),
+            (self.train_x, self.train_y),
+            (self.test_x, self.test_y),
+            self.train_data_local_num_dict,
+            self.train_idx_map,
+            self.test_idx_map,
+            self.class_num,
+        )
+
+
+def pack_clients(
+    data: FederatedData,
+    client_ids: np.ndarray,
+    batch_size: int,
+    max_batches: int | None = None,
+    seed: int = 0,
+    round_idx: int = 0,
+) -> ClientBatch:
+    """Pack the sampled clients' train data into a dense ClientBatch.
+
+    Each client's indices are shuffled per-round (the DataLoader shuffle
+    analogue), then laid into [B, bs] with zero padding. B is the max batch
+    count among sampled clients unless ``max_batches`` caps it (the cap
+    matches reference behavior only when no client overflows it).
+    """
+    rng = np.random.RandomState(seed * 7_919 + round_idx)
+    counts = [len(data.train_idx_map[int(c)]) for c in client_ids]
+    b_needed = max(int(np.ceil(n / batch_size)) for n in counts)
+    B = b_needed if max_batches is None else min(max_batches, b_needed)
+    K = len(client_ids)
+    bs = batch_size
+
+    xshape = data.train_x.shape[1:]
+    yshape = data.train_y.shape[1:]
+    x = np.zeros((K, B, bs) + xshape, dtype=data.train_x.dtype)
+    y = np.zeros((K, B, bs) + yshape, dtype=data.train_y.dtype)
+    mask = np.zeros((K, B, bs), dtype=np.float32)
+    num = np.zeros((K,), dtype=np.float32)
+
+    for k, cid in enumerate(client_ids):
+        idx = np.array(data.train_idx_map[int(cid)])
+        rng.shuffle(idx)
+        idx = idx[: B * bs]
+        n = len(idx)
+        num[k] = n
+        flat_x = data.train_x[idx]
+        flat_y = data.train_y[idx]
+        x[k].reshape(B * bs, *xshape)[:n] = flat_x
+        y[k].reshape(B * bs, *yshape)[:n] = flat_y
+        mask[k].reshape(B * bs)[:n] = 1.0
+    return ClientBatch(x=x, y=y, mask=mask, num_samples=num)
+
+
+def batch_global(x: np.ndarray, y: np.ndarray, batch_size: int):
+    """Pad-and-batch a global dataset into [B, bs, ...] + mask, for eval."""
+    n = len(x)
+    B = int(np.ceil(n / batch_size))
+    xb = np.zeros((B, batch_size) + x.shape[1:], dtype=x.dtype)
+    yb = np.zeros((B, batch_size) + y.shape[1:], dtype=y.dtype)
+    mb = np.zeros((B, batch_size), dtype=np.float32)
+    xb.reshape(B * batch_size, *x.shape[1:])[:n] = x
+    yb.reshape(B * batch_size, *y.shape[1:])[:n] = y
+    mb.reshape(B * batch_size)[:n] = 1.0
+    return xb, yb, mb

@@ -1,0 +1,90 @@
+"""Non-IID data partitioning (numpy, host-side).
+
+Re-implementation of the reference partitioners:
+- latent-Dirichlet partition with a min-size retry loop
+  (fedml_core/non_iid_partition/noniid_partition.py:6-73 and the CIFAR variant
+  fedml_api/data_preprocessing/cifar10/data_loader.py:172-196)
+- uniform ("homo") partition (cifar10/data_loader.py:144-148)
+- per-client class histogram logging (noniid_partition.py:94-103)
+
+Partitioning is one-time host-side preprocessing; it stays numpy. The output
+client->index map is then packed into fixed-shape device arrays by
+fedml_tpu.core.client_data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def homo_partition(n_samples: int, n_clients: int, seed: int = 0) -> dict[int, np.ndarray]:
+    """Uniform IID split: shuffle then equal chunks."""
+    rng = np.random.RandomState(seed)
+    idxs = rng.permutation(n_samples)
+    return {i: np.sort(chunk) for i, chunk in enumerate(np.array_split(idxs, n_clients))}
+
+
+def dirichlet_partition(
+    labels: np.ndarray,
+    n_clients: int,
+    alpha: float,
+    seed: int = 0,
+    min_size_floor: int = 10,
+) -> dict[int, np.ndarray]:
+    """LDA partition: for each class, split its indices among clients by a
+    Dirichlet(alpha) draw, retrying until every client has >= min_size_floor
+    samples (the reference's `while min_size < 10` loop,
+    noniid_partition.py:24-49). Balance correction: a client already holding
+    more than n/n_clients samples gets probability 0 for the current class
+    (noniid_partition.py:39 / cifar10/data_loader.py:184).
+    """
+    rng = np.random.RandomState(seed)
+    labels = np.asarray(labels).ravel()
+    n = labels.shape[0]
+    classes = np.unique(labels)
+    min_size = 0
+    while min_size < min_size_floor:
+        idx_batch: list[list[int]] = [[] for _ in range(n_clients)]
+        for c in classes:
+            idx_c = np.where(labels == c)[0]
+            rng.shuffle(idx_c)
+            props = rng.dirichlet(np.repeat(alpha, n_clients))
+            props = np.array(
+                [p * (len(b) < n / n_clients) for p, b in zip(props, idx_batch)]
+            )
+            props = props / props.sum()
+            cuts = (np.cumsum(props) * len(idx_c)).astype(int)[:-1]
+            for i, part in enumerate(np.split(idx_c, cuts)):
+                idx_batch[i].extend(part.tolist())
+        min_size = min(len(b) for b in idx_batch)
+    out = {}
+    for i in range(n_clients):
+        rng.shuffle(idx_batch[i])
+        out[i] = np.asarray(idx_batch[i], dtype=np.int64)
+    return out
+
+
+def partition_data(
+    labels: np.ndarray,
+    n_clients: int,
+    method: str = "hetero",
+    alpha: float = 0.5,
+    seed: int = 0,
+) -> dict[int, np.ndarray]:
+    """Dispatch matching the reference's partition_data
+    (cifar10/data_loader.py:140-209): 'homo' | 'hetero' (LDA)."""
+    if method == "homo":
+        return homo_partition(len(labels), n_clients, seed)
+    if method in ("hetero", "noniid", "lda"):
+        return dirichlet_partition(labels, n_clients, alpha, seed)
+    raise ValueError(f"unknown partition method: {method}")
+
+
+def record_data_stats(labels: np.ndarray, net_dataidx_map: dict[int, np.ndarray]):
+    """Per-client class histograms (noniid_partition.py:94-103)."""
+    labels = np.asarray(labels).ravel()
+    stats = {}
+    for cid, idxs in net_dataidx_map.items():
+        vals, counts = np.unique(labels[idxs], return_counts=True)
+        stats[cid] = {int(v): int(c) for v, c in zip(vals, counts)}
+    return stats

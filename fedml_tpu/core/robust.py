@@ -1,0 +1,35 @@
+"""Robust aggregation defenses (L2).
+
+Port of fedml_core/robustness/robust_aggregation.py: norm-difference clipping
+(:38-49) and weak-DP Gaussian noise (:51-55), as pure pytree functions that
+run on device inside the aggregation program instead of host-side torch ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import tree_global_norm
+
+
+def norm_diff_clipping(local_net, global_net, norm_bound: float):
+    """Clip the client->server update (w_local - w_global) to an L2 ball of
+    radius norm_bound, then re-add the global weights
+    (robust_aggregation.py:38-49)."""
+    diff = jax.tree.map(jnp.subtract, local_net, global_net)
+    norm = tree_global_norm(diff)
+    scale = jnp.minimum(1.0, norm_bound / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g, d: g + d * scale, global_net, diff)
+
+
+def add_gaussian_noise(rng, net, stddev: float):
+    """Weak differential privacy: add N(0, stddev^2) to every weight
+    (robust_aggregation.py:51-55)."""
+    leaves, treedef = jax.tree.flatten(net)
+    keys = jax.random.split(rng, len(leaves))
+    noisy = [
+        x + stddev * jax.random.normal(k, x.shape, x.dtype)
+        for x, k in zip(leaves, keys)
+    ]
+    return jax.tree.unflatten(treedef, noisy)

@@ -1,0 +1,40 @@
+"""Per-round client sampling.
+
+Reference semantics (FedAVGAggregator.client_sampling,
+fedml_api/distributed/fedavg/FedAVGAggregator.py:89-97): seed numpy with the
+round index, then np.random.choice(num_clients, n, replace=False); full
+participation when client_num_per_round == client_num_in_total. We reproduce
+the same *semantics* (deterministic per-round subset, uniform without
+replacement) with numpy seeded by (seed, round) so host-side data packing can
+use it, and provide a jax.random variant for on-device sampling.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.random as jrandom
+
+
+def sample_clients(
+    round_idx: int,
+    client_num_in_total: int,
+    client_num_per_round: int,
+    seed: int = 0,
+) -> np.ndarray:
+    """Host-side deterministic sampler (numpy RandomState(seed + round))."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total, dtype=np.int64)
+    rng = np.random.RandomState(seed * 1_000_003 + round_idx)
+    return np.sort(
+        rng.choice(client_num_in_total, client_num_per_round, replace=False)
+    ).astype(np.int64)
+
+
+def sample_clients_device(key, round_idx, client_num_in_total: int, client_num_per_round: int):
+    """On-device sampler: fold the round index into the key and take a
+    without-replacement choice. Shapes are static; usable under jit."""
+    k = jrandom.fold_in(key, round_idx)
+    return jrandom.choice(
+        k, client_num_in_total, (client_num_per_round,), replace=False
+    )

@@ -1,0 +1,166 @@
+"""Standard Task builders for flax modules.
+
+The reference ships one ModelTrainer per task family:
+my_model_trainer_classification.py (cross-entropy),
+my_model_trainer_nwp.py (next-word prediction with pad masking),
+my_model_trainer_tag_prediction.py (multi-label BCE) under
+fedml_api/standalone/fedavg/. These builders are the equivalents: they wrap a
+flax.linen module (which must accept ``train: bool``) into the pure
+(init, loss, predict, eval_batch) bundle consumed by core.local.
+
+Conventions:
+- modules may carry 'dropout' rngs and mutable collections (batch_stats);
+  both are handled generically.
+- x: [bs, ...], y: [bs] int labels (classification) / [bs, seq] int tokens
+  (sequence) / [bs, C] multi-hot (tags). mask: [bs] sample-validity.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.core.local import NetState, Task
+
+
+def _split_variables(variables) -> NetState:
+    params = variables["params"]
+    extra = {k: v for k, v in variables.items() if k != "params"}
+    return NetState(params, extra)
+
+
+def _apply_train(module, params, extra, x, rng):
+    out = module.apply(
+        {"params": params, **extra},
+        x,
+        train=True,
+        mutable=list(extra.keys()),
+        rngs={"dropout": rng},
+    )
+    logits, mutated = out
+    new_extra = dict(extra)
+    new_extra.update(mutated)
+    return logits, new_extra
+
+
+def _apply_eval(module, params, extra, x):
+    return module.apply({"params": params, **extra}, x, train=False)
+
+
+def classification_task(module) -> Task:
+    """Softmax cross-entropy over integer labels."""
+
+    def init(rng, x_sample):
+        p_rng, d_rng = jax.random.split(rng)
+        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        return _split_variables(variables)
+
+    def loss(params, extra, x, y, mask, rng, train):
+        if train:
+            logits, new_extra = _apply_train(module, params, extra, x, rng)
+        else:
+            logits, new_extra = _apply_eval(module, params, extra, x), extra
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        l = jnp.sum(per_ex * mask) / n
+        correct = jnp.sum((jnp.argmax(logits, -1) == y) * mask)
+        metrics = {"loss_sum": jnp.sum(per_ex * mask), "correct": correct, "count": jnp.sum(mask)}
+        return l, new_extra, metrics
+
+    def predict(params, extra, x):
+        return _apply_eval(module, params, extra, x)
+
+    def eval_batch(params, extra, x, y, mask):
+        logits = _apply_eval(module, params, extra, x)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return {
+            "loss_sum": jnp.sum(per_ex * mask),
+            "correct": jnp.sum((jnp.argmax(logits, -1) == y) * mask),
+            "count": jnp.sum(mask),
+        }
+
+    return Task(init, loss, predict, eval_batch)
+
+
+def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False) -> Task:
+    """Next-token prediction: module maps tokens [bs, T] -> logits [bs, T, V];
+    labels are the inputs shifted by the module itself or provided as y
+    [bs, T]. Tokens equal to ``pad_id`` are masked out of loss and accuracy
+    (the reference masks PAD in nwp, my_model_trainer_nwp.py)."""
+
+    def init(rng, x_sample):
+        p_rng, d_rng = jax.random.split(rng)
+        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        return _split_variables(variables)
+
+    def _tok_mask(y, mask):
+        tm = (y != pad_id).astype(jnp.float32)
+        return tm * mask[:, None]
+
+    def loss(params, extra, x, y, mask, rng, train):
+        if train:
+            logits, new_extra = _apply_train(module, params, extra, x, rng)
+        else:
+            logits, new_extra = _apply_eval(module, params, extra, x), extra
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        tm = _tok_mask(y, mask)
+        n = jnp.maximum(jnp.sum(tm), 1.0)
+        l = jnp.sum(per_tok * tm) / n
+        correct = jnp.sum((jnp.argmax(logits, -1) == y) * tm)
+        metrics = {"loss_sum": jnp.sum(per_tok * tm), "correct": correct, "count": jnp.sum(tm)}
+        return l, new_extra, metrics
+
+    def predict(params, extra, x):
+        return _apply_eval(module, params, extra, x)
+
+    def eval_batch(params, extra, x, y, mask):
+        logits = _apply_eval(module, params, extra, x)
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        tm = _tok_mask(y, mask)
+        return {
+            "loss_sum": jnp.sum(per_tok * tm),
+            "correct": jnp.sum((jnp.argmax(logits, -1) == y) * tm),
+            "count": jnp.sum(tm),
+        }
+
+    return Task(init, loss, predict, eval_batch)
+
+
+def tag_prediction_task(module, threshold: float = 0.5) -> Task:
+    """Multi-label (tag) prediction with sigmoid BCE; y is multi-hot [bs, C].
+    Accuracy = micro-F1-style exact element accuracy over real samples."""
+
+    def init(rng, x_sample):
+        p_rng, d_rng = jax.random.split(rng)
+        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        return _split_variables(variables)
+
+    def _metrics(logits, y, mask):
+        per_ex = jnp.sum(optax.sigmoid_binary_cross_entropy(logits, y), axis=-1)
+        pred = (jax.nn.sigmoid(logits) > threshold).astype(y.dtype)
+        correct = jnp.sum(jnp.all(pred == y, axis=-1) * mask)
+        return per_ex, correct
+
+    def loss(params, extra, x, y, mask, rng, train):
+        if train:
+            logits, new_extra = _apply_train(module, params, extra, x, rng)
+        else:
+            logits, new_extra = _apply_eval(module, params, extra, x), extra
+        per_ex, correct = _metrics(logits, y, mask)
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        l = jnp.sum(per_ex * mask) / n
+        metrics = {"loss_sum": jnp.sum(per_ex * mask), "correct": correct, "count": jnp.sum(mask)}
+        return l, new_extra, metrics
+
+    def predict(params, extra, x):
+        return _apply_eval(module, params, extra, x)
+
+    def eval_batch(params, extra, x, y, mask):
+        logits = _apply_eval(module, params, extra, x)
+        per_ex, correct = _metrics(logits, y, mask)
+        return {"loss_sum": jnp.sum(per_ex * mask), "correct": correct, "count": jnp.sum(mask)}
+
+    return Task(init, loss, predict, eval_batch)

@@ -1,0 +1,16 @@
+"""Partitioned dataset loaders (L3b).
+
+Re-implements the reference data layer (fedml_api/data_preprocessing/*): every
+loader returns a ``FederatedData`` (global train/test arrays + client->index
+map + class_num), convertible to the reference's 8-tuple via
+``as_eight_tuple()`` (contract at cifar10/data_loader.py:468).
+
+Real dataset files are read when present under ``data_dir`` (LEAF json, TFF
+h5, CIFAR pickles); otherwise loaders fall back to a deterministic synthetic
+dataset with IDENTICAL shapes, vocab sizes, and client counts, so every
+algorithm, test, and benchmark runs in a zero-download environment. The
+fallback is flagged on the returned object (``synthetic_fallback=True``).
+"""
+
+from fedml_tpu.data.registry import load_dataset, DATASETS
+from fedml_tpu.core.client_data import FederatedData

@@ -1,0 +1,143 @@
+"""Dataset registry — the load_data dispatch.
+
+Mirror of the reference's load_data switch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:123-229) covering every
+dataset family in fedml_api/data_preprocessing/. Each entry knows its
+canonical client count, input shape, and class count; ``load_dataset`` tries
+the real files under ``data_dir`` first (see fedml_tpu/data/files.py) and
+falls back to deterministic shape-identical synthetic data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+from fedml_tpu.core.client_data import FederatedData
+from fedml_tpu.core.partition import partition_data
+from fedml_tpu.data import synthetic as syn
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    name: str
+    num_clients: int          # canonical client count in the reference
+    input_shape: tuple        # per-sample shape (images HWC; sequences (T,))
+    num_classes: int
+    task: str                 # 'classification' | 'sequence' | 'tags'
+    partition: str            # 'natural' | 'lda'
+    samples_per_client: int   # used by the synthetic fallback
+
+
+# canonical client counts: MNIST 1000 (benchmark/README.md:12), FEMNIST 3400
+# (:54), fed_cifar100 500 (:55), fed_shakespeare 715 (:56), stackoverflow
+# 342477 (:57); cross-silo datasets use --client_num_in_total (default 10).
+DATASETS: dict[str, DatasetSpec] = {
+    "mnist": DatasetSpec("mnist", 1000, (28, 28, 1), 10, "classification", "natural", 60),
+    "femnist": DatasetSpec("femnist", 3400, (28, 28, 1), 62, "classification", "natural", 110),
+    "fed_cifar100": DatasetSpec("fed_cifar100", 500, (32, 32, 3), 100, "classification", "natural", 100),
+    "shakespeare": DatasetSpec("shakespeare", 715, (80,), 90, "sequence", "natural", 50),
+    "fed_shakespeare": DatasetSpec("fed_shakespeare", 715, (80,), 90, "sequence", "natural", 50),
+    "stackoverflow_nwp": DatasetSpec("stackoverflow_nwp", 342477, (20,), 10004, "sequence", "natural", 30),
+    "stackoverflow_lr": DatasetSpec("stackoverflow_lr", 342477, (10004,), 500, "tags", "natural", 30),
+    "cifar10": DatasetSpec("cifar10", 10, (32, 32, 3), 10, "classification", "lda", 5000),
+    "cifar100": DatasetSpec("cifar100", 10, (32, 32, 3), 100, "classification", "lda", 5000),
+    "cinic10": DatasetSpec("cinic10", 10, (32, 32, 3), 10, "classification", "lda", 9000),
+    "svhn": DatasetSpec("svhn", 10, (32, 32, 3), 10, "classification", "lda", 7000),
+    "imagenet": DatasetSpec("imagenet", 100, (224, 224, 3), 1000, "classification", "natural", 100),
+    "gld23k": DatasetSpec("gld23k", 233, (224, 224, 3), 203, "classification", "natural", 100),
+    "gld160k": DatasetSpec("gld160k", 1262, (224, 224, 3), 2028, "classification", "natural", 130),
+    "synthetic": DatasetSpec("synthetic", 30, (60,), 10, "classification", "natural", 200),
+}
+
+
+def load_dataset(
+    name: str,
+    data_dir: str | None = None,
+    client_num: int | None = None,
+    partition_method: str | None = None,
+    partition_alpha: float = 0.5,
+    seed: int = 0,
+    samples_per_client: int | None = None,
+    test_samples: int | None = None,
+) -> FederatedData:
+    """Load (or synthesize) a federated dataset by reference name.
+
+    client_num overrides the canonical count (the cross-silo datasets take it
+    from --client_num_in_total in the reference; natural-partition datasets
+    ignore it there but we allow subsetting for simulation scale).
+    """
+    spec = DATASETS.get(name)
+    if spec is None:
+        raise ValueError(f"unknown dataset {name}; known: {sorted(DATASETS)}")
+    n_clients = client_num or spec.num_clients
+
+    if data_dir is not None and os.path.isdir(data_dir):
+        from fedml_tpu.data import files
+
+        fd = files.try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed)
+        if fd is not None:
+            return fd
+
+    if name == "synthetic":
+        return syn.synthetic_lr(num_clients=n_clients, seed=seed)
+
+    spc = samples_per_client or spec.samples_per_client
+    ts = test_samples or min(2000, spc * n_clients // 10 + 100)
+    if spec.task == "classification" and len(spec.input_shape) >= 2:
+        pm = partition_method or ("hetero" if spec.partition == "lda" else "natural")
+        return syn.synthetic_images(
+            num_clients=n_clients,
+            image_shape=spec.input_shape,
+            num_classes=spec.num_classes,
+            samples_per_client=spc,
+            test_samples=ts,
+            partition_method=pm,
+            partition_alpha=partition_alpha,
+            seed=seed,
+        )
+    if spec.task == "sequence":
+        return syn.synthetic_sequences(
+            num_clients=n_clients,
+            seq_len=spec.input_shape[0],
+            vocab_size=spec.num_classes,
+            samples_per_client=spc,
+            test_samples=ts,
+            seed=seed,
+        )
+    if spec.task == "tags":
+        # multi-hot bag-of-words in, multi-hot tags out
+        rng = np.random.RandomState(seed)
+        dim = spec.input_shape[0]
+        n = spc * n_clients
+        W = rng.normal(0, 1, (64, spec.num_classes))
+        emb = rng.normal(0, 1, (dim, 64))
+
+        def make(n):
+            x = (rng.rand(n, dim) < (8.0 / dim)).astype(np.float32)
+            logits = (x @ emb) @ W + rng.normal(0, 0.1, (n, spec.num_classes))
+            y = (logits > np.quantile(logits, 0.98, axis=1, keepdims=True)).astype(np.float32)
+            return x, y
+
+        x, y = make(n)
+        tx, ty = make(ts)
+        idx = {k: np.arange(k * spc, (k + 1) * spc) for k in range(n_clients)}
+        fd = FederatedData(x, y, tx, ty, idx, None, spec.num_classes)
+        fd.synthetic_fallback = True
+        return fd
+    # tabular classification (e.g. synthetic fallback for 1-D inputs)
+    pm = partition_method or "hetero"
+    rng = np.random.RandomState(seed)
+    dim = int(np.prod(spec.input_shape))
+    n = spc * n_clients
+    W = rng.normal(0, 1, (dim, spec.num_classes))
+    x = rng.normal(0, 1, (n, dim)).astype(np.float32)
+    y = np.argmax(x @ W + rng.normal(0, 0.5, (n, spec.num_classes)), 1).astype(np.int64)
+    tx = rng.normal(0, 1, (ts, dim)).astype(np.float32)
+    ty = np.argmax(tx @ W, 1).astype(np.int64)
+    idx = partition_data(y, n_clients, pm, partition_alpha, seed)
+    fd = FederatedData(x, y, tx, ty, idx, None, spec.num_classes)
+    fd.synthetic_fallback = True
+    return fd

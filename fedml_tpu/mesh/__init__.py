@@ -1,0 +1,7 @@
+from fedml_tpu.mesh.mesh import (
+    make_client_mesh,
+    make_hierarchical_mesh,
+    replicated,
+    client_sharded,
+    shard_leading_axis,
+)

@@ -1,0 +1,61 @@
+"""Device mesh + sharding helpers (L0).
+
+Replaces the reference's process/device placement layer: mpirun rank spawning
+plus the rank->GPU yaml map (fedml_api/distributed/utils/gpu_mapping.py:8-37).
+On TPU there is one process per host and an N-device mesh; "which client runs
+where" is a sharding annotation, not a process boundary.
+
+Axis conventions:
+  'clients'          — the FL client-parallel axis (the reference's one process
+                       per client, FedAvgAPI.py:20-28).
+  ('groups','clients') — hierarchical FL (standalone/hierarchical_fl/).
+  'data'             — within-client batch data parallelism (centralized mode's
+                       DistributedDataParallel, fedml_experiments/centralized/main.py:13).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_client_mesh(num_devices: int | None = None, axis_name: str = "clients") -> Mesh:
+    """1-D mesh over all (or the first ``num_devices``) local devices."""
+    devs = jax.devices()
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    return Mesh(np.asarray(devs), (axis_name,))
+
+
+def make_hierarchical_mesh(num_groups: int, clients_per_group: int) -> Mesh:
+    """2-D ('groups','clients') mesh for hierarchical FL.
+
+    On a multi-slice pod, the 'groups' axis should map to DCN (slower,
+    inter-slice) and 'clients' to ICI — group aggregation happens rarely
+    (every group_comm_round), client aggregation every round.
+    """
+    devs = jax.devices()
+    n = num_groups * clients_per_group
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    arr = np.asarray(devs[:n]).reshape(num_groups, clients_per_group)
+    return Mesh(arr, ("groups", "clients"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding for fully-replicated values (global model params)."""
+    return NamedSharding(mesh, P())
+
+
+def client_sharded(mesh: Mesh, axis_name: str = "clients") -> NamedSharding:
+    """Sharding that splits the leading axis across the client axis."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def shard_leading_axis(tree, mesh: Mesh, axis_name: str = "clients"):
+    """Device_put a host pytree with its leading axis split over ``axis_name``."""
+    sh = client_sharded(mesh, axis_name)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

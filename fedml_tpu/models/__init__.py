@@ -1,0 +1,12 @@
+"""Flax model zoo (L3a) — re-designs of fedml_api/model/* for TPU.
+
+All modules accept ``train: bool = False`` in __call__ and use channels-last
+NHWC layout (TPU-native; the torch reference is NCHW). The factory
+``create_model`` mirrors the reference's dispatch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:232-267).
+"""
+
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.factory import create_model

@@ -1,0 +1,52 @@
+"""FedAvg-paper CNNs (reference: fedml_api/model/cv/cnn.py:26-163).
+
+CNN_OriginalFedAvg: conv5x5(32) -> maxpool -> conv5x5(64) -> maxpool ->
+dense 512 -> softmax head; 1,663,370 params for femnist (62 classes).
+CNN_DropOut: the TFF/LEAF variant with 3x3 convs and dropout.
+
+Input layout is NHWC [bs, 28, 28, 1] (TPU-native; torch reference is NCHW).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """McMahan et al. CNN (cnn.py:26-97). only_digits=False -> 62 classes."""
+
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(10 if self.only_digits else 62)(x)
+
+
+class CNNDropOut(nn.Module):
+    """TFF-style dropout CNN (cnn.py:100-163)."""
+
+    only_digits: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.relu(nn.Conv(32, (3, 3))(x))
+        x = nn.relu(nn.Conv(64, (3, 3))(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else 62)(x)

@@ -1,0 +1,53 @@
+"""Model factory — mirror of the reference's create_model dispatch
+(fedml_experiments/distributed/fedavg/main_fedavg.py:232-267)."""
+
+from __future__ import annotations
+
+
+def create_model(model_name: str, output_dim: int = 10, **kwargs):
+    """Return a flax module for the given reference model name."""
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg, CNNDropOut
+    from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+
+    name = model_name.lower()
+    if name == "lr":
+        return LogisticRegression(num_classes=output_dim)
+    if name == "cnn":
+        return CNNOriginalFedAvg(only_digits=(output_dim == 10))
+    if name == "cnn_dropout":
+        return CNNDropOut(only_digits=(output_dim == 10))
+    if name == "rnn":
+        return RNNOriginalFedAvg(vocab_size=output_dim or 90)
+    if name == "rnn_stackoverflow":
+        return RNNStackOverflow()
+    if name in ("resnet56", "resnet110"):
+        from fedml_tpu.models.resnet import ResNetCIFAR
+
+        depth = 56 if name == "resnet56" else 110
+        return ResNetCIFAR(depth=depth, num_classes=output_dim)
+    if name == "resnet18_gn":
+        from fedml_tpu.models.resnet_gn import ResNet18GN
+
+        return ResNet18GN(num_classes=output_dim)
+    if name == "mobilenet":
+        from fedml_tpu.models.mobilenet import MobileNetV1
+
+        return MobileNetV1(num_classes=output_dim)
+    if name == "mobilenet_v3":
+        from fedml_tpu.models.mobilenet import MobileNetV3
+
+        return MobileNetV3(num_classes=output_dim, **kwargs)
+    if name == "efficientnet":
+        from fedml_tpu.models.efficientnet import EfficientNet
+
+        return EfficientNet(num_classes=output_dim, **kwargs)
+    if name == "vgg11":
+        from fedml_tpu.models.vgg import VGG
+
+        return VGG(depth=11, num_classes=output_dim)
+    if name == "vgg16":
+        from fedml_tpu.models.vgg import VGG
+
+        return VGG(depth=16, num_classes=output_dim)
+    raise ValueError(f"unknown model: {model_name}")

@@ -1,0 +1,21 @@
+"""Linear models (reference: fedml_api/model/linear/lr.py:4-11)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """Single dense layer over flattened input; logits out.
+
+    Reference lr.py applies sigmoid in forward; we return logits and fold the
+    nonlinearity into the loss (numerically better, same optimum).
+    """
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes)(x)

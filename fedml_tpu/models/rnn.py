@@ -1,0 +1,43 @@
+"""Character/word LSTMs (reference: fedml_api/model/nlp/rnn.py).
+
+RNN_OriginalFedAvg (rnn.py:4-36): embedding(vocab 90 -> 8), 2x LSTM(256),
+dense to vocab — Shakespeare next-char.
+RNN_StackOverFlow (rnn.py:39-70): embedding(10004 -> 96), 1x LSTM(670),
+dense 96 -> dense 10004 — next-word prediction.
+
+TPU notes: the torch versions run cuDNN LSTM on [bs, T]; here the recurrence
+is an nn.RNN (flax scan over an OptimizedLSTMCell), which XLA unrolls into
+fused matmuls on the MXU. Input: int tokens [bs, T]; output: logits
+[bs, T, vocab] predicting the NEXT token at each position.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10004  # 10000 words + pad/bos/eos/oov
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

@@ -1,0 +1,13 @@
+from fedml_tpu.utils import tree
+from fedml_tpu.utils.tree import (
+    tree_weighted_mean,
+    tree_stack,
+    tree_unstack,
+    tree_vectorize,
+    tree_unvectorize,
+    tree_zeros_like,
+    tree_global_norm,
+    tree_add,
+    tree_sub,
+    tree_scale,
+)

@@ -1,0 +1,28 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference tests multi-node behavior by spawning many OS processes on one
+box (SURVEY.md §4.5); the TPU-native analogue is many virtual XLA CPU devices
+in one process. Must run before any jax backend initialization.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return Mesh(np.asarray(devs[:8]), ("clients",))

@@ -1,0 +1,100 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.collectives import finite_field as ff
+from fedml_tpu.collectives.ops import (
+    all_gather_tree,
+    mix_with_topology,
+    ppermute_tree,
+    weighted_mean_tree,
+)
+
+
+def test_weighted_mean_tree_matches_host(mesh8):
+    x = np.arange(8.0 * 3).reshape(8, 3).astype(np.float32)
+    w = np.array([1, 2, 3, 4, 5, 6, 7, 8], np.float32)
+
+    f = jax.shard_map(
+        lambda xv, wv: weighted_mean_tree({"p": xv[0]}, wv[0], "clients"),
+        mesh=mesh8, in_specs=(P("clients"), P("clients")), out_specs=P(),
+    )
+    out = f(x, w)["p"]
+    expected = (w[:, None] * x).sum(0) / w.sum()
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_ppermute_ring(mesh8):
+    x = np.arange(8.0)
+    perm = [(i, (i + 1) % 8) for i in range(8)]
+    f = jax.shard_map(
+        lambda v: ppermute_tree(v, perm, "clients"),
+        mesh=mesh8, in_specs=P("clients"), out_specs=P("clients"),
+    )
+    out = f(x)
+    np.testing.assert_allclose(out, np.roll(x, 1))
+
+
+def test_mix_with_topology_matches_matmul(mesh8):
+    rng = np.random.RandomState(0)
+    W = rng.rand(8, 8).astype(np.float32)
+    W = W / W.sum(1, keepdims=True)  # row-normalized mixing
+    x = rng.rand(8, 4).astype(np.float32)
+
+    f = jax.shard_map(
+        lambda wrow, xv: mix_with_topology(xv[0], wrow[0], "clients")[None],
+        mesh=mesh8, in_specs=(P("clients"), P("clients")), out_specs=P("clients"),
+    )
+    out = f(W, x)
+    np.testing.assert_allclose(out, W @ x, rtol=1e-5)
+
+
+def test_all_gather_tree(mesh8):
+    x = np.arange(8.0)
+    f = jax.shard_map(
+        lambda v: all_gather_tree(v, "clients", axis=0, tiled=True),
+        mesh=mesh8, in_specs=P("clients"), out_specs=P("clients"),
+    )
+    out = f(x)  # each shard gathers all -> sharded result stacks to [8*8]/8
+    assert out.shape == (64,)
+
+
+def test_mod_inv():
+    p = ff.P_DEFAULT
+    for a in [2, 5, 123456, p - 2]:
+        inv = int(ff.mod_inv(jnp.asarray(a)))
+        assert (a * inv) % p == 1
+
+
+def test_field_roundtrip():
+    x = jnp.array([1.5, -2.25, 0.0, 100.125])
+    z = ff.field_encode(x)
+    back = ff.field_decode(z)
+    np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_shamir_encode_decode():
+    key = jax.random.PRNGKey(0)
+    secret = ff.field_encode(jnp.array([3.5, -1.25, 7.0]))
+    n, t = 5, 2
+    shares = ff.shamir_encode(secret, key, n, t)
+    alphas = jnp.arange(1, n + 1, dtype=jnp.int64)
+    rec = ff.shamir_decode(shares, alphas, t)
+    np.testing.assert_array_equal(np.asarray(rec), np.asarray(secret))
+
+
+def test_shamir_additive_homomorphism():
+    # sum of shares decodes to sum of secrets — the secure-aggregation property
+    key = jax.random.PRNGKey(1)
+    k1, k2 = jax.random.split(key)
+    s1 = ff.field_encode(jnp.array([1.0, 2.0]))
+    s2 = ff.field_encode(jnp.array([0.5, -1.0]))
+    n, t = 5, 2
+    sh1 = ff.shamir_encode(s1, k1, n, t)
+    sh2 = ff.shamir_encode(s2, k2, n, t)
+    # sum in int64 on host (outside an x64 scope jnp would truncate to int32)
+    summed = (np.asarray(sh1) + np.asarray(sh2)) % ff.P_DEFAULT
+    alphas = np.arange(1, n + 1, dtype=np.int64)
+    rec = ff.shamir_decode(summed, alphas, t)
+    np.testing.assert_allclose(ff.field_decode(rec), np.array([1.5, 1.0]), atol=1e-4)

@@ -1,0 +1,56 @@
+import numpy as np
+
+from fedml_tpu.core.partition import (
+    dirichlet_partition,
+    homo_partition,
+    partition_data,
+    record_data_stats,
+)
+
+
+def test_homo_partition_covers_all():
+    m = homo_partition(103, 7, seed=1)
+    allidx = np.sort(np.concatenate(list(m.values())))
+    np.testing.assert_array_equal(allidx, np.arange(103))
+    sizes = [len(v) for v in m.values()]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_dirichlet_partition_properties():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, 5000)
+    m = dirichlet_partition(labels, 8, alpha=0.5, seed=0)
+    allidx = np.sort(np.concatenate(list(m.values())))
+    np.testing.assert_array_equal(allidx, np.arange(5000))
+    assert all(len(v) >= 10 for v in m.values())  # min-size guarantee
+
+
+def test_dirichlet_skew_increases_with_small_alpha():
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, 20000)
+
+    def skew(alpha):
+        m = dirichlet_partition(labels, 10, alpha=alpha, seed=0)
+        stats = record_data_stats(labels, m)
+        # mean fraction of a client's data in its top class
+        tops = []
+        for cid, hist in stats.items():
+            total = sum(hist.values())
+            tops.append(max(hist.values()) / total)
+        return np.mean(tops)
+
+    assert skew(0.1) > skew(10.0)
+
+
+def test_partition_data_dispatch():
+    labels = np.random.RandomState(0).randint(0, 5, 500)
+    assert len(partition_data(labels, 4, "homo")) == 4
+    assert len(partition_data(labels, 4, "hetero", alpha=1.0)) == 4
+
+
+def test_deterministic():
+    labels = np.random.RandomState(0).randint(0, 10, 2000)
+    a = dirichlet_partition(labels, 5, 0.5, seed=3)
+    b = dirichlet_partition(labels, 5, 0.5, seed=3)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])

@@ -1,0 +1,54 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.utils.tree import (
+    tree_global_norm,
+    tree_stack,
+    tree_sub,
+    tree_unstack,
+    tree_unvectorize,
+    tree_vectorize,
+    tree_weighted_mean,
+)
+
+
+def _tree():
+    return {"a": jnp.arange(6.0).reshape(2, 3), "b": {"c": jnp.ones(4)}}
+
+
+def test_vectorize_roundtrip():
+    t = _tree()
+    v = tree_vectorize(t)
+    assert v.shape == (10,)
+    t2 = tree_unvectorize(v, t)
+    for x, y in zip(jax.tree.leaves(t), jax.tree.leaves(t2)):
+        np.testing.assert_allclose(x, y)
+
+
+def test_weighted_mean_matches_manual():
+    trees = [_tree() for _ in range(3)]
+    trees = [jax.tree.map(lambda x, i=i: x * (i + 1), t) for i, t in enumerate(trees)]
+    stacked = tree_stack(trees)
+    w = jnp.array([1.0, 2.0, 3.0])
+    out = tree_weighted_mean(stacked, w)
+    expected = (1 * 1 + 2 * 2 + 3 * 3) / 6.0  # multiplier on base leaves
+    np.testing.assert_allclose(out["b"]["c"], np.ones(4) * expected, rtol=1e-6)
+
+
+def test_stack_unstack():
+    trees = [_tree(), jax.tree.map(lambda x: x + 1, _tree())]
+    s = tree_stack(trees)
+    back = tree_unstack(s, 2)
+    np.testing.assert_allclose(back[1]["a"], trees[1]["a"])
+
+
+def test_global_norm():
+    t = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+    np.testing.assert_allclose(tree_global_norm(t), 5.0, rtol=1e-6)
+
+
+def test_sub():
+    t = _tree()
+    z = tree_sub(t, t)
+    assert float(tree_global_norm(z)) == 0.0
