@@ -1,0 +1,177 @@
+"""Decentralized (server-less) FL: gossip averaging, DSGD and PushSum.
+
+References:
+- fedml_api/distributed/decentralized_framework/ — gossip skeleton: each
+  worker trains then pushes its result to topology out-neighbors
+  (decentralized_worker_manager.py:29-46).
+- fedml_api/standalone/decentralized/ — online decentralized learning:
+  ClientDSGD (client_dsgd.py:6-101) and ClientPushsum (client_pushsum.py:7-129)
+  do per-iteration local gradient steps followed by topology-weighted neighbor
+  mixing (PushSum adds weight scalars for directed graphs).
+
+TPU re-design: one worker per mesh shard; params are NOT replicated — each
+shard carries its own pytree. A gossip step is: local SGD step(s), then
+mixing with `collectives.mix_with_topology` (all_gather + contraction over
+ICI) using each worker's row of the mixing matrix W from core.topology.
+PushSum carries (x_tilde, w_scalar) and mixes both, estimating params as
+x_tilde / w_scalar — exact for row-stochastic directed W.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.collectives.ops import mix_with_topology
+from fedml_tpu.core.local import NetState, Task
+from fedml_tpu.core.topology import SymmetricTopologyManager, AsymmetricTopologyManager
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+
+@dataclasses.dataclass(frozen=True)
+class DecentralizedConfig:
+    n_workers: int = 8
+    iterations: int = 100
+    lr: float = 0.1
+    batch_size: int = 16
+    neighbor_num: int = 2
+    method: str = "dsgd"  # 'dsgd' | 'pushsum' | 'local' (no mixing baseline)
+    seed: int = 0
+
+
+class DecentralizedFLAPI:
+    """Runs DSGD/PushSum over a 'workers' mesh axis (or vmapped on 1 device).
+
+    Data: each worker owns a stream [iterations, batch_size, ...] — the
+    online-learning setting of the reference (regret over a stream).
+    """
+
+    def __init__(self, task: Task, config: DecentralizedConfig,
+                 worker_x: np.ndarray, worker_y: np.ndarray,
+                 mesh: Mesh | None = None):
+        # worker_x: [n_workers, iterations, bs, ...]
+        self.task = task
+        self.cfg = config
+        self.mesh = mesh
+        n = config.n_workers
+        topo = (AsymmetricTopologyManager if config.method == "pushsum"
+                else SymmetricTopologyManager)(n, config.neighbor_num, config.seed)
+        self.W = topo.generate_topology().astype(np.float32)
+        self.topology_manager = topo
+
+        key = jax.random.PRNGKey(config.seed)
+        net0 = task.init(key, jnp.asarray(worker_x[0, 0]))
+        # every worker starts from the same init (reference does likewise)
+        self.params = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (n,) + v.shape), net0.params
+        )
+        self.extra = net0.extra
+        self.worker_x = jnp.asarray(worker_x)
+        self.worker_y = jnp.asarray(worker_y)
+        self._step = self._build()
+
+    def _build(self):
+        cfg = self.cfg
+        task = self.task
+        lr = cfg.lr
+        mix_mode = cfg.method
+
+        def grad_step(params, extra, x, y, key):
+            mask = jnp.ones(x.shape[0])
+            def loss_fn(p):
+                l, new_extra, metr = task.loss(p, extra, x, y, mask, key, True)
+                return l, metr
+            (l, metr), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            return jax.tree.map(lambda p_, g_: p_ - lr * g_, params, g), l
+
+        if self.mesh is not None:
+            axis = self.mesh.axis_names[0]
+
+            def shard_step(params, wrow, wscalar, x, y, key):
+                # shapes: leading dim 1 (this worker's slice); drop it
+                p = jax.tree.map(lambda v: v[0], params)
+                p, l = grad_step(p, self.extra, x[0], y[0], key)
+                if mix_mode == "dsgd":
+                    p = mix_with_topology(p, wrow[0], axis)
+                elif mix_mode == "pushsum":
+                    ws = mix_with_topology(wscalar[0], wrow[0], axis)
+                    p = mix_with_topology(
+                        jax.tree.map(lambda v: v * wscalar[0], p), wrow[0], axis
+                    )
+                    p = jax.tree.map(lambda v: v / jnp.maximum(ws, 1e-8), p)
+                    wscalar = ws[None]
+                return (jax.tree.map(lambda v: v[None], p), wscalar,
+                        jax.lax.psum(l, axis)[None] / self.cfg.n_workers)
+
+            smapped = jax.shard_map(
+                shard_step, mesh=self.mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P()),
+                out_specs=(P(axis), P(axis), P(axis)),
+            )
+
+            @jax.jit
+            def run(params, W, x_all, y_all, key):
+                wscalar = jnp.ones((self.cfg.n_workers,))
+                def body(carry, it):
+                    params, wscalar, key = carry
+                    key, sub = jax.random.split(key)
+                    params, wscalar, l = smapped(
+                        params, W, wscalar, x_all[:, it], y_all[:, it], sub
+                    )
+                    return (params, wscalar, key), l[0]
+                (params, _, _), losses = jax.lax.scan(
+                    body, (params, wscalar, key), jnp.arange(x_all.shape[1])
+                )
+                return params, losses
+
+            return run
+
+        # single-device: vmap workers, mix via matmul with W
+        def vstep(params, W, x, y, key):
+            keys = jax.random.split(key, self.cfg.n_workers)
+            new_p, losses = jax.vmap(
+                lambda p, xx, yy, k: grad_step(p, self.extra, xx, yy, k)
+            )(params, x, y, keys)
+            if mix_mode in ("dsgd", "pushsum"):
+                # x_i <- sum_j W[i,j] x_j  (PushSum with row-stochastic W and
+                # uniform start reduces to the same linear mixing here)
+                new_p = jax.tree.map(
+                    lambda v: jnp.tensordot(W, v, axes=([1], [0])), new_p
+                )
+            return new_p, jnp.mean(losses)
+
+        @jax.jit
+        def run(params, W, x_all, y_all, key):
+            def body(carry, it):
+                params, key = carry
+                key, sub = jax.random.split(key)
+                params, l = vstep(params, W, x_all[:, it], y_all[:, it], sub)
+                return (params, key), l
+            (params, _), losses = jax.lax.scan(
+                body, (params, key), jnp.arange(x_all.shape[1])
+            )
+            return params, losses
+
+        return run
+
+    def train(self):
+        key = jax.random.PRNGKey(self.cfg.seed + 1)
+        W = jnp.asarray(self.W)
+        params, losses = self._step(self.params, W, self.worker_x, self.worker_y, key)
+        self.params = params
+        return np.asarray(losses)
+
+    def consensus_distance(self) -> float:
+        """Mean squared distance of workers' params from their average — the
+        gossip convergence diagnostic."""
+        mean = jax.tree.map(lambda v: jnp.mean(v, 0, keepdims=True), self.params)
+        sq = jax.tree.map(lambda v, m: jnp.sum((v - m) ** 2), self.params, mean)
+        return float(sum(jax.tree.leaves(sq)) / self.cfg.n_workers)
+
+    def average_params(self):
+        return tree_weighted_mean(self.params, jnp.ones((self.cfg.n_workers,)))

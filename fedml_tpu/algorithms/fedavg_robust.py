@@ -1,0 +1,68 @@
+"""Robust FedAvg — norm clipping + weak-DP noise against poisoning/backdoors.
+
+Reference: fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py
+applies fedml_core/robustness/robust_aggregation.py defenses
+(--defense_type norm_diff_clipping|weak_dp, --norm_bound, --stddev flags
+consumed at robust_aggregation.py:33-36) before/after the weighted average,
+and evaluates backdoor targeted-task accuracy (:14-80).
+
+TPU form: clipping is the engine's client_result_hook (runs vmapped on
+device, per client, before the psum); noise is the post_aggregate_hook.
+Backdoor evaluation = eval_fn on a poisoned test set with target labels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.local import NetState
+from fedml_tpu.core.robust import add_gaussian_noise, norm_diff_clipping
+from fedml_tpu.core.client_data import batch_global
+
+
+class FedAvgRobustAPI(FedAvgAPI):
+    def __init__(
+        self,
+        dataset,
+        task,
+        config: FedAvgConfig,
+        mesh=None,
+        defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'none'
+        norm_bound: float = 30.0,
+        stddev: float = 0.025,
+        poisoned_test: tuple | None = None,  # (x, y_target) backdoor eval set
+        **kwargs,
+    ):
+        self.defense_type = defense_type
+        hooks = {}
+        if defense_type in ("norm_diff_clipping", "weak_dp"):
+            def clip_hook(net_k: NetState, net_global: NetState, rng):
+                return NetState(
+                    norm_diff_clipping(net_k.params, net_global.params, norm_bound),
+                    net_k.extra,
+                )
+            hooks["client_result_hook"] = clip_hook
+        if defense_type == "weak_dp":
+            def noise_hook(net: NetState, rng):
+                return NetState(add_gaussian_noise(rng, net.params, stddev), net.extra)
+            hooks["post_aggregate_hook"] = noise_hook
+
+        super().__init__(dataset, task, config, mesh=mesh, **hooks, **kwargs)
+        self._poisoned = None
+        if poisoned_test is not None:
+            px, py = poisoned_test
+            self._poisoned = tuple(
+                jnp.asarray(a) for a in batch_global(px, py, config.eval_batch_size)
+            )
+
+    def evaluate_backdoor(self):
+        """Targeted-task accuracy on the poisoned set: fraction of poisoned
+        inputs classified as the attacker's target label (the reference's
+        backdoor test loop, FedAvgRobustAggregator.py:14-80)."""
+        if self._poisoned is None:
+            raise ValueError("no poisoned_test set provided")
+        xb, yb, mb = self._poisoned
+        return self.eval_fn(self.net, xb, yb, mb)

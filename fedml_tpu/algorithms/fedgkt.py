@@ -1,0 +1,234 @@
+"""FedGKT — group knowledge transfer (split computing + bidirectional KD).
+
+Reference: fedml_api/distributed/fedgkt/ — each client trains a small model
+(feature extractor + lightweight classifier) with CE + KL toward the server's
+logits (GKTClientTrainer.train, GKTClientTrainer.py:49-60, KL at :39), then
+ships its extracted feature maps + logits + labels; the server trains a large
+model that consumes feature maps, with CE + KL toward each client's logits
+(GKTServerTrainer.train_large_model_on_the_server, GKTServerTrainer.py:233),
+and returns per-client server logits for the next round. Models:
+fedml_api/model/cv/resnet56_gkt/.
+
+TPU form: three jitted programs per round — (1) vmapped client phase (K small
+models train concurrently), (2) one batched feature-extraction forward, (3)
+server phase scanning over the pooled (features, client-logits, labels)
+tensors. The "exchange" is just device arrays flowing between programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.feddf import kl_divergence
+from fedml_tpu.core.client_data import FederatedData, pack_clients
+from fedml_tpu.core.sampling import sample_clients
+
+
+@dataclasses.dataclass(frozen=True)
+class FedGKTConfig:
+    comm_round: int = 5
+    client_num_in_total: int = 4
+    client_num_per_round: int = 4
+    epochs_client: int = 1
+    epochs_server: int = 1
+    batch_size: int = 16
+    lr_client: float = 0.01
+    lr_server: float = 0.01
+    temperature: float = 3.0
+    kd_alpha: float = 1.0  # weight of the KL term
+    max_batches: int | None = None
+    seed: int = 0
+
+
+class FedGKTAPI:
+    """extractor: x -> features; client_head: features -> logits;
+    server_model: features -> logits (the large trunk)."""
+
+    def __init__(self, dataset: FederatedData, extractor, client_head,
+                 server_model, config: FedGKTConfig, num_classes: int):
+        self.data = dataset
+        self.cfg = config
+        self.extractor, self.client_head, self.server_model = (
+            extractor, client_head, server_model)
+        self.num_classes = num_classes
+
+        key = jax.random.PRNGKey(config.seed)
+        ke, kh, ks = jax.random.split(key, 3)
+        x0 = jnp.asarray(dataset.train_x[: config.batch_size])
+        evars = extractor.init(ke, x0, train=False)
+        f0 = extractor.apply(evars, x0, train=False)
+        hvars = client_head.init(kh, f0, train=False)
+        svars = server_model.init(ks, f0, train=False)
+
+        K = config.client_num_per_round
+        # per-client small models, stacked for vmap
+        self.ext_params = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (K,) + v.shape), evars["params"])
+        self.head_params = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (K,) + v.shape), hvars["params"])
+        self.server_params = svars["params"]
+        self.ctx = optax.sgd(config.lr_client)
+        self.stx = optax.sgd(config.lr_server)
+        self.server_opt = self.stx.init(self.server_params)
+        self.rng = key
+        self._client_phase = jax.jit(self._build_client_phase())
+        self._server_phase = jax.jit(self._build_server_phase())
+        self.history: list[dict] = []
+
+    # ---------------------------------------------------------------- client
+    def _build_client_phase(self):
+        cfg = self.cfg
+        ext, head = self.extractor, self.client_head
+        tx = self.ctx
+        T, alpha = cfg.temperature, cfg.kd_alpha
+
+        def one_client(ep, hp, x, y, m, s_logits, use_kd):
+            # x: [B, bs, ...], s_logits: [B, bs, C] server logits from last round
+            opt = tx.init((ep, hp))
+
+            def batch_step(carry, batch):
+                (ep, hp), opt = carry
+                xb, yb, mb, sl = batch
+
+                def loss_fn(params):
+                    ep_, hp_ = params
+                    feats = ext.apply({"params": ep_}, xb, train=True)
+                    logits = head.apply({"params": hp_}, feats, train=True)
+                    n = jnp.maximum(jnp.sum(mb), 1.0)
+                    per = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+                    ce = jnp.sum(per * mb) / n
+                    t_probs = jax.nn.softmax(sl / T, axis=-1)
+                    kl = kl_divergence(logits, t_probs, T)
+                    return ce + alpha * use_kd * kl, (jnp.sum(per * mb),
+                                                      jnp.sum((jnp.argmax(logits, -1) == yb) * mb),
+                                                      jnp.sum(mb))
+
+                (l, aux), g = jax.value_and_grad(loss_fn, has_aux=True)((ep, hp))
+                upd, opt_n = tx.update(g, opt, (ep, hp))
+                newp = optax.apply_updates((ep, hp), upd)
+                has = jnp.sum(mb) > 0
+                keep = lambda a, b: jax.tree.map(
+                    lambda u, v: jax.lax.select(has, u, v), a, b)
+                return (keep(newp, (ep, hp)), keep(opt_n, opt)), jnp.stack(aux)
+
+            def epoch(carry, _):
+                return jax.lax.scan(batch_step, carry, (x, y, m, s_logits))
+
+            ((ep, hp), _), aux = jax.lax.scan(
+                epoch, ((ep, hp), opt), None, length=cfg.epochs_client)
+            # after training: extract features + logits to ship to the server
+            def fwd(xb):
+                feats = ext.apply({"params": ep}, xb, train=False)
+                logits = head.apply({"params": hp}, feats, train=False)
+                return feats, logits
+
+            feats, logits = jax.vmap(fwd)(x)
+            return ep, hp, feats, logits, aux.sum((0, 1))
+
+        def phase(ext_p, head_p, x, y, m, s_logits, use_kd):
+            return jax.vmap(one_client, in_axes=(0, 0, 0, 0, 0, 0, None))(
+                ext_p, head_p, x, y, m, s_logits, use_kd)
+
+        return phase
+
+    # ---------------------------------------------------------------- server
+    def _build_server_phase(self):
+        cfg = self.cfg
+        sm = self.server_model
+        tx = self.stx
+        T, alpha = cfg.temperature, cfg.kd_alpha
+
+        def phase(sp, sopt, feats, c_logits, y, m):
+            # feats: [K, B, bs, F...] -> flatten client/batch dims into steps
+            K, B = feats.shape[0], feats.shape[1]
+            fl = feats.reshape((K * B,) + feats.shape[2:])
+            cl = c_logits.reshape((K * B,) + c_logits.shape[2:])
+            yl = y.reshape((K * B,) + y.shape[2:])
+            ml = m.reshape((K * B,) + m.shape[2:])
+
+            def batch_step(carry, batch):
+                sp, sopt = carry
+                fb, cb, yb, mb = batch
+
+                def loss_fn(sp_):
+                    logits = sm.apply({"params": sp_}, fb, train=True)
+                    n = jnp.maximum(jnp.sum(mb), 1.0)
+                    per = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
+                    ce = jnp.sum(per * mb) / n
+                    kl = kl_divergence(logits, jax.nn.softmax(cb / T, -1), T)
+                    return ce + alpha * kl
+
+                l, g = jax.value_and_grad(loss_fn)(sp)
+                upd, sopt_n = tx.update(g, sopt, sp)
+                has = jnp.sum(mb) > 0
+                keep = lambda a, b: jax.tree.map(
+                    lambda u, v: jax.lax.select(has, u, v), a, b)
+                return (keep(optax.apply_updates(sp, upd), sp),
+                        keep(sopt_n, sopt)), l
+
+            def epoch(carry, _):
+                return jax.lax.scan(batch_step, carry, (fl, cl, yl, ml))
+
+            (sp, sopt), _ = jax.lax.scan(
+                epoch, (sp, sopt), None, length=cfg.epochs_server)
+            # fresh server logits per client sample for next round's KD
+            s_logits = sm.apply({"params": sp}, fl, train=False)
+            return sp, sopt, s_logits.reshape((K, B) + s_logits.shape[1:])
+
+        return phase
+
+    # ----------------------------------------------------------------- round
+    def run_round(self, round_idx: int):
+        cfg = self.cfg
+        ids = sample_clients(round_idx, cfg.client_num_in_total,
+                             cfg.client_num_per_round, cfg.seed)
+        cb = pack_clients(self.data, ids, cfg.batch_size,
+                          max_batches=cfg.max_batches, seed=cfg.seed,
+                          round_idx=round_idx)
+        x, y, m = jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)
+        K, B, bs = x.shape[0], x.shape[1], x.shape[2]
+        if not hasattr(self, "_s_logits") or self._s_logits.shape[:3] != (K, B, bs):
+            self._s_logits = jnp.zeros((K, B, bs, self.num_classes))
+            use_kd = 0.0  # first round: no server logits yet (reference warms up too)
+        else:
+            use_kd = 1.0
+
+        self.ext_params, self.head_params, feats, c_logits, aux = \
+            self._client_phase(self.ext_params, self.head_params, x, y, m,
+                               self._s_logits, use_kd)
+        self.server_params, self.server_opt, self._s_logits = \
+            self._server_phase(self.server_params, self.server_opt,
+                               feats, c_logits, y, m)
+        loss_sum, correct, count = (float(aux[:, i].sum()) for i in range(3))
+        rec = {"round": round_idx, "train_loss": loss_sum / max(count, 1),
+               "train_acc": correct / max(count, 1)}
+        self.history.append(rec)
+        return rec
+
+    def evaluate(self, batch_size: int = 256):
+        """Server-side eval: extractor(client 0) + server trunk on the global
+        test set (the reference evaluates the joint small+large pipeline)."""
+        from fedml_tpu.core.client_data import batch_global
+
+        xb, yb, mb = (jnp.asarray(a) for a in batch_global(
+            self.data.test_x, self.data.test_y, batch_size))
+        ext, sm = self.extractor, self.server_model
+        ep = jax.tree.map(lambda v: v[0], self.ext_params)
+
+        @jax.jit
+        def ev(ep, sp):
+            def body(acc, b):
+                x, y, m = b
+                feats = ext.apply({"params": ep}, x, train=False)
+                logits = sm.apply({"params": sp}, feats, train=False)
+                return (acc[0] + jnp.sum((jnp.argmax(logits, -1) == y) * m),
+                        acc[1] + jnp.sum(m)), None
+            (c, n), _ = jax.lax.scan(body, (0.0, 0.0), (xb, yb, mb))
+            return c / jnp.maximum(n, 1.0)
+
+        return float(ev(ep, self.server_params))

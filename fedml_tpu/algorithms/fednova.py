@@ -1,0 +1,86 @@
+"""FedNova — normalized averaging for heterogeneous local work.
+
+Reference: fedml_api/standalone/fednova/ — a custom torch Optimizer tracks
+per-client accumulated gradient direction and local step count tau
+(fednova.py:10-60+); the server aggregates *normalized* gradients scaled by
+effective tau (fednova_trainer.py:97: aggregate(params, norm_grads, tau_effs)).
+
+TPU form: each client returns its cumulative update d_k = (w_global - w_k)
+and its local step count tau_k (counted exactly as its number of REAL
+batches x epochs, from the mask). Then with p_k = n_k / n:
+    tau_eff = sum_k p_k * tau_k            (the 'effective' steps)
+    w_new   = w_global - tau_eff * sum_k p_k * d_k / tau_k
+which reproduces FedNova's normalized averaging (momentum-free case) without
+a stateful optimizer class — the normalization is pure arithmetic on the
+aggregated pytrees, fused into the round program.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.local import NetState
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+
+class FedNovaAPI(FedAvgAPI):
+    """FedNova via the FedAvg engine.
+
+    The engine aggregates a weighted mean of client NetStates; FedNova needs
+    the mean of d_k/tau_k instead. So the local update is wrapped to return
+    the pre-normalized state  w_global - d_k / tau_k  (tau_k derived exactly
+    from the batch mask), and the server update rescales the aggregated
+    direction by tau_eff.
+    """
+
+    def __init__(self, dataset, task, config: FedAvgConfig, mesh=None, **kwargs):
+        def server_update(old: NetState, avg: NetState, opt_state):
+            # avg was computed over normalized client states (see run_round):
+            # avg.params = sum_k p_k (w_global - d_k / tau_k)
+            #            = w_global - sum_k p_k d_k / tau_k
+            tau_eff = opt_state  # stashed per-round scalar
+            d = jax.tree.map(lambda g, a: (g - a) * tau_eff, old.params, avg.params)
+            new_params = jax.tree.map(lambda g, dd: g - dd, old.params, d)
+            return NetState(new_params, avg.extra), opt_state
+
+        super().__init__(dataset, task, config, mesh=mesh,
+                         server_update=server_update, **kwargs)
+        # wrap local_update so each client's output is pre-normalized by tau_k
+        base_local = self.local_update
+        cfg = config
+
+        def normalized_local(rng, global_net, x, y, mask):
+            net_k, metrics = base_local(rng, global_net, x, y, mask)
+            # tau_k = real steps taken = epochs * (#batches with any data)
+            real_batches = jnp.sum(jnp.any(mask > 0, axis=-1).astype(jnp.float32))
+            tau_k = jnp.maximum(cfg.epochs * real_batches, 1.0)
+            normed = jax.tree.map(
+                lambda g, wk: g - (g - wk) / tau_k, global_net.params, net_k.params
+            )
+            return NetState(normed, net_k.extra), dict(metrics, tau=tau_k)
+
+        self.local_update = normalized_local
+        self.round_fn = self._build_round_fn()
+
+    def run_round(self, round_idx: int):
+        # tau_eff = sum_k p_k tau_k needs this round's client sizes; compute
+        # host-side from the same pack (cheap, numpy) and stash it as the
+        # "server opt state" consumed by server_update.
+        cb = self._pack_round(round_idx)
+        import numpy as np
+
+        mask = np.asarray(jax.device_get(cb.mask))
+        nsamp = np.asarray(jax.device_get(cb.num_samples))
+        real_batches = (mask.sum(-1) > 0).sum(-1).astype(np.float32)
+        tau = np.maximum(self.cfg.epochs * real_batches, 1.0)
+        p = nsamp / max(nsamp.sum(), 1e-12)
+        tau_eff = float((p * tau).sum())
+        self.server_opt_state = jnp.asarray(tau_eff, jnp.float32)
+
+        self.rng, rk = jax.random.split(self.rng)
+        self.net, self.server_opt_state, metrics = self.round_fn(
+            rk, self.net, self.server_opt_state, cb
+        )
+        return metrics

@@ -1,0 +1,117 @@
+"""Hierarchical (two-tier) FL: groups of clients, nested aggregation.
+
+Reference: fedml_api/standalone/hierarchical_fl/ — Group.train runs
+group_comm_round local FedAvg rounds inside each group (group.py:24), the
+global trainer samples clients per group and averages group models every
+global round (trainer.py:32-43). The reference CI asserts that with
+global_rounds x group_rounds held constant the result matches flat FedAvg
+(CI-script-fedavg.sh:51-58) — reproduced in tests/test_hierarchical.py.
+
+TPU form: group state is a stacked pytree [G, ...]; one jitted sub-round
+program vmaps (groups) x vmaps (clients) the local update and does the
+group-level weighted mean; the global aggregation is a weighted mean over the
+group axis. On a ('groups','clients') mesh the same body shard_maps with the
+group psum riding DCN and the client psum riding ICI (mesh.make_hierarchical_mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.client_data import ClientBatch, pack_clients
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+
+class HierarchicalFLAPI(FedAvgAPI):
+    def __init__(
+        self,
+        dataset,
+        task,
+        config: FedAvgConfig,
+        group_num: int = 2,
+        group_comm_round: int = 1,
+        group_method: str = "random",  # client->group assignment
+        mesh=None,
+        **kwargs,
+    ):
+        super().__init__(dataset, task, config, mesh=None, **kwargs)
+        self.group_num = group_num
+        self.group_comm_round = group_comm_round
+        rng = np.random.RandomState(config.seed)
+        ids = np.arange(config.client_num_in_total)
+        if group_method == "random":
+            rng.shuffle(ids)
+        self.groups = np.array_split(ids, group_num)  # group -> client ids
+
+        # jitted: one group sub-round vmapped over groups
+        local_update = self.local_update
+
+        @jax.jit
+        def group_round(rng, group_nets, x, y, mask, nsamp):
+            # group_nets: stacked [G, ...]; x: [G, K, B, bs, ...]
+            G, K = x.shape[0], x.shape[1]
+            keys = jax.random.split(rng, G * K).reshape(G, K, -1)
+
+            def per_group(net_g, keys_g, xg, yg, mg, ng):
+                nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
+                    keys_g, net_g, xg, yg, mg
+                )
+                avg = tree_weighted_mean(nets, ng)
+                return avg, {k: jnp.sum(v) for k, v in metrics.items()}
+
+            return jax.vmap(per_group)(group_nets, keys, x, y, mask, nsamp)
+
+        self._group_round = group_round
+
+    def _pack_groups(self, round_idx: int, sub_round: int):
+        """Sample cfg.client_num_per_round/G clients per group and pack to
+        [G, K, B, bs, ...] (groups padded to a common K)."""
+        cfg = self.cfg
+        G = self.group_num
+        k_per = max(1, cfg.client_num_per_round // G)
+        packs = []
+        for g, members in enumerate(self.groups):
+            # per-group deterministic sampling (trainer.py:32-43 semantics)
+            local_round = round_idx * self.group_comm_round * 131 + sub_round * 31 + g
+            sel = sample_clients(local_round, len(members), min(k_per, len(members)), cfg.seed)
+            cb = pack_clients(self.data, members[sel], cfg.batch_size,
+                              max_batches=self.num_batches, seed=cfg.seed,
+                              round_idx=local_round)
+            packs.append(cb)
+        K = max(p.x.shape[0] for p in packs)
+        B = self.num_batches
+
+        def pad(cb: ClientBatch):
+            k, b = cb.x.shape[0], cb.x.shape[1]
+            pads = [(0, K - k), (0, B - b)]
+            x = np.pad(cb.x, pads + [(0, 0)] * (cb.x.ndim - 2))
+            y = np.pad(cb.y, pads + [(0, 0)] * (cb.y.ndim - 2))
+            m = np.pad(cb.mask, pads + [(0, 0)])
+            n = np.pad(cb.num_samples, (0, K - k))
+            return x, y, m, n
+
+        xs, ys, ms, ns = zip(*[pad(p) for p in packs])
+        return (np.stack(xs), np.stack(ys), np.stack(ms), np.stack(ns))
+
+    def run_round(self, round_idx: int):
+        # broadcast global net to all groups, run group_comm_round sub-rounds,
+        # then weighted-average groups by their processed sample counts
+        group_nets = jax.tree.map(
+            lambda v: jnp.broadcast_to(v[None], (self.group_num,) + v.shape), self.net
+        )
+        group_counts = jnp.zeros((self.group_num,))
+        metrics_acc = None
+        for s in range(self.group_comm_round):
+            x, y, m, n = self._pack_groups(round_idx, s)
+            self.rng, rk = jax.random.split(self.rng)
+            group_nets, metrics = self._group_round(rk, group_nets, x, y, m, n)
+            group_counts = group_counts + jnp.asarray(n.sum(axis=1))
+            metrics_acc = metrics if metrics_acc is None else {
+                k: metrics_acc[k] + v for k, v in metrics.items()
+            }
+        self.net = tree_weighted_mean(group_nets, group_counts)
+        return {k: jnp.sum(v) for k, v in metrics_acc.items()}

@@ -1,0 +1,156 @@
+"""SplitNN — split learning: model cut between client and server.
+
+Reference: fedml_api/distributed/split_nn/ — client holds the lower layers,
+server the upper; per batch the client sends activations + labels
+(client.py:25-31), the server computes loss and returns activation gradients
+(server.py:40-60), the client backprops and steps (client.py:33-35); clients
+take turns in a ring (SplitNNAPI.py). Control crosses the process boundary
+twice per batch — the latency-critical pattern (SURVEY.md §3.4).
+
+TPU re-design: the activation/gradient exchange is NOT a message — the
+composed function  loss = head(server_params, body(client_params_k, x))  is
+differentiated end-to-end by jax.grad, and XLA schedules the cut as a single
+fused program; on a two-stage mesh the same code pjits with the boundary
+riding ICI. Semantics preserved exactly: per-client lower weights, shared
+upper weights, updates per batch, clients in ring order (a lax.scan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.client_data import FederatedData, pack_clients
+from fedml_tpu.core.sampling import sample_clients
+
+
+@dataclasses.dataclass(frozen=True)
+class SplitNNConfig:
+    epochs: int = 1            # passes over the client ring
+    batch_size: int = 32
+    lr: float = 0.01
+    client_num: int = 4
+    max_batches: int | None = None
+    seed: int = 0
+
+
+class SplitNNAPI:
+    """client_module: x -> activations; server_module: activations -> logits."""
+
+    def __init__(self, dataset: FederatedData, client_module, server_module,
+                 config: SplitNNConfig):
+        self.data = dataset
+        self.cfg = config
+        self.client_module = client_module
+        self.server_module = server_module
+
+        key = jax.random.PRNGKey(config.seed)
+        k1, k2 = jax.random.split(key)
+        x0 = jnp.asarray(dataset.train_x[: config.batch_size])
+        cvars = client_module.init(k1, x0, train=False)
+        acts0 = client_module.apply(cvars, x0, train=False)
+        svars = server_module.init(k2, acts0, train=False)
+        # per-client lower params (each client owns its cut), shared upper
+        self.client_params = [cvars["params"] for _ in range(config.client_num)]
+        self.server_params = svars["params"]
+        self.ctx = optax.sgd(config.lr)
+        self.stx = optax.sgd(config.lr)
+        self.client_opt = [self.ctx.init(p) for p in self.client_params]
+        self.server_opt = self.stx.init(self.server_params)
+        self.rng = key
+        self._fit_client = jax.jit(self._build_fit())
+
+    def _build_fit(self):
+        cm, sm = self.client_module, self.server_module
+        ctx, stx = self.ctx, self.stx
+
+        def batch_step(carry, batch):
+            cp, sp, copt, sopt = carry
+            x, y, m = batch
+
+            def loss_fn(cp_, sp_):
+                acts = cm.apply({"params": cp_}, x, train=True)
+                logits = sm.apply({"params": sp_}, acts, train=True)
+                per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+                n = jnp.maximum(jnp.sum(m), 1.0)
+                l = jnp.sum(per * m) / n
+                correct = jnp.sum((jnp.argmax(logits, -1) == y) * m)
+                return l, (jnp.sum(per * m), correct, jnp.sum(m))
+
+            (l, aux), (gc, gs) = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                                    has_aux=True)(cp, sp)
+            has = jnp.sum(m) > 0
+            upd_c, copt_n = ctx.update(gc, copt, cp)
+            upd_s, sopt_n = stx.update(gs, sopt, sp)
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jax.lax.select(has, a, b), new, old)
+            cp = keep(optax.apply_updates(cp, upd_c), cp)
+            sp = keep(optax.apply_updates(sp, upd_s), sp)
+            copt = keep(copt_n, copt)
+            sopt = keep(sopt_n, sopt)
+            return (cp, sp, copt, sopt), jnp.stack(aux)
+
+        def fit_client(cp, sp, copt, sopt, x, y, mask):
+            (cp, sp, copt, sopt), aux = jax.lax.scan(
+                batch_step, (cp, sp, copt, sopt), (x, y, mask)
+            )
+            return cp, sp, copt, sopt, aux.sum(0)
+
+        return fit_client
+
+    def train(self, rounds: int = 1):
+        """Ring passes: client 0..K-1 each fit their shard against the shared
+        server model in turn (the reference's turn-taking ring)."""
+        cfg = self.cfg
+        history = []
+        for r in range(rounds):
+            ids = sample_clients(r, self.data.num_clients, cfg.client_num, cfg.seed)
+            cb = pack_clients(self.data, ids, cfg.batch_size,
+                              max_batches=cfg.max_batches, seed=cfg.seed, round_idx=r)
+            loss_sum = correct = count = 0.0
+            for e in range(cfg.epochs):
+                for k in range(cfg.client_num):
+                    cp, sp, copt, sopt, aux = self._fit_client(
+                        self.client_params[k], self.server_params,
+                        self.client_opt[k], self.server_opt,
+                        jnp.asarray(cb.x[k]), jnp.asarray(cb.y[k]),
+                        jnp.asarray(cb.mask[k]),
+                    )
+                    self.client_params[k] = cp
+                    self.server_params = sp
+                    self.client_opt[k] = copt
+                    self.server_opt = sopt
+                    loss_sum += float(aux[0]); correct += float(aux[1]); count += float(aux[2])
+            history.append({
+                "round": r,
+                "train_loss": loss_sum / max(count, 1.0),
+                "train_acc": correct / max(count, 1.0),
+            })
+        return history
+
+    def evaluate(self, client_idx: int = 0, batch_size: int = 256):
+        from fedml_tpu.core.client_data import batch_global
+
+        xb, yb, mb = (jnp.asarray(a) for a in batch_global(
+            self.data.test_x, self.data.test_y, batch_size))
+        cm, sm = self.client_module, self.server_module
+        cp, sp = self.client_params[client_idx], self.server_params
+
+        @jax.jit
+        def ev(cp, sp):
+            def body(acc, b):
+                x, y, m = b
+                logits = sm.apply({"params": sp},
+                                  cm.apply({"params": cp}, x, train=False),
+                                  train=False)
+                correct = jnp.sum((jnp.argmax(logits, -1) == y) * m)
+                return (acc[0] + correct, acc[1] + jnp.sum(m)), None
+            (c, n), _ = jax.lax.scan(body, (0.0, 0.0), (xb, yb, mb))
+            return c / jnp.maximum(n, 1.0)
+
+        return float(ev(cp, sp))

@@ -1,0 +1,100 @@
+"""TurboAggregate — secure aggregation via coded shares over GF(p).
+
+Reference: fedml_api/distributed/turboaggregate/ — Lagrange-coded MPC over a
+finite field (mpc_function.py: modular_inv :4-18, gen_Lagrange_coeffs :38-59,
+BGW_encoding :62-76) arranged in a decentralized ring; TA_Aggregator.aggregate
+(TA_Aggregator.py:56+) reconstructs the sum without seeing any single update.
+
+TPU form: clients quantize their updates into GF(2^31-1)
+(collectives.finite_field.field_encode), Shamir-encode into n shares; share j
+of every client is summed (this is where, on hardware, an int psum over ICI
+runs per share index — no party ever holds another's cleartext update);
+the aggregate is reconstructed from t+1 summed shares by Lagrange
+interpolation at 0 and dequantized. Additive homomorphism makes the result
+equal plain FedAvg up to quantization (tested: <1e-3 relative error).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.collectives import finite_field as ff
+from fedml_tpu.core.local import NetState
+from fedml_tpu.utils.tree import tree_unvectorize, tree_vectorize
+
+
+class TurboAggregateAPI(FedAvgAPI):
+    """FedAvg whose aggregation path goes through coded shares.
+
+    The engine's device-side weighted mean is replaced by a host-driven
+    secure-sum: each client's weighted params vector is field-encoded and
+    Shamir-shared; only summed shares are decoded.
+    """
+
+    def __init__(self, dataset, task, config: FedAvgConfig,
+                 n_shares: int = 5, threshold_t: int = 2,
+                 quant_scale: float = 2**16, **kwargs):
+        if config.client_num_per_round > 32:
+            raise ValueError("TurboAggregate secure path is for cross-silo scale")
+        self.n_shares = n_shares
+        self.threshold_t = threshold_t
+        self.quant_scale = quant_scale
+        super().__init__(dataset, task, config, **kwargs)
+        # rebuild round fn: we need the per-client nets, not the engine mean
+        self._local_batch = jax.jit(self._build_local_batch())
+
+    def _build_local_batch(self):
+        local_update = self.local_update
+
+        def run(rng, net, x, y, mask):
+            keys = jax.random.split(rng, x.shape[0])
+            nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
+                keys, net, x, y, mask
+            )
+            return nets, {k: jnp.sum(v) for k, v in metrics.items()}
+
+        return run
+
+    def run_round(self, round_idx: int):
+        cb = self._pack_round(round_idx)
+        self.rng, rk, sk = jax.random.split(self.rng, 3)
+        nets, metrics = self._local_batch(rk, self.net,
+                                          jnp.asarray(cb.x), jnp.asarray(cb.y),
+                                          jnp.asarray(cb.mask))
+        K = cb.x.shape[0]
+        nsamp = np.asarray(cb.num_samples, np.float64)
+        wts = nsamp / max(nsamp.sum(), 1e-12)
+
+        # --- secure aggregation of params ---
+        # each client: weighted vector -> field encode -> Shamir shares
+        template = self.net.params
+        summed_shares = None
+        for k in range(K):
+            pk = jax.tree.map(lambda v, i=k: v[i], nets.params)
+            vec = tree_vectorize(pk) * wts[k]
+            z = ff.field_encode(vec, self.quant_scale)
+            shares = ff.shamir_encode(z, jax.random.fold_in(sk, k),
+                                      self.n_shares, self.threshold_t)
+            sh = np.asarray(shares, np.int64)
+            summed_shares = sh if summed_shares is None else (
+                (summed_shares + sh) % ff.P_DEFAULT
+            )
+        alphas = np.arange(1, self.n_shares + 1, dtype=np.int64)
+        z_sum = ff.shamir_decode(jnp.asarray(summed_shares), jnp.asarray(alphas),
+                                 self.threshold_t)
+        vec_sum = np.asarray(ff.field_decode(z_sum, self.quant_scale), np.float32)
+        new_params = tree_unvectorize(jnp.asarray(vec_sum), template)
+
+        # extras (BN stats) take the plain weighted mean (not secret)
+        from fedml_tpu.utils.tree import tree_weighted_mean
+
+        new_extra = tree_weighted_mean(nets.extra, jnp.asarray(nsamp, jnp.float32))
+        avg = NetState(new_params, new_extra)
+        new_net, self.server_opt_state = self.server_update(
+            self.net, avg, self.server_opt_state
+        )
+        self.net = new_net
+        return metrics

@@ -1,0 +1,107 @@
+"""Topology managers for decentralized FL (L2).
+
+Re-design of fedml_core/distributed/topology/: ring-with-random-links
+topologies and row-normalized mixing matrices
+(symmetric_topology_manager.py:21-52, asymmetric_topology_manager.py) and the
+standalone variant (fedml_api/standalone/decentralized/topology_manager.py:5-142).
+The reference builds networkx graphs; here topologies are plain numpy mixing
+matrices W plus ppermute edge schedules, the two forms the TPU collectives
+consume (collectives.ops.mix_with_topology / ppermute_tree).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SymmetricTopologyManager:
+    """Undirected ring + random symmetric extra links, equal-weight rows.
+
+    ``neighbor_num`` counts ring neighbors per side like the reference's
+    Watts-Strogatz base (k nearest neighbors); ``undirected_neighbor_num``
+    adds random symmetric links.
+    """
+
+    def __init__(self, n: int, neighbor_num: int = 2, seed: int = 0):
+        self.n = n
+        self.neighbor_num = min(neighbor_num, max(n - 1, 0))
+        self.seed = seed
+        self.topology: np.ndarray | None = None
+
+    def generate_topology(self):
+        n, k = self.n, self.neighbor_num
+        rng = np.random.RandomState(self.seed)
+        A = np.eye(n, dtype=np.float64)
+        # ring lattice: connect each node to k nearest neighbors (both sides)
+        for i in range(n):
+            for d in range(1, k // 2 + 1):
+                A[i, (i + d) % n] = 1.0
+                A[i, (i - d) % n] = 1.0
+        # random symmetric rewiring/additions (WS-style randomness)
+        extra = rng.rand(n, n) < (k / max(n, 1)) * 0.5
+        extra = np.triu(extra, 1)
+        A = np.clip(A + extra + extra.T, 0, 1)
+        # row-normalize to a doubly-stochastic-ish mixing matrix
+        W = A / A.sum(axis=1, keepdims=True)
+        self.topology = W
+        return W
+
+    def get_in_neighbor_idx_list(self, node: int) -> list[int]:
+        W = self.topology
+        return [j for j in range(self.n) if W[node, j] > 0 and j != node]
+
+    def get_out_neighbor_idx_list(self, node: int) -> list[int]:
+        W = self.topology
+        return [j for j in range(self.n) if W[j, node] > 0 and j != node]
+
+    def get_in_neighbor_weights(self, node: int) -> np.ndarray:
+        return self.topology[node]
+
+    def get_out_neighbor_weights(self, node: int) -> np.ndarray:
+        return self.topology[:, node]
+
+
+class AsymmetricTopologyManager(SymmetricTopologyManager):
+    """Directed topology: ring base + random directed extra edges, so the
+    mixing matrix is row-stochastic but not symmetric (the PushSum setting)."""
+
+    def generate_topology(self):
+        n, k = self.n, self.neighbor_num
+        rng = np.random.RandomState(self.seed)
+        A = np.eye(n, dtype=np.float64)
+        for i in range(n):
+            for d in range(1, k // 2 + 1):
+                A[i, (i + d) % n] = 1.0
+        A = np.clip(A + (rng.rand(n, n) < (k / max(n, 1)) * 0.5), 0, 1)
+        W = A / A.sum(axis=1, keepdims=True)
+        self.topology = W
+        return W
+
+
+def ring_permutation(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    """ppermute schedule for a directed ring: device i -> i+shift (mod n)."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def topology_to_ppermutes(W: np.ndarray) -> list[list[tuple[int, int]]]:
+    """Decompose a sparse topology into a minimal set of ppermute schedules.
+
+    Each schedule is a partial permutation (each src/dst used at most once);
+    edges are greedily packed so dense rings need 1-2 schedules instead of
+    one all_gather. Self-loops are excluded (local term is added separately).
+    """
+    n = W.shape[0]
+    edges = [(i, j) for i in range(n) for j in range(n) if i != j and W[j, i] > 0]
+    # edge (src=i, dst=j) delivers i's value to j (W[j, i] weights arrivals at j)
+    schedules: list[list[tuple[int, int]]] = []
+    remaining = edges
+    while remaining:
+        used_src, used_dst, batch, rest = set(), set(), [], []
+        for (s, d) in remaining:
+            if s not in used_src and d not in used_dst:
+                batch.append((s, d)); used_src.add(s); used_dst.add(d)
+            else:
+                rest.append((s, d))
+        schedules.append(batch)
+        remaining = rest
+    return schedules

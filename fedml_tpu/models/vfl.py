@@ -1,0 +1,30 @@
+"""Vertical-FL party towers (reference: fedml_api/model/finance/
+vfl_models_standalone.py:1-72 — small dense feature extractors + a linear
+classifier whose outputs the guest sums)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+
+class DenseTower(nn.Module):
+    """Feature-slice -> per-class logit contribution."""
+
+    hidden: int = 32
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+class LinearTower(nn.Module):
+    """Logistic-regression party model (the reference's LR guest/host)."""
+
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.num_classes)(x.reshape((x.shape[0], -1)))

@@ -1,0 +1,204 @@
+"""Tests for FedOpt / FedProx / FedNova / robust / hierarchical / decentralized."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.fedopt import FedOptAPI
+from fedml_tpu.algorithms.fedprox import FedProxAPI
+from fedml_tpu.algorithms.fednova import FedNovaAPI
+from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
+from fedml_tpu.algorithms.decentralized import DecentralizedConfig, DecentralizedFLAPI
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.utils.tree import tree_global_norm, tree_sub
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synthetic_lr(num_clients=8, dim=20, num_classes=5, seed=0)
+
+
+@pytest.fixture(scope="module")
+def task():
+    return classification_task(LogisticRegression(num_classes=5))
+
+
+def _cfg(**kw):
+    base = dict(
+        comm_round=5, client_num_in_total=8, client_num_per_round=8,
+        epochs=1, batch_size=16, lr=0.05, seed=0, frequency_of_the_test=100,
+    )
+    base.update(kw)
+    return FedAvgConfig(**base)
+
+
+def test_fedopt_sgd_lr1_equals_fedavg(data, task):
+    """FedOpt with server SGD(lr=1, no momentum) is algebraically FedAvg:
+    w - 1*(w - avg) = avg."""
+    a = FedAvgAPI(data, task, _cfg())
+    b = FedOptAPI(data, task, _cfg(), server_optimizer="sgd", server_lr=1.0,
+                  server_momentum=0.0)
+    for r in range(3):
+        a.run_round(r)
+        b.run_round(r)
+    diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(diff) / float(tree_global_norm(a.net.params)) < 1e-5
+
+
+def test_fedopt_adam_learns(data, task):
+    api = FedOptAPI(data, task, _cfg(comm_round=25, epochs=2), server_optimizer="adam",
+                    server_lr=0.1)
+    api.train()
+    assert api.history[-1]["test_acc"] > 0.5
+
+
+def test_fedprox_mu0_equals_fedavg(data, task):
+    a = FedAvgAPI(data, task, _cfg())
+    b = FedProxAPI(data, task, _cfg(), mu=0.0)
+    for r in range(3):
+        a.run_round(r)
+        b.run_round(r)
+    diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(diff) < 1e-6
+
+
+def test_fedprox_mu_pulls_toward_global(data, task):
+    """Large mu must shrink the distance each client moves from the global
+    weights, hence the aggregated step size."""
+    a = FedAvgAPI(data, task, _cfg(epochs=5))
+    b = FedProxAPI(data, task, _cfg(epochs=5), mu=10.0)
+    w0a = a.net
+    a.run_round(0)
+    b.run_round(0)
+    da = tree_global_norm(tree_sub(a.net.params, w0a.params))
+    db = tree_global_norm(tree_sub(b.net.params, w0a.params))
+    assert float(db) < float(da)
+
+
+def test_fednova_uniform_tau_equals_fedavg(data, task):
+    """With equal client sizes and equal local steps, FedNova == FedAvg.
+    Use a homogeneous synthetic set so all tau_k are equal."""
+    from fedml_tpu.data.synthetic import synthetic_images
+
+    d = synthetic_images(num_clients=4, image_shape=(12,), num_classes=3,
+                         samples_per_client=32, test_samples=40, seed=1,
+                         size_lognormal=False)
+    t = classification_task(LogisticRegression(num_classes=3))
+    cfg = _cfg(client_num_in_total=4, client_num_per_round=4, batch_size=8)
+    a = FedAvgAPI(d, t, cfg)
+    b = FedNovaAPI(d, t, cfg)
+    for r in range(2):
+        a.run_round(r)
+        b.run_round(r)
+    diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(diff) / float(tree_global_norm(a.net.params)) < 1e-4
+
+
+def test_fednova_learns(data, task):
+    api = FedNovaAPI(data, task, _cfg(comm_round=10, epochs=2))
+    api.train()
+    assert api.history[-1]["test_acc"] > 0.5
+
+
+def test_robust_clipping_bounds_update(data, task):
+    """With a tiny norm bound the aggregated step must be <= bound."""
+    bound = 0.01
+    api = FedAvgRobustAPI(data, task, _cfg(lr=1.0, epochs=3),
+                          defense_type="norm_diff_clipping", norm_bound=bound)
+    w0 = api.net
+    api.run_round(0)
+    step = tree_global_norm(tree_sub(api.net.params, w0.params))
+    assert float(step) <= bound + 1e-5
+
+
+def test_robust_weak_dp_adds_noise(data, task):
+    a = FedAvgAPI(data, task, _cfg())
+    b = FedAvgRobustAPI(data, task, _cfg(), defense_type="weak_dp",
+                        norm_bound=1e9, stddev=0.05)
+    a.run_round(0)
+    b.run_round(0)
+    diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(diff) > 1e-3  # noise visible
+
+
+def test_hierarchical_one_group_equals_flat(data, task):
+    """1 group x 1 group_round == flat FedAvg (the reference CI assertion,
+    CI-script-fedavg.sh:51-58). Full batch (batch_size=-1 analogue) so the
+    per-round shuffle order can't distinguish the two loops."""
+    max_n = max(len(v) for v in data.train_idx_map.values())
+    cfg = _cfg(batch_size=max_n, epochs=1)
+    a = FedAvgAPI(data, task, cfg)
+    h = HierarchicalFLAPI(data, task, cfg, group_num=1, group_comm_round=1)
+    # align sampling: with full participation both take all 8 clients
+    for r in range(2):
+        a.run_round(r)
+        h.run_round(r)
+    diff = tree_global_norm(tree_sub(a.net.params, h.net.params))
+    assert float(diff) / float(tree_global_norm(a.net.params)) < 1e-4
+
+
+def test_hierarchical_learns(data, task):
+    h = HierarchicalFLAPI(data, task, _cfg(comm_round=6), group_num=2,
+                          group_comm_round=2)
+    h.train(6)
+    ev = h.evaluate()
+    assert float(ev["acc"]) > 0.4
+
+
+def _worker_stream(n_workers=8, iters=30, bs=8, dim=10, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    W = rng.normal(0, 1, (dim, classes))
+    x = rng.normal(0, 1, (n_workers, iters, bs, dim)).astype(np.float32)
+    y = np.argmax(x @ W, -1).astype(np.int32)
+    return x, y
+
+
+def test_dsgd_reaches_consensus_vmap():
+    x, y = _worker_stream()
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = DecentralizedConfig(n_workers=8, iterations=30, lr=0.1, method="dsgd")
+    api = DecentralizedFLAPI(task, cfg, x, y)
+    losses = api.train()
+    assert losses[-1] < losses[0]
+    assert api.consensus_distance() < 0.05
+
+
+def test_local_only_no_consensus():
+    x, y = _worker_stream(seed=1)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = DecentralizedConfig(n_workers=8, iterations=30, lr=0.1, method="local")
+    api = DecentralizedFLAPI(task, cfg, x, y)
+    api.train()
+    cons_local = api.consensus_distance()
+
+    cfg2 = DecentralizedConfig(n_workers=8, iterations=30, lr=0.1, method="dsgd")
+    api2 = DecentralizedFLAPI(task, cfg2, x, y)
+    api2.train()
+    assert api2.consensus_distance() < cons_local  # mixing tightens consensus
+
+
+def test_dsgd_shard_map_matches_vmap(mesh8):
+    x, y = _worker_stream(seed=2)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = DecentralizedConfig(n_workers=8, iterations=10, lr=0.1, method="dsgd")
+    a = DecentralizedFLAPI(task, cfg, x, y)
+    la = a.train()
+    b = DecentralizedFLAPI(task, cfg, x, y, mesh=mesh8)
+    lb = b.train()
+    np.testing.assert_allclose(la, lb, rtol=2e-3, atol=1e-4)
+    diff = tree_global_norm(tree_sub(a.params, b.params))
+    assert float(diff) / max(float(tree_global_norm(a.params)), 1e-9) < 1e-3
+
+
+def test_pushsum_directed_converges():
+    x, y = _worker_stream(seed=3)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = DecentralizedConfig(n_workers=8, iterations=30, lr=0.1, method="pushsum")
+    api = DecentralizedFLAPI(task, cfg, x, y)
+    losses = api.train()
+    assert losses[-1] < losses[0]

@@ -1,0 +1,111 @@
+"""SplitNN, vertical FL, and TurboAggregate secure aggregation tests."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.split_nn import SplitNNAPI, SplitNNConfig
+from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+from fedml_tpu.algorithms.vfl import VFLAPI, VFLConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images, synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.vfl import DenseTower, LinearTower
+from fedml_tpu.utils.tree import tree_global_norm, tree_sub
+
+
+class _Body(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.relu(nn.Dense(16)(x))
+
+
+class _Head(nn.Module):
+    classes: int = 5
+
+    @nn.compact
+    def __call__(self, acts, train: bool = False):
+        return nn.Dense(self.classes)(acts)
+
+
+def test_splitnn_learns():
+    data = synthetic_images(num_clients=4, image_shape=(10,), num_classes=5,
+                            samples_per_client=60, test_samples=200, seed=0)
+    cfg = SplitNNConfig(epochs=1, batch_size=16, lr=0.1, client_num=4)
+    api = SplitNNAPI(data, _Body(), _Head(classes=5), cfg)
+    acc0 = api.evaluate()
+    api.train(rounds=5)
+    acc1 = api.evaluate()
+    assert acc1 > acc0 + 0.1
+    assert acc1 > 0.5
+
+
+def test_splitnn_per_client_bodies_differ():
+    data = synthetic_images(num_clients=3, image_shape=(10,), num_classes=5,
+                            samples_per_client=40, test_samples=50, seed=1)
+    cfg = SplitNNConfig(epochs=1, batch_size=16, lr=0.1, client_num=3)
+    api = SplitNNAPI(data, _Body(), _Head(classes=5), cfg)
+    api.train(rounds=2)
+    d = tree_global_norm(tree_sub(api.client_params[0], api.client_params[1]))
+    assert float(d) > 1e-4  # each client keeps its own lower cut
+
+
+def _vfl_data(n=600, dg=6, dh=5, H=2, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    xg = rng.normal(0, 1, (n, dg)).astype(np.float32)
+    xh = rng.normal(0, 1, (H, n, dh)).astype(np.float32)
+    W = rng.normal(0, 1, (dg + H * dh, classes))
+    feats = np.concatenate([xg] + [xh[h] for h in range(H)], axis=1)
+    y = np.argmax(feats @ W, -1)
+    return xg, xh, y
+
+
+def test_vfl_learns_from_all_parties():
+    xg, xh, y = _vfl_data()
+    api = VFLAPI(DenseTower(num_classes=2), DenseTower(num_classes=2),
+                 xg, xh, y, VFLConfig(epochs=10, batch_size=64, guest_lr=0.1,
+                                      host_lr=0.1))
+    hist = api.train()
+    assert hist[-1]["acc"] > hist[0]["acc"]
+    assert hist[-1]["acc"] > 0.8
+
+
+def test_vfl_hosts_contribute():
+    """Guest-only (hosts zeroed by zero lr from zero-init?) — instead compare
+    full VFL vs guest-only LR on the guest slice: the feature-partitioned
+    model must beat the guest-only model on data whose signal spans parties."""
+    xg, xh, y = _vfl_data(seed=2)
+    full = VFLAPI(LinearTower(num_classes=2), LinearTower(num_classes=2),
+                  xg, xh, y, VFLConfig(epochs=15, batch_size=64, guest_lr=0.1,
+                                       host_lr=0.1))
+    full.train()
+    acc_full = full.evaluate(xg, xh, y)
+
+    guest_only = VFLAPI(LinearTower(num_classes=2), LinearTower(num_classes=2),
+                        xg, np.zeros_like(xh), y,
+                        VFLConfig(epochs=15, batch_size=64, guest_lr=0.1,
+                                  host_lr=0.1))
+    guest_only.train()
+    acc_guest = guest_only.evaluate(xg, np.zeros_like(xh), y)
+    assert acc_full > acc_guest + 0.05
+
+
+def test_turboaggregate_matches_fedavg():
+    """Secure-aggregated FedAvg must equal plain FedAvg up to quantization."""
+    data = synthetic_lr(num_clients=4, dim=12, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4, client_num_per_round=4,
+                       epochs=1, batch_size=16, lr=0.05, seed=0,
+                       frequency_of_the_test=100)
+    a = FedAvgAPI(data, task, cfg)
+    b = TurboAggregateAPI(data, task, cfg, n_shares=5, threshold_t=2)
+    for r in range(2):
+        a.run_round(r)
+        b.run_round(r)
+    diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    rel = float(diff) / float(tree_global_norm(a.net.params))
+    assert rel < 1e-3, f"secure aggregation drifted: rel={rel}"
