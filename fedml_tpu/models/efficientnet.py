@@ -1,0 +1,103 @@
+"""EfficientNet-B0..B7 (reference: fedml_api/model/cv/efficientnet.py +
+efficientnet_utils.py, 988 LoC).
+
+MBConv blocks with SE, swish activation, compound width/depth scaling.
+TPU: NHWC; stochastic depth as dropout on the residual branch.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (width_mult, depth_mult, resolution, dropout)
+_PARAMS = {
+    "b0": (1.0, 1.0, 224, 0.2), "b1": (1.0, 1.1, 240, 0.2),
+    "b2": (1.1, 1.2, 260, 0.3), "b3": (1.2, 1.4, 300, 0.3),
+    "b4": (1.4, 1.8, 380, 0.4), "b5": (1.6, 2.2, 456, 0.4),
+    "b6": (1.8, 2.6, 528, 0.5), "b7": (2.0, 3.1, 600, 0.5),
+}
+
+# base blocks: (expand, filters, repeats, kernel, stride)
+_BLOCKS = [
+    (1, 16, 1, 3, 1), (6, 24, 2, 3, 2), (6, 40, 2, 5, 2), (6, 80, 3, 3, 2),
+    (6, 112, 3, 5, 1), (6, 192, 4, 5, 2), (6, 320, 1, 3, 1),
+]
+
+
+def _round_filters(f, mult):
+    f *= mult
+    new = max(8, int(f + 4) // 8 * 8)
+    if new < 0.9 * f:
+        new += 8
+    return int(new)
+
+
+def _round_repeats(r, mult):
+    return int(math.ceil(r * mult))
+
+
+class _MBConv(nn.Module):
+    expand: int
+    filters: int
+    kernel: int
+    strides: int
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inp = x
+        c_in = x.shape[-1]
+        c_mid = c_in * self.expand
+        if self.expand != 1:
+            x = nn.Conv(c_mid, (1, 1), use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.swish(x)
+        x = nn.Conv(c_mid, (self.kernel, self.kernel),
+                    (self.strides, self.strides), padding="SAME",
+                    feature_group_count=c_mid, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        x = nn.swish(x)
+        # squeeze-excite at ratio 0.25 of input channels
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.swish(nn.Dense(max(1, c_in // 4))(s))
+        s = nn.sigmoid(nn.Dense(c_mid)(s))
+        x = x * s[:, None, None, :]
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        if self.strides == 1 and c_in == self.filters:
+            if self.drop_rate > 0:
+                x = nn.Dropout(self.drop_rate, deterministic=not train,
+                               broadcast_dims=(1, 2, 3))(x)
+            x = x + inp
+        return x
+
+
+class EfficientNet(nn.Module):
+    variant: str = "b0"
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        wm, dm, _res, drop = _PARAMS[self.variant]
+        y = nn.Conv(_round_filters(32, wm), (3, 3), (2, 2), padding="SAME",
+                    use_bias=False)(x)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(y)
+        y = nn.swish(y)
+        total = sum(_round_repeats(r, dm) for (_, _, r, _, _) in _BLOCKS)
+        bidx = 0
+        for expand, filters, repeats, kernel, stride in _BLOCKS:
+            f = _round_filters(filters, wm)
+            for i in range(_round_repeats(repeats, dm)):
+                s = stride if i == 0 else 1
+                y = _MBConv(expand, f, kernel, s,
+                            drop_rate=0.2 * bidx / total)(y, train)
+                bidx += 1
+        y = nn.Conv(_round_filters(1280, wm), (1, 1), use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9)(y)
+        y = nn.swish(y)
+        y = jnp.mean(y, axis=(1, 2))
+        y = nn.Dropout(drop, deterministic=not train)(y)
+        return nn.Dense(self.num_classes)(y)

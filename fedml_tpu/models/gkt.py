@@ -1,0 +1,58 @@
+"""FedGKT split ResNets (reference: fedml_api/model/cv/resnet56_gkt/ — the
+client runs a small ResNet-8 feature extractor + tiny classifier head; the
+server runs the large trunk (ResNet-55/49) that consumes the client's
+stage-1 feature maps)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import BasicBlock
+from functools import partial
+
+
+class GKTClientExtractor(nn.Module):
+    """Stem + one stage of basic blocks -> [H, W, 16] feature maps."""
+
+    blocks: int = 3  # ResNet-8: 3 blocks in one 16-channel stage
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.BatchNorm, momentum=0.9)
+        y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        y = norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        for _ in range(self.blocks):
+            y = BasicBlock(16, (1, 1), norm)(y, train)
+        return y
+
+
+class GKTClientHead(nn.Module):
+    """Tiny classifier on pooled client features (the client-side logits
+    shipped to the server for KD)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        y = jnp.mean(feats, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
+
+
+class GKTServerModel(nn.Module):
+    """Large trunk: stages 2-3 of a CIFAR ResNet consuming 16-ch features."""
+
+    blocks_per_stage: int = 9  # ResNet-56 geometry minus the client stage
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        norm = partial(nn.BatchNorm, momentum=0.9)
+        y = feats
+        for filters, stride in [(32, 2), (64, 2)]:
+            for i in range(self.blocks_per_stage):
+                s = (stride, stride) if i == 0 else (1, 1)
+                y = BasicBlock(filters, s, norm)(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)

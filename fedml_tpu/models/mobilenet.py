@@ -1,0 +1,125 @@
+"""MobileNet V1 and V3 (reference: fedml_api/model/cv/mobilenet.py and
+mobilenet_v3.py, 466 LoC — cross-silo CV models).
+
+TPU notes: depthwise convs use feature_group_count; NHWC; hard-swish /
+hard-sigmoid as in V3. Widths kept at the reference's defaults.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def _hard_swish(x):
+    return x * _hard_sigmoid(x)
+
+
+class _ConvBN(nn.Module):
+    filters: int
+    kernel: tuple = (3, 3)
+    strides: tuple = (1, 1)
+    groups: int = 1
+    act: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.filters, self.kernel, self.strides, padding="SAME",
+                    feature_group_count=self.groups, use_bias=False)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+        if self.act == "relu":
+            x = nn.relu(x)
+        elif self.act == "hswish":
+            x = _hard_swish(x)
+        return x
+
+
+class MobileNetV1(nn.Module):
+    """Depthwise-separable stack (mobilenet.py)."""
+
+    num_classes: int = 10
+    width: float = 1.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        w = lambda c: max(8, int(c * self.width))
+        x = _ConvBN(w(32), strides=(2, 2))(x, train)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+               (1024, 1)]
+        for filters, stride in cfg:
+            in_c = x.shape[-1]
+            x = _ConvBN(in_c, (3, 3), (stride, stride), groups=in_c)(x, train)  # depthwise
+            x = _ConvBN(w(filters), (1, 1))(x, train)  # pointwise
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class _SEBlock(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(8, c // self.reduce))(s))
+        s = _hard_sigmoid(nn.Dense(c)(s))
+        return x * s[:, None, None, :]
+
+
+class _V3Block(nn.Module):
+    expand: int
+    filters: int
+    kernel: int = 3
+    strides: int = 1
+    se: bool = False
+    act: str = "relu"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inp = x
+        x = _ConvBN(self.expand, (1, 1), act=self.act)(x, train)
+        x = _ConvBN(self.expand, (self.kernel, self.kernel),
+                    (self.strides, self.strides), groups=self.expand,
+                    act=self.act)(x, train)
+        if self.se:
+            x = _SEBlock()(x)
+        x = _ConvBN(self.filters, (1, 1), act="none")(x, train)
+        if self.strides == 1 and inp.shape[-1] == self.filters:
+            x = x + inp
+        return x
+
+
+class MobileNetV3(nn.Module):
+    """MobileNetV3-Small (mobilenet_v3.py 'small' mode)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ConvBN(16, strides=(2, 2), act="hswish")(x, train)
+        # (expand, out, kernel, stride, se, act)
+        cfg = [
+            (16, 16, 3, 2, True, "relu"),
+            (72, 24, 3, 2, False, "relu"),
+            (88, 24, 3, 1, False, "relu"),
+            (96, 40, 5, 2, True, "hswish"),
+            (240, 40, 5, 1, True, "hswish"),
+            (240, 40, 5, 1, True, "hswish"),
+            (120, 48, 5, 1, True, "hswish"),
+            (144, 48, 5, 1, True, "hswish"),
+            (288, 96, 5, 2, True, "hswish"),
+            (576, 96, 5, 1, True, "hswish"),
+            (576, 96, 5, 1, True, "hswish"),
+        ]
+        for e, f, k, s, se, act in cfg:
+            x = _V3Block(e, f, k, s, se, act)(x, train)
+        x = _ConvBN(576, (1, 1), act="hswish")(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = _hard_swish(nn.Dense(1024)(x))
+        x = nn.Dropout(0.2, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

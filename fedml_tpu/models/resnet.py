@@ -1,0 +1,101 @@
+"""CIFAR ResNets — resnet56/resnet110 (reference: fedml_api/model/cv/resnet.py:1-268).
+
+The reference uses the classic 3-stage basic-block CIFAR ResNet (He et al.)
+with BatchNorm. TPU notes: NHWC layout, bfloat16-friendly conv widths
+(16/32/64 channels), BatchNorm running stats live in the 'batch_stats'
+collection and are federated-averaged with the params (the reference
+averages the full state_dict including BN buffers, FedAVGAggregator.py:72-80).
+``norm='group'`` swaps in GroupNorm — BN-free variant for non-IID robustness
+(the reference ships resnet_wo_bn.py for the same reason).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    norm: Callable = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(x)
+        y = self.norm(use_running_average=not train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = self.norm(use_running_average=not train)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False)(residual)
+            residual = self.norm(use_running_average=not train)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNetCIFAR(nn.Module):
+    """depth = 6n+2 (56 -> n=9, 110 -> n=18); 3 stages of n basic blocks."""
+
+    depth: int = 56
+    num_classes: int = 10
+    norm_type: str = "batch"  # 'batch' | 'group'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        assert (self.depth - 2) % 6 == 0, "depth must be 6n+2"
+        n = (self.depth - 2) // 6
+        if self.norm_type == "batch":
+            norm = partial(nn.BatchNorm, momentum=0.9, epsilon=1e-5)
+        else:
+            norm = partial(_GN, num_groups=8)
+
+        y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        y = norm(use_running_average=not train)(y) if self.norm_type == "batch" \
+            else norm()(y)
+        y = nn.relu(y)
+        for stage, (filters, stride) in enumerate([(16, 1), (32, 2), (64, 2)]):
+            for i in range(n):
+                s = (stride, stride) if i == 0 else (1, 1)
+                if self.norm_type == "batch":
+                    y = BasicBlock(filters, s, norm)(y, train)
+                else:
+                    y = _GNBasicBlock(filters, s)(y, train)
+        y = jnp.mean(y, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes)(y)
+
+
+class _GN(nn.Module):
+    """GroupNorm shim accepting (and ignoring) use_running_average."""
+
+    num_groups: int = 8
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = True):
+        return nn.GroupNorm(num_groups=min(self.num_groups, x.shape[-1]))(x)
+
+
+class _GNBasicBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        gn = lambda c: nn.GroupNorm(num_groups=min(8, c))
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(x)
+        y = gn(self.filters)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = gn(self.filters)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False)(residual)
+            residual = gn(self.filters)(residual)
+        return nn.relu(y + residual)

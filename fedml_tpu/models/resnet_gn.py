@@ -1,0 +1,59 @@
+"""ResNet-18 with GroupNorm — the fed_cifar100 model.
+
+Reference: fedml_api/model/cv/resnet_gn.py:1-235 — ImageNet-style ResNet-18
+with GroupNorm replacing BatchNorm (per the Adaptive Federated Optimization
+paper: BN's running stats are ill-defined under client drift, GN is stateless).
+TPU: NHWC, no mutable collections at all (pure params pytree -> cheaper
+aggregation: no 'extra' to average).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _gn(c: int):
+    return nn.GroupNorm(num_groups=min(32, c))
+
+
+class GNBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(x)
+        y = _gn(self.filters)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = _gn(self.filters)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=False)(residual)
+            residual = _gn(self.filters)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet18GN(nn.Module):
+    num_classes: int = 100
+    # CIFAR-style stem (3x3, no maxpool) since fed_cifar100 is 24x24 crops
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.small_input:
+            y = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+        else:
+            y = nn.Conv(64, (7, 7), (2, 2), padding="SAME", use_bias=False)(x)
+        y = _gn(64)(y)
+        y = nn.relu(y)
+        if not self.small_input:
+            y = nn.max_pool(y, (3, 3), strides=(2, 2), padding="SAME")
+        for filters, stride in [(64, 1), (64, 1), (128, 2), (128, 1),
+                                (256, 2), (256, 1), (512, 2), (512, 1)]:
+            y = GNBlock(filters, (stride, stride))(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
