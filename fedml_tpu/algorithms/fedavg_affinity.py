@@ -1,0 +1,66 @@
+"""FedAvg-affinity — FedAvg + server-side affinity tracking (fork addition).
+
+Reference: fedml_api/standalone/fedavg_affinity/fedavg_api.py:12-130 — the
+fork's variant that records similarity metrics between client updates at the
+server each round (plus server-side testing, _test_on_server :130-153).
+
+TPU form: the pairwise affinity matrix of client updates is one device-side
+computation on the vmapped round results: normalize each client's flattened
+delta and take the Gram matrix (a single [K, D] x [D, K] matmul on the MXU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+
+class FedAvgAffinityAPI(FedAvgAPI):
+    def __init__(self, dataset, task, config: FedAvgConfig, **kwargs):
+        super().__init__(dataset, task, config, **kwargs)
+        self._local_batch = jax.jit(self._build_local_batch())
+        self._affinity = jax.jit(self._build_affinity())
+        self.affinity_history: list[np.ndarray] = []
+
+    def _build_local_batch(self):
+        local_update = self.local_update
+
+        def run(rng, net, x, y, mask):
+            keys = jax.random.split(rng, x.shape[0])
+            nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
+                keys, net, x, y, mask
+            )
+            return nets, {k: jnp.sum(v) for k, v in metrics.items()}
+
+        return run
+
+    def _build_affinity(self):
+        def affinity(client_params, global_params):
+            # deltas: [K, D] normalized; affinity = cosine Gram matrix
+            deltas = jax.vmap(
+                lambda p: jnp.concatenate([
+                    jnp.ravel(a - b) for a, b in zip(
+                        jax.tree.leaves(p), jax.tree.leaves(global_params))
+                ])
+            )(client_params)
+            norms = jnp.linalg.norm(deltas, axis=1, keepdims=True)
+            unit = deltas / jnp.maximum(norms, 1e-12)
+            return unit @ unit.T
+
+        return affinity
+
+    def run_round(self, round_idx: int):
+        cb = self._pack_round(round_idx)
+        self.rng, rk = jax.random.split(self.rng)
+        nets, metrics = self._local_batch(
+            rk, self.net, jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask))
+        aff = self._affinity(nets.params, self.net.params)
+        self.affinity_history.append(np.asarray(aff))
+        avg = tree_weighted_mean(nets, jnp.asarray(cb.num_samples))
+        self.net, self.server_opt_state = self.server_update(
+            self.net, avg, self.server_opt_state)
+        return metrics

