@@ -285,6 +285,17 @@ class FedAvgAPI:
                 log.info("round %d: %s", r, rec)
         return self.net
 
+    # ------------------------------------------------------------------ state
+    def load_state(self, net, server_opt_state, rng):
+        """Install restored state, re-placing it for the engine's mesh (a
+        checkpoint restored host-side lands on one device; the round program
+        expects replicated layout when a mesh is active)."""
+        if self.mesh is not None:
+            rep = NamedSharding(self.mesh, P())
+            put = lambda t: jax.tree.map(lambda v: jax.device_put(v, rep), t)
+            net, server_opt_state, rng = put(net), put(server_opt_state), put(rng)
+        self.net, self.server_opt_state, self.rng = net, server_opt_state, rng
+
     # ------------------------------------------------------------------ eval
     def evaluate(self):
         """Global test-set eval (the reference evaluates per client over all

@@ -1,0 +1,225 @@
+"""Unified experiment launcher (L5).
+
+Mirror of the reference CLI surface: the ~20 argparse flags of
+fedml_experiments/distributed/fedavg/main_fedavg.py:48-119 plus the
+multi-algorithm dispatch of fedml_experiments/distributed/fed_launch/main.py
+and algorithm-specific flags (--server_optimizer/--server_lr main_fedopt.py:
+54-60; --defense_type/--norm_bound/--stddev robust_aggregation.py:33-36).
+
+Where the reference wraps this in `mpirun -np N+1` + hostfiles + gpu_mapping
+yamls, here `--mesh N` creates an N-device 'clients' mesh; no process
+management exists to configure.
+
+Usage:
+    python -m fedml_tpu.experiments.cli --algo fedavg --dataset mnist \
+        --model lr --client_num_in_total 50 --client_num_per_round 10 \
+        --comm_round 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import time
+
+
+def add_args(parser: argparse.ArgumentParser):
+    # core flag surface (main_fedavg.py:48-119 parity)
+    parser.add_argument("--algo", type=str, default="fedavg",
+                        choices=["fedavg", "fedopt", "fedprox", "fednova",
+                                 "fedavg_robust", "hierarchical", "feddf",
+                                 "feddf_hard", "fedavg_affinity", "fednas",
+                                 "decentralized", "centralized", "turboaggregate"])
+    parser.add_argument("--model", type=str, default="lr")
+    parser.add_argument("--dataset", type=str, default="mnist")
+    parser.add_argument("--data_dir", type=str, default=None)
+    parser.add_argument("--partition_method", type=str, default=None,
+                        help="homo | hetero (LDA) | natural")
+    parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--client_num_in_total", type=int, default=None)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--client_optimizer", type=str, default="sgd")
+    parser.add_argument("--lr", type=float, default=0.03)
+    parser.add_argument("--wd", type=float, default=0.0)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--comm_round", type=int, default=10)
+    parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ci", type=int, default=0)
+    # TPU execution surface (replaces --backend/--gpu_mapping/--is_mobile)
+    parser.add_argument("--mesh", type=int, default=0,
+                        help="devices on the 'clients' mesh axis; 0 = single-device vmap")
+    parser.add_argument("--max_batches", type=int, default=None)
+    # algorithm-specific
+    parser.add_argument("--server_optimizer", type=str, default="sgd")
+    parser.add_argument("--server_lr", type=float, default=1.0)
+    parser.add_argument("--server_momentum", type=float, default=0.9)
+    parser.add_argument("--mu", type=float, default=0.1, help="FedProx mu")
+    parser.add_argument("--defense_type", type=str, default="norm_diff_clipping")
+    parser.add_argument("--norm_bound", type=float, default=30.0)
+    parser.add_argument("--stddev", type=float, default=0.025)
+    parser.add_argument("--group_num", type=int, default=2)
+    parser.add_argument("--group_comm_round", type=int, default=2)
+    parser.add_argument("--distill_steps", type=int, default=20)
+    parser.add_argument("--distill_lr", type=float, default=1e-3)
+    # checkpoint / logging
+    parser.add_argument("--ckpt_dir", type=str, default=None)
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--run_dir", type=str, default="./runs")
+    parser.add_argument("--run_name", type=str, default=None)
+    return parser
+
+
+def build_api(args):
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import (classification_task, sequence_task,
+                                      tag_prediction_task)
+    from fedml_tpu.data.registry import DATASETS, load_dataset
+    from fedml_tpu.models import create_model
+
+    spec = DATASETS[args.dataset]
+    data = load_dataset(
+        args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
+        partition_method=args.partition_method, partition_alpha=args.partition_alpha,
+        seed=args.seed,
+    )
+    n_total = data.num_clients
+    model = create_model(args.model, output_dim=spec.num_classes)
+    task = {"classification": classification_task,
+            "sequence": sequence_task,
+            "tags": tag_prediction_task}[spec.task](model)
+
+    cfg = FedAvgConfig(
+        comm_round=args.comm_round, client_num_in_total=n_total,
+        client_num_per_round=min(args.client_num_per_round, n_total),
+        epochs=args.epochs, batch_size=args.batch_size,
+        client_optimizer=args.client_optimizer, lr=args.lr, wd=args.wd,
+        frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
+        max_batches=args.max_batches, ci=bool(args.ci),
+    )
+    mesh = None
+    if args.mesh:
+        mesh = Mesh(np.asarray(jax.devices()[: args.mesh]), ("clients",))
+
+    algo = args.algo
+    if algo == "fedavg":
+        return FedAvgAPI(data, task, cfg, mesh=mesh), data
+    if algo == "fedopt":
+        from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+        return FedOptAPI(data, task, cfg, mesh=mesh,
+                         server_optimizer=args.server_optimizer,
+                         server_lr=args.server_lr,
+                         server_momentum=args.server_momentum), data
+    if algo == "fedprox":
+        from fedml_tpu.algorithms.fedprox import FedProxAPI
+
+        return FedProxAPI(data, task, cfg, mesh=mesh, mu=args.mu), data
+    if algo == "fednova":
+        from fedml_tpu.algorithms.fednova import FedNovaAPI
+
+        return FedNovaAPI(data, task, cfg, mesh=mesh), data
+    if algo == "fedavg_robust":
+        from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+
+        return FedAvgRobustAPI(data, task, cfg, mesh=mesh,
+                               defense_type=args.defense_type,
+                               norm_bound=args.norm_bound,
+                               stddev=args.stddev), data
+    if algo == "hierarchical":
+        from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
+
+        return HierarchicalFLAPI(data, task, cfg, group_num=args.group_num,
+                                 group_comm_round=args.group_comm_round), data
+    if algo in ("feddf", "feddf_hard"):
+        from fedml_tpu.algorithms.feddf import FedDFAPI
+
+        return FedDFAPI(data, task, cfg, mesh=mesh,
+                        distill_steps=args.distill_steps,
+                        distill_lr=args.distill_lr,
+                        hard_label=(algo == "feddf_hard")), data
+    if algo == "fedavg_affinity":
+        from fedml_tpu.algorithms.fedavg_affinity import FedAvgAffinityAPI
+
+        return FedAvgAffinityAPI(data, task, cfg), data
+    if algo == "turboaggregate":
+        from fedml_tpu.algorithms.turboaggregate import TurboAggregateAPI
+
+        return TurboAggregateAPI(data, task, cfg), data
+    if algo == "fednas":
+        from fedml_tpu.algorithms.fednas import FedNASAPI
+
+        return FedNASAPI(data, cfg, mesh=mesh), data
+    if algo == "centralized":
+        from fedml_tpu.centralized import CentralizedConfig, CentralizedTrainer
+
+        ccfg = CentralizedConfig(epochs=args.epochs * args.comm_round,
+                                 batch_size=args.batch_size, lr=args.lr,
+                                 wd=args.wd, seed=args.seed)
+        return CentralizedTrainer(task, data.train_x, data.train_y,
+                                  data.test_x, data.test_y, ccfg), data
+    raise ValueError(f"unhandled algo {algo}")
+
+
+def main(argv=None):
+    from fedml_tpu.utils.metrics import RunLogger, setup_logging
+
+    args = add_args(argparse.ArgumentParser("fedml_tpu")).parse_args(argv)
+    setup_logging(f"fedml-tpu-{args.algo}")
+    log = logging.getLogger("cli")
+    t0 = time.time()
+    api, data = build_api(args)
+    logger = RunLogger(args.run_dir, args.run_name,
+                       config=vars(args))
+    log.info("dataset=%s clients=%d algo=%s mesh=%d", args.dataset,
+             data.num_clients, args.algo, args.mesh)
+
+    if args.algo == "centralized":
+        api.train()
+        for rec in api.history:
+            logger.log(rec, step=rec.get("epoch"))
+    else:
+        start_round = 0
+        if args.resume and args.ckpt_dir:
+            from fedml_tpu.core.checkpoint import latest_round, restore_round
+
+            lr_ = latest_round(args.ckpt_dir)
+            if lr_ is not None:
+                tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
+                        "rng": api.rng, "round": 0}
+                st = restore_round(args.ckpt_dir, lr_, tmpl)
+                api.load_state(st["net"], st["server_opt_state"], st["rng"])
+                start_round = int(st["round"]) + 1
+                log.info("resumed from round %d", start_round - 1)
+        for r in range(start_round, args.comm_round):
+            metrics = api.run_round(r)
+            if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
+                ev = api.evaluate() if hasattr(api, "evaluate") else {}
+                n = float(max(float(metrics.get("count", 1)), 1))
+                rec = {"round": r,
+                       "train_loss": float(metrics.get("loss_sum", 0)) / n,
+                       "train_acc": float(metrics.get("correct", 0)) / n}
+                if ev:
+                    rec["test_acc"] = float(ev["acc"])
+                    rec["test_loss"] = float(ev["loss"])
+                logger.log(rec, step=r)
+                log.info("round %d: %s", r, rec)
+            if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):
+                from fedml_tpu.core.checkpoint import save_round
+
+                save_round(args.ckpt_dir, r, api.net, api.server_opt_state,
+                           api.rng)
+    logger.finish()
+    log.info("done in %.1fs; summary=%s", time.time() - t0,
+             json.dumps(logger.summary, default=float))
+
+
+if __name__ == "__main__":
+    main()

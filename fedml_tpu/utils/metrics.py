@@ -1,0 +1,88 @@
+"""Metrics sink + logging (L-aux).
+
+The reference's observability is wandb on rank 0 (main_fedavg.py:300-308,
+FedAVGAggregator.py:136-162 wandb.log of Train/Acc etc.) plus rank-prefixed
+python logging (fedml_api/utils/logger.py:8-33). In zero-egress TPU
+environments wandb is unavailable, so the sink is local-first: an append-only
+JSONL run log + in-memory summary (the wandb-summary.json analogue the
+reference's CI consumes, CI-script-fedavg.sh:42-46). If wandb IS importable
+and WANDB_MODE allows it, it mirrors transparently.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any
+
+
+def setup_logging(process_name: str = "fedml-tpu", level=logging.INFO,
+                  log_dir: str | None = None):
+    """Rank/process-prefixed format (logger.py:8-33 analogue)."""
+    fmt = (f"[{process_name}] %(asctime)s %(levelname)s "
+           "%(name)s:%(lineno)d %(message)s")
+    handlers = [logging.StreamHandler()]
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        handlers.append(logging.FileHandler(
+            os.path.join(log_dir, f"{process_name}.log")))
+    logging.basicConfig(level=level, format=fmt, handlers=handlers, force=True)
+
+
+class RunLogger:
+    """wandb-compatible facade writing JSONL locally (and to wandb if live)."""
+
+    def __init__(self, run_dir: str = "./runs", name: str | None = None,
+                 config: dict | None = None, use_wandb: bool = False):
+        self.name = name or time.strftime("run_%Y%m%d_%H%M%S")
+        self.dir = os.path.join(run_dir, self.name)
+        os.makedirs(self.dir, exist_ok=True)
+        self.summary: dict[str, Any] = {}
+        self._f = open(os.path.join(self.dir, "metrics.jsonl"), "a")
+        if config:
+            with open(os.path.join(self.dir, "config.json"), "w") as f:
+                json.dump(config, f, indent=2, default=str)
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project="fedml-tpu", name=self.name,
+                                         config=config or {})
+            except Exception:
+                self._wandb = None
+
+    def log(self, metrics: dict, step: int | None = None):
+        rec = dict(metrics)
+        if step is not None:
+            rec["_step"] = step
+        rec["_time"] = time.time()
+        self._f.write(json.dumps(rec, default=float) + "\n")
+        self._f.flush()
+        self.summary.update(metrics)
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
+
+    def finish(self):
+        """Write the summary file (wandb-summary.json analogue)."""
+        with open(os.path.join(self.dir, "summary.json"), "w") as f:
+            json.dump(self.summary, f, indent=2, default=float)
+        self._f.close()
+        if self._wandb is not None:
+            self._wandb.finish()
+
+
+def notify_sweep_done(path: str = "./tmp/fedml"):
+    """Completion signal for sweep orchestrators — the reference writes into a
+    named pipe (fedavg/utils.py:19-26); we write/touch a regular file if no
+    fifo exists at ``path``."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_NONBLOCK)
+        os.write(fd, b"done\n")
+        os.close(fd)
+    except OSError:
+        with open(path, "w") as f:
+            f.write("done\n")
