@@ -1,0 +1,87 @@
+"""Decoder-only transformer with pluggable sequence-parallel attention.
+
+Not in the reference (its NLP models are tiny LSTMs, model/nlp/rnn.py) — this
+is the long-context capability the TPU framework treats as first-class: with
+``seq_mesh`` set, self-attention runs as ring attention over the 'seq' axis
+(fedml_tpu.parallel.ring_attention) so sequence length scales with the mesh.
+Usable as an FL model through the standard sequence_task wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.parallel.ring_attention import full_attention, ring_attention
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    causal: bool = True
+    seq_axis: str | None = None  # set to run ring attention inside shard_map
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, C = x.shape
+        H, D = self.num_heads, self.head_dim
+        qkv = nn.Dense(3 * H * D, use_bias=False)(x)
+        q, k, v = jnp.split(qkv.reshape(B, T, 3, H, D), 3, axis=2)
+        q, k, v = (t.squeeze(2) for t in (q, k, v))
+        if self.seq_axis is not None:
+            o = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+        else:
+            o = full_attention(q, k, v, causal=self.causal)
+        return nn.Dense(C, use_bias=False)(o.reshape(B, T, H * D))
+
+
+class Block(nn.Module):
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    causal: bool = True
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm()(x)
+        x = x + SelfAttention(self.num_heads, self.head_dim, self.causal,
+                              self.seq_axis)(h, train)
+        h = nn.LayerNorm()(x)
+        C = x.shape[-1]
+        m = nn.Dense(self.mlp_ratio * C)(h)
+        m = nn.gelu(m)
+        x = x + nn.Dense(C)(m)
+        return x
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256
+    dim: int = 128
+    depth: int = 2
+    num_heads: int = 4
+    max_len: int = 2048
+    causal: bool = True
+    seq_axis: str | None = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab_size, self.dim)(tokens)
+        pos = self.param("pos_emb",
+                         nn.initializers.normal(0.02), (self.max_len, self.dim))
+        if self.seq_axis is not None:
+            # inside shard_map T is the LOCAL block; offset into the global
+            # position table by this shard's ring position
+            offset = jax.lax.axis_index(self.seq_axis) * T
+            x = x + jax.lax.dynamic_slice_in_dim(pos, offset, T)[None]
+        else:
+            x = x + pos[:T][None]
+        for _ in range(self.depth):
+            x = Block(self.num_heads, self.dim // self.num_heads,
+                      causal=self.causal, seq_axis=self.seq_axis)(x, train)
+        x = nn.LayerNorm()(x)
+        return nn.Dense(self.vocab_size)(x)

@@ -1,0 +1,23 @@
+"""Sequence/context parallelism (first-class TPU capability).
+
+The reference has NO long-context machinery (its longest sequence is 80
+chars, SURVEY.md §2.7) — this package is the TPU-native headroom the
+framework is designed around: a 'seq' mesh axis with
+
+- ring_attention: blockwise attention with K/V blocks rotating over the ICI
+  ring (lax.ppermute) and online-softmax accumulation — memory per device is
+  O(T/N), enabling sequences far beyond one chip's HBM.
+- ulysses_attention: all-to-all sequence<->head re-sharding so each device
+  computes full-sequence attention for a head subset (DeepSpeed-Ulysses
+  pattern) — cheaper at moderate T, needs heads % N == 0.
+
+Both are pure shard_map bodies usable inside any jitted train step, tested
+for exactness against single-device full attention on a CPU mesh.
+"""
+
+from fedml_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_sharded,
+    ulysses_attention_sharded,
+    full_attention,
+)

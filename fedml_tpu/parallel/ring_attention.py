@@ -1,0 +1,125 @@
+"""Ring attention + Ulysses sequence parallelism over a mesh axis.
+
+Ring attention (Liu et al.): Q stays put; K/V blocks rotate around the ring
+via lax.ppermute while each device accumulates its queries' attention with a
+numerically-stable online softmax (the flash-attention recurrence). After N
+steps every query has attended to every key with O(T/N) memory per device and
+all communication riding ICI, overlapped by XLA with the einsums.
+
+Layouts: block tensors are [B, T_blk, H, D]; scores are [B, H, Tq, Tk]
+(contractions land on the MXU).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def full_attention(q, k, v, causal: bool = False):
+    """Single-device reference: softmax(QK^T/sqrt(d))V. [B, T, H, D] in/out."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        T, S = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _online_block_update(q, k, v, o, l, m, q_offset, k_offset, causal, scale):
+    """One flash-attention style block accumulation step."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if causal:
+        Tq, Tk = scores.shape[-2], scores.shape[-1]
+        qpos = q_offset + jnp.arange(Tq)[:, None]
+        kpos = k_offset + jnp.arange(Tk)[None, :]
+        scores = jnp.where(kpos <= qpos, scores, -jnp.inf)
+    m_blk = jnp.max(scores, axis=-1)                      # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows: exp(-inf - -inf) -> 0
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(jnp.where(jnp.isfinite(scores), scores - safe_m[..., None], -jnp.inf))
+    p = jnp.where(jnp.isfinite(scores), p, 0.0)
+    corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return o_new, l_new, m_new
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Call INSIDE shard_map: q/k/v are this device's sequence block
+    [B, T_blk, H, D]; returns the attention output for the local queries."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    T_blk = q.shape[1]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    B, H = q.shape[0], q.shape[2]
+
+    # accumulators start replicated but the loop carry is device-varying
+    var = lambda x: lax.pcast(x, axis_name, to="varying")
+    o = var(jnp.zeros(q.shape, jnp.float32))
+    l = var(jnp.zeros((B, H, T_blk), jnp.float32))
+    m = var(jnp.full((B, H, T_blk), -jnp.inf, jnp.float32))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        o, l, m, k, v = carry
+        src = (idx - s) % n  # which device's block we currently hold
+        o, l, m = _online_block_update(
+            q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32),
+            o, l, m, idx * T_blk, src * T_blk, causal, scale)
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        return o, l, m, k, v
+
+    o, l, m, _, _ = lax.fori_loop(0, n, body, (o, l, m, k, v))
+    l_safe = jnp.maximum(l, 1e-20)
+    return (o / l_safe.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention_sharded(mesh: Mesh, axis_name: str = "seq",
+                           causal: bool = False):
+    """shard_map-wrapped ring attention: takes full [B, T, H, D] tensors
+    sharded (or shardable) on T; returns same layout."""
+    f = partial(ring_attention, axis_name=axis_name, causal=causal)
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+    ))
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+    """Call INSIDE shard_map. DeepSpeed-Ulysses: all_to_all swaps the sharded
+    axis from sequence to heads, each device computes FULL-sequence attention
+    for H/N heads, then swaps back. Requires H % axis_size == 0."""
+    n = lax.axis_size(axis_name)
+    # [B, T/N, H, D] -> all_to_all on H -> [B, T, H/N, D]
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    oh = full_attention(qh, kh, vh, causal=causal)
+    return gather_seq(oh)
+
+
+def ulysses_attention_sharded(mesh: Mesh, axis_name: str = "seq",
+                              causal: bool = False):
+    f = partial(ulysses_attention, axis_name=axis_name, causal=causal)
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+    ))

@@ -1,0 +1,55 @@
+"""Ring attention / Ulysses exactness vs full attention on the CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention_sharded,
+    ulysses_attention_sharded,
+)
+
+
+def _qkv(B=2, T=32, H=8, D=16, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (B, T, H, D)
+    return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(mesh8, causal):
+    q, k, v = _qkv()
+    ref = full_attention(q, k, v, causal=causal)
+    out = ring_attention_sharded(mesh8, "clients", causal=causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full(mesh8, causal):
+    q, k, v = _qkv(H=8)
+    ref = full_attention(q, k, v, causal=causal)
+    out = ulysses_attention_sharded(mesh8, "clients", causal=causal)(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_grads_flow(mesh8):
+    """Ring attention must be differentiable (training path)."""
+    q, k, v = _qkv(T=16, H=8, D=8)
+    att = ring_attention_sharded(mesh8, "clients", causal=True)
+
+    def loss(q, k, v):
+        return jnp.sum(att(q, k, v) ** 2)
+
+    g = jax.grad(loss)(q, k, v)
+    assert np.all(np.isfinite(np.asarray(g)))
+    # compare against full-attention grads
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=5e-3, atol=1e-4)

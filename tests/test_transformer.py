@@ -1,0 +1,61 @@
+"""TransformerLM: single-device vs seq-parallel (ring attention) parity, and
+FL training of a transformer through the standard engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.models.transformer import TransformerLM
+
+
+def test_transformer_forward():
+    m = TransformerLM(vocab_size=50, dim=32, depth=2, num_heads=4, max_len=64)
+    toks = jnp.zeros((2, 24), jnp.int32)
+    v = m.init(jax.random.PRNGKey(0), toks, train=False)
+    out = m.apply(v, toks, train=False)
+    assert out.shape == (2, 24, 50)
+
+
+def test_transformer_seq_parallel_matches(mesh8):
+    """Same params, same input: seq-sharded ring-attention forward must equal
+    the single-device forward."""
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 50)
+    ref_model = TransformerLM(vocab_size=50, dim=32, depth=2, num_heads=4,
+                              max_len=64, seq_axis=None)
+    v = ref_model.init(jax.random.PRNGKey(0), toks, train=False)
+    ref = ref_model.apply(v, toks, train=False)
+
+    sp_model = TransformerLM(vocab_size=50, dim=32, depth=2, num_heads=4,
+                             max_len=64, seq_axis="clients")
+
+    def fwd(params, toks):
+        # inside shard_map: toks [B, T/8]; pos ids handled by global T below
+        return sp_model.apply({"params": params}, toks, train=False)
+
+    f = jax.jit(jax.shard_map(
+        fwd, mesh=mesh8,
+        in_specs=(P(), P(None, "clients")),
+        out_specs=P(None, "clients"),
+    ))
+    out = f(v["params"], toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_transformer_federates():
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import sequence_task
+    from fedml_tpu.data.synthetic import synthetic_sequences
+
+    data = synthetic_sequences(num_clients=4, seq_len=16, vocab_size=40,
+                               samples_per_client=24, test_samples=40, seed=0)
+    task = sequence_task(TransformerLM(vocab_size=40, dim=32, depth=1,
+                                       num_heads=4, max_len=32))
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=4,
+                       client_num_per_round=4, epochs=1, batch_size=8,
+                       lr=0.01, client_optimizer="adam",
+                       frequency_of_the_test=3)
+    api = FedAvgAPI(data, task, cfg)
+    api.train()
+    assert api.history[-1]["train_loss"] < api.history[0]["train_loss"]
