@@ -1,0 +1,86 @@
+"""Data augmentation (reference: fedml_api/data_preprocessing/augmentation.py,
+233 LoC — RandAugment-style policies applied in the torch dataloaders).
+
+TPU re-design: augmentations are pure jax functions applied ON DEVICE inside
+the jitted train step (vmapped over the batch), so the host data plane stays
+a zero-copy array feed. The op set covers the reference's geometric +
+photometric policies; magnitudes follow RandAugment conventions.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def random_crop(key, img, padding: int = 4):
+    """Pad-and-random-crop (the CIFAR standard)."""
+    H, W = img.shape[0], img.shape[1]
+    padded = jnp.pad(img, ((padding, padding), (padding, padding), (0, 0)),
+                     mode="reflect")
+    kx, ky = jax.random.split(key)
+    x0 = jax.random.randint(kx, (), 0, 2 * padding + 1)
+    y0 = jax.random.randint(ky, (), 0, 2 * padding + 1)
+    return jax.lax.dynamic_slice(padded, (x0, y0, 0), (H, W, img.shape[2]))
+
+
+def random_flip(key, img):
+    return jax.lax.cond(jax.random.bernoulli(key),
+                        lambda x: x[:, ::-1, :], lambda x: x, img)
+
+
+def brightness(key, img, max_delta: float = 0.2):
+    return img + jax.random.uniform(key, (), minval=-max_delta, maxval=max_delta)
+
+
+def contrast(key, img, max_factor: float = 0.3):
+    f = 1.0 + jax.random.uniform(key, (), minval=-max_factor, maxval=max_factor)
+    mean = jnp.mean(img, axis=(0, 1), keepdims=True)
+    return (img - mean) * f + mean
+
+
+def cutout(key, img, size: int = 8):
+    """Zero a random square (the reference's Cutout policy)."""
+    H, W = img.shape[0], img.shape[1]
+    kx, ky = jax.random.split(key)
+    cx = jax.random.randint(kx, (), 0, H)
+    cy = jax.random.randint(ky, (), 0, W)
+    yy, xx = jnp.mgrid[0:H, 0:W]
+    mask = ((jnp.abs(yy - cx) > size // 2) | (jnp.abs(xx - cy) > size // 2))
+    return img * mask[..., None]
+
+
+def standard_cifar_augment(key, img):
+    """crop + flip — the baseline train-time policy."""
+    k1, k2 = jax.random.split(key)
+    return random_flip(k2, random_crop(k1, img))
+
+
+def rand_augment(key, img, num_ops: int = 2):
+    """Pick ``num_ops`` random photometric/geometric ops per image. Uses
+    lax.switch so the op choice is data-dependent but trace-static."""
+    ops = [
+        lambda k, x: random_crop(k, x),
+        lambda k, x: random_flip(k, x),
+        lambda k, x: brightness(k, x),
+        lambda k, x: contrast(k, x),
+        lambda k, x: cutout(k, x),
+    ]
+
+    def apply_one(i, carry):
+        key, img = carry
+        key, kop, kchoice = jax.random.split(key, 3)
+        idx = jax.random.randint(kchoice, (), 0, len(ops))
+        img = jax.lax.switch(idx, [partial(f, kop) for f in ops], img)
+        return key, img
+
+    _, img = jax.lax.fori_loop(0, num_ops, apply_one, (key, img))
+    return img
+
+
+def batch_augment(key, batch, fn=standard_cifar_augment):
+    """vmap an augmentation over [bs, H, W, C]."""
+    keys = jax.random.split(key, batch.shape[0])
+    return jax.vmap(fn)(keys, batch)

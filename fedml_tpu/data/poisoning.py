@@ -1,0 +1,120 @@
+"""Backdoor / poisoning attack data utilities.
+
+Reference: fedml_api/data_preprocessing/edge_case_examples/data_loader.py
+(load_poisoned_dataset :283, 1,294 LoC) — injects attacker-controlled
+"edge case" samples (ARDIS digits into MNIST clients, southwest-airline
+planes into CIFAR clients, green cars) labeled with the attacker's target
+class, so the aggregate model misclassifies that semantic slice while clean
+accuracy stays high. Consumed by fedavg_robust for attack/defense evaluation.
+
+Without the proprietary edge-case archives, the same attack structure is
+reproduced synthetically: (1) pixel-pattern (BadNets) triggers, (2) semantic
+edge-case clusters drawn from a distribution shifted off the clean manifold,
+(3) label flipping. Each returns (x_poison, y_target) pairs to blend into
+attacker-controlled clients plus a poisoned eval set for targeted-accuracy
+measurement (FedAvgRobustAPI.evaluate_backdoor).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fedml_tpu.core.client_data import FederatedData
+
+
+def add_pixel_trigger(x: np.ndarray, size: int = 3, value: float = 2.5):
+    """BadNets-style bottom-right square trigger."""
+    x = np.array(x, copy=True)
+    x[..., -size:, -size:, :] = value
+    return x
+
+
+def make_backdoor_dataset(
+    data: FederatedData,
+    target_label: int,
+    poison_client_ids: list[int],
+    poison_frac: float = 0.5,
+    trigger_size: int = 3,
+    seed: int = 0,
+):
+    """Inject triggered+relabeled samples into the given clients' partitions.
+
+    Returns (poisoned FederatedData, eval set (x_triggered, y_target)) — the
+    eval pair measures targeted-task accuracy like the reference's backdoor
+    test loop (FedAvgRobustAggregator.test :14-80).
+    """
+    rng = np.random.RandomState(seed)
+    x = np.array(data.train_x, copy=True)
+    y = np.array(data.train_y, copy=True)
+    for cid in poison_client_ids:
+        idx = data.train_idx_map[cid]
+        n_poison = max(1, int(len(idx) * poison_frac))
+        sel = rng.choice(idx, n_poison, replace=False)
+        x[sel] = add_pixel_trigger(x[sel], trigger_size)
+        y[sel] = target_label
+
+    poisoned = FederatedData(
+        train_x=x, train_y=y, test_x=data.test_x, test_y=data.test_y,
+        train_idx_map=data.train_idx_map, test_idx_map=data.test_idx_map,
+        class_num=data.class_num,
+    )
+    # eval: clean test inputs NOT already of the target class, with trigger
+    keep = np.where(np.asarray(data.test_y) != target_label)[0]
+    ex = add_pixel_trigger(np.asarray(data.test_x)[keep], trigger_size)
+    ey = np.full(len(keep), target_label, dtype=np.int64)
+    return poisoned, (ex, ey)
+
+
+def make_edge_case_dataset(
+    data: FederatedData,
+    target_label: int,
+    poison_client_ids: list[int],
+    num_edge_samples: int = 50,
+    shift: float = 3.0,
+    seed: int = 0,
+):
+    """Semantic edge-case attack: a tight off-manifold cluster labeled with
+    the target class, appended to attacker clients (the ARDIS/southwest
+    pattern — samples that are RARE in clean data, so defenses relying on
+    majority statistics miss them)."""
+    rng = np.random.RandomState(seed)
+    shape = data.train_x.shape[1:]
+    center = rng.normal(0, 1, shape).astype(np.float32)
+    center = center / max(np.linalg.norm(center), 1e-6) * shift
+    edge_x = (center[None] + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape)
+              ).astype(np.float32)
+    edge_y = np.full(num_edge_samples, target_label, dtype=np.int64)
+
+    x = np.concatenate([data.train_x, edge_x])
+    y = np.concatenate([data.train_y, edge_y])
+    idx_map = {k: np.array(v, copy=True) for k, v in data.train_idx_map.items()}
+    edge_ids = np.arange(len(data.train_x), len(x))
+    split = np.array_split(edge_ids, len(poison_client_ids))
+    for cid, extra in zip(poison_client_ids, split):
+        idx_map[cid] = np.concatenate([idx_map[cid], extra])
+
+    poisoned = FederatedData(
+        train_x=x, train_y=y, test_x=data.test_x, test_y=data.test_y,
+        train_idx_map=idx_map, test_idx_map=data.test_idx_map,
+        class_num=data.class_num,
+    )
+    # eval: fresh draws from the same edge distribution
+    ex = (center[None] + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape)
+          ).astype(np.float32)
+    ey = np.full(num_edge_samples, target_label, dtype=np.int64)
+    return poisoned, (ex, ey)
+
+
+def flip_labels(data: FederatedData, client_ids: list[int], from_label: int,
+                to_label: int):
+    """Label-flip attack on the given clients."""
+    y = np.array(data.train_y, copy=True)
+    for cid in client_ids:
+        idx = data.train_idx_map[cid]
+        sel = idx[np.asarray(data.train_y)[idx] == from_label]
+        y[sel] = to_label
+    return FederatedData(
+        train_x=data.train_x, train_y=y, test_x=data.test_x,
+        test_y=data.test_y, train_idx_map=data.train_idx_map,
+        test_idx_map=data.test_idx_map, class_num=data.class_num,
+    )

@@ -1,0 +1,94 @@
+"""Augmentation ops and backdoor/poisoning attack+defense flow."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.augmentation import (batch_augment, cutout, rand_augment,
+                                         random_crop, random_flip,
+                                         standard_cifar_augment)
+from fedml_tpu.data.poisoning import (add_pixel_trigger, flip_labels,
+                                      make_backdoor_dataset,
+                                      make_edge_case_dataset)
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.models.linear import LogisticRegression
+
+
+def test_augment_shapes_and_jit():
+    img = jnp.asarray(np.random.RandomState(0).rand(16, 16, 3), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    for fn in (random_crop, random_flip, cutout,
+               standard_cifar_augment, rand_augment):
+        out = jax.jit(fn)(key, img)
+        assert out.shape == img.shape
+
+
+def test_batch_augment_varies_per_sample():
+    batch = jnp.ones((8, 16, 16, 3))
+    out = batch_augment(jax.random.PRNGKey(1), batch, cutout)
+    # different cutout positions -> not all identical
+    flat = np.asarray(out).reshape(8, -1)
+    assert len(np.unique(flat.sum(1))) > 1
+
+
+def test_trigger_injection():
+    x = np.zeros((4, 16, 16, 3), np.float32)
+    t = add_pixel_trigger(x, size=3, value=2.5)
+    assert np.all(t[:, -3:, -3:, :] == 2.5)
+    assert np.all(t[:, :-3, :, :] == 0)
+
+
+def test_backdoor_attack_and_clipping_defense():
+    data = synthetic_images(num_clients=8, image_shape=(12, 12, 1),
+                            num_classes=4, samples_per_client=60,
+                            test_samples=400, seed=0, size_lognormal=False)
+    poisoned, (ex, ey) = make_backdoor_dataset(
+        data, target_label=0, poison_client_ids=[0, 1], poison_frac=0.8)
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=8, client_num_in_total=8,
+                       client_num_per_round=8, epochs=2, batch_size=16,
+                       lr=0.2, seed=0, frequency_of_the_test=100)
+
+    # undefended: backdoor takes
+    att = FedAvgRobustAPI(poisoned, task, cfg, defense_type="none",
+                          poisoned_test=(ex, ey))
+    for r in range(8):
+        att.run_round(r)
+    bd_undefended = float(att.evaluate_backdoor()["acc"])
+
+    # norm clipping blunts it
+    dfd = FedAvgRobustAPI(poisoned, task, cfg,
+                          defense_type="norm_diff_clipping", norm_bound=0.05,
+                          poisoned_test=(ex, ey))
+    for r in range(8):
+        dfd.run_round(r)
+    bd_defended = float(dfd.evaluate_backdoor()["acc"])
+    assert bd_undefended > 0.3  # attack effective without defense
+    assert bd_defended < bd_undefended  # defense reduces targeted accuracy
+
+
+def test_edge_case_dataset_grows_attacker_clients():
+    data = synthetic_images(num_clients=4, image_shape=(8, 8, 1), num_classes=3,
+                            samples_per_client=20, test_samples=30, seed=0,
+                            size_lognormal=False)
+    poisoned, (ex, ey) = make_edge_case_dataset(
+        data, target_label=1, poison_client_ids=[2], num_edge_samples=10)
+    assert len(poisoned.train_idx_map[2]) == len(data.train_idx_map[2]) + 10
+    assert len(poisoned.train_x) == len(data.train_x) + 10
+    assert np.all(ey == 1) and ex.shape[1:] == (8, 8, 1)
+
+
+def test_flip_labels():
+    data = synthetic_images(num_clients=2, image_shape=(8,), num_classes=3,
+                            samples_per_client=30, test_samples=10, seed=0,
+                            size_lognormal=False)
+    flipped = flip_labels(data, [0], from_label=1, to_label=2)
+    idx = data.train_idx_map[0]
+    was1 = np.asarray(data.train_y)[idx] == 1
+    assert np.all(np.asarray(flipped.train_y)[idx][was1] == 2)
+    idx1 = data.train_idx_map[1]
+    np.testing.assert_array_equal(np.asarray(flipped.train_y)[idx1],
+                                  np.asarray(data.train_y)[idx1])
