@@ -95,6 +95,7 @@ def pack_clients(
     max_batches: int | None = None,
     seed: int = 0,
     round_idx: int = 0,
+    use_native: bool | None = None,
 ) -> ClientBatch:
     """Pack the sampled clients' train data into a dense ClientBatch.
 
@@ -102,6 +103,10 @@ def pack_clients(
     analogue), then laid into [B, bs] with zero padding. B is the max batch
     count among sampled clients unless ``max_batches`` caps it (the cap
     matches reference behavior only when no client overflows it).
+
+    ``use_native``: True forces the C++ packer (fedml_tpu.native), False the
+    numpy loop, None auto-selects native when available. The two paths use
+    different (both deterministic) per-client shuffles.
     """
     rng = np.random.RandomState(seed * 7_919 + round_idx)
     counts = [len(data.train_idx_map[int(c)]) for c in client_ids]
@@ -109,6 +114,24 @@ def pack_clients(
     B = b_needed if max_batches is None else min(max_batches, b_needed)
     K = len(client_ids)
     bs = batch_size
+
+    if use_native is not False:
+        from fedml_tpu import native
+
+        if native.native_available():
+            idx_lists = [np.asarray(data.train_idx_map[int(c)], np.int64)
+                         for c in client_ids]
+            x, y, mask, num = native.pack_clients_native(
+                data.train_x, data.train_y, idx_lists, B * bs,
+                seed * 7_919 + round_idx + 1)
+            return ClientBatch(
+                x=x.reshape((K, B, bs) + data.train_x.shape[1:]),
+                y=y.reshape((K, B, bs) + data.train_y.shape[1:]),
+                mask=mask.reshape(K, B, bs),
+                num_samples=num,
+            )
+        if use_native:
+            raise RuntimeError("native packer requested but unavailable")
 
     xshape = data.train_x.shape[1:]
     yshape = data.train_y.shape[1:]

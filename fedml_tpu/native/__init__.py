@@ -1,0 +1,120 @@
+"""Native (C++) host runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA; this package holds the host-side data plane in
+C++: the per-round client packer (packer.cpp) that gathers/shuffles/pads the
+sampled clients' samples into the dense device block. Compiled on first use
+with g++ -O3 -march=native and cached next to the source; everything degrades
+to the numpy implementation if the toolchain is missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packer.cpp")
+_SO = os.path.join(_DIR, "_packer.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+            os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.fedml_pack_clients.restype = ctypes.c_int
+        lib.fedml_pack_clients.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,        # x, x_row_bytes
+            ctypes.c_char_p, ctypes.c_int64,        # y, y_row_bytes
+            ctypes.POINTER(ctypes.c_int64),         # idx_concat
+            ctypes.POINTER(ctypes.c_int64),         # idx_offsets
+            ctypes.c_int64, ctypes.c_int64,         # K, capacity
+            ctypes.c_uint64, ctypes.c_int,          # seed, assume_zeroed
+            ctypes.c_char_p, ctypes.c_char_p,       # out_x, out_y
+            ctypes.POINTER(ctypes.c_float),         # out_mask
+            ctypes.POINTER(ctypes.c_float),         # out_num
+            ctypes.c_int,                           # n_threads
+        ]
+        lib.fedml_shuffle_indices.restype = None
+        lib.fedml_shuffle_indices.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return get_lib() is not None
+
+
+def pack_clients_native(train_x: np.ndarray, train_y: np.ndarray,
+                        idx_lists: list[np.ndarray], capacity: int,
+                        seed: int, n_threads: int = 0):
+    """C++ fast path of core.client_data.pack_clients' inner loop.
+
+    Returns (x [K, capacity, ...], y [K, capacity, ...], mask [K, capacity],
+    num [K]) with rows shuffled per-client by splitmix64(seed, k).
+    """
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError("native packer unavailable")
+    x = np.ascontiguousarray(train_x)
+    y = np.ascontiguousarray(train_y)
+    K = len(idx_lists)
+    offsets = np.zeros(K + 1, np.int64)
+    for k, il in enumerate(idx_lists):
+        offsets[k + 1] = offsets[k] + len(il)
+    idx_concat = (np.concatenate(idx_lists).astype(np.int64) if K
+                  else np.zeros(0, np.int64))
+    x_row = int(np.prod(x.shape[1:])) * x.itemsize
+    y_row = (int(np.prod(y.shape[1:])) if y.ndim > 1 else 1) * y.itemsize
+
+    # np.zeros -> calloc zero pages: padding never gets touched, so the
+    # packer only writes real rows (see packer.cpp assume_zeroed)
+    out_x = np.zeros((K, capacity) + x.shape[1:], x.dtype)
+    out_y = np.zeros((K, capacity) + y.shape[1:], y.dtype)
+    out_mask = np.zeros((K, capacity), np.float32)
+    out_num = np.empty((K,), np.float32)
+
+    rc = lib.fedml_pack_clients(
+        x.ctypes.data_as(ctypes.c_char_p), x_row,
+        y.ctypes.data_as(ctypes.c_char_p), y_row,
+        idx_concat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        K, capacity, seed & 0xFFFFFFFFFFFFFFFF, 1,
+        out_x.ctypes.data_as(ctypes.c_char_p),
+        out_y.ctypes.data_as(ctypes.c_char_p),
+        out_mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out_num.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        int(n_threads),
+    )
+    if rc != 0:
+        raise RuntimeError(f"fedml_pack_clients failed rc={rc}")
+    return out_x, out_y, out_mask, out_num
