@@ -61,6 +61,30 @@ class LocalSpec:
     prox_mu: float = 0.0  # FedProx proximal coefficient (0 = plain FedAvg)
 
 
+def _vma_of(tree) -> frozenset:
+    """Union of shard_map varying-manual-axes across a pytree's leaves."""
+    out: frozenset = frozenset()
+    for v in jax.tree.leaves(tree):
+        out = out | getattr(jax.typeof(v), "vma", frozenset())
+    return out
+
+
+def _match_vma(tree, target_vma: frozenset):
+    """Mark invariant leaves device-varying over ``target_vma`` axes.
+
+    Opt states may mix param-derived leaves (already varying inside shard_map)
+    with freshly-created counters (e.g. the schedule step in
+    ScaleByScheduleState) that are invariant; the per-client masked select in
+    batch_step makes every carry leaf varying, so invariant ones must be cast
+    up front or lax.scan rejects the carry."""
+
+    def f(v):
+        missing = target_vma - getattr(jax.typeof(v), "vma", frozenset())
+        return lax.pcast(v, tuple(missing), to="varying") if missing else v
+
+    return jax.tree.map(f, tree)
+
+
 def make_local_update(task: Task, spec: LocalSpec):
     """Build the pure local-fit function for one client.
 
@@ -69,6 +93,8 @@ def make_local_update(task: Task, spec: LocalSpec):
                      mask[B,bs]) -> (NetState, metrics)
 
     metrics: dict of scalars averaged/summed over real samples only.
+    The fn is vma-aware: when traced inside shard_map (varying params) it
+    casts the opt-state carry to match, so it needs no axis plumbing.
     """
     optimizer = spec.optimizer
 
@@ -109,6 +135,9 @@ def make_local_update(task: Task, spec: LocalSpec):
     def local_update(rng, global_net: NetState, x, y, mask):
         params, extra = global_net.params, global_net.extra
         opt_state = optimizer.init(params)
+        vma = _vma_of(params)
+        if vma:
+            opt_state = _match_vma(opt_state, vma)
 
         def run_epoch(carry, _):
             params, extra, opt_state, rng = carry

@@ -1,0 +1,55 @@
+"""Learning-rate schedules (reference: LR_Scheduler,
+fedml_api/distributed/fedseg/utils.py:113-170).
+
+The reference mutates optimizer.param_groups per iteration with three modes —
+step (``base * 0.1^(epoch // lr_step)``), cos
+(``0.5 * base * (1 + cos(pi * T / N))``) and poly
+(``base * (1 - T/N)^0.9``) — plus a linear warmup over the first
+``warmup_epochs`` epochs. Here the same curves are pure step->lr functions
+plugged straight into optax (``optax.sgd(schedule)``), so the schedule is
+traced into the jitted local-update program instead of touched from Python.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def make_lr_schedule(
+    mode: str,
+    base_lr: float,
+    total_steps: int,
+    *,
+    warmup_steps: int = 0,
+    steps_per_epoch: int = 1,
+    lr_step: int = 0,
+    power: float = 0.9,
+):
+    """Return ``schedule(step) -> lr`` matching the reference's modes.
+
+    total_steps = N = num_epochs * iters_per_epoch; ``step`` is the global
+    iteration T. ``constant`` is also accepted (no reference analogue needed
+    for FedAvg-family algorithms).
+    """
+    if mode == "step" and not lr_step:
+        raise ValueError("mode='step' requires lr_step")
+
+    def schedule(step):
+        t = jnp.asarray(step, jnp.float32)
+        n = jnp.asarray(max(total_steps, 1), jnp.float32)
+        if mode == "constant":
+            lr = jnp.asarray(base_lr, jnp.float32)
+        elif mode == "cos":
+            lr = 0.5 * base_lr * (1.0 + jnp.cos(jnp.pi * t / n))
+        elif mode == "poly":
+            lr = base_lr * jnp.power(jnp.clip(1.0 - t / n, 0.0, 1.0), power)
+        elif mode == "step":
+            epoch = jnp.floor(t / steps_per_epoch)
+            lr = base_lr * jnp.power(0.1, jnp.floor(epoch / lr_step))
+        else:
+            raise ValueError(f"unknown lr schedule mode {mode!r}")
+        if warmup_steps > 0:
+            lr = jnp.where(t < warmup_steps, lr * t / warmup_steps, lr)
+        return lr
+
+    return schedule

@@ -129,6 +129,63 @@ def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False) -> Ta
     return Task(init, loss, predict, eval_batch)
 
 
+def segmentation_task(
+    module,
+    ignore_index: int = 255,
+    loss_mode: str = "ce",
+    focal_gamma: float = 2.0,
+    focal_alpha: float = 0.5,
+) -> Task:
+    """Pixel-wise segmentation: module maps [bs, H, W, C] -> logits
+    [bs, H, W, num_classes]; y is [bs, H, W] int labels with ``ignore_index``
+    marking void pixels (reference SegmentationLosses, fedseg/utils.py:66-110:
+    CrossEntropyLoss(ignore_index=255) and FocalLoss). The focal variant here
+    is the standard per-pixel (1-pt)^gamma weighting; the reference applies
+    the transform to the batch-mean CE (utils.py:97-110), which collapses to
+    a scalar reweighting — per-pixel is the published form.
+
+    Metrics count *valid pixels* (not samples): loss_sum/correct/count are
+    summed over non-ignored pixels of non-padded samples, so the engine's
+    weighted aggregation stays exact.
+    """
+
+    def init(rng, x_sample):
+        p_rng, d_rng = jax.random.split(rng)
+        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        return _split_variables(variables)
+
+    def _pixel_metrics(logits, y, mask):
+        valid = (y != ignore_index).astype(jnp.float32) * mask[:, None, None]
+        y_safe = jnp.where(y == ignore_index, 0, y)
+        per_px = optax.softmax_cross_entropy_with_integer_labels(logits, y_safe)
+        if loss_mode == "focal":
+            pt = jnp.exp(-per_px)
+            per_px = focal_alpha * jnp.power(1.0 - pt, focal_gamma) * per_px
+        correct = jnp.sum((jnp.argmax(logits, -1) == y) * valid)
+        return per_px, valid, correct
+
+    def loss(params, extra, x, y, mask, rng, train):
+        if train:
+            logits, new_extra = _apply_train(module, params, extra, x, rng)
+        else:
+            logits, new_extra = _apply_eval(module, params, extra, x), extra
+        per_px, valid, correct = _pixel_metrics(logits, y, mask)
+        n = jnp.maximum(jnp.sum(valid), 1.0)
+        l = jnp.sum(per_px * valid) / n
+        metrics = {"loss_sum": jnp.sum(per_px * valid), "correct": correct, "count": jnp.sum(valid)}
+        return l, new_extra, metrics
+
+    def predict(params, extra, x):
+        return _apply_eval(module, params, extra, x)
+
+    def eval_batch(params, extra, x, y, mask):
+        logits = _apply_eval(module, params, extra, x)
+        per_px, valid, correct = _pixel_metrics(logits, y, mask)
+        return {"loss_sum": jnp.sum(per_px * valid), "correct": correct, "count": jnp.sum(valid)}
+
+    return Task(init, loss, predict, eval_batch)
+
+
 def tag_prediction_task(module, threshold: float = 0.5) -> Task:
     """Multi-label (tag) prediction with sigmoid BCE; y is multi-hot [bs, C].
     Accuracy = micro-F1-style exact element accuracy over real samples."""

@@ -26,7 +26,7 @@ class DatasetSpec:
     num_clients: int          # canonical client count in the reference
     input_shape: tuple        # per-sample shape (images HWC; sequences (T,))
     num_classes: int
-    task: str                 # 'classification' | 'sequence' | 'tags'
+    task: str                 # 'classification' | 'sequence' | 'tags' | 'segmentation'
     partition: str            # 'natural' | 'lda'
     samples_per_client: int   # used by the synthetic fallback
 
@@ -50,6 +50,10 @@ DATASETS: dict[str, DatasetSpec] = {
     "gld23k": DatasetSpec("gld23k", 233, (224, 224, 3), 203, "classification", "natural", 100),
     "gld160k": DatasetSpec("gld160k", 1262, (224, 224, 3), 2028, "classification", "natural", 130),
     "synthetic": DatasetSpec("synthetic", 30, (60,), 10, "classification", "natural", 200),
+    # FedSeg datasets (fedml_api/distributed/fedseg; PASCAL VOC 21 classes,
+    # COCO mapped to the same 21-class VOC subset in the reference pipeline)
+    "pascal_voc": DatasetSpec("pascal_voc", 4, (513, 513, 3), 21, "segmentation", "lda", 200),
+    "coco": DatasetSpec("coco", 8, (513, 513, 3), 21, "segmentation", "lda", 300),
 }
 
 
@@ -97,6 +101,17 @@ def load_dataset(
             partition_method=pm,
             partition_alpha=partition_alpha,
             seed=seed,
+        )
+    if spec.task == "segmentation":
+        # synthetic fallback at reduced resolution: full 513x513 blobs are
+        # pure padding cost for a stand-in dataset
+        h, w, c = spec.input_shape
+        shape = (min(h, 64), min(w, 64), c)
+        return syn.synthetic_segmentation(
+            num_clients=n_clients, image_shape=shape,
+            num_classes=spec.num_classes, samples_per_client=spc,
+            test_samples=min(ts, 64), seed=seed,
+            partition_alpha=partition_alpha,
         )
     if spec.task == "sequence":
         return syn.synthetic_sequences(

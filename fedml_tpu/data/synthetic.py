@@ -112,6 +112,66 @@ def synthetic_images(
     return fd
 
 
+def synthetic_segmentation(
+    num_clients: int,
+    image_shape: tuple[int, int, int] = (64, 64, 3),
+    num_classes: int = 21,
+    samples_per_client: int = 20,
+    test_samples: int = 40,
+    seed: int = 0,
+    ignore_index: int = 255,
+    partition_alpha: float = 0.5,
+) -> FederatedData:
+    """Blob-world segmentation stand-in for PASCAL VOC / COCO (FedSeg).
+
+    Each image contains 1-3 axis-aligned rectangles of random foreground
+    classes on a class-0 background; pixel labels follow the rectangles, with
+    a 1-px ``ignore_index`` border around each object (mimicking VOC's void
+    boundary pixels). Clients draw objects from a Dirichlet(partition_alpha)
+    class mix -> non-IID, sharper as alpha shrinks (the LDA knob of
+    cifar10/data_loader.py:172-196 applied to object classes).
+    """
+    rng = np.random.RandomState(seed)
+    h, w, c = image_shape
+    class_colors = rng.normal(0, 1, (num_classes, c)).astype(np.float32)
+
+    def gen(n, class_probs):
+        x = np.zeros((n, h, w, c), np.float32)
+        y = np.zeros((n, h, w), np.int64)
+        for i in range(n):
+            x[i] = class_colors[0] + 0.3 * rng.normal(0, 1, (h, w, c))
+            for _ in range(rng.randint(1, 4)):
+                cls = 1 + int(rng.choice(num_classes - 1, p=class_probs))
+                bh, bw = rng.randint(h // 4, h // 2), rng.randint(w // 4, w // 2)
+                r0, c0 = rng.randint(0, h - bh), rng.randint(0, w - bw)
+                x[i, r0:r0 + bh, c0:c0 + bw] = class_colors[cls] + \
+                    0.3 * rng.normal(0, 1, (bh, bw, c))
+                y[i, r0:r0 + bh, c0:c0 + bw] = cls
+                # void boundary ring (all four edges)
+                y[i, r0, c0:c0 + bw] = ignore_index
+                y[i, r0 + bh - 1, c0:c0 + bw] = ignore_index
+                y[i, r0:r0 + bh, c0] = ignore_index
+                y[i, r0:r0 + bh, c0 + bw - 1] = ignore_index
+        return x, y
+
+    xs, ys, idx_map, off = [], [], {}, 0
+    n_fg = num_classes - 1
+    for k in range(num_clients):
+        probs = rng.dirichlet(np.repeat(partition_alpha, n_fg))
+        x, y = gen(samples_per_client, probs)
+        xs.append(x); ys.append(y)
+        idx_map[k] = np.arange(off, off + samples_per_client)
+        off += samples_per_client
+    tx, ty = gen(test_samples, np.full(n_fg, 1.0 / n_fg))
+    fd = FederatedData(
+        train_x=np.concatenate(xs), train_y=np.concatenate(ys),
+        test_x=tx, test_y=ty,
+        train_idx_map=idx_map, test_idx_map=None, class_num=num_classes,
+    )
+    fd.synthetic_fallback = True
+    return fd
+
+
 def synthetic_sequences(
     num_clients: int,
     seq_len: int,

@@ -1,0 +1,143 @@
+"""Semantic-segmentation models for FedSeg (reference:
+fedml_api/distributed/fedseg/ — the reference trains DeepLabV3+-style
+encoder/decoder torch models; see FedSegAPI.py:19-38 where the torch model is
+injected into MyModelTrainer).
+
+TPU-first design notes:
+- NHWC throughout; every conv static-shaped so XLA tiles onto the MXU.
+- Atrous (dilated) convs via ``kernel_dilation`` — no im2col tricks needed.
+- Upsampling via ``jax.image.resize`` (bilinear), which XLA lowers to
+  gather-free convolutions on TPU.
+- GroupNorm instead of BatchNorm by default: FL clients have small local
+  batches and BN running stats are a known source of non-IID drift (the
+  reference ships SynchronizedBatchNorm workarounds, model/cv/batchnorm_utils.py).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _gn(x, groups: int = 8):
+    return nn.GroupNorm(num_groups=min(groups, x.shape[-1]))(x)
+
+
+class ConvBlock(nn.Module):
+    filters: int
+    kernel: tuple[int, int] = (3, 3)
+    strides: tuple[int, int] = (1, 1)
+    dilation: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    kernel_dilation=self.dilation, padding="SAME",
+                    use_bias=False)(x)
+        x = _gn(x)
+        return nn.relu(x)
+
+
+class ResStage(nn.Module):
+    """Two-block residual stage with optional stride/dilation."""
+
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dilation: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        y = ConvBlock(self.filters, strides=self.strides, dilation=self.dilation)(x)
+        y = nn.Conv(self.filters, (3, 3), kernel_dilation=self.dilation,
+                    padding="SAME", use_bias=False)(y)
+        y = _gn(y)
+        if x.shape != y.shape:
+            x = nn.Conv(self.filters, (1, 1), self.strides, use_bias=False)(x)
+            x = _gn(x)
+        return nn.relu(x + y)
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling: parallel dilated branches + image pool."""
+
+    filters: int = 128
+    rates: Sequence[int] = (1, 6, 12, 18)
+
+    @nn.compact
+    def __call__(self, x):
+        branches = []
+        for r in self.rates:
+            k = (1, 1) if r == 1 else (3, 3)
+            branches.append(ConvBlock(self.filters, kernel=k, dilation=(r, r))(x))
+        # image-level pooling branch
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        pooled = ConvBlock(self.filters, kernel=(1, 1))(pooled)
+        pooled = jnp.broadcast_to(pooled, x.shape[:3] + (self.filters,))
+        branches.append(pooled)
+        y = jnp.concatenate(branches, axis=-1)
+        return ConvBlock(self.filters, kernel=(1, 1))(y)
+
+
+class DeepLabLite(nn.Module):
+    """DeepLabV3+-style encoder/decoder, compact enough for federated silos.
+
+    Encoder: 4 residual stages (output stride 16, last stage dilated);
+    ASPP head; decoder fuses the stride-4 low-level features; bilinear
+    upsample back to input resolution. Output: [bs, H, W, num_classes].
+    """
+
+    num_classes: int = 21
+    width: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # GroupNorm everywhere — no train-time mutable state
+        h, w = x.shape[1], x.shape[2]
+        y = ConvBlock(self.width, strides=(2, 2))(x)           # /2
+        y = ResStage(self.width * 2, strides=(2, 2))(y)        # /4
+        low = y
+        y = ResStage(self.width * 4, strides=(2, 2))(y)        # /8
+        y = ResStage(self.width * 8, strides=(2, 2))(y)        # /16
+        y = ResStage(self.width * 8, dilation=(2, 2))(y)       # /16, dilated
+        y = ASPP(self.width * 4)(y)
+
+        # decoder: upsample to /4, fuse low-level features
+        y = jax.image.resize(y, (y.shape[0], low.shape[1], low.shape[2],
+                                 y.shape[-1]), "bilinear")
+        low = ConvBlock(self.width, kernel=(1, 1))(low)
+        y = jnp.concatenate([y, low], axis=-1)
+        y = ConvBlock(self.width * 4)(y)
+        y = nn.Conv(self.num_classes, (1, 1))(y)
+        return jax.image.resize(y, (y.shape[0], h, w, self.num_classes),
+                                "bilinear")
+
+
+class UNetLite(nn.Module):
+    """Small U-Net — the lighter FedSeg option for low-resource silos."""
+
+    num_classes: int = 21
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train
+        w = self.width
+        e1 = ConvBlock(w)(ConvBlock(w)(x))
+        e2 = ConvBlock(w * 2)(nn.max_pool(e1, (2, 2), (2, 2)))
+        e3 = ConvBlock(w * 4)(nn.max_pool(e2, (2, 2), (2, 2)))
+        b = ConvBlock(w * 8)(nn.max_pool(e3, (2, 2), (2, 2)))
+
+        def up(y, skip, f):
+            y = jax.image.resize(
+                y, (y.shape[0], skip.shape[1], skip.shape[2], y.shape[-1]),
+                "bilinear")
+            y = jnp.concatenate([y, skip], axis=-1)
+            return ConvBlock(f)(y)
+
+        d3 = up(b, e3, w * 4)
+        d2 = up(d3, e2, w * 2)
+        d1 = up(d2, e1, w)
+        return nn.Conv(self.num_classes, (1, 1))(d1)

@@ -3,8 +3,9 @@
 The compute path is JAX/XLA; this package holds the host-side data plane in
 C++: the per-round client packer (packer.cpp) that gathers/shuffles/pads the
 sampled clients' samples into the dense device block. Compiled on first use
-with g++ -O3 -march=native and cached next to the source; everything degrades
-to the numpy implementation if the toolchain is missing.
+with g++ -O3 (portable flags — the .so is never committed) and cached next to
+the source; everything degrades to the numpy implementation if the toolchain
+is missing.
 """
 
 from __future__ import annotations
@@ -25,12 +26,20 @@ _tried = False
 
 
 def _build() -> bool:
+    # build to a private temp path then atomically rename: concurrent
+    # first-use builds from several processes must not corrupt the shared .so
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -1,0 +1,52 @@
+"""Segmentation evaluation — confusion-matrix scores (reference: Evaluator,
+fedml_api/distributed/fedseg/utils.py:246-288).
+
+The reference accumulates a numpy [C, C] confusion matrix batch-by-batch on
+the host and derives Pixel_Accuracy / Pixel_Accuracy_Class / MIoU / FWIoU.
+Here the accumulation is a jitted one-hot matmul (MXU-friendly, stays on
+device across the whole eval scan); only the final [C, C] matrix crosses to
+the host for the score formulas, which match the reference exactly
+(including nanmean over absent classes).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def confusion_matrix(pred, label, num_classes: int, valid):
+    """Batch confusion counts: conf[i, j] = #pixels with gt i predicted j.
+
+    pred/label: integer arrays of identical shape; valid: float/bool mask of
+    the same shape (0 for ignore_index pixels and padded samples — the
+    reference drops gt outside [0, C) the same way, utils.py:277-281).
+    """
+    v = valid.reshape(-1).astype(jnp.float32)
+    p = jnp.clip(pred.reshape(-1), 0, num_classes - 1)
+    l = jnp.clip(label.reshape(-1), 0, num_classes - 1)
+    idx = l * num_classes + p
+    flat = jnp.zeros(num_classes * num_classes, jnp.float32).at[idx].add(v)
+    return flat.reshape(num_classes, num_classes)
+
+
+def seg_scores(conf: np.ndarray) -> dict:
+    """Reference Evaluator formulas on a [C, C] confusion matrix."""
+    conf = np.asarray(conf, np.float64)
+    total = conf.sum()
+    diag = np.diag(conf)
+    row = conf.sum(axis=1)  # gt counts
+    col = conf.sum(axis=0)  # pred counts
+    with np.errstate(divide="ignore", invalid="ignore"):
+        pixel_acc = diag.sum() / total if total > 0 else 0.0
+        class_acc = float(np.nanmean(diag / row))
+        iu = diag / (row + col - diag)
+        miou = float(np.nanmean(iu))
+        freq = row / total if total > 0 else row
+        fwiou = float((freq[freq > 0] * iu[freq > 0]).sum())
+    return {
+        "pixel_acc": float(pixel_acc),
+        "class_acc": class_acc,
+        "mIoU": miou,
+        "FWIoU": fwiou,
+    }
