@@ -38,6 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from fedml_tpu.core.client_data import ClientBatch, FederatedData, batch_global, pack_clients
 from fedml_tpu.core.local import LocalSpec, NetState, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.utils.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
 
 log = logging.getLogger("fedml_tpu.fedavg")
@@ -129,6 +130,7 @@ class FedAvgAPI:
         self.round_fn = self._build_round_fn()
         self._test_cache = None
         self.history: list[dict] = []
+        self.tracer = RoundTracer()  # pack/compute/eval spans (SURVEY.md §5)
 
     # ------------------------------------------------------------------ round
     def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp, hook_key):
@@ -162,9 +164,8 @@ class FedAvgAPI:
         if self.mesh is None:
 
             @jax.jit
-            def round_fn(rng, net, server_opt_state, batch: ClientBatch):
-                rng, kb, kh, kp = jax.random.split(rng, 4)
-                keys = jax.random.split(kb, batch.x.shape[0])
+            def round_fn(rng, net, server_opt_state, batch: ClientBatch, keys):
+                rng, kh, kp = jax.random.split(rng, 3)
                 nets, metrics, nsamp = self._round_body(
                     keys, net, server_opt_state, batch.x, batch.y, batch.mask,
                     batch.num_samples, kh,
@@ -214,9 +215,8 @@ class FedAvgAPI:
         )
 
         @jax.jit
-        def round_fn(rng, net, server_opt_state, batch: ClientBatch):
-            rng, kb, kh, kp = jax.random.split(rng, 4)
-            keys = jax.random.split(kb, batch.x.shape[0])
+        def round_fn(rng, net, server_opt_state, batch: ClientBatch, keys):
+            rng, kh, kp = jax.random.split(rng, 3)
             avg, metrics = smapped(
                 keys, net, batch.x, batch.y, batch.mask, batch.num_samples, kh
             )
@@ -228,11 +228,18 @@ class FedAvgAPI:
         return round_fn
 
     # ------------------------------------------------------------------ data
+    def _client_keys(self, round_idx: int, ids) -> jax.Array:
+        """Per-client local-fit keys: fold_in(fold_in(PRNGKey(seed), round),
+        client_id). Grouping-invariant like the pack_clients shuffle, so the
+        cross-process runtime (fedml_tpu/distributed — one client per rank)
+        derives the identical key and the distributed == standalone oracle
+        holds even for rng-using tasks (dropout, augmentation)."""
+        base = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
+        return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.asarray(ids))
+
     def _pack_round(self, round_idx: int) -> ClientBatch:
         cfg = self.cfg
-        ids = sample_clients(
-            round_idx, cfg.client_num_in_total, cfg.client_num_per_round, cfg.seed
-        )
+        ids = self._sampled_ids(round_idx)
         cb = pack_clients(
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,
@@ -255,13 +262,27 @@ class FedAvgAPI:
             )
         return cb
 
+    def _sampled_ids(self, round_idx: int):
+        cfg = self.cfg
+        return sample_clients(
+            round_idx, cfg.client_num_in_total, cfg.client_num_per_round, cfg.seed
+        )
+
     # ------------------------------------------------------------------ train
     def run_round(self, round_idx: int):
-        cb = self._pack_round(round_idx)
-        self.rng, rk = jax.random.split(self.rng)
-        self.net, self.server_opt_state, metrics = self.round_fn(
-            rk, self.net, self.server_opt_state, cb
-        )
+        with self.tracer.span("pack"):
+            ids = self._sampled_ids(round_idx)
+            cb = self._pack_round(round_idx)
+            keys = self._client_keys(round_idx, ids)
+            if self.mesh is not None:
+                keys = jax.device_put(
+                    keys, NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+                )
+        with self.tracer.span("round"):
+            self.rng, rk = jax.random.split(self.rng)
+            self.net, self.server_opt_state, metrics = self.round_fn(
+                rk, self.net, self.server_opt_state, cb, keys
+            )
         return metrics
 
     def train(self, num_rounds: int | None = None):
@@ -271,7 +292,8 @@ class FedAvgAPI:
             t0 = time.perf_counter()
             metrics = self.run_round(r)
             if (r % cfg.frequency_of_the_test == 0) or (r == rounds - 1):
-                ev = self.evaluate()
+                with self.tracer.span("eval"):
+                    ev = self.evaluate()
                 n = float(max(metrics["count"], 1.0))
                 rec = {
                     "round": r,
@@ -283,6 +305,7 @@ class FedAvgAPI:
                 }
                 self.history.append(rec)
                 log.info("round %d: %s", r, rec)
+            self.tracer.next_round()
         return self.net
 
     # ------------------------------------------------------------------ state

@@ -79,8 +79,9 @@ class FedNovaAPI(FedAvgAPI):
         tau_eff = float((p * tau).sum())
         self.server_opt_state = jnp.asarray(tau_eff, jnp.float32)
 
+        ids = self._sampled_ids(round_idx)
         self.rng, rk = jax.random.split(self.rng)
         self.net, self.server_opt_state, metrics = self.round_fn(
-            rk, self.net, self.server_opt_state, cb
+            rk, self.net, self.server_opt_state, cb, self._client_keys(round_idx, ids)
         )
         return metrics

@@ -1,0 +1,27 @@
+"""L1 communication layer — pluggable cross-process backends.
+
+TPU-native replacement of fedml_core/distributed/communication/. The SPMD
+engine (fedml_tpu/algorithms) is the fast path when all simulated clients
+live in one program; this layer exists for the reference's OTHER computing
+paradigm — *distributed training* with one OS process per participant
+(README.md:93-97) — i.e. real cross-silo/cross-device federation where
+parties do not share an address space.
+
+Backends:
+- ``loopback`` — in-process queues (threads as ranks); the test transport.
+- ``grpc``    — per-rank insecure gRPC server, port base+rank, ip-table
+  routing (mirror of fedml_core/distributed/communication/gRPC/).
+- ``mqtt``    — broker pub/sub (mirror of .../mqtt/); gated on paho-mqtt.
+
+Unlike the reference there is no MPI backend: on TPU pods, intra-job
+transport is XLA collectives over ICI (fedml_tpu/collectives); this layer
+only carries *inter-job* traffic (DCN/ethernet), where gRPC is the native
+choice.
+"""
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.loopback import LoopbackCommManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.observer import Observer
+
+__all__ = ["BaseCommManager", "LoopbackCommManager", "Message", "Observer"]

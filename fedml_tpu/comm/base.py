@@ -1,0 +1,61 @@
+"""Abstract communication backend API.
+
+Mirror of fedml_core/distributed/communication/base_com_manager.py:7-27,
+with one behavioral fix: the reference's MPI manager polls its receive queue
+with a 0.3 s sleep (mpi/com_manager.py:71-78), which puts a 0.3 s floor under
+every round. Backends here block on the queue instead, so message dispatch
+latency is microseconds.
+"""
+
+from __future__ import annotations
+
+import abc
+import queue
+import threading
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.observer import Observer
+
+
+class BaseCommManager(abc.ABC):
+    def __init__(self):
+        self._observers: list["Observer"] = []
+        self._q: "queue.Queue[Message]" = queue.Queue()
+        self._running = threading.Event()
+
+    # ------------------------------------------------------------- interface
+    @abc.abstractmethod
+    def send_message(self, msg: "Message") -> None:
+        ...
+
+    def add_observer(self, observer: "Observer") -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: "Observer") -> None:
+        self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        """Dispatch loop: block on the inbound queue, notify observers.
+
+        Returns when stop_receive_message() is called.
+        """
+        self._running.set()
+        while self._running.is_set():
+            try:
+                msg = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._running.clear()
+
+    # -------------------------------------------------------------- plumbing
+    def _enqueue(self, msg: "Message") -> None:
+        self._q.put(msg)
+
+    def _notify(self, msg: "Message") -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg.get_params())

@@ -1,0 +1,105 @@
+"""gRPC transport — one insecure server per rank, ip-table routing.
+
+Mirror of fedml_core/distributed/communication/gRPC/grpc_comm_manager.py:
+each rank serves on port base+rank (reference: 50000+rank,
+grpc_comm_manager.py:29,60); senders route via a rank->ip table
+(fedml_api/distributed/utils/ip_config_utils.py reads grpc_ipconfig.csv).
+
+Redesigns vs the reference:
+- No protoc-generated stubs: the service is registered with a generic bytes
+  handler (identity serializers), so the binary Message frame from
+  message.py goes over the wire untouched — no JSON-ification of weights
+  (reference sends weights as JSON nested lists, a ~10x size blowup).
+- Channels are cached per destination instead of opened per message
+  (reference opens and closes a channel every send, grpc_comm_manager.py:53-74).
+- The inbound path enqueues into the blocking dispatch queue of
+  BaseCommManager instead of a 0.1 s polling drain thread
+  (grpc_comm_manager.py:86-97).
+"""
+
+from __future__ import annotations
+
+import csv
+import logging
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message
+
+log = logging.getLogger("fedml_tpu.comm.grpc")
+
+_SERVICE = "fedml_tpu.Comm"
+_METHOD = "Send"
+_MAX_MSG = 1024 * 1024 * 1024  # 1 GB (reference caps at 100 MB, :35-36)
+
+
+def read_ip_config(path: str) -> dict[int, str]:
+    """rank -> ip, from a csv with header (receiver_id, ip)."""
+    table: dict[int, str] = {}
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            table[int(row["receiver_id"])] = row["ip"]
+    return table
+
+
+class GrpcCommManager(BaseCommManager):
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        ip_table: dict[int, str] | str | None = None,
+        base_port: int = 50000,
+        host: str = "0.0.0.0",
+    ):
+        super().__init__()
+        import grpc
+
+        self.rank, self.size, self.base_port = rank, size, base_port
+        if isinstance(ip_table, str):
+            ip_table = read_ip_config(ip_table)
+        self.ip_table = ip_table or {r: "127.0.0.1" for r in range(size)}
+        self._channels: dict[int, object] = {}
+        self._grpc = grpc
+
+        from concurrent import futures
+
+        def recv(request: bytes, context):
+            self._enqueue(Message.from_bytes(request))
+            return b"ok"
+
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {_METHOD: grpc.unary_unary_rpc_method_handler(recv)},
+        )
+        opts = [
+            ("grpc.max_send_message_length", _MAX_MSG),
+            ("grpc.max_receive_message_length", _MAX_MSG),
+        ]
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=8), options=opts)
+        self._server.add_generic_rpc_handlers((handler,))
+        self._port = self._server.add_insecure_port(f"{host}:{base_port + rank}")
+        if self._port == 0:
+            raise RuntimeError(f"grpc: cannot bind {host}:{base_port + rank}")
+        self._server.start()
+        log.info("rank %d serving on %s:%d", rank, host, self._port)
+
+    def _stub(self, dest: int):
+        if dest not in self._channels:
+            addr = f"{self.ip_table[dest]}:{self.base_port + dest}"
+            opts = [
+                ("grpc.max_send_message_length", _MAX_MSG),
+                ("grpc.max_receive_message_length", _MAX_MSG),
+            ]
+            self._channels[dest] = self._grpc.insecure_channel(addr, options=opts)
+        return self._channels[dest].unary_unary(f"/{_SERVICE}/{_METHOD}")
+
+    def send_message(self, msg: Message) -> None:
+        dest = int(msg.get_receiver_id())
+        frame = msg.to_bytes()
+        self._stub(dest)(frame, timeout=600)
+
+    def stop_receive_message(self) -> None:
+        super().stop_receive_message()
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+        self._server.stop(grace=0.5)

@@ -1,0 +1,44 @@
+"""In-process loopback transport — ranks are threads, links are queues.
+
+The reference has no mock transport; its "fake cluster" is mpirun with all
+ranks on localhost (SURVEY.md §4.5). On TPU CI we want the same multi-party
+semantics without processes, so this backend routes Message frames through a
+process-local registry keyed by (job_id, rank). Frames still round-trip
+through to_bytes()/from_bytes(), so loopback exercises the exact wire path
+the gRPC backend uses — a loopback test is a serialization test.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message
+
+_registry: dict = defaultdict(dict)  # job_id -> {rank: LoopbackCommManager}
+_registry_lock = threading.Lock()
+
+
+class LoopbackCommManager(BaseCommManager):
+    def __init__(self, job_id: str, rank: int, size: int):
+        super().__init__()
+        self.job_id, self.rank, self.size = job_id, rank, size
+        with _registry_lock:
+            _registry[job_id][rank] = self
+
+    def send_message(self, msg: Message) -> None:
+        frame = msg.to_bytes()  # force the real wire path
+        dest = int(msg.get_receiver_id())
+        with _registry_lock:
+            peer = _registry[self.job_id].get(dest)
+        if peer is None:
+            raise RuntimeError(f"loopback: rank {dest} not registered in job {self.job_id}")
+        peer._enqueue(Message.from_bytes(frame))
+
+    def stop_receive_message(self) -> None:
+        super().stop_receive_message()
+        with _registry_lock:
+            _registry[self.job_id].pop(self.rank, None)
+            if not _registry[self.job_id]:
+                _registry.pop(self.job_id, None)

@@ -1,0 +1,74 @@
+"""MQTT transport — broker-mediated pub/sub for mobile/IoT federation.
+
+Mirror of fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:
+topic scheme preserved: the server (id 0) publishes to ``fedml0_<cid>`` and
+subscribes to ``fedml_<cid>``; client cid publishes ``fedml_<cid>`` and
+subscribes ``fedml0_<cid>`` (mqtt_comm_manager.py:47-70). Payloads are the
+binary Message frame, not JSON.
+
+Gated: paho-mqtt is not bundled in this image; constructing the manager
+without it raises ImportError with instructions. The class is fully
+implemented so it works wherever paho is installed.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+
+from fedml_tpu.comm.base import BaseCommManager
+from fedml_tpu.comm.message import Message
+
+log = logging.getLogger("fedml_tpu.comm.mqtt")
+
+
+class MqttCommManager(BaseCommManager):
+    def __init__(self, broker_host: str, broker_port: int, client_id: int, client_num: int):
+        super().__init__()
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:  # pragma: no cover - env without paho
+            raise ImportError(
+                "MqttCommManager requires paho-mqtt (pip install paho-mqtt); "
+                "use the 'grpc' or 'loopback' backend in this environment"
+            ) from e
+
+        self.client_id, self.client_num = client_id, client_num
+        name = f"fedml_tpu-{client_id}-{uuid.uuid4().hex[:6]}"
+        if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+            self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION2, client_id=name)
+        else:  # paho-mqtt 1.x
+            self._client = mqtt.Client(client_id=name)
+        self._client.on_connect = self._on_connect
+        self._client.on_message = self._on_message
+        self._client.connect(broker_host, broker_port, keepalive=180)
+        self._client.loop_start()
+
+    # topic scheme parity (mqtt_comm_manager.py:47-70)
+    def _sub_topics(self):
+        if self.client_id == 0:  # server listens to every client's uplink
+            return [f"fedml_{cid}" for cid in range(1, self.client_num + 1)]
+        return [f"fedml0_{self.client_id}"]
+
+    def _pub_topic(self, receiver_id: int) -> str:
+        if self.client_id == 0:
+            return f"fedml0_{receiver_id}"
+        return f"fedml_{self.client_id}"
+
+    def _on_connect(self, client, userdata, flags, rc, properties=None):
+        # signature covers both paho v1 (4 args) and v2 (5 args) callbacks
+        for t in self._sub_topics():
+            client.subscribe(t, qos=1)
+
+    def _on_message(self, client, userdata, m):
+        self._enqueue(Message.from_bytes(m.payload))
+
+    def send_message(self, msg: Message) -> None:
+        self._client.publish(
+            self._pub_topic(int(msg.get_receiver_id())), payload=msg.to_bytes(), qos=1
+        )
+
+    def stop_receive_message(self) -> None:
+        super().stop_receive_message()
+        self._client.loop_stop()
+        self._client.disconnect()

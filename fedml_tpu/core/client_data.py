@@ -88,6 +88,34 @@ class FederatedData:
         )
 
 
+_U64 = (1 << 64) - 1
+
+
+def _splitmix_shuffle(idx: np.ndarray, seed: int) -> None:
+    """In-place Fisher-Yates with splitmix64 — bit-identical to the C++
+    packer's shuffle (native/packer.cpp pack_one_client).
+
+    The splitmix state at step t is the affine seed + t*GOLDEN, so all mixed
+    outputs (and hence all swap targets j) are computed vectorized; only the
+    inherently-sequential swap sweep stays in Python."""
+    n = len(idx)
+    if n <= 1:
+        return
+    with np.errstate(over="ignore"):
+        t = np.arange(1, n, dtype=np.uint64)
+        z = np.uint64(seed) + t * np.uint64(0x9E3779B97F4A7C15)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        z = z ^ (z >> np.uint64(31))
+        i_vals = np.arange(n - 1, 0, -1, dtype=np.uint64)
+        j = (z % (i_vals + np.uint64(1))).astype(np.int64)
+    lst = idx.tolist()  # python-list swaps are ~3x faster than ndarray ones
+    for t_, i in enumerate(range(n - 1, 0, -1)):
+        jj = j[t_]
+        lst[i], lst[jj] = lst[jj], lst[i]
+    idx[:] = lst
+
+
 def pack_clients(
     data: FederatedData,
     client_ids: np.ndarray,
@@ -104,16 +132,26 @@ def pack_clients(
     count among sampled clients unless ``max_batches`` caps it (the cap
     matches reference behavior only when no client overflows it).
 
+    The shuffle is splitmix64 Fisher-Yates seeded by (seed, round, CLIENT
+    ID) — identical in the native and numpy paths, and independent of which
+    other clients are packed in the same call. That grouping-invariance is
+    what makes the cross-process distributed runtime (one client per rank,
+    fedml_tpu/distributed) bit-identical to the SPMD simulation (all clients
+    in one block) — the distributed ≡ standalone equivalence oracle.
+
     ``use_native``: True forces the C++ packer (fedml_tpu.native), False the
-    numpy loop, None auto-selects native when available. The two paths use
-    different (both deterministic) per-client shuffles.
+    numpy loop, None auto-selects native when available.
     """
-    rng = np.random.RandomState(seed * 7_919 + round_idx)
     counts = [len(data.train_idx_map[int(c)]) for c in client_ids]
     b_needed = max(int(np.ceil(n / batch_size)) for n in counts)
     B = b_needed if max_batches is None else min(max_batches, b_needed)
     K = len(client_ids)
     bs = batch_size
+    base = (seed * 7_919 + round_idx + 1) & _U64
+    seeds = np.array(
+        [(base * 0x9E3779B97F4A7C15 + int(c) + 1) & _U64 for c in client_ids],
+        dtype=np.uint64,
+    )
 
     if use_native is not False:
         from fedml_tpu import native
@@ -122,8 +160,7 @@ def pack_clients(
             idx_lists = [np.asarray(data.train_idx_map[int(c)], np.int64)
                          for c in client_ids]
             x, y, mask, num = native.pack_clients_native(
-                data.train_x, data.train_y, idx_lists, B * bs,
-                seed * 7_919 + round_idx + 1)
+                data.train_x, data.train_y, idx_lists, B * bs, seeds)
             return ClientBatch(
                 x=x.reshape((K, B, bs) + data.train_x.shape[1:]),
                 y=y.reshape((K, B, bs) + data.train_y.shape[1:]),
@@ -142,7 +179,7 @@ def pack_clients(
 
     for k, cid in enumerate(client_ids):
         idx = np.array(data.train_idx_map[int(cid)])
-        rng.shuffle(idx)
+        _splitmix_shuffle(idx, int(seeds[k]))
         idx = idx[: B * bs]
         n = len(idx)
         num[k] = n

@@ -1,0 +1,12 @@
+"""Cross-process distributed FL — the reference's second computing paradigm.
+
+One OS process (or thread, under the loopback backend) per participant,
+coordinated by typed messages over fedml_tpu/comm. Mirrors
+fedml_api/distributed/<algo>/'s 6-file pattern (API / Aggregator / Trainer /
+ServerManager / ClientManager / message_define — SURVEY.md §2.2) with the
+torch local loops replaced by the jitted local-fit from fedml_tpu/core.
+
+When to use which runtime:
+- all clients simulated in one TPU job  -> fedml_tpu/algorithms (SPMD, fast)
+- real federation across silos/devices  -> this package (gRPC over DCN)
+"""

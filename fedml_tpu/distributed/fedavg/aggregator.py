@@ -1,0 +1,107 @@
+"""Server-side aggregator: collect per-client results, weighted-average, eval.
+
+Mirror of fedml_api/distributed/fedavg/FedAVGAggregator.py — add_local_
+trained_result (:44-48), check_whether_all_receive (:50-56), aggregate
+(:58-87, per-key sample-weighted sum), client_sampling (:89-97, np.random
+seeded by round), test_on_server_for_all_clients (:109-163).
+
+The average itself is one jitted pytree op on stacked leaves rather than a
+python loop over state_dict keys.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+from fedml_tpu.core.client_data import FederatedData, batch_global
+from fedml_tpu.core.local import Task, make_eval_fn
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+log = logging.getLogger("fedml_tpu.distributed.fedavg")
+
+
+class FedAvgAggregator:
+    def __init__(self, dataset: FederatedData, task: Task, cfg: FedAvgConfig, worker_num: int):
+        self.dataset, self.task, self.cfg = dataset, task, cfg
+        self.worker_num = worker_num
+        self.model_dict: dict[int, list] = {}
+        self.sample_num_dict: dict[int, int] = {}
+        self.flag_client_model_uploaded = {i: False for i in range(worker_num)}
+
+        # same init-key derivation as FedAvgAPI/DistributedTrainer so every
+        # party (and the standalone oracle) starts from identical weights
+        _, init_key = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        self.net = task.init(init_key, jnp.asarray(dataset.train_x[: cfg.batch_size]))
+        self.eval_fn = make_eval_fn(task)
+        self._test_cache = None
+        self.history: list[dict] = []
+        # same formula (and code) as the SPMD engine's aggregation so the
+        # two runtimes cannot drift numerically
+        self._wavg = jax.jit(tree_weighted_mean)
+
+    def get_global_model_params(self):
+        return pack_pytree(self.net)
+
+    # ------------------------------------------------------------- receive
+    def add_local_trained_result(self, index: int, wire_leaves, sample_num: int) -> None:
+        self.model_dict[index] = wire_leaves
+        self.sample_num_dict[index] = sample_num
+        self.flag_client_model_uploaded[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if not all(self.flag_client_model_uploaded.values()):
+            return False
+        for i in self.flag_client_model_uploaded:
+            self.flag_client_model_uploaded[i] = False
+        return True
+
+    # ----------------------------------------------------------- aggregate
+    def aggregate(self):
+        t0 = time.perf_counter()
+        ranks = sorted(self.model_dict)
+        stacked = [
+            jnp.stack([jnp.asarray(self.model_dict[r][i]) for r in ranks])
+            for i in range(len(self.model_dict[ranks[0]]))
+        ]
+        weights = jnp.asarray([self.sample_num_dict[r] for r in ranks], jnp.float32)
+        avg_leaves = self._wavg(stacked, weights)
+        self.net = unpack_pytree(self.net, avg_leaves)
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        log.info("aggregate time cost: %.3fs", time.perf_counter() - t0)
+        return pack_pytree(self.net)
+
+    # ------------------------------------------------------------ sampling
+    def client_sampling(self, round_idx: int) -> np.ndarray:
+        return sample_clients(
+            round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round,
+            self.cfg.seed,
+        )
+
+    # ----------------------------------------------------------------- eval
+    def test_on_server_for_all_clients(self, round_idx: int) -> None:
+        cfg = self.cfg
+        if round_idx % cfg.frequency_of_the_test != 0 and round_idx != cfg.comm_round - 1:
+            return
+        if self._test_cache is None:
+            n = len(self.dataset.test_x)
+            if cfg.ci:
+                n = min(n, 512)  # --ci truncation (FedAVGAggregator.py:126-131)
+            self._test_cache = tuple(
+                jnp.asarray(a)
+                for a in batch_global(
+                    self.dataset.test_x[:n], self.dataset.test_y[:n], cfg.eval_batch_size
+                )
+            )
+        ev = self.eval_fn(self.net, *self._test_cache)
+        rec = {"round": round_idx, "test_loss": float(ev["loss"]), "test_acc": float(ev["acc"])}
+        self.history.append(rec)
+        log.info("server eval %s", rec)

@@ -1,0 +1,78 @@
+"""Distributed FedAvg entry — rank dispatch + in-process simulation helper.
+
+Mirror of fedml_api/distributed/fedavg/FedAvgAPI.py:13-75: rank 0 becomes
+the server (aggregator + server manager), rank k the client (trainer +
+client manager). ``run_simulated`` stands in for mpirun: it launches all
+ranks as threads over the loopback (or localhost-gRPC) backend — the
+reference's "fake cluster = many processes on one box" pattern (SURVEY.md
+§4.5) without processes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.core.client_data import FederatedData
+from fedml_tpu.core.local import Task
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+
+
+def init_server(dataset, task, cfg, size, backend, **kw):
+    aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
+    return FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+
+
+def init_client(dataset, task, cfg, rank, size, backend, **kw):
+    trainer = DistributedTrainer(rank, dataset, task, cfg)
+    return FedAvgClientManager(trainer, rank=rank, size=size, backend=backend, **kw)
+
+
+def FedML_FedAvg_distributed(
+    process_id: int,
+    worker_number: int,
+    dataset: FederatedData,
+    task: Task,
+    cfg: FedAvgConfig,
+    backend: str = "GRPC",
+    **backend_kw,
+):
+    """Launch this process's role and block until the job finishes.
+
+    Returns the manager (server manager exposes .aggregator.history/.net).
+    """
+    if process_id == 0:
+        mgr = init_server(dataset, task, cfg, worker_number, backend, **backend_kw)
+    else:
+        mgr = init_client(dataset, task, cfg, process_id, worker_number, backend, **backend_kw)
+    mgr.run()
+    return mgr
+
+
+def run_simulated(
+    dataset: FederatedData,
+    task: Task,
+    cfg: FedAvgConfig,
+    backend: str = "LOOPBACK",
+    job_id: str = "fedavg-sim",
+    base_port: int = 50000,
+) -> FedAvgAggregator:
+    """All ranks as threads on one host — the mpirun-on-localhost analogue."""
+    size = cfg.client_num_per_round + 1
+    kw = {"job_id": job_id} if backend.upper() == "LOOPBACK" else {"base_port": base_port}
+
+    aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    clients = [
+        init_client(dataset, task, cfg, rank, size, backend, **kw) for rank in range(1, size)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return aggregator

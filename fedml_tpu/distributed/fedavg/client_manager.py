@@ -1,0 +1,48 @@
+"""FedAvg client manager — local fit on command, upload to server.
+
+Mirror of fedml_api/distributed/fedavg/FedAvgClientManager.py: on INIT/SYNC,
+update model + assigned client index, run __train (:72-75), send model to
+rank 0 (:66-70).
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.comm.managers import ClientManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.distributed.fedavg.message_define import MyMessage
+from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+
+
+class FedAvgClientManager(ClientManager):
+    def __init__(self, trainer: DistributedTrainer, rank, size, backend="LOOPBACK", **kw):
+        self.trainer = trainer
+        self.round_idx = 0
+        super().__init__(rank, size, backend, **kw)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.handle_message_init
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.handle_message_receive_model
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, lambda _m: self.finish()
+        )
+
+    def handle_message_init(self, msg_params):
+        self.round_idx = 0
+        self._sync_and_train(msg_params)
+
+    def handle_message_receive_model(self, msg_params):
+        self.round_idx += 1
+        self._sync_and_train(msg_params)
+
+    def _sync_and_train(self, msg_params):
+        self.trainer.update_model(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS])
+        self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
+        wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        self.send_message(msg)

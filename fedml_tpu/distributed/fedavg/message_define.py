@@ -1,0 +1,18 @@
+"""FedAvg message vocabulary.
+
+Mirror of fedml_api/distributed/fedavg/message_define.py:6-11.
+"""
+
+
+class MyMessage:
+    # server -> client
+    MSG_TYPE_S2C_INIT_CONFIG = "s2c_init"
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = "s2c_sync"
+    MSG_TYPE_S2C_FINISH = "s2c_finish"
+    # client -> server
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = "c2s_send_model"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_ROUND = "round_idx"

@@ -1,0 +1,85 @@
+"""FedAvg server manager — round coordination over the comm layer.
+
+Mirror of fedml_api/distributed/fedavg/FedAvgServerManager.py: send_init_msg
+(:31-39), handle_message_receive_model_from_client (:45-82, aggregate when
+all received, eval, resample, sync), send_message_sync_model_to_client
+(:90-95). Adds a straggler watchdog (on_timeout) the reference lacks.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fedml_tpu.comm.managers import ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.message_define import MyMessage
+
+log = logging.getLogger("fedml_tpu.distributed.fedavg")
+
+
+class FedAvgServerManager(ServerManager):
+    def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0, backend="LOOPBACK", **kw):
+        self.aggregator = aggregator
+        self.round_num = aggregator.cfg.comm_round
+        self.round_idx = 0
+        if size - 1 != aggregator.cfg.client_num_per_round:
+            # one worker process per sampled client (FedAvgAPI.py:20-28
+            # launches client_num_per_round+1 ranks); a deficit would
+            # silently aggregate fewer clients than configured.
+            raise ValueError(
+                f"worker count {size - 1} != client_num_per_round="
+                f"{aggregator.cfg.client_num_per_round}"
+            )
+        super().__init__(rank, size, backend, **kw)
+
+    def run(self):
+        self.send_init_msg()
+        super().run()
+
+    def send_init_msg(self):
+        client_indexes = self.aggregator.client_sampling(self.round_idx)
+        global_params = self.aggregator.get_global_model_params()
+        for rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self.handle_message_receive_model_from_client,
+        )
+
+    def handle_message_receive_model_from_client(self, msg_params):
+        sender = msg_params[Message.MSG_ARG_KEY_SENDER]
+        self.aggregator.add_local_trained_result(
+            sender - 1,
+            msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS],
+            msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        global_params = self.aggregator.aggregate()
+        self.aggregator.test_on_server_for_all_clients(self.round_idx)
+
+        self.round_idx += 1
+        if self.round_idx == self.round_num:
+            for rank in range(1, self.size):
+                self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+            self.finish()
+            return
+        client_indexes = self.aggregator.client_sampling(self.round_idx)
+        for rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
+            self.send_message(msg)
+
+    def on_timeout(self, idle_s: float):
+        missing = [i + 1 for i, v in self.aggregator.flag_client_model_uploaded.items() if not v]
+        log.error(
+            "round %d stalled %.1fs: waiting on client ranks %s",
+            self.round_idx, idle_s, missing,
+        )

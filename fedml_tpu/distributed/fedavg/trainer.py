@@ -1,0 +1,59 @@
+"""Per-process client trainer: jitted local fit over this rank's client shard.
+
+Mirror of fedml_api/distributed/fedavg/FedAVGTrainer.py:6-40 +
+MyModelTrainer.py:19-49, with the epochs x batches torch loop replaced by the
+lax.scan local_update from fedml_tpu/core/local.py — the whole local fit is
+one compiled program, re-used every round (static shapes via pack_clients).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig, make_client_optimizer
+from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+from fedml_tpu.core.client_data import FederatedData, pack_clients
+from fedml_tpu.core.local import LocalSpec, Task, make_local_update
+
+
+class DistributedTrainer:
+    def __init__(self, client_rank: int, dataset: FederatedData, task: Task, cfg: FedAvgConfig):
+        self.dataset, self.task, self.cfg = dataset, task, cfg
+        self.client_index = client_rank - 1  # re-assigned per round by the server
+
+        counts = [len(v) for v in dataset.train_idx_map.values()]
+        b_needed = int(np.ceil(max(counts) / cfg.batch_size))
+        self.num_batches = min(cfg.max_batches or b_needed, b_needed)
+
+        spec = LocalSpec(optimizer=make_client_optimizer(cfg), epochs=cfg.epochs)
+        self.local_update = jax.jit(make_local_update(task, spec))
+
+        # template NetState for wire unpacking; derive the init key exactly
+        # like the SPMD engine (FedAvgAPI.__init__: split(PRNGKey(seed))[1])
+        # so distributed and standalone start from identical weights.
+        _, init_key = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        import jax.numpy as jnp
+
+        self.net = task.init(init_key, jnp.asarray(dataset.train_x[: cfg.batch_size]))
+
+    def update_model(self, wire_leaves) -> None:
+        self.net = unpack_pytree(self.net, wire_leaves)
+
+    def update_dataset(self, client_index: int) -> None:
+        self.client_index = int(client_index)
+
+    def train(self, round_idx: int):
+        """Run the local fit on the currently assigned client's data.
+
+        Returns (wire_leaves, local_sample_number).
+        """
+        cb = pack_clients(
+            self.dataset, [self.client_index], self.cfg.batch_size,
+            max_batches=self.num_batches, seed=self.cfg.seed, round_idx=round_idx,
+        )
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
+        rng = jax.random.fold_in(rng, self.client_index)
+        new_net, _metrics = self.local_update(rng, self.net, cb.x[0], cb.y[0], cb.mask[0])
+        self.net = new_net
+        return pack_pytree(new_net), int(cb.num_samples[0])

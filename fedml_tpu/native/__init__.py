@@ -66,7 +66,8 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int64),         # idx_concat
             ctypes.POINTER(ctypes.c_int64),         # idx_offsets
             ctypes.c_int64, ctypes.c_int64,         # K, capacity
-            ctypes.c_uint64, ctypes.c_int,          # seed, assume_zeroed
+            ctypes.POINTER(ctypes.c_uint64),        # per-client seeds [K]
+            ctypes.c_int,                           # assume_zeroed
             ctypes.c_char_p, ctypes.c_char_p,       # out_x, out_y
             ctypes.POINTER(ctypes.c_float),         # out_mask
             ctypes.POINTER(ctypes.c_float),         # out_num
@@ -85,11 +86,12 @@ def native_available() -> bool:
 
 def pack_clients_native(train_x: np.ndarray, train_y: np.ndarray,
                         idx_lists: list[np.ndarray], capacity: int,
-                        seed: int, n_threads: int = 0):
+                        seeds: np.ndarray, n_threads: int = 0):
     """C++ fast path of core.client_data.pack_clients' inner loop.
 
     Returns (x [K, capacity, ...], y [K, capacity, ...], mask [K, capacity],
-    num [K]) with rows shuffled per-client by splitmix64(seed, k).
+    num [K]) with client k's rows shuffled by splitmix64(seeds[k]); the
+    caller derives seeds from client IDs so packing is grouping-invariant.
     """
     lib = get_lib()
     if lib is None:
@@ -117,7 +119,9 @@ def pack_clients_native(train_x: np.ndarray, train_y: np.ndarray,
         y.ctypes.data_as(ctypes.c_char_p), y_row,
         idx_concat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        K, capacity, seed & 0xFFFFFFFFFFFFFFFF, 1,
+        K, capacity,
+        np.ascontiguousarray(seeds, np.uint64).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_uint64)), 1,
         out_x.ctypes.data_as(ctypes.c_char_p),
         out_y.ctypes.data_as(ctypes.c_char_p),
         out_mask.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),

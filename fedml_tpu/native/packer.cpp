@@ -13,8 +13,11 @@
 //   idx      concatenated client index lists; offsets[K+1] frames client k
 //   out_x    [K, B*bs, x_row_bytes]    out_y    [K, B*bs, y_row_bytes]
 //   out_mask [K, B*bs] float32         out_num  [K] float32
-// Each client's indices are shuffled with splitmix64(seed, k) Fisher-Yates,
-// truncated to B*bs, gathered, zero-padded. Returns 0 on success.
+// Each client's indices are shuffled with splitmix64(seeds[k]) Fisher-Yates
+// (seeds are derived from the CLIENT ID by the caller, so packing a client
+// alone or in a group yields the same rows — required for the
+// distributed ≡ standalone equivalence oracle), truncated to B*bs,
+// gathered, zero-padded. Returns 0 on success.
 
 #include <cstdint>
 #include <cstring>
@@ -73,7 +76,7 @@ int fedml_pack_clients(
     const char* x, int64_t x_row_bytes,
     const char* y, int64_t y_row_bytes,
     const int64_t* idx_concat, const int64_t* idx_offsets, int64_t K,
-    int64_t capacity, uint64_t seed, int assume_zeroed,
+    int64_t capacity, const uint64_t* seeds, int assume_zeroed,
     char* out_x, char* out_y, float* out_mask, float* out_num,
     int n_threads) {
   if (K <= 0 || capacity <= 0 || x_row_bytes <= 0 || y_row_bytes <= 0) return 1;
@@ -86,8 +89,7 @@ int fedml_pack_clients(
     for (int64_t k = k0; k < k1; ++k) {
       const int64_t* idx = idx_concat + idx_offsets[k];
       int64_t n_idx = idx_offsets[k + 1] - idx_offsets[k];
-      uint64_t s = seed * 0x9E3779B97F4A7C15ULL + static_cast<uint64_t>(k) + 1;
-      pack_one_client(x, x_row_bytes, y, y_row_bytes, idx, n_idx, capacity, s,
+      pack_one_client(x, x_row_bytes, y, y_row_bytes, idx, n_idx, capacity, seeds[k],
                       assume_zeroed,
                       out_x + k * capacity * x_row_bytes,
                       out_y + k * capacity * y_row_bytes,
