@@ -37,6 +37,10 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed)
             fd = _load_cifar_pickle(data_dir, spec, n_clients, partition_method or "hetero", partition_alpha, seed)
             if fd is not None:
                 return fd
+        if name in ("gld23k", "gld160k"):
+            fd = _load_landmarks_csv(data_dir, spec, n_clients)
+            if fd is not None:
+                return fd
     except Exception:
         return None
     return None
@@ -109,6 +113,71 @@ def _load_tff_h5(data_dir, spec, n_clients):
         TX = TX[..., None]
     return FederatedData(X, np.concatenate(tr_y), TX, np.concatenate(te_y),
                          idx_map, te_map, spec.num_classes)
+
+
+def _load_landmarks_csv(data_dir, spec, n_clients, image_size=(64, 64)):
+    """Google Landmarks federated split (gld23k/gld160k).
+
+    Mirror of fedml_api/data_preprocessing/Landmarks/data_loader.py: a csv
+    maps (user_id, image_id, class); images live under ``images/``. Images
+    are decoded with PIL (gated) and resized to a fixed size; users become
+    clients in csv order. Returns None when csv or images are absent.
+    """
+    csvs = sorted(glob.glob(os.path.join(data_dir, "*train*.csv")))
+    img_dir = os.path.join(data_dir, "images")
+    if not csvs or not os.path.isdir(img_dir):
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    import csv as _csv
+
+    per_user: dict[str, list] = {}
+    with open(csvs[0]) as f:
+        for row in _csv.DictReader(f):
+            per_user.setdefault(row["user_id"], []).append(
+                (row["image_id"], int(row["class"]))
+            )
+
+    xs, ys, idx_map, off = [], [], {}, 0
+    for k, (_uid, items) in enumerate(sorted(per_user.items())[:n_clients]):
+        cnt = 0
+        for image_id, cls in items:
+            path = os.path.join(img_dir, f"{image_id}.jpg")
+            if not os.path.exists(path):
+                continue
+            with Image.open(path) as im:
+                arr = np.asarray(
+                    im.convert("RGB").resize(image_size), np.float32
+                ) / 255.0
+            xs.append(arr)
+            ys.append(cls)
+            cnt += 1
+        if cnt:
+            idx_map[k] = np.arange(off, off + cnt)
+            off += cnt
+    if not xs:
+        return None
+    X = np.stack(xs)
+    Y = np.asarray(ys, np.int64)
+
+    test_csvs = sorted(glob.glob(os.path.join(data_dir, "*test*.csv")))
+    TX, TY = X[:256], Y[:256]
+    if test_csvs:
+        txs, tys = [], []
+        with open(test_csvs[0]) as f:
+            for row in _csv.DictReader(f):
+                path = os.path.join(img_dir, f"{row['image_id']}.jpg")
+                if not os.path.exists(path):
+                    continue
+                with Image.open(path) as im:
+                    txs.append(np.asarray(
+                        im.convert("RGB").resize(image_size), np.float32) / 255.0)
+                tys.append(int(row["class"]))
+        if txs:
+            TX, TY = np.stack(txs), np.asarray(tys, np.int64)
+    return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
 
 
 def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed):

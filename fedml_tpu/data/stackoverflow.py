@@ -1,0 +1,83 @@
+"""StackOverflow vocabulary + encoding utilities (NWP and tag-LR tasks).
+
+Mirror of fedml_api/data_preprocessing/stackoverflow_nwp/ and
+stackoverflow_lr/ vocab utils: the NWP task uses the 10,000 most frequent
+words plus 4 special ids (pad=0, then vocab, then bos/eos/oov), giving the
+10004-way output of RNN_StackOverFlow (model/nlp/rnn.py:39-70); the LR task
+uses the top-500 tags and top-10,000 words as a bag-of-words multi-label
+problem.
+
+File-format note: the TFF h5 stores per-client token strings; when the real
+h5 is absent, the registry's synthetic sequence fallback is used and these
+utilities still define the id space.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+DEFAULT_WORD_VOCAB_SIZE = 10000
+DEFAULT_TAG_VOCAB_SIZE = 500
+PAD, BOS, EOS, OOV = "<pad>", "<bos>", "<eos>", "<oov>"
+
+
+def build_word_vocab(word_counts: dict[str, int], vocab_size: int = DEFAULT_WORD_VOCAB_SIZE):
+    """Top-``vocab_size`` words by count -> id. Ids: pad=0, words 1..V,
+    bos=V+1, eos=V+2, oov=V+3 (the reference's 10004 = 10000+4 layout)."""
+    most = sorted(word_counts.items(), key=lambda kv: (-kv[1], kv[0]))[:vocab_size]
+    vocab = {PAD: 0}
+    for i, (w, _) in enumerate(most):
+        vocab[w] = i + 1
+    vocab[BOS] = vocab_size + 1
+    vocab[EOS] = vocab_size + 2
+    vocab[OOV] = vocab_size + 3
+    return vocab
+
+
+def build_tag_vocab(tag_counts: dict[str, int], vocab_size: int = DEFAULT_TAG_VOCAB_SIZE):
+    most = sorted(tag_counts.items(), key=lambda kv: (-kv[1], kv[0]))[:vocab_size]
+    return {t: i for i, (t, _) in enumerate(most)}
+
+
+def encode_nwp(sentence: str, vocab: dict[str, int], seq_len: int = 20) -> np.ndarray:
+    """bos + tokens + eos, truncated/padded to seq_len+1 ids (x = ids[:-1],
+    y = ids[1:] is the next-word-prediction frame)."""
+    V = len(vocab) - 4
+    oov = vocab[OOV]
+    ids = [vocab[BOS]] + [vocab.get(w, oov) for w in sentence.split()] + [vocab[EOS]]
+    ids = ids[: seq_len + 1]
+    ids += [vocab[PAD]] * (seq_len + 1 - len(ids))
+    return np.asarray(ids, np.int32)
+
+
+def encode_tags(tags: str, tag_vocab: dict[str, int]) -> np.ndarray:
+    """'|'-separated tag string -> multi-hot [num_tags] float32."""
+    out = np.zeros((len(tag_vocab),), np.float32)
+    for t in tags.split("|"):
+        i = tag_vocab.get(t)
+        if i is not None:
+            out[i] = 1.0
+    return out
+
+
+def encode_bow(sentence: str, vocab: dict[str, int]) -> np.ndarray:
+    """Normalized bag-of-words over the word vocab (the LR task's input)."""
+    out = np.zeros((len(vocab),), np.float32)
+    words = sentence.split()
+    oov = vocab[OOV]
+    for w in words:
+        out[vocab.get(w, oov)] += 1.0
+    if words:
+        out /= len(words)
+    return out
+
+
+def word_counts_from_clients(client_sentences: dict[int, list[str]]):
+    """Aggregate corpus counts (the h5 preprocessing step)."""
+    counts: collections.Counter = collections.Counter()
+    for sents in client_sentences.values():
+        for s in sents:
+            counts.update(s.split())
+    return dict(counts)

@@ -1,0 +1,130 @@
+"""Vertical-FL tabular datasets — NUS-WIDE, Lending Club, UCI.
+
+Mirror of the reference's vertical-FL data layer (SURVEY.md §2.5):
+fedml_api/data_preprocessing/NUS_WIDE/nus_wide_dataset.py (634 low-level
+image features for one party + 1000 tag features for the other, binary
+two-class selection), lending_club_loan/ (loan table split by feature
+columns), and UCI/ (susy et al.). Each loader returns the party-sliced
+arrays the VFL engine consumes:
+
+    (x_guest [N, d_guest], x_hosts [H, N, d_host], y [N])
+
+Real files are read when present under ``data_dir`` (csv with a label
+column); otherwise a deterministic synthetic table with the same shapes is
+generated, so every algorithm/test path runs without downloads (the repo-wide
+data-fallback convention of fedml_tpu/data/registry.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class VerticalSpec:
+    name: str
+    guest_dim: int
+    host_dims: tuple  # one entry per host party
+    num_classes: int
+    num_samples: int  # synthetic fallback size
+    label_col: str    # csv label column for the real reader
+
+
+VERTICAL_DATASETS: dict[str, VerticalSpec] = {
+    # NUS-WIDE: guest = 1000-d tag features, host = 634-d low-level image
+    # features (nus_wide_dataset.py two-party split)
+    "nus_wide": VerticalSpec("nus_wide", 1000, (634,), 2, 4000, "label"),
+    # Lending Club loan table: features split between the loan platform
+    # (guest, holds default label) and a partner bank (host)
+    "lending_club": VerticalSpec("lending_club", 48, (24,), 2, 6000, "loan_status"),
+    # UCI SUSY: 18 kinematic features split 10/8, binary signal/background
+    "uci_susy": VerticalSpec("uci_susy", 10, (8,), 2, 8000, "label"),
+}
+
+
+def _synthetic_vertical(spec: VerticalSpec, seed: int):
+    """Linearly-separable-ish table: y from a random hyperplane over the
+    CONCATENATED features, so neither party alone is sufficient — the VFL
+    training signal requires the cross-party sum, like the real datasets."""
+    rng = np.random.RandomState(seed * 131 + 7)
+    n = spec.num_samples
+    xg = rng.randn(n, spec.guest_dim).astype(np.float32)
+    xh = np.stack(
+        [rng.randn(n, d).astype(np.float32) for d in spec.host_dims]
+    )
+    wg = rng.randn(spec.guest_dim) / np.sqrt(spec.guest_dim)
+    whs = [rng.randn(d) / np.sqrt(d) for d in spec.host_dims]
+    score = xg @ wg + sum(xh[h] @ w for h, w in enumerate(whs))
+    if spec.num_classes == 2:
+        y = (score > np.median(score)).astype(np.int64)
+    else:
+        qs = np.quantile(score, np.linspace(0, 1, spec.num_classes + 1)[1:-1])
+        y = np.digitize(score, qs).astype(np.int64)
+    return xg, xh, y
+
+
+def _read_csv_vertical(path: str, spec: VerticalSpec):
+    """Real reader: one csv, label column by name, features split
+    guest-first then host parties in column order (the reference fixes the
+    split by column index the same way)."""
+    import csv
+
+    with open(path) as f:
+        reader = csv.reader(f)
+        header = next(reader)
+        rows = [r for r in reader if r]
+    li = header.index(spec.label_col)
+    feat_cols = [i for i in range(len(header)) if i != li]
+    need = spec.guest_dim + sum(spec.host_dims)
+    if len(feat_cols) < need:
+        raise ValueError(
+            f"{spec.name}: csv has {len(feat_cols)} feature cols, need {need}"
+        )
+    mat = np.array([[float(r[i]) for i in feat_cols[:need]] for r in rows], np.float32)
+    raw_y = [r[li] for r in rows]
+    try:
+        y = np.array([int(float(v)) for v in raw_y], np.int64)
+    except ValueError:  # categorical labels
+        uniq = {v: i for i, v in enumerate(sorted(set(raw_y)))}
+        y = np.array([uniq[v] for v in raw_y], np.int64)
+
+    xg = mat[:, : spec.guest_dim]
+    hosts, off = [], spec.guest_dim
+    for d in spec.host_dims:
+        hosts.append(mat[:, off : off + d])
+        off += d
+    # hosts may have unequal dims; VFLAPI stacks equal-dim hosts — pad to max
+    dmax = max(spec.host_dims)
+    xh = np.zeros((len(spec.host_dims), len(rows), dmax), np.float32)
+    for h, hm in enumerate(hosts):
+        xh[h, :, : hm.shape[1]] = hm
+    return xg, xh, y
+
+
+def load_vertical(name: str, data_dir: str | None = None, seed: int = 0):
+    """Load a vertical-FL dataset: real csv if ``data_dir/<name>.csv``
+    exists, synthetic fallback otherwise.
+
+    Returns (x_guest, x_hosts, y, spec).
+    """
+    spec = VERTICAL_DATASETS[name]
+    if data_dir:
+        path = os.path.join(data_dir, f"{name}.csv")
+        if os.path.exists(path):
+            xg, xh, y = _read_csv_vertical(path, spec)
+            return xg, xh, y, spec
+    xg, xh, y = _synthetic_vertical(spec, seed)
+    return xg, xh, y, spec
+
+
+def train_test_split_vertical(xg, xh, y, test_frac: float = 0.2, seed: int = 0):
+    """Aligned split across every party (vertical FL requires row alignment)."""
+    n = len(y)
+    rng = np.random.RandomState(seed * 17 + 3)
+    perm = rng.permutation(n)
+    cut = int(n * (1 - test_frac))
+    tr, te = perm[:cut], perm[cut:]
+    return (xg[tr], xh[:, tr], y[tr]), (xg[te], xh[:, te], y[te])

@@ -26,6 +26,10 @@ def create_model(model_name: str, output_dim: int = 10, **kwargs):
 
         depth = 56 if name == "resnet56" else 110
         return ResNetCIFAR(depth=depth, num_classes=output_dim)
+    if name in ("resnet_wo_bn", "resnet56_wo_bn"):
+        from fedml_tpu.models.resnet import ResNetCIFAR
+
+        return ResNetCIFAR(depth=56, num_classes=output_dim, norm_type="none")
     if name == "resnet18_gn":
         from fedml_tpu.models.resnet_gn import ResNet18GN
 

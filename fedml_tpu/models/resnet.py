@@ -5,8 +5,12 @@ with BatchNorm. TPU notes: NHWC layout, bfloat16-friendly conv widths
 (16/32/64 channels), BatchNorm running stats live in the 'batch_stats'
 collection and are federated-averaged with the params (the reference
 averages the full state_dict including BN buffers, FedAVGAggregator.py:72-80).
-``norm='group'`` swaps in GroupNorm — BN-free variant for non-IID robustness
-(the reference ships resnet_wo_bn.py for the same reason).
+``norm='group'`` swaps in GroupNorm — BN-free variant for non-IID robustness.
+``norm='none'`` is the normalization-FREE ResNet (reference
+fedml_api/model/cv/resnet_wo_bn.py, used in robust-FL experiments where BN
+buffers poison the average): Fixup-style blocks — zero-init on each residual
+branch's last conv plus learned scalar scale/bias — keep it trainable
+without any norm layer, and aggregation touches only true parameters.
 """
 
 from __future__ import annotations
@@ -55,17 +59,22 @@ class ResNetCIFAR(nn.Module):
         else:
             norm = partial(_GN, num_groups=8)
 
-        y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
-        y = norm(use_running_average=not train)(y) if self.norm_type == "batch" \
-            else norm()(y)
+        y = nn.Conv(16, (3, 3), padding="SAME",
+                    use_bias=(self.norm_type == "none"))(x)
+        if self.norm_type == "batch":
+            y = norm(use_running_average=not train)(y)
+        elif self.norm_type == "group":
+            y = norm()(y)
         y = nn.relu(y)
         for stage, (filters, stride) in enumerate([(16, 1), (32, 2), (64, 2)]):
             for i in range(n):
                 s = (stride, stride) if i == 0 else (1, 1)
                 if self.norm_type == "batch":
                     y = BasicBlock(filters, s, norm)(y, train)
-                else:
+                elif self.norm_type == "group":
                     y = _GNBasicBlock(filters, s)(y, train)
+                else:
+                    y = _FixupBasicBlock(filters, s)(y, train)
         y = jnp.mean(y, axis=(1, 2))  # global average pool
         return nn.Dense(self.num_classes)(y)
 
@@ -78,6 +87,33 @@ class _GN(nn.Module):
     @nn.compact
     def __call__(self, x, use_running_average: bool = True):
         return nn.GroupNorm(num_groups=min(self.num_groups, x.shape[-1]))(x)
+
+
+class _FixupBasicBlock(nn.Module):
+    """Norm-free basic block (resnet_wo_bn parity): residual branch is
+    conv-relu-conv with the second conv zero-initialized and a learned
+    scalar scale + bias, so the block starts as identity and training stays
+    stable without normalization."""
+
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        b1 = self.param("bias1", nn.initializers.zeros, (1,))
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME",
+                    use_bias=True)(x + b1)
+        y = nn.relu(y)
+        b2 = self.param("bias2", nn.initializers.zeros, (1,))
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=True,
+                    kernel_init=nn.initializers.zeros)(y + b2)
+        scale = self.param("scale", nn.initializers.ones, (1,))
+        y = y * scale
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), self.strides,
+                               use_bias=True)(residual)
+        return nn.relu(y + residual)
 
 
 class _GNBasicBlock(nn.Module):

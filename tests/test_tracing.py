@@ -1,0 +1,51 @@
+"""Tracing subsystem: span stats + engine integration."""
+
+import time
+
+from fedml_tpu.utils.tracing import RoundTracer, annotate
+
+
+def test_round_tracer_spans_and_summary():
+    tr = RoundTracer()
+    for _ in range(3):
+        with tr.span("pack"):
+            time.sleep(0.002)
+        with tr.span("round"):
+            time.sleep(0.004)
+        tr.next_round()
+    s = tr.summary()
+    assert s["pack"]["count"] == 3 and s["round"]["count"] == 3
+    assert s["round"]["mean"] >= s["pack"]["mean"]
+    assert s["pack"]["total"] >= 0.006
+
+
+def test_span_accumulates_within_round():
+    tr = RoundTracer()
+    with tr.span("x"):
+        pass
+    with tr.span("x"):
+        pass
+    assert tr.summary()["x"]["count"] == 1  # same round -> one accumulated entry
+
+
+def test_annotate_noop_outside_trace():
+    with annotate("region"):
+        pass  # must not raise without an active profiler
+
+
+def test_engine_populates_tracer():
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=4, image_shape=(8, 8, 1), num_classes=3,
+                            samples_per_client=12, test_samples=30, seed=0)
+    api = FedAvgAPI(data, classification_task(LogisticRegression(num_classes=3)),
+                    FedAvgConfig(comm_round=2, client_num_in_total=4,
+                                 client_num_per_round=2, batch_size=6,
+                                 frequency_of_the_test=1))
+    api.train()
+    s = api.tracer.summary()
+    assert s["pack"]["count"] == 2 and s["round"]["count"] == 2
+    assert "eval" in s
