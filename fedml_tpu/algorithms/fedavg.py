@@ -35,13 +35,35 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.core.client_data import ClientBatch, FederatedData, batch_global, pack_clients
+from fedml_tpu.core.client_data import (
+    ClientBatch,
+    FederatedData,
+    IndexBatch,
+    batch_global,
+    pack_client_indices,
+    pack_clients,
+)
 from fedml_tpu.core.local import LocalSpec, NetState, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.utils.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
 
 log = logging.getLogger("fedml_tpu.fedavg")
+
+
+def _gather_rows(dev_x, dev_y, idx, mask):
+    """Row gather for the device-resident data plane (single-device and
+    per-shard SPMD paths share this). Padded slots (mask==0) carry idx 0, so
+    gathered garbage rows are zeroed to match the host packer's zero padding
+    bit-for-bit — models with mutable batch_stats (BatchNorm ignores the
+    loss mask) see identical statistics on both planes."""
+    shp = idx.shape
+    flat = idx.reshape(-1)
+    x = jnp.take(dev_x, flat, axis=0).reshape(shp + dev_x.shape[1:])
+    y = jnp.take(dev_y, flat, axis=0).reshape(shp + dev_y.shape[1:])
+    mx = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)) > 0
+    my = mask.reshape(mask.shape + (1,) * (y.ndim - mask.ndim)) > 0
+    return jnp.where(mx, x, jnp.zeros_like(x)), jnp.where(my, y, jnp.zeros_like(y))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -97,12 +119,23 @@ class FedAvgAPI:
         client_result_hook: Callable | None = None,
         post_aggregate_hook: Callable | None = None,
         local_spec: LocalSpec | None = None,
+        device_data: bool = False,
     ):
         self.data = dataset
         self.task = task
         self.cfg = config
         self.mesh = mesh
         self.rng = jax.random.PRNGKey(config.seed)
+
+        # device-resident data plane: park the whole train set in HBM once;
+        # each round ships only an IndexBatch (KBs) and the row gather runs
+        # on device. Batches are bit-identical to the host packer's.
+        self.device_data = device_data
+        if device_data:
+            sh = NamedSharding(mesh, P()) if mesh is not None else None
+            put = (lambda a: jax.device_put(a, sh)) if sh else jax.device_put
+            self._dev_x = put(dataset.train_x)
+            self._dev_y = put(dataset.train_y)
 
         # static per-client batch budget: fixed across rounds so the round
         # program compiles once (see SURVEY.md §7 "hard parts" (1))
@@ -158,17 +191,36 @@ class FedAvgAPI:
         agg_metrics = {k: jnp.sum(v) for k, v in metrics.items()}
         return new_net, new_opt, agg_metrics
 
+    def _materialize(self, batch):
+        """(x, y, mask, nsamp) from either data plane. IndexBatch -> on-device
+        row gather from the HBM-resident train set (device_data mode);
+        ClientBatch passes through."""
+        if isinstance(batch, IndexBatch):
+            x, y = _gather_rows(self._dev_x, self._dev_y, batch.idx, batch.mask)
+            return x, y, batch.mask, batch.num_samples
+        return batch.x, batch.y, batch.mask, batch.num_samples
+
     def _build_round_fn(self):
         cfg = self.cfg
+
+        seed = cfg.seed
+
+        def client_keys(round_idx, ids):
+            # inside jit: no per-round host dispatch for key derivation; same
+            # fold_in(fold_in(PRNGKey(seed), round), client_id) chain as the
+            # cross-process DistributedTrainer (distributed/fedavg/trainer.py)
+            base = jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
+            return jax.vmap(lambda i: jax.random.fold_in(base, i))(ids)
 
         if self.mesh is None:
 
             @jax.jit
-            def round_fn(rng, net, server_opt_state, batch: ClientBatch, keys):
+            def round_fn(rng, net, server_opt_state, batch, round_idx, ids):
+                x, y, mask, nsamp_in = self._materialize(batch)
+                keys = client_keys(round_idx, ids)
                 rng, kh, kp = jax.random.split(rng, 3)
                 nets, metrics, nsamp = self._round_body(
-                    keys, net, server_opt_state, batch.x, batch.y, batch.mask,
-                    batch.num_samples, kh,
+                    keys, net, server_opt_state, x, y, mask, nsamp_in, kh,
                 )
                 new_net, new_opt, m = self._aggregate_and_update(
                     net, server_opt_state, nets, metrics, nsamp, kp
@@ -214,12 +266,33 @@ class FedAvgAPI:
             out_specs=(P(), P()),
         )
 
+        def shard_body_devdata(keys, net, dev_x, dev_y, idx, mask, nsamp, hook_key):
+            # device-resident plane under SPMD: the train set is replicated,
+            # the index block is sharded -> each device gathers its own
+            # clients' rows locally (no collective)
+            x, y = _gather_rows(dev_x, dev_y, idx, mask)
+            return shard_body(keys, net, x, y, mask, nsamp, hook_key)
+
+        smapped_dd = jax.shard_map(
+            shard_body_devdata,
+            mesh=mesh,
+            in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P(axis), P()),
+            out_specs=(P(), P()),
+        )
+
         @jax.jit
-        def round_fn(rng, net, server_opt_state, batch: ClientBatch, keys):
+        def round_fn(rng, net, server_opt_state, batch, round_idx, ids):
+            keys = client_keys(round_idx, ids)
             rng, kh, kp = jax.random.split(rng, 3)
-            avg, metrics = smapped(
-                keys, net, batch.x, batch.y, batch.mask, batch.num_samples, kh
-            )
+            if isinstance(batch, IndexBatch):
+                avg, metrics = smapped_dd(
+                    keys, net, self._dev_x, self._dev_y,
+                    batch.idx, batch.mask, batch.num_samples, kh,
+                )
+            else:
+                avg, metrics = smapped(
+                    keys, net, batch.x, batch.y, batch.mask, batch.num_samples, kh
+                )
             new_net, new_opt = self.server_update(net, avg, server_opt_state)
             if self.post_aggregate_hook is not None:
                 new_net = self.post_aggregate_hook(new_net, kp)
@@ -228,18 +301,40 @@ class FedAvgAPI:
         return round_fn
 
     # ------------------------------------------------------------------ data
-    def _client_keys(self, round_idx: int, ids) -> jax.Array:
-        """Per-client local-fit keys: fold_in(fold_in(PRNGKey(seed), round),
-        client_id). Grouping-invariant like the pack_clients shuffle, so the
-        cross-process runtime (fedml_tpu/distributed — one client per rank)
-        derives the identical key and the distributed == standalone oracle
-        holds even for rng-using tasks (dropout, augmentation)."""
-        base = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
-        return jax.vmap(lambda i: jax.random.fold_in(base, i))(jnp.asarray(ids))
+    def _pack_round_host(self, round_idx: int) -> ClientBatch:
+        """Always the dense host-packed ClientBatch, regardless of
+        device_data — for engines that consume .x/.y directly (FedDF's
+        distillation batches, TurboAggregate's share encoding, affinity)."""
+        was = self.device_data
+        try:
+            self.device_data = False
+            return self._pack_round(round_idx)
+        finally:
+            self.device_data = was
 
-    def _pack_round(self, round_idx: int) -> ClientBatch:
+    def _pack_round(self, round_idx: int):
         cfg = self.cfg
         ids = self._sampled_ids(round_idx)
+        if self.device_data:
+            ib = pack_client_indices(
+                self.data, ids, cfg.batch_size, max_batches=self.num_batches,
+                seed=cfg.seed, round_idx=round_idx,
+            )
+            if ib.idx.shape[1] < self.num_batches:
+                pad = self.num_batches - ib.idx.shape[1]
+                K, _, bs = ib.idx.shape
+                ib = IndexBatch(
+                    idx=np.concatenate([ib.idx, np.zeros((K, pad, bs), ib.idx.dtype)], 1),
+                    mask=np.concatenate([ib.mask, np.zeros((K, pad, bs), ib.mask.dtype)], 1),
+                    num_samples=ib.num_samples,
+                )
+            if self.mesh is not None:
+                sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+                ib = IndexBatch(
+                    idx=jax.device_put(ib.idx, sh), mask=jax.device_put(ib.mask, sh),
+                    num_samples=jax.device_put(ib.num_samples, sh),
+                )
+            return ib
         cb = pack_clients(
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,
@@ -273,15 +368,11 @@ class FedAvgAPI:
         with self.tracer.span("pack"):
             ids = self._sampled_ids(round_idx)
             cb = self._pack_round(round_idx)
-            keys = self._client_keys(round_idx, ids)
-            if self.mesh is not None:
-                keys = jax.device_put(
-                    keys, NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
-                )
         with self.tracer.span("round"):
             self.rng, rk = jax.random.split(self.rng)
             self.net, self.server_opt_state, metrics = self.round_fn(
-                rk, self.net, self.server_opt_state, cb, keys
+                rk, self.net, self.server_opt_state, cb,
+                jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
             )
         return metrics
 

@@ -54,7 +54,7 @@ class FedAvgAffinityAPI(FedAvgAPI):
         return affinity
 
     def run_round(self, round_idx: int):
-        cb = self._pack_round(round_idx)
+        cb = self._pack_round_host(round_idx)
         self.rng, rk = jax.random.split(self.rng)
         nets, metrics = self._local_batch(
             rk, self.net, jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask))

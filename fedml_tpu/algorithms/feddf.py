@@ -127,7 +127,7 @@ class FedDFAPI(FedAvgAPI):
         return jnp.asarray(self.public_x[sel])
 
     def run_round(self, round_idx: int):
-        cb = self._pack_round(round_idx)
+        cb = self._pack_round_host(round_idx)
         self.rng, rk = jax.random.split(self.rng)
         nets, metrics = self._local_batch(
             rk, self.net, jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)

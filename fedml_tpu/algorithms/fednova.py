@@ -82,6 +82,7 @@ class FedNovaAPI(FedAvgAPI):
         ids = self._sampled_ids(round_idx)
         self.rng, rk = jax.random.split(self.rng)
         self.net, self.server_opt_state, metrics = self.round_fn(
-            rk, self.net, self.server_opt_state, cb, self._client_keys(round_idx, ids)
+            rk, self.net, self.server_opt_state, cb,
+            jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
         )
         return metrics

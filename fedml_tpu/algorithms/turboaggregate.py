@@ -59,7 +59,7 @@ class TurboAggregateAPI(FedAvgAPI):
         return run
 
     def run_round(self, round_idx: int):
-        cb = self._pack_round(round_idx)
+        cb = self._pack_round_host(round_idx)
         self.rng, rk, sk = jax.random.split(self.rng, 3)
         nets, metrics = self._local_batch(rk, self.net,
                                           jnp.asarray(cb.x), jnp.asarray(cb.y),

@@ -116,6 +116,24 @@ def _splitmix_shuffle(idx: np.ndarray, seed: int) -> None:
     idx[:] = lst
 
 
+def client_shuffle_seeds(client_ids, seed: int, round_idx: int) -> np.ndarray:
+    """Per-client shuffle seeds keyed by (seed, round, CLIENT ID) — the ONE
+    definition of the grouping-invariance chain shared by pack_clients,
+    pack_client_indices, and (via the seeds argument) the native packer."""
+    base = (seed * 7_919 + round_idx + 1) & _U64
+    return np.array(
+        [(base * 0x9E3779B97F4A7C15 + int(c) + 1) & _U64 for c in client_ids],
+        dtype=np.uint64,
+    )
+
+
+def _shuffled_client_rows(data: "FederatedData", cid: int, cseed: int, cap: int):
+    """Client cid's row indices for this round: splitmix shuffle, truncate."""
+    idx = np.array(data.train_idx_map[int(cid)])
+    _splitmix_shuffle(idx, int(cseed))
+    return idx[:cap]
+
+
 def pack_clients(
     data: FederatedData,
     client_ids: np.ndarray,
@@ -147,11 +165,7 @@ def pack_clients(
     B = b_needed if max_batches is None else min(max_batches, b_needed)
     K = len(client_ids)
     bs = batch_size
-    base = (seed * 7_919 + round_idx + 1) & _U64
-    seeds = np.array(
-        [(base * 0x9E3779B97F4A7C15 + int(c) + 1) & _U64 for c in client_ids],
-        dtype=np.uint64,
-    )
+    seeds = client_shuffle_seeds(client_ids, seed, round_idx)
 
     if use_native is not False:
         from fedml_tpu import native
@@ -178,9 +192,7 @@ def pack_clients(
     num = np.zeros((K,), dtype=np.float32)
 
     for k, cid in enumerate(client_ids):
-        idx = np.array(data.train_idx_map[int(cid)])
-        _splitmix_shuffle(idx, int(seeds[k]))
-        idx = idx[: B * bs]
+        idx = _shuffled_client_rows(data, cid, seeds[k], B * bs)
         n = len(idx)
         num[k] = n
         flat_x = data.train_x[idx]
@@ -189,6 +201,52 @@ def pack_clients(
         y[k].reshape(B * bs, *yshape)[:n] = flat_y
         mask[k].reshape(B * bs)[:n] = 1.0
     return ClientBatch(x=x, y=y, mask=mask, num_samples=num)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class IndexBatch:
+    """Device-resident data plane: one round's client sample INDICES.
+
+    Instead of gathering/copying sample rows on the host and DMA-ing a dense
+    [K, B, bs, ...] block every round (pack_clients), the full train set
+    lives in HBM once and a round ships only this index block (~KBs); the
+    row gather happens inside the jitted round program, where HBM bandwidth
+    dwarfs the host link. Same per-client-id splitmix shuffle as
+    pack_clients, so the two data planes produce identical batches.
+    """
+
+    idx: Any          # [K, B, bs] int32 into train_x/train_y; 0 where padded
+    mask: Any         # [K, B, bs] float32
+    num_samples: Any  # [K] float32
+
+
+def pack_client_indices(
+    data: FederatedData,
+    client_ids: np.ndarray,
+    batch_size: int,
+    max_batches: int | None = None,
+    seed: int = 0,
+    round_idx: int = 0,
+) -> IndexBatch:
+    """Index-only variant of pack_clients (same shuffle, same layout)."""
+    counts = [len(data.train_idx_map[int(c)]) for c in client_ids]
+    b_needed = max(int(np.ceil(n / batch_size)) for n in counts)
+    B = b_needed if max_batches is None else min(max_batches, b_needed)
+    K, bs = len(client_ids), batch_size
+    seeds = client_shuffle_seeds(client_ids, seed, round_idx)
+    idx_out = np.zeros((K, B * bs), np.int32)
+    mask = np.zeros((K, B * bs), np.float32)
+    num = np.zeros((K,), np.float32)
+    for k, cid in enumerate(client_ids):
+        idx = _shuffled_client_rows(data, cid, seeds[k], B * bs)
+        n = len(idx)
+        idx_out[k, :n] = idx
+        mask[k, :n] = 1.0
+        num[k] = n
+    return IndexBatch(
+        idx=idx_out.reshape(K, B, bs), mask=mask.reshape(K, B, bs), num_samples=num
+    )
 
 
 def batch_global(x: np.ndarray, y: np.ndarray, batch_size: int):

@@ -50,15 +50,28 @@ def _apply_eval(module, params, extra, x):
     return module.apply({"params": params, **extra}, x, train=False)
 
 
+def _as_float_image(x):
+    """Integer pixel blocks (the uint8 fast transfer path — see
+    fedml_tpu/data/registry.py uint8_pixels) normalize to f32/255 ON DEVICE;
+    float inputs pass through untouched. Trace-time dtype check, zero cost
+    under jit."""
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+        return jnp.asarray(x, jnp.float32) / 255.0
+    return x
+
+
 def classification_task(module) -> Task:
     """Softmax cross-entropy over integer labels."""
 
     def init(rng, x_sample):
         p_rng, d_rng = jax.random.split(rng)
-        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        variables = module.init(
+            {"params": p_rng, "dropout": d_rng}, _as_float_image(x_sample), train=False
+        )
         return _split_variables(variables)
 
     def loss(params, extra, x, y, mask, rng, train):
+        x = _as_float_image(x)
         if train:
             logits, new_extra = _apply_train(module, params, extra, x, rng)
         else:
@@ -71,10 +84,10 @@ def classification_task(module) -> Task:
         return l, new_extra, metrics
 
     def predict(params, extra, x):
-        return _apply_eval(module, params, extra, x)
+        return _apply_eval(module, params, extra, _as_float_image(x))
 
     def eval_batch(params, extra, x, y, mask):
-        logits = _apply_eval(module, params, extra, x)
+        logits = _apply_eval(module, params, extra, _as_float_image(x))
         per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         return {
             "loss_sum": jnp.sum(per_ex * mask),
@@ -151,7 +164,9 @@ def segmentation_task(
 
     def init(rng, x_sample):
         p_rng, d_rng = jax.random.split(rng)
-        variables = module.init({"params": p_rng, "dropout": d_rng}, x_sample, train=False)
+        variables = module.init(
+            {"params": p_rng, "dropout": d_rng}, _as_float_image(x_sample), train=False
+        )
         return _split_variables(variables)
 
     def _pixel_metrics(logits, y, mask):
@@ -165,6 +180,7 @@ def segmentation_task(
         return per_px, valid, correct
 
     def loss(params, extra, x, y, mask, rng, train):
+        x = _as_float_image(x)
         if train:
             logits, new_extra = _apply_train(module, params, extra, x, rng)
         else:
@@ -176,10 +192,10 @@ def segmentation_task(
         return l, new_extra, metrics
 
     def predict(params, extra, x):
-        return _apply_eval(module, params, extra, x)
+        return _apply_eval(module, params, extra, _as_float_image(x))
 
     def eval_batch(params, extra, x, y, mask):
-        logits = _apply_eval(module, params, extra, x)
+        logits = _apply_eval(module, params, extra, _as_float_image(x))
         per_px, valid, correct = _pixel_metrics(logits, y, mask)
         return {"loss_sum": jnp.sum(per_px * valid), "correct": correct, "count": jnp.sum(valid)}
 

@@ -65,6 +65,7 @@ def synthetic_images(
     partition_alpha: float = 0.5,
     seed: int = 0,
     size_lognormal: bool = True,
+    as_uint8: bool = False,
 ) -> FederatedData:
     """Class-conditional Gaussian images, shape-compatible stand-in for
     MNIST/FEMNIST/CIFAR when real files are absent. Each class c has a fixed
@@ -103,9 +104,19 @@ def synthetic_images(
     x = means[y] + 0.5 * pool[rng.randint(0, 4096, total)]
     ty = rng.choice(num_classes, test_samples).astype(np.int64)
     tx = means[ty] + 0.5 * pool[rng.randint(0, 4096, test_samples)]
+    if as_uint8:
+        # map the ~N(0,1.1) pixel field onto the uint8 grid; after the image
+        # tasks' on-device /255 the model sees ~N(0.5, 0.125^2) — an affine
+        # rescale of the float variant (standard [0,1] image normalization),
+        # NOT the same raw scale, at 1/4 the host->device bytes. Real image
+        # datasets are natively uint8, so this only affects the synthetic
+        # stand-in; learning-rate-sensitive comparisons between the float
+        # and uint8 synthetic variants are not scale-equivalent.
+        q = lambda a: np.clip(a * 32.0 + 128.0, 0, 255).astype(np.uint8)
+        x, tx = q(x), q(tx)
     fd = FederatedData(
-        train_x=x.astype(np.float32), train_y=y,
-        test_x=tx.astype(np.float32), test_y=ty,
+        train_x=x if as_uint8 else x.astype(np.float32), train_y=y,
+        test_x=tx if as_uint8 else tx.astype(np.float32), test_y=ty,
         train_idx_map=idx_map, test_idx_map=None, class_num=num_classes,
     )
     fd.synthetic_fallback = True

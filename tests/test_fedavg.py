@@ -141,3 +141,58 @@ def test_weighted_aggregation_exact(lr_task):
     m = api.run_round(0)
     # count = sum over clients of (samples * epochs)
     assert abs(float(m["count"]) - sum(sizes)) < 1e-3
+
+
+def test_device_data_plane_matches_host_pack():
+    """The HBM-resident IndexBatch plane must produce bit-identical batches
+    (same splitmix shuffle) and hence the same trained model as the host
+    packer, in both single-device and mesh modes — including uint8 pixels
+    normalized on device."""
+    task = classification_task(LogisticRegression(num_classes=4))
+    data = synthetic_images(num_clients=16, image_shape=(8, 8, 1), num_classes=4,
+                            samples_per_client=24, test_samples=48, seed=2,
+                            as_uint8=True)
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=16, client_num_per_round=8,
+                       batch_size=8, lr=0.1, frequency_of_the_test=10)
+
+    host = FedAvgAPI(data, task, cfg)
+    host.train()
+    dev = FedAvgAPI(data, task, cfg, device_data=True)
+    dev.train()
+    for u, v in zip(jax.tree.leaves(host.net), jax.tree.leaves(dev.net)):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v), rtol=1e-6, atol=1e-7)
+
+    mesh = jax.make_mesh((8,), ("clients",))
+    dev_mesh = FedAvgAPI(data, task, cfg, mesh=mesh, device_data=True)
+    dev_mesh.train()
+    for u, v in zip(jax.tree.leaves(host.net), jax.tree.leaves(dev_mesh.net)):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v), rtol=2e-5, atol=1e-6)
+
+
+def test_device_data_plane_exact_with_batch_stats():
+    """BatchNorm consumes padded rows regardless of the loss mask, so the
+    device plane must zero gathered padding to match the host packer —
+    batch_stats (net.extra) must agree too."""
+    import flax.linen as nn
+
+    class TinyBN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.Conv(4, (3, 3))(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9)(x)
+            x = nn.relu(x).reshape((x.shape[0], -1))
+            return nn.Dense(4)(x)
+
+    task = classification_task(TinyBN())
+    # ragged sizes (lognormal) -> guaranteed padded slots
+    data = synthetic_images(num_clients=10, image_shape=(8, 8, 1), num_classes=4,
+                            samples_per_client=20, test_samples=40, seed=5,
+                            as_uint8=True)
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=10, client_num_per_round=4,
+                       batch_size=8, lr=0.05, frequency_of_the_test=10)
+    host = FedAvgAPI(data, task, cfg)
+    host.train()
+    dev = FedAvgAPI(data, task, cfg, device_data=True)
+    dev.train()
+    for u, v in zip(jax.tree.leaves(host.net), jax.tree.leaves(dev.net)):
+        np.testing.assert_allclose(np.asarray(u), np.asarray(v), rtol=1e-6, atol=1e-7)
