@@ -1,0 +1,17 @@
+"""Base framework — minimal centralized message-round skeleton.
+
+Mirror of fedml_api/distributed/base_framework/ (algorithm_api.py,
+central_manager.py — SURVEY.md §2.2 'template for new algorithms'): a
+coordinator broadcasts a payload, workers apply a local function and reply,
+the coordinator reduces and starts the next round. Subclass or pass
+``local_fn``/``reduce_fn`` to prototype a new distributed algorithm without
+touching transport code.
+"""
+
+from fedml_tpu.distributed.base_framework.framework import (
+    BaseClientManager,
+    BaseServerManager,
+    run_base_framework,
+)
+
+__all__ = ["BaseClientManager", "BaseServerManager", "run_base_framework"]

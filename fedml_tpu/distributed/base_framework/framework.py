@@ -1,0 +1,102 @@
+"""Minimal centralized round skeleton over the comm layer.
+
+Message flow (mirror of base_framework/central_manager.py +
+algorithm_api.py): coordinator (rank 0) broadcasts MSG_BCAST with a payload
+array; every worker applies ``local_fn(payload, rank, round)`` and replies
+MSG_RESULT; coordinator applies ``reduce_fn([results])`` and either starts
+the next round or broadcasts MSG_FINISH.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+
+MSG_BCAST = "base_bcast"
+MSG_RESULT = "base_result"
+MSG_FINISH = "base_finish"
+KEY_PAYLOAD = "payload"
+KEY_ROUND = "round_idx"
+
+
+class BaseServerManager(ServerManager):
+    def __init__(self, payload0: np.ndarray, reduce_fn: Callable, num_rounds: int,
+                 rank=0, size=0, backend="LOOPBACK", **kw):
+        self.payload = np.asarray(payload0)
+        self.reduce_fn = reduce_fn
+        self.num_rounds = num_rounds
+        self.round_idx = 0
+        self.results: dict[int, np.ndarray] = {}
+        super().__init__(rank, size, backend, **kw)
+
+    def run(self):
+        self._broadcast()
+        super().run()
+
+    def _broadcast(self):
+        for rank in range(1, self.size):
+            msg = Message(MSG_BCAST, self.rank, rank)
+            msg.add_params(KEY_PAYLOAD, self.payload)
+            msg.add_params(KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_RESULT, self._on_result)
+
+    def _on_result(self, params):
+        self.results[params[Message.MSG_ARG_KEY_SENDER]] = params[KEY_PAYLOAD]
+        if len(self.results) < self.size - 1:
+            return
+        self.payload = np.asarray(self.reduce_fn(
+            [self.results[r] for r in sorted(self.results)]
+        ))
+        self.results.clear()
+        self.round_idx += 1
+        if self.round_idx >= self.num_rounds:
+            for rank in range(1, self.size):
+                self.send_message(Message(MSG_FINISH, self.rank, rank))
+            self.finish()
+            return
+        self._broadcast()
+
+
+class BaseClientManager(ClientManager):
+    def __init__(self, local_fn: Callable, rank, size, backend="LOOPBACK", **kw):
+        self.local_fn = local_fn
+        super().__init__(rank, size, backend, **kw)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_BCAST, self._on_bcast)
+        self.register_message_receive_handler(MSG_FINISH, lambda _m: self.finish())
+
+    def _on_bcast(self, params):
+        result = self.local_fn(
+            params[KEY_PAYLOAD], self.rank, int(params[KEY_ROUND])
+        )
+        msg = Message(MSG_RESULT, self.rank, 0)
+        msg.add_params(KEY_PAYLOAD, np.asarray(result))
+        self.send_message(msg)
+
+
+def run_base_framework(payload0, local_fn, reduce_fn, num_workers: int,
+                       num_rounds: int, backend="LOOPBACK", job_id="base-fw",
+                       **kw):
+    """All ranks as threads (the mpirun-on-localhost analogue). Returns the
+    final reduced payload."""
+    size = num_workers + 1
+    bkw = {"job_id": job_id} if backend.upper() == "LOOPBACK" else kw
+    server = BaseServerManager(payload0, reduce_fn, num_rounds, 0, size, backend, **bkw)
+    clients = [BaseClientManager(local_fn, r, size, backend, **bkw)
+               for r in range(1, size)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    return server.payload

@@ -1,0 +1,16 @@
+"""Decentralized (server-less) gossip framework over the comm layer.
+
+Mirror of fedml_api/distributed/decentralized_framework/ (SURVEY.md §2.2,
+§3.5): each worker trains locally, pushes its result to topology
+out-neighbors, and advances to the next round once all in-neighbor results
+arrive (decentralized_worker_manager.py:29-46). The on-TPU SPMD counterpart
+(lax.ppermute mixing) lives in fedml_tpu/algorithms/decentralized.py; this
+package is the cross-process form for real multi-party deployments.
+"""
+
+from fedml_tpu.distributed.decentralized_framework.worker import (
+    DecentralizedWorkerManager,
+    run_decentralized,
+)
+
+__all__ = ["DecentralizedWorkerManager", "run_decentralized"]

@@ -1,0 +1,100 @@
+"""Gossip worker: train -> push to out-neighbors -> await in-neighbors.
+
+Message flow parity with decentralized_worker_manager.py:25-46; the mixing
+step is the topology-weighted average of in-neighbor vectors (DSGD-style,
+standalone/decentralized/client_dsgd.py semantics), with ``train_fn``
+supplied by the caller (a jitted local step in real use).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import numpy as np
+
+from fedml_tpu.comm.managers import DistributedManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.topology import SymmetricTopologyManager
+
+MSG_NEIGHBOR = "gossip_result"
+KEY_VEC = "vec"
+KEY_ROUND = "round_idx"
+
+
+class DecentralizedWorkerManager(DistributedManager):
+    def __init__(self, rank: int, size: int, topology: SymmetricTopologyManager,
+                 x0: np.ndarray, train_fn: Callable, num_rounds: int,
+                 backend="LOOPBACK", **kw):
+        self.topology = topology
+        self.x = np.asarray(x0, np.float64)
+        self.train_fn = train_fn
+        self.num_rounds = num_rounds
+        self.round_idx = 0
+        self.inbox: dict[int, dict[int, np.ndarray]] = {}
+        self.done = threading.Event()
+        self.history: list[np.ndarray] = []
+        super().__init__(rank, size, backend, **kw)
+
+    # all ranks are workers: in/out neighbors come from the mixing topology
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_NEIGHBOR, self._on_neighbor)
+
+    def run(self):
+        self._train_and_push()
+        super().run()
+
+    def _train_and_push(self):
+        self.x = np.asarray(self.train_fn(self.x, self.rank, self.round_idx))
+        for nb in self.topology.get_out_neighbor_idx_list(self.rank):
+            msg = Message(MSG_NEIGHBOR, self.rank, int(nb))
+            msg.add_params(KEY_VEC, self.x)
+            msg.add_params(KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+        self._maybe_advance()
+
+    def _on_neighbor(self, params):
+        r = int(params[KEY_ROUND])
+        self.inbox.setdefault(r, {})[params[Message.MSG_ARG_KEY_SENDER]] = params[KEY_VEC]
+        self._maybe_advance()
+
+    def _maybe_advance(self):
+        in_nbs = self.topology.get_in_neighbor_idx_list(self.rank)
+        got = self.inbox.get(self.round_idx, {})
+        if any(nb not in got for nb in in_nbs):
+            return
+        # topology-weighted mixing (row-stochastic W)
+        w = self.topology.get_in_neighbor_weights(self.rank)
+        mixed = w[self.rank] * self.x
+        for nb in in_nbs:
+            mixed = mixed + w[nb] * got[nb]
+        self.x = mixed
+        self.history.append(self.x.copy())
+        self.inbox.pop(self.round_idx, None)
+        self.round_idx += 1
+        if self.round_idx >= self.num_rounds:
+            self.done.set()
+            self.finish()
+            return
+        self._train_and_push()
+
+
+def run_decentralized(x0s, train_fn, num_rounds: int, neighbor_num: int = 2,
+                      backend="LOOPBACK", job_id="gossip", seed=0):
+    """All workers as threads; returns the list of final worker vectors."""
+    n = len(x0s)
+    topo = SymmetricTopologyManager(n, neighbor_num=neighbor_num, seed=seed)
+    topo.generate_topology()
+    workers = [
+        DecentralizedWorkerManager(
+            r, n, topo, x0s[r], train_fn, num_rounds, backend,
+            **({"job_id": job_id} if backend.upper() == "LOOPBACK" else {}),
+        )
+        for r in range(n)
+    ]
+    threads = [threading.Thread(target=w.run, daemon=True) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    return [w.x for w in workers]

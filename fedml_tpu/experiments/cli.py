@@ -53,6 +53,10 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--mesh", type=int, default=0,
                         help="devices on the 'clients' mesh axis; 0 = single-device vmap")
     parser.add_argument("--max_batches", type=int, default=None)
+    parser.add_argument("--device_data", type=int, default=0,
+                        help="1 = HBM-resident train set + per-round index blocks")
+    parser.add_argument("--uint8_pixels", type=int, default=0,
+                        help="1 = ship image pixels as uint8, normalize on device")
     # algorithm-specific
     parser.add_argument("--server_optimizer", type=str, default="sgd")
     parser.add_argument("--server_lr", type=float, default=1.0)
@@ -88,7 +92,7 @@ def build_api(args):
     data = load_dataset(
         args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
         partition_method=args.partition_method, partition_alpha=args.partition_alpha,
-        seed=args.seed,
+        seed=args.seed, uint8_pixels=bool(getattr(args, "uint8_pixels", 0)),
     )
     n_total = data.num_clients
     model = create_model(args.model, output_dim=spec.num_classes)
@@ -110,7 +114,8 @@ def build_api(args):
 
     algo = args.algo
     if algo == "fedavg":
-        return FedAvgAPI(data, task, cfg, mesh=mesh), data
+        return FedAvgAPI(data, task, cfg, mesh=mesh,
+                         device_data=bool(getattr(args, "device_data", 0))), data
     if algo == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptAPI
 

@@ -1,0 +1,105 @@
+"""Cross-process distributed FedAvg launcher — the mpirun analogue.
+
+The reference launches `mpirun -np N+1 python3 main_fedavg.py ...`
+(fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh:
+16-35) with rank from MPI and routing from hostfiles/grpc_ipconfig.csv.
+Here each party is started explicitly (or via run_fedavg_distributed.sh):
+
+    # server
+    python -m fedml_tpu.experiments.distributed_launch --rank 0 \
+        --world_size 5 --backend grpc --dataset mnist --model lr
+    # clients 1..4 likewise (same flags, different --rank)
+
+Routing: --ip_config CSV (receiver_id,ip — grpc_ipconfig.csv parity) or
+everything on 127.0.0.1 by default. The server process prints the eval
+history when the job completes; worker count must be
+client_num_per_round (one process per sampled client, FedAvgAPI.py:20-28).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def add_args(p: argparse.ArgumentParser):
+    p.add_argument("--rank", type=int, required=True, help="0 = server")
+    p.add_argument("--world_size", type=int, required=True,
+                   help="client_num_per_round + 1")
+    p.add_argument("--backend", type=str, default="grpc",
+                   choices=["grpc", "loopback", "mqtt"])
+    p.add_argument("--base_port", type=int, default=50000)
+    p.add_argument("--ip_config", type=str, default=None,
+                   help="csv receiver_id,ip (grpc_ipconfig.csv parity)")
+    p.add_argument("--broker_host", type=str, default="127.0.0.1")
+    p.add_argument("--broker_port", type=int, default=1883)
+    p.add_argument("--timeout_s", type=float, default=None,
+                   help="failure-detection watchdog (server logs stragglers)")
+    # experiment surface (subset of cli.py, same names)
+    p.add_argument("--model", type=str, default="lr")
+    p.add_argument("--dataset", type=str, default="mnist")
+    p.add_argument("--data_dir", type=str, default=None)
+    p.add_argument("--partition_method", type=str, default=None)
+    p.add_argument("--partition_alpha", type=float, default=0.5)
+    p.add_argument("--client_num_in_total", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=32)
+    p.add_argument("--client_optimizer", type=str, default="sgd")
+    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--wd", type=float, default=0.0)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--comm_round", type=int, default=10)
+    p.add_argument("--frequency_of_the_test", type=int, default=5)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ci", type=int, default=0)
+    return p
+
+
+def main(argv=None):
+    args = add_args(argparse.ArgumentParser("fedml_tpu.distributed")).parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s rank{args.rank} %(name)s %(levelname)s %(message)s",
+    )
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task, sequence_task, tag_prediction_task
+    from fedml_tpu.data.registry import DATASETS, load_dataset
+    from fedml_tpu.distributed.fedavg import FedML_FedAvg_distributed
+    from fedml_tpu.models import create_model
+
+    spec = DATASETS[args.dataset]
+    data = load_dataset(
+        args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
+        partition_method=args.partition_method, partition_alpha=args.partition_alpha,
+        seed=args.seed,
+    )
+    model = create_model(args.model, output_dim=spec.num_classes)
+    task = {"classification": classification_task, "sequence": sequence_task,
+            "tags": tag_prediction_task}[spec.task](model)
+    cfg = FedAvgConfig(
+        comm_round=args.comm_round, client_num_in_total=data.num_clients,
+        client_num_per_round=args.world_size - 1, epochs=args.epochs,
+        batch_size=args.batch_size, client_optimizer=args.client_optimizer,
+        lr=args.lr, wd=args.wd, frequency_of_the_test=args.frequency_of_the_test,
+        seed=args.seed, ci=bool(args.ci),
+    )
+
+    backend_kw: dict = {"timeout_s": args.timeout_s}
+    if args.backend == "grpc":
+        backend_kw.update(base_port=args.base_port, ip_table=args.ip_config)
+    elif args.backend == "mqtt":
+        backend_kw.update(broker_host=args.broker_host, broker_port=args.broker_port)
+    else:
+        backend_kw.update(job_id="launch")
+
+    mgr = FedML_FedAvg_distributed(
+        args.rank, args.world_size, data, task, cfg,
+        backend=args.backend.upper(), **backend_kw,
+    )
+    if args.rank == 0:
+        print(json.dumps(mgr.aggregator.history, default=float))
+
+
+if __name__ == "__main__":
+    main()
