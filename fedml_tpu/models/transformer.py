@@ -23,6 +23,7 @@ class SelfAttention(nn.Module):
     head_dim: int
     causal: bool = True
     seq_axis: str | None = None  # set to run ring attention inside shard_map
+    use_flash: bool = False      # Pallas blockwise kernel (fedml_tpu.ops)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -33,6 +34,10 @@ class SelfAttention(nn.Module):
         q, k, v = (t.squeeze(2) for t in (q, k, v))
         if self.seq_axis is not None:
             o = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+        elif self.use_flash:
+            from fedml_tpu.ops import flash_attention
+
+            o = flash_attention(q, k, v, self.causal)
         else:
             o = full_attention(q, k, v, causal=self.causal)
         return nn.Dense(C, use_bias=False)(o.reshape(B, T, H * D))
@@ -44,12 +49,13 @@ class Block(nn.Module):
     mlp_ratio: int = 4
     causal: bool = True
     seq_axis: str | None = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.LayerNorm()(x)
         x = x + SelfAttention(self.num_heads, self.head_dim, self.causal,
-                              self.seq_axis)(h, train)
+                              self.seq_axis, self.use_flash)(h, train)
         h = nn.LayerNorm()(x)
         C = x.shape[-1]
         m = nn.Dense(self.mlp_ratio * C)(h)
@@ -66,6 +72,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     causal: bool = True
     seq_axis: str | None = None
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -82,6 +89,7 @@ class TransformerLM(nn.Module):
             x = x + pos[:T][None]
         for _ in range(self.depth):
             x = Block(self.num_heads, self.dim // self.num_heads,
-                      causal=self.causal, seq_axis=self.seq_axis)(x, train)
+                      causal=self.causal, seq_axis=self.seq_axis,
+                      use_flash=self.use_flash)(x, train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

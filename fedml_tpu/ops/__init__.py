@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The compute path is mostly XLA-fused jnp; this package holds the ops where a
+hand-written kernel beats the fusion XLA finds on its own — currently
+blockwise flash attention (forward + backward), the inner loop of the
+TransformerLM and of ring attention's per-device block update.
+"""
+
+from fedml_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,266 @@
+"""Blockwise flash attention as Pallas TPU kernels (fwd + bwd).
+
+The reference has no attention anywhere (its largest sequence model is an
+80-char LSTM, model/nlp/rnn.py:4-36); long-context support is a
+capability-plus of this framework (SURVEY.md §2.7). The sequence-parallel
+layer (fedml_tpu/parallel/ring_attention.py) rotates K/V blocks over ICI and
+runs an online-softmax block update per step — this module is that block
+update as a proper TPU kernel: Q/K/V tiles staged through VMEM, scores on
+the MXU with f32 accumulation, the softmax running max/denominator kept in
+registers instead of HBM round-trips.
+
+Layout: [B, T, H, D] in, collapsed to a (B*H, q-block) grid; each program
+owns one 128-row query tile and loops over key tiles. Backward follows the
+standard flash recurrence (recompute P from the saved logsumexp, then
+dV = P^T dO, dS = P*(dP - delta), dQ/dK via dS) as two kernels gridded over
+q-tiles (dQ) and k-tiles (dK/dV).
+
+Runs in interpreter mode off-TPU (tests exercise it on CPU); on TPU the
+kernels compile with Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _mask(scores, q0, k0, bq, bk, seq_len, causal):
+    qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = k0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    ok = kpos < seq_len
+    if causal:
+        ok = jnp.logical_and(ok, kpos <= qpos)
+    return jnp.where(ok, scores, NEG_INF)
+
+
+# ----------------------------------------------------------------- forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
+                causal, scale):
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    qi = pl.program_id(1)
+    q0 = qi * bq
+    q = q_ref[0].astype(jnp.float32)
+
+    nk = pl.cdiv(k_ref.shape[1], block_k)
+
+    def body(j, carry):
+        o, l, m = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _mask(s, q0, j * block_k, bq, block_k, seq_len, causal)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[:, None] + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return o_new, l_new, m_new
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    o, l, m = jax.lax.fori_loop(0, nk, body, (o0, l0, m0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    Tp = -(-T // block_q) * block_q
+    Tkp = -(-T // block_k) * block_k
+    Tpad = max(Tp, Tkp)
+
+    def prep(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(B * H, T, D)  # [BH, T, D]
+        return jnp.pad(x, ((0, 0), (0, Tpad - T), (0, 0)))
+
+    qf, kf, vf = prep(q), prep(k), prep(v)
+    BH = B * H
+    grid = (BH, Tpad // block_q)
+
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, seq_len=T,
+                          causal=causal, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tpad, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tpad), jnp.float32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ),
+        interpret=_use_interpret(),
+    )(qf, kf, vf)
+    return o, lse, (qf, kf, vf)
+
+
+# ---------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, seq_len, causal, scale):
+    bq = q_ref.shape[1]
+    qi = pl.program_id(1)
+    q0 = qi * bq
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    nk = pl.cdiv(k_ref.shape[1], block_k)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _mask(s, q0, j * block_k, bq, block_k, seq_len, causal)
+        p = jnp.exp(s - lse[:, None])
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, seq_len, causal, scale):
+    bk = k_ref.shape[1]
+    ki = pl.program_id(1)
+    k0 = ki * bk
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    nq = pl.cdiv(q_ref.shape[1], block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        s = _mask(s, i * block_q, k0, block_q, bk, seq_len, causal)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(0, nq, body, (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ------------------------------------------------------------------ public
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128):
+    """softmax(QK^T/sqrt(D))V with O(T) memory. [B, T, H, D] in/out.
+
+    Equivalent to parallel/ring_attention.full_attention; pads T internally
+    to the block size, so any sequence length works.
+    """
+    out, _ = _flash_call(q, k, v, causal, block_q, block_k)
+    return out
+
+
+def _flash_call(q, k, v, causal, block_q, block_k):
+    B, T, H, D = q.shape
+    o, lse, _ = _flash_fwd(q, k, v, causal, block_q, block_k)
+    out = jnp.moveaxis(o[:, :T].reshape(B, H, T, D), 1, 2)
+    return out, (o, lse)
+
+
+def _fwd_rule(q, k, v, causal, block_q, block_k):
+    out, (o, lse) = _flash_call(q, k, v, causal, block_q, block_k)
+    return out, (q, k, v, o, lse)
+
+
+def _bwd_rule(causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    B, T, H, D = q.shape
+    scale = 1.0 / (D ** 0.5)
+    Tpad = o.shape[1]
+    BH = B * H
+
+    def prep(x):
+        x = jnp.moveaxis(x, 2, 1).reshape(BH, T, D)
+        return jnp.pad(x, ((0, 0), (0, Tpad - T), (0, 0)))
+
+    qf, kf, vf = prep(q), prep(k), prep(v)
+    dof = prep(g)
+    # delta_i = sum_d dO_i O_i (the rowwise correction of the softmax vjp)
+    delta = jnp.sum(dof.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    common_in = [
+        pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tpad), lambda b, i: (b, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, Tpad), lambda b, i: (b, 0), memory_space=pltpu.VMEM),
+    ]
+
+    dqf = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, seq_len=T,
+                          causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((BH, Tpad, D), q.dtype),
+        grid=(BH, Tpad // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            common_in[1], common_in[2],
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=_use_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkf, dvf = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, seq_len=T,
+                          causal=causal, scale=scale),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tpad, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tpad, D), v.dtype),
+        ),
+        grid=(BH, Tpad // block_k),
+        in_specs=[
+            common_in[0],
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            common_in[3], common_in[4], common_in[5],
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=_use_interpret(),
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unprep(x):
+        return jnp.moveaxis(x[:, :T].reshape(B, H, T, D), 1, 2)
+
+    return unprep(dqf), unprep(dkf), unprep(dvf)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

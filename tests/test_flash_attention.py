@@ -1,0 +1,73 @@
+"""Pallas flash attention vs the dense reference (fwd + grads).
+
+Runs in interpreter mode on the CPU test mesh; compiles with Mosaic on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ops import flash_attention
+from fedml_tpu.parallel.ring_attention import full_attention
+
+
+def _rand_qkv(key, B=2, T=96, H=2, D=32):
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (B, T, H, D)
+    return (jax.random.normal(kq, shape, jnp.float32),
+            jax.random.normal(kk, shape, jnp.float32),
+            jax.random.normal(kv, shape, jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward_matches_dense(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(0))
+    out = flash_attention(q, k, v, causal, 32, 32)
+    ref = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_flash_handles_ragged_T():
+    # T=70 not a multiple of the 32-block: internal padding must be exact
+    q, k, v = _rand_qkv(jax.random.PRNGKey(1), T=70)
+    out = flash_attention(q, k, v, True, 32, 32)
+    ref = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_dense(causal):
+    q, k, v = _rand_qkv(jax.random.PRNGKey(2), B=1, T=64, H=2, D=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, 32, 32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(full_attention(q, k, v, causal=causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-4)
+
+
+def test_flash_under_jit_and_vmap():
+    q, k, v = _rand_qkv(jax.random.PRNGKey(3), B=2, T=64, H=2, D=16)
+    f = jax.jit(lambda q, k, v: flash_attention(q, k, v, True, 32, 32))
+    out = f(q, k, v)
+    assert out.shape == q.shape and bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_transformer_lm_with_flash_kernel():
+    from fedml_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=50, dim=32, depth=1, num_heads=2,
+                          max_len=64, use_flash=True)
+    ref = TransformerLM(vocab_size=50, dim=32, depth=1, num_heads=2, max_len=64)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 48), 0, 50)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    out_f = model.apply(params, tokens)
+    out_r = ref.apply(params, tokens)  # same params: flash vs dense path
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r),
+                               rtol=2e-4, atol=2e-4)
