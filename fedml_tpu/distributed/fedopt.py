@@ -1,0 +1,69 @@
+"""Distributed FedOpt — the FedAvg cross-process runtime + a server optimizer.
+
+Mirror of fedml_api/distributed/fedopt/ (6-file pattern): the message flow,
+trainer, and managers are exactly FedAvg's (the reference's are near-copies
+too); only the aggregator differs — after the weighted average it applies
+the pseudo-gradient server step (FedOptAggregator.py:70-121), here the same
+jitted optax update the SPMD engine uses (algorithms/fedopt.py), so the two
+runtimes stay numerically aligned.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.algorithms.fedopt import make_server_optimizer
+from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+from fedml_tpu.core.local import NetState
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.api import init_client
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.utils.tree import tree_sub
+
+
+class FedOptAggregator(FedAvgAggregator):
+    def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
+                 server_optimizer: str = "sgd", server_lr: float = 1.0,
+                 server_momentum: float = 0.9):
+        super().__init__(dataset, task, cfg, worker_num)
+        tx = make_server_optimizer(server_optimizer, server_lr, server_momentum)
+        self._server_opt_state = tx.init(self.net.params)
+
+        @jax.jit
+        def step(old: NetState, avg: NetState, opt_state):
+            pseudo_grad = tree_sub(old.params, avg.params)
+            updates, new_state = tx.update(pseudo_grad, opt_state, old.params)
+            return NetState(optax.apply_updates(old.params, updates), avg.extra), new_state
+
+        self._server_step = step
+
+    def aggregate(self):
+        old = self.net
+        super().aggregate()  # weighted average -> self.net
+        self.net, self._server_opt_state = self._server_step(
+            old, self.net, self._server_opt_state
+        )
+        return pack_pytree(self.net)
+
+
+def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
+                  job_id="fedopt-sim", base_port=50000, **opt_kw):
+    """All ranks as threads (mpirun-on-localhost analogue); returns the
+    aggregator with .net/.history."""
+    size = cfg.client_num_per_round + 1
+    kw = {"job_id": job_id} if backend.upper() == "LOOPBACK" else {"base_port": base_port}
+    aggregator = FedOptAggregator(dataset, task, cfg, worker_num=size - 1, **opt_kw)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    clients = [init_client(dataset, task, cfg, r, size, backend, **kw)
+               for r in range(1, size)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    return aggregator

@@ -60,10 +60,13 @@ def test_decentralized_gossip_with_local_steps():
 def test_distributed_launch_multiprocess_grpc(tmp_path):
     """Real OS processes + gRPC on localhost — the closest analogue of the
     reference's mpirun smoke runs."""
+    import time
+
     env = dict(os.environ)
     env.update(PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=1")
-    base = ["--world_size", "3", "--backend", "grpc", "--base_port", "59200",
+    port = 52000 + (os.getpid() * 7 + int(time.time())) % 6000  # fresh ports per run
+    base = ["--world_size", "3", "--backend", "grpc", "--base_port", str(port),
             "--dataset", "mnist", "--model", "lr", "--comm_round", "2",
             "--client_num_in_total", "6", "--frequency_of_the_test", "1",
             "--ci", "1"]
@@ -75,12 +78,67 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
         )
         for r in (1, 2)
     ]
-    server = subprocess.run(
-        [sys.executable, "-m", "fedml_tpu.experiments.distributed_launch",
-         "--rank", "0"] + base,
-        env=env, capture_output=True, text=True, timeout=300,
-    )
-    for c in clients:
-        c.wait(timeout=60)
+    try:
+        server = subprocess.run(
+            [sys.executable, "-m", "fedml_tpu.experiments.distributed_launch",
+             "--rank", "0"] + base,
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        # the server only exits after broadcasting FINISH; give slow-starting
+        # clients time to drain it, then reap
+        deadline = time.time() + 120
+        for c in clients:
+            c.wait(timeout=max(1.0, deadline - time.time()))
+    except subprocess.TimeoutExpired as e:  # surface client logs on failure
+        outs = []
+        for c in clients:
+            if c.poll() is None:
+                c.kill()
+            out, _ = c.communicate(timeout=10)
+            outs.append(out.decode(errors="replace")[-2000:] if out else "")
+        raise AssertionError(f"launch timeout: {e}\nclient logs:\n" + "\n---\n".join(outs))
+    finally:
+        for c in clients:
+            if c.poll() is None:
+                c.kill()
     assert server.returncode == 0, server.stdout + server.stderr
     assert '"round": 1' in server.stdout.replace("'", '"') or "round" in server.stdout
+
+
+def test_distributed_fedopt_matches_standalone():
+    """Cross-process FedOpt == the SPMD FedOptAPI (same server optimizer
+    state threading), extending the FedAvg oracle to server-side Adam."""
+    import jax
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.distributed import fedopt as dist_fedopt
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=6, image_shape=(6, 6, 1), num_classes=3,
+                            samples_per_client=18, test_samples=36, seed=4)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=6, client_num_per_round=3,
+                       batch_size=6, lr=0.1, frequency_of_the_test=1, seed=0)
+
+    standalone = FedOptAPI(data, task, cfg, server_optimizer="adam", server_lr=0.05)
+    standalone.train()
+    agg = dist_fedopt.run_simulated(data, task, cfg, job_id="t-fedopt",
+                                    server_optimizer="adam", server_lr=0.05)
+    for a, b in zip(jax.tree.leaves(standalone.net), jax.tree.leaves(agg.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+
+
+def test_worker_mapping_yaml(tmp_path):
+    from fedml_tpu.distributed.utils import load_worker_mapping, mapping_to_ip_config_csv
+    from fedml_tpu.comm.grpc_backend import read_ip_config
+
+    y = tmp_path / "map.yaml"
+    y.write_text("workers:\n  - host: 10.0.0.1\n    ranks: [0, 1]\n"
+                 "  - host: 10.0.0.2\n    ranks: [2]\n")
+    table = load_worker_mapping(str(y))
+    assert table == {0: "10.0.0.1", 1: "10.0.0.1", 2: "10.0.0.2"}
+    csv_path = tmp_path / "ipconfig.csv"
+    mapping_to_ip_config_csv(table, str(csv_path))
+    assert read_ip_config(str(csv_path)) == table
