@@ -35,14 +35,18 @@ class FedAvgClientManager(ClientManager):
         self._sync_and_train(msg_params)
 
     def handle_message_receive_model(self, msg_params):
-        self.round_idx += 1
+        self.round_idx += 1  # fallback when the server omits the round tag
         self._sync_and_train(msg_params)
 
     def _sync_and_train(self, msg_params):
+        # trust the server's round counter (keeps stragglers aligned after an
+        # elastic partial aggregation skipped them)
+        self.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx))
         self.trainer.update_model(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS])
         self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
         self.send_message(msg)

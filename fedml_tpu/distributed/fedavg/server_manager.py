@@ -3,12 +3,20 @@
 Mirror of fedml_api/distributed/fedavg/FedAvgServerManager.py: send_init_msg
 (:31-39), handle_message_receive_model_from_client (:45-82, aggregate when
 all received, eval, resample, sync), send_message_sync_model_to_client
-(:90-95). Adds a straggler watchdog (on_timeout) the reference lacks.
+(:90-95).
+
+Elastic extension (absent in the reference — SURVEY.md §5 'failure
+detection: none'): with ``round_timeout_s`` set, a round that stalls past
+the deadline aggregates over the subset of clients that DID report
+(sample-weighted, so the average stays exact over the participants) and
+moves on; late uploads from superseded rounds are round-tagged and dropped.
+A crashed client therefore degrades throughput instead of hanging the job.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 
 from fedml_tpu.comm.managers import ServerManager
 from fedml_tpu.comm.message import Message
@@ -19,10 +27,13 @@ log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
 
 class FedAvgServerManager(ServerManager):
-    def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0, backend="LOOPBACK", **kw):
+    def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0,
+                 backend="LOOPBACK", round_timeout_s: float | None = None, **kw):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
+        self.round_timeout_s = round_timeout_s
+        self._round_lock = threading.Lock()
         if size - 1 != aggregator.cfg.client_num_per_round:
             # one worker process per sampled client (FedAvgAPI.py:20-28
             # launches client_num_per_round+1 ranks); a deficit would
@@ -31,7 +42,8 @@ class FedAvgServerManager(ServerManager):
                 f"worker count {size - 1} != client_num_per_round="
                 f"{aggregator.cfg.client_num_per_round}"
             )
-        super().__init__(rank, size, backend, **kw)
+        ts = kw.pop("timeout_s", None)
+        super().__init__(rank, size, backend, timeout_s=round_timeout_s or ts, **kw)
 
     def run(self):
         self.send_init_msg()
@@ -44,6 +56,7 @@ class FedAvgServerManager(ServerManager):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             self.send_message(msg)
 
     def register_message_receive_handlers(self):
@@ -53,14 +66,25 @@ class FedAvgServerManager(ServerManager):
         )
 
     def handle_message_receive_model_from_client(self, msg_params):
-        sender = msg_params[Message.MSG_ARG_KEY_SENDER]
-        self.aggregator.add_local_trained_result(
-            sender - 1,
-            msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS],
-            msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
-        )
-        if not self.aggregator.check_whether_all_receive():
-            return
+        with self._round_lock:
+            sender = msg_params[Message.MSG_ARG_KEY_SENDER]
+            msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if int(msg_round) != self.round_idx:
+                log.warning("drop stale upload from rank %d (round %s, now %d)",
+                            sender, msg_round, self.round_idx)
+                return
+            self.aggregator.add_local_trained_result(
+                sender - 1,
+                msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS],
+                msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
+            )
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._advance_round()
+
+    def _advance_round(self):
+        """Aggregate what's collected, eval, and start the next round (or
+        finish). Caller holds _round_lock."""
         global_params = self.aggregator.aggregate()
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
 
@@ -75,11 +99,25 @@ class FedAvgServerManager(ServerManager):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             self.send_message(msg)
 
     def on_timeout(self, idle_s: float):
-        missing = [i + 1 for i, v in self.aggregator.flag_client_model_uploaded.items() if not v]
-        log.error(
-            "round %d stalled %.1fs: waiting on client ranks %s",
-            self.round_idx, idle_s, missing,
-        )
+        """Watchdog (own thread): no traffic for round_timeout_s."""
+        with self._round_lock:
+            received = [i + 1 for i, v in
+                        self.aggregator.flag_client_model_uploaded.items() if v]
+            missing = [i + 1 for i, v in
+                       self.aggregator.flag_client_model_uploaded.items() if not v]
+            if self.round_timeout_s is None or not received or self._finished.is_set():
+                log.error("round %d stalled %.1fs: waiting on client ranks %s",
+                          self.round_idx, idle_s, missing)
+                return
+            log.warning(
+                "round %d: elastic partial aggregation over ranks %s "
+                "(stragglers %s dropped after %.1fs)",
+                self.round_idx, received, missing, idle_s,
+            )
+            for i in list(self.aggregator.flag_client_model_uploaded):
+                self.aggregator.flag_client_model_uploaded[i] = False
+            self._advance_round()

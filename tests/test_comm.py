@@ -175,3 +175,39 @@ def test_grpc_distributed_fedavg_smoke(lr_setup):
     agg = run_simulated(data, task, cfg, backend="GRPC",
                         base_port=57000 + (int(time.time()) % 500))
     assert agg.history and agg.history[-1]["round"] == 1
+
+
+def test_elastic_partial_aggregation_survives_dead_client(lr_setup):
+    """A client that never reports must not hang the job: with
+    round_timeout_s set, the server aggregates over the live subset and
+    completes every round (failure detection + elastic recovery,
+    SURVEY.md §5 parity-plus)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+    from fedml_tpu.distributed.fedavg.api import init_client
+    from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+
+    data, task = lr_setup
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8, client_num_per_round=3,
+                       epochs=1, batch_size=8, lr=0.1, frequency_of_the_test=1, seed=2)
+    size = cfg.client_num_per_round + 1
+    job = "t-elastic"
+
+    aggregator = FedAvgAggregator(data, task, cfg, worker_num=size - 1)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend="LOOPBACK",
+                                 round_timeout_s=1.5, job_id=job)
+    # rank 3 is "dead": register its loopback endpoint but never run it, so
+    # sends to it succeed and it never replies
+    from fedml_tpu.comm.loopback import LoopbackCommManager
+
+    dead = LoopbackCommManager(job, 3, size)
+    live = [init_client(data, task, cfg, r, size, "LOOPBACK", job_id=job)
+            for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in live]
+    for t in threads:
+        t.start()
+    server.run()  # returns only if every round completed
+    dead.stop_receive_message()
+    for t in threads:
+        t.join(timeout=30)
+    assert aggregator.history and aggregator.history[-1]["round"] == cfg.comm_round - 1
