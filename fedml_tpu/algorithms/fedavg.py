@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from fedml_tpu.core.client_data import (
@@ -411,6 +412,84 @@ class FedAvgAPI:
         self.net, self.server_opt_state, self.rng = net, server_opt_state, rng
 
     # ------------------------------------------------------------------ eval
+    def evaluate_per_client(self, split: str = "test", chunk: int = 64,
+                            max_clients: int | None = None):
+        """Reference-fidelity eval: iterate EVERY client's own split
+        (_local_test_on_all_clients, fedavg_api.py:117-180), vectorized —
+        clients are packed in chunks of ``chunk`` and evaluated as one
+        vmapped masked batch block per chunk.
+
+        Returns (per_client list of {client, loss, acc, count}, aggregate
+        dict weighted by sample counts — the reference's Train/Acc /
+        Test/Acc numbers).
+        """
+        import dataclasses as _dc
+
+        if split == "test" and self.data.test_idx_map is not None:
+            view = _dc.replace(self.data, train_x=self.data.test_x,
+                               train_y=self.data.test_y,
+                               train_idx_map=self.data.test_idx_map)
+        elif split == "test":
+            # no per-client test partition: every client shares the global
+            # test set (the cross-silo datasets' convention)
+            view = None
+        else:
+            view = self.data
+
+        if view is None:
+            ev = self.evaluate()
+            agg = {"loss": float(ev["loss"]), "acc": float(ev["acc"]),
+                   "count": float(ev["count"])}
+            return [], agg
+
+        ids = np.arange(view.num_clients if max_clients is None
+                        else min(max_clients, view.num_clients))
+        if self.cfg.ci:
+            ids = ids[:1]  # --ci truncation (FedAVGAggregator.py:126-131)
+
+        if not hasattr(self, "_chunk_eval"):
+
+            @jax.jit
+            def chunk_eval(net, x, y, mask):
+                # [K, B, bs, ...] -> per-client metric sums
+                def per_client(xk, yk, mk):
+                    def body(acc, b):
+                        xb, yb, mb = b
+                        metr = self.task.eval_batch(net.params, net.extra, xb, yb, mb)
+                        return {k: acc[k] + metr[k] for k in acc}, None
+
+                    init = {"loss_sum": jnp.zeros(()), "correct": jnp.zeros(()),
+                            "count": jnp.zeros(())}
+                    acc, _ = lax.scan(body, init, (xk, yk, mk))
+                    return acc
+
+                return jax.vmap(per_client)(x, y, mask)
+
+            self._chunk_eval = chunk_eval
+        chunk_eval = self._chunk_eval
+
+        per_client: list[dict] = []
+        tot = {"loss_sum": 0.0, "correct": 0.0, "count": 0.0}
+        for s in range(0, len(ids), chunk):
+            cids = ids[s : s + chunk]
+            cb = pack_clients(view, cids, self.cfg.eval_batch_size,
+                              seed=self.cfg.seed, round_idx=0)
+            m = jax.device_get(chunk_eval(self.net, jnp.asarray(cb.x),
+                                          jnp.asarray(cb.y), jnp.asarray(cb.mask)))
+            for i, cid in enumerate(cids):
+                n = float(max(m["count"][i], 1.0))
+                per_client.append({
+                    "client": int(cid),
+                    "loss": float(m["loss_sum"][i]) / n,
+                    "acc": float(m["correct"][i]) / n,
+                    "count": float(m["count"][i]),
+                })
+                for k in tot:
+                    tot[k] += float(m[k][i])
+        n = max(tot["count"], 1.0)
+        agg = {"loss": tot["loss_sum"] / n, "acc": tot["correct"] / n, "count": tot["count"]}
+        return per_client, agg
+
     def evaluate(self):
         """Global test-set eval (the reference evaluates per client over all
         clients, fedavg_api.py:117-180; on a global-shared test set the two

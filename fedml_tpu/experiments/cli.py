@@ -31,7 +31,8 @@ def add_args(parser: argparse.ArgumentParser):
                         choices=["fedavg", "fedopt", "fedprox", "fednova",
                                  "fedavg_robust", "hierarchical", "feddf",
                                  "feddf_hard", "fedavg_affinity", "fednas",
-                                 "decentralized", "centralized", "turboaggregate"])
+                                 "decentralized", "centralized", "turboaggregate",
+                                 "fedseg", "split_nn", "fedgkt", "vfl"])
     parser.add_argument("--model", type=str, default="lr")
     parser.add_argument("--dataset", type=str, default="mnist")
     parser.add_argument("--data_dir", type=str, default=None)
@@ -69,6 +70,10 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--group_comm_round", type=int, default=2)
     parser.add_argument("--distill_steps", type=int, default=20)
     parser.add_argument("--distill_lr", type=float, default=1e-3)
+    # fedseg (--loss_type/--lr_scheduler surface of the reference fedseg main)
+    parser.add_argument("--loss_type", type=str, default="ce")
+    parser.add_argument("--lr_scheduler", type=str, default="poly")
+    parser.add_argument("--lr_step", type=int, default=30)
     # checkpoint / logging
     parser.add_argument("--ckpt_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
@@ -88,6 +93,26 @@ def build_api(args):
     from fedml_tpu.data.registry import DATASETS, load_dataset
     from fedml_tpu.models import create_model
 
+    if args.algo == "vfl":
+        # vertical datasets live in their own registry (feature-partitioned)
+        from fedml_tpu.algorithms.vfl import VFLAPI, VFLConfig
+        from fedml_tpu.data.tabular import load_vertical, train_test_split_vertical
+        from fedml_tpu.models.vfl import DenseTower
+
+        xg, xh, y, vspec = load_vertical(args.dataset, data_dir=args.data_dir,
+                                         seed=args.seed)
+        (tg, th, ty), _ = train_test_split_vertical(xg, xh, y, seed=args.seed)
+        api = VFLAPI(
+            DenseTower(num_classes=vspec.num_classes),
+            DenseTower(num_classes=vspec.num_classes),
+            tg, th, ty,
+            VFLConfig(epochs=args.epochs * args.comm_round,
+                      batch_size=args.batch_size, guest_lr=args.lr,
+                      host_lr=args.lr, seed=args.seed),
+            num_classes=vspec.num_classes,
+        )
+        return api, None
+
     spec = DATASETS[args.dataset]
     data = load_dataset(
         args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
@@ -95,6 +120,59 @@ def build_api(args):
         seed=args.seed, uint8_pixels=bool(getattr(args, "uint8_pixels", 0)),
     )
     n_total = data.num_clients
+
+    if args.algo == "fedseg":
+        from fedml_tpu.algorithms.fedseg import FedSegAPI, FedSegConfig
+        from fedml_tpu.models.segmentation import DeepLabLite, UNetLite
+
+        seg_model = (DeepLabLite(num_classes=spec.num_classes)
+                     if args.model in ("deeplab", "deeplab_lite")
+                     else UNetLite(num_classes=spec.num_classes))
+        scfg = FedSegConfig(
+            comm_round=args.comm_round, client_num_in_total=n_total,
+            client_num_per_round=min(args.client_num_per_round, n_total),
+            epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
+            wd=args.wd, frequency_of_the_test=args.frequency_of_the_test,
+            seed=args.seed, max_batches=args.max_batches, ci=bool(args.ci),
+            loss_type=args.loss_type, lr_scheduler=args.lr_scheduler,
+            lr_step=args.lr_step,
+        )
+        return FedSegAPI(data, seg_model, scfg), data
+
+    if args.algo == "split_nn":
+        from fedml_tpu.algorithms.split_nn import SplitNNAPI, SplitNNConfig
+        from fedml_tpu.models.gkt import SplitLowerNet, SplitUpperNet
+
+        return SplitNNAPI(
+            data, SplitLowerNet(),
+            SplitUpperNet(num_classes=spec.num_classes),
+            SplitNNConfig(epochs=args.epochs, batch_size=args.batch_size,
+                          lr=args.lr, client_num=min(args.client_num_per_round,
+                                                     n_total),
+                          max_batches=args.max_batches, seed=args.seed),
+        ), data
+
+    if args.algo == "fedgkt":
+        from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
+        from fedml_tpu.models.gkt import (GKTClientExtractor, GKTClientHead,
+                                          GKTServerModel)
+
+        nclients = min(args.client_num_per_round, n_total)
+        gcfg = FedGKTConfig(
+            comm_round=args.comm_round, client_num_in_total=nclients,
+            client_num_per_round=nclients, epochs_client=args.epochs,
+            epochs_server=args.epochs, batch_size=args.batch_size,
+            lr_client=args.lr, lr_server=args.lr,
+            max_batches=args.max_batches, seed=args.seed,
+        )
+        return FedGKTAPI(
+            data, GKTClientExtractor(norm_type="group", blocks=1),
+            GKTClientHead(num_classes=spec.num_classes),
+            GKTServerModel(norm_type="group", blocks_per_stage=2,
+                           num_classes=spec.num_classes),
+            gcfg, num_classes=spec.num_classes,
+        ), data
+
     model = create_model(args.model, output_dim=spec.num_classes)
     task = {"classification": classification_task,
             "sequence": sequence_task,
@@ -183,13 +261,19 @@ def main(argv=None):
     api, data = build_api(args)
     logger = RunLogger(args.run_dir, args.run_name,
                        config=vars(args))
-    log.info("dataset=%s clients=%d algo=%s mesh=%d", args.dataset,
-             data.num_clients, args.algo, args.mesh)
+    log.info("dataset=%s clients=%s algo=%s mesh=%d", args.dataset,
+             data.num_clients if data is not None else "vertical", args.algo,
+             args.mesh)
 
     if args.algo == "centralized":
         api.train()
         for rec in api.history:
             logger.log(rec, step=rec.get("epoch"))
+    elif args.algo in ("vfl", "split_nn"):
+        hist = api.train(args.comm_round) if args.algo == "split_nn" else api.train()
+        for i, rec in enumerate(hist or []):
+            logger.log(rec, step=i)
+            log.info("%s", rec)
     else:
         start_round = 0
         if args.resume and args.ckpt_dir:
@@ -207,6 +291,8 @@ def main(argv=None):
             metrics = api.run_round(r)
             if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
                 ev = api.evaluate() if hasattr(api, "evaluate") else {}
+                if isinstance(ev, (int, float)):  # FedGKT returns a bare acc
+                    ev = {"acc": float(ev), "loss": 0.0}
                 n = float(max(float(metrics.get("count", 1)), 1))
                 rec = {"round": r,
                        "train_loss": float(metrics.get("loss_sum", 0)) / n,

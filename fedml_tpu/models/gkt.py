@@ -8,17 +8,30 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedml_tpu.models.resnet import BasicBlock
+from fedml_tpu.models.resnet import BasicBlock, _GN, _GNBasicBlock
 from functools import partial
 
 
 class GKTClientExtractor(nn.Module):
-    """Stem + one stage of basic blocks -> [H, W, 16] feature maps."""
+    """Stem + one stage of basic blocks -> [H, W, 16] feature maps.
+
+    norm_type 'group' swaps stateless GroupNorm in for BatchNorm — required
+    when the extractor runs under a params-only engine (FedGKTAPI keeps no
+    mutable collections, matching its vmapped per-client stacking).
+    """
 
     blocks: int = 3  # ResNet-8: 3 blocks in one 16-channel stage
+    norm_type: str = "batch"  # 'batch' | 'group'
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.norm_type == "group":
+            y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+            y = _GN()(y)
+            y = nn.relu(y)
+            for _ in range(self.blocks):
+                y = _GNBasicBlock(16, (1, 1))(y, train)
+            return y
         norm = partial(nn.BatchNorm, momentum=0.9)
         y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
         y = norm(use_running_average=not train)(y)
@@ -45,14 +58,54 @@ class GKTServerModel(nn.Module):
 
     blocks_per_stage: int = 9  # ResNet-56 geometry minus the client stage
     num_classes: int = 10
+    norm_type: str = "batch"  # 'group' for params-only engines (FedGKTAPI)
 
     @nn.compact
     def __call__(self, feats, train: bool = False):
-        norm = partial(nn.BatchNorm, momentum=0.9)
         y = feats
+        if self.norm_type == "group":
+            for filters, stride in [(32, 2), (64, 2)]:
+                for i in range(self.blocks_per_stage):
+                    s = (stride, stride) if i == 0 else (1, 1)
+                    y = _GNBasicBlock(filters, s)(y, train)
+            y = jnp.mean(y, axis=(1, 2))
+            return nn.Dense(self.num_classes)(y)
+        norm = partial(nn.BatchNorm, momentum=0.9)
         for filters, stride in [(32, 2), (64, 2)]:
             for i in range(self.blocks_per_stage):
                 s = (stride, stride) if i == 0 else (1, 1)
                 y = BasicBlock(filters, s, norm)(y, train)
         y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
+
+
+class SplitLowerNet(nn.Module):
+    """SplitNN default lower cut (client side): norm-free conv features.
+
+    The reference cuts an arbitrary torch model between client and server
+    (split_nn/client.py holds the lower layers); SplitNNAPI keeps only
+    trainable params per side, so the default cut avoids mutable
+    normalization state.
+    """
+
+    width: int = 16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat features
+            return nn.relu(nn.Dense(self.width * 4)(x))
+        y = nn.relu(nn.Conv(self.width, (3, 3), (2, 2), padding="SAME")(x))
+        y = nn.relu(nn.Conv(self.width * 2, (3, 3), (2, 2), padding="SAME")(y))
+        return y
+
+
+class SplitUpperNet(nn.Module):
+    """SplitNN default upper cut (server side): activations -> logits."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, acts, train: bool = False):
+        y = acts.reshape((acts.shape[0], -1))
+        y = nn.relu(nn.Dense(128)(y))
         return nn.Dense(self.num_classes)(y)

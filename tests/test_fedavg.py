@@ -196,3 +196,32 @@ def test_device_data_plane_exact_with_batch_stats():
     dev.train()
     for u, v in zip(jax.tree.leaves(host.net), jax.tree.leaves(dev.net)):
         np.testing.assert_allclose(np.asarray(u), np.asarray(v), rtol=1e-6, atol=1e-7)
+
+
+def test_evaluate_per_client_matches_global():
+    """Per-client eval (reference _local_test_on_all_clients fidelity):
+    the sample-weighted aggregate over clients must equal the global eval
+    when clients partition the test set."""
+    data = synthetic_images(num_clients=10, image_shape=(8, 8, 1), num_classes=4,
+                            samples_per_client=20, test_samples=100, seed=7)
+    # give clients disjoint test shards covering the whole test set
+    n_test = len(data.test_x)
+    splits = np.array_split(np.arange(n_test), 10)
+    data.test_idx_map = {k: splits[k] for k in range(10)}
+
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=10, client_num_per_round=5,
+                       batch_size=10, lr=0.1, frequency_of_the_test=10)
+    api = FedAvgAPI(data, task, cfg)
+    api.train()
+
+    per_client, agg = api.evaluate_per_client(split="test", chunk=4)
+    assert len(per_client) == 10
+    assert abs(sum(c["count"] for c in per_client) - n_test) < 1e-6
+    ev = api.evaluate()
+    np.testing.assert_allclose(agg["acc"], float(ev["acc"]), atol=1e-6)
+    np.testing.assert_allclose(agg["loss"], float(ev["loss"]), rtol=1e-5)
+
+    # train split works too and respects max_clients
+    pc_train, agg_train = api.evaluate_per_client(split="train", max_clients=3)
+    assert len(pc_train) == 3 and agg_train["count"] > 0

@@ -109,3 +109,22 @@ def test_cli_build_api_all_algos():
         ])
         api, data = build_api(args)
         assert api is not None
+
+
+def test_cli_fedseg_split_gkt_vfl_smoke(tmp_path):
+    """CI-script parity: the remaining algorithm entries launch end-to-end
+    through the unified CLI (tiny configs)."""
+    from fedml_tpu.experiments.cli import main
+
+    main(["--algo", "fedseg", "--dataset", "pascal_voc", "--comm_round", "1",
+          "--client_num_per_round", "2", "--batch_size", "2", "--ci", "1",
+          "--frequency_of_the_test", "1", "--run_dir", str(tmp_path)])
+    main(["--algo", "split_nn", "--dataset", "mnist", "--client_num_in_total", "4",
+          "--comm_round", "1", "--client_num_per_round", "2", "--batch_size", "8",
+          "--max_batches", "2", "--ci", "1", "--run_dir", str(tmp_path)])
+    main(["--algo", "fedgkt", "--dataset", "mnist", "--client_num_in_total", "4",
+          "--comm_round", "1", "--client_num_per_round", "2", "--batch_size", "8",
+          "--max_batches", "2", "--ci", "1", "--frequency_of_the_test", "1",
+          "--run_dir", str(tmp_path)])
+    main(["--algo", "vfl", "--dataset", "uci_susy", "--comm_round", "2",
+          "--batch_size", "64", "--lr", "0.05", "--run_dir", str(tmp_path)])
