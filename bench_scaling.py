@@ -1,0 +1,60 @@
+"""Client-scaling benchmark: rounds/sec and samples/sec vs clients-per-round.
+
+BASELINE.md north-star row 3: "client scaling 8 -> 256 simulated clients,
+near-linear". The SPMD engine vmaps clients, so scaling K multiplies work
+per round; throughput in samples/sec should grow until the chip saturates.
+
+Usage:  python bench_scaling.py [--device_data 1] [--points 8,32,128,256]
+Prints one JSON line per point (bench.py remains the single-line driver
+benchmark; this script is the scaling study).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=str, default="8,32,128,256")
+    ap.add_argument("--device_data", type=int, default=1)
+    ap.add_argument("--rounds", type=int, default=10)
+    args = ap.parse_args()
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.registry import load_dataset
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    data = load_dataset("femnist", seed=0, uint8_pixels=True)
+    task = classification_task(CNNOriginalFedAvg(only_digits=False))
+
+    for k in [int(p) for p in args.points.split(",")]:
+        cfg = FedAvgConfig(
+            comm_round=args.rounds, client_num_in_total=data.num_clients,
+            client_num_per_round=k, epochs=1, batch_size=20, lr=0.1,
+            frequency_of_the_test=10_000, max_batches=28,
+        )
+        api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
+        api.run_round(0)
+        jax.block_until_ready(api.net.params)
+        t0 = time.perf_counter()
+        for r in range(1, args.rounds + 1):
+            m = api.run_round(r)
+        jax.block_until_ready(api.net.params)
+        dt = time.perf_counter() - t0
+        rps = args.rounds / dt
+        print(json.dumps({
+            "clients_per_round": k,
+            "rounds_per_sec": round(rps, 3),
+            "samples_per_sec": round(float(m["count"]) * rps, 1),
+            "device": jax.devices()[0].platform,
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Cross-device synchronized BatchNorm + GroupNorm helper.
+
+Mirror of fedml_api/model/cv/batchnorm_utils.py (DataParallelWithCallback +
+SynchronizedBatchNorm, 462 LoC of CUDA-stream choreography) and
+group_normalization.py. On TPU the whole mechanism collapses: flax's
+BatchNorm already reduces batch statistics over a named mesh axis when
+``axis_name`` is set — inside shard_map/pmap the mean/var become a psum
+over the axis, which is exactly sync-BN, scheduled by XLA over ICI.
+
+``sync_batchnorm("clients")`` inside a client-sharded model makes BN behave
+as if the global batch (all devices) were normalized together — the
+single-process DataParallel semantics the reference's utility recreates.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+
+
+def sync_batchnorm(axis_name: str, momentum: float = 0.9, epsilon: float = 1e-5):
+    """BatchNorm constructor whose statistics sync over ``axis_name``.
+
+    Use inside shard_map/pmap bodies; outside any mapped axis, construct
+    plain ``nn.BatchNorm`` instead (flax raises on unbound axis names).
+    """
+    return partial(
+        nn.BatchNorm, momentum=momentum, epsilon=epsilon, axis_name=axis_name
+    )
+
+
+def group_norm(num_groups: int = 8):
+    """GroupNorm helper (model/cv/group_normalization.py analogue) — the
+    stateless alternative recommended for federated averaging (no running
+    stats to aggregate)."""
+    return partial(nn.GroupNorm, num_groups=num_groups)

@@ -1,0 +1,44 @@
+"""Sync-BN: per-device BatchNorm with axis_name must equal global-batch BN."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.models.norm import sync_batchnorm
+
+
+class _BNNet(nn.Module):
+    axis: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = (sync_batchnorm(self.axis)() if self.axis
+              else nn.BatchNorm(momentum=0.9))
+        return bn(x, use_running_average=not train)
+
+
+def test_sync_bn_equals_global_batch_bn():
+    mesh = jax.make_mesh((8,), ("clients",))
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 4))  # 8 shards of 4
+
+    global_net = _BNNet()
+    gv = global_net.init(jax.random.PRNGKey(1), x)
+    ref, gstats = global_net.apply(gv, x, mutable=["batch_stats"])
+
+    sync_net = _BNNet(axis="clients")
+    sv = sync_net.init(jax.random.PRNGKey(1), x[:4])
+
+    def body(params, xs):
+        out, stats = sync_net.apply(params, xs, mutable=["batch_stats"])
+        return out, stats
+
+    out, stats = jax.jit(
+        jax.shard_map(body, mesh=mesh,
+                      in_specs=(P(), P("clients")), out_specs=(P("clients"), P()))
+    )(sv, x)
+
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(stats), jax.tree.leaves(gstats)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5)
