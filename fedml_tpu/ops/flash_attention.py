@@ -115,15 +115,17 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
 
 
 # ---------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k, seq_len, causal, scale):
+# ds_ij = p_ij * (dp_ij - delta_i + glse_i): the last term is the cotangent
+# of the lse output (dlse_i/ds_ij = p_ij), zero when only `out` is used.
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+                   dq_ref, *, block_k, seq_len, causal, scale):
     bq = q_ref.shape[1]
     qi = pl.program_id(1)
     q0 = qi * bq
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0]
-    delta = delta_ref[0]
+    corr = glse_ref[0] - delta_ref[0]
     nk = pl.cdiv(k_ref.shape[1], block_k)
 
     def body(j, dq):
@@ -133,14 +135,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _mask(s, q0, j * block_k, bq, block_k, seq_len, causal)
         p = jnp.exp(s - lse[:, None])
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp + corr[:, None])
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
 
     dq = jax.lax.fori_loop(0, nk, body, jnp.zeros_like(q))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
                     dk_ref, dv_ref, *, block_q, seq_len, causal, scale):
     bk = k_ref.shape[1]
     ki = pl.program_id(1)
@@ -154,13 +156,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        corr = (glse_ref[0, pl.ds(i * block_q, block_q)]
+                - delta_ref[0, pl.ds(i * block_q, block_q)])
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         s = _mask(s, i * block_q, k0, block_q, bk, seq_len, causal)
         p = jnp.exp(s - lse[:, None])
         dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp + corr[:, None])
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * scale
         return dk, dv
 
@@ -171,6 +174,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 # ------------------------------------------------------------------ public
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal: bool = False, block_q: int = 128,
+                             block_k: int = 128):
+    """flash attention returning (out [B,T,H,D], lse [B,H,T]).
+
+    The per-row logsumexp is a first-class output with a correct cotangent
+    (folded into the backward kernels), so downstream code may use it —
+    ring attention merges per-rotation partials as
+    out = w1*out1 + w2*out2, w_i = exp(lse_i - logaddexp(lse1, lse2))
+    (parallel/ring_attention.ring_attention_flash) and gradients stay exact.
+    """
+    out, (_, lse) = _flash_call(q, k, v, causal, block_q, block_k)
+    B, T, H, D = q.shape
+    return out, lse[:, :T].reshape(B, H, T)
+
+
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
                     block_k: int = 128):
     """softmax(QK^T/sqrt(D))V with O(T) memory. [B, T, H, D] in/out.
@@ -178,8 +196,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     Equivalent to parallel/ring_attention.full_attention; pads T internally
     to the block size, so any sequence length works.
     """
-    out, _ = _flash_call(q, k, v, causal, block_q, block_k)
-    return out
+    return flash_attention_with_lse(q, k, v, causal, block_q, block_k)[0]
 
 
 def _flash_call(q, k, v, causal, block_q, block_k):
@@ -191,10 +208,12 @@ def _flash_call(q, k, v, causal, block_q, block_k):
 
 def _fwd_rule(q, k, v, causal, block_q, block_k):
     out, (o, lse) = _flash_call(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, o, lse)
+    B, T, H, D = q.shape
+    return (out, lse[:, :T].reshape(B, H, T)), (q, k, v, o, lse)
 
 
-def _bwd_rule(causal, block_q, block_k, res, g):
+def _bwd_rule(causal, block_q, block_k, res, gs):
+    g, g_lse = gs
     q, k, v, o, lse = res
     B, T, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -209,6 +228,9 @@ def _bwd_rule(causal, block_q, block_k, res, g):
     dof = prep(g)
     # delta_i = sum_d dO_i O_i (the rowwise correction of the softmax vjp)
     delta = jnp.sum(dof.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # lse cotangent, padded back to [BH, Tpad] (zeros on out-only use)
+    glse = jnp.pad(g_lse.astype(jnp.float32).reshape(BH, T),
+                   ((0, 0), (0, Tpad - T)))
 
     common_in = [
         pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
@@ -230,11 +252,12 @@ def _bwd_rule(causal, block_q, block_k, res, g):
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
         interpret=_use_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, glse)
 
     dkf, dvf = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, block_q=block_q, seq_len=T,
@@ -248,14 +271,14 @@ def _bwd_rule(causal, block_q, block_k, res, g):
             common_in[0],
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
-            common_in[3], common_in[4], common_in[5],
+            common_in[3], common_in[4], common_in[5], common_in[5],
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_k, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
         ),
         interpret=_use_interpret(),
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse, delta, glse)
 
     def unprep(x):
         return jnp.moveaxis(x[:, :T].reshape(B, H, T, D), 1, 2)
@@ -263,4 +286,4 @@ def _bwd_rule(causal, block_q, block_k, res, g):
     return unprep(dqf), unprep(dkf), unprep(dvf)
 
 
-flash_attention.defvjp(_fwd_rule, _bwd_rule)
+flash_attention_with_lse.defvjp(_fwd_rule, _bwd_rule)

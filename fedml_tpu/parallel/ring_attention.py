@@ -123,3 +123,71 @@ def ulysses_attention_sharded(mesh: Mesh, axis_name: str = "seq",
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
     ))
+
+
+def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
+                         block_q: int = 128, block_k: int = 128):
+    """Ring attention with the Pallas flash kernel as the per-step block op.
+
+    Call INSIDE shard_map (same contract as ring_attention). Each rotation
+    computes this device's queries against the currently-held K/V block with
+    fedml_tpu.ops.flash_attention_with_lse, then merges into the running
+    result by logsumexp weighting:
+
+        lse' = logaddexp(lse, lse_b)
+        o'   = exp(lse - lse')*o + exp(lse_b - lse')*o_b
+
+    Causality across blocks is positional: the s=0 rotation (own block) uses
+    the kernel's causal mask; for s>0 a block contributes iff its ring
+    source precedes this device (src < idx), else its lse is -inf and the
+    merge is a no-op. Gradients are exact — the lse output carries a true
+    cotangent through the kernel's custom VJP.
+    """
+    from fedml_tpu.ops.flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # no pcast here: the wrapper runs with check_vma=False because
+    # pallas_call out_shapes carry no vma annotation
+    o = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full((q.shape[0], q.shape[2], q.shape[1]), -jnp.inf, jnp.float32)
+
+    def merge(o, lse, o_b, lse_b):
+        lse_new = jnp.logaddexp(lse, lse_b)
+        w = lambda a: jnp.where(jnp.isfinite(lse_new), jnp.exp(a - lse_new), 0.0)
+        w1, w2 = w(lse), w(lse_b)
+        # weights are [B, H, Tq] -> broadcast over [B, Tq, H, D]
+        bc = lambda t: t.transpose(0, 2, 1)[..., None]
+        return bc(w1) * o + bc(w2) * o_b.astype(jnp.float32), lse_new
+
+    # python loop: n is static inside shard_map, and s=0 needs the causal
+    # kernel variant while s>0 uses the full kernel + dynamic src gating
+    kk, vv = k, v
+    for s in range(n):
+        if s == 0:
+            o_b, lse_b = flash_attention_with_lse(q, kk, vv, causal, block_q, block_k)
+        else:
+            o_b, lse_b = flash_attention_with_lse(q, kk, vv, False, block_q, block_k)
+            if causal:
+                src = (idx - s) % n
+                lse_b = jnp.where(src < idx, lse_b, -jnp.inf)
+        o, lse = merge(o, lse, o_b, lse_b)
+        if s != n - 1:
+            kk = lax.ppermute(kk, axis_name, perm)
+            vv = lax.ppermute(vv, axis_name, perm)
+    return o.astype(q.dtype)
+
+
+def ring_attention_flash_sharded(mesh: Mesh, axis_name: str = "seq",
+                                 causal: bool = False, block_q: int = 128,
+                                 block_k: int = 128):
+    f = partial(ring_attention_flash, axis_name=axis_name, causal=causal,
+                block_q=block_q, block_k=block_k)
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    ))
