@@ -96,10 +96,14 @@ def ring_attention_sharded(mesh: Mesh, axis_name: str = "seq",
     ))
 
 
-def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      use_flash: bool = False):
     """Call INSIDE shard_map. DeepSpeed-Ulysses: all_to_all swaps the sharded
     axis from sequence to heads, each device computes FULL-sequence attention
-    for H/N heads, then swaps back. Requires H % axis_size == 0."""
+    for H/N heads, then swaps back. Requires H % axis_size == 0.
+    ``use_flash`` runs the per-device full-sequence attention through the
+    Pallas flash kernel (fedml_tpu.ops) — O(T) memory for the long sequence
+    each device now holds."""
     n = lax.axis_size(axis_name)
     # [B, T/N, H, D] -> all_to_all on H -> [B, T, H/N, D]
     def scatter_heads(x):
@@ -111,17 +115,24 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False):
                               tiled=True)
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    oh = full_attention(qh, kh, vh, causal=causal)
+    if use_flash:
+        from fedml_tpu.ops.flash_attention import flash_attention
+
+        oh = flash_attention(qh, kh, vh, causal)
+    else:
+        oh = full_attention(qh, kh, vh, causal=causal)
     return gather_seq(oh)
 
 
 def ulysses_attention_sharded(mesh: Mesh, axis_name: str = "seq",
-                              causal: bool = False):
-    f = partial(ulysses_attention, axis_name=axis_name, causal=causal)
+                              causal: bool = False, use_flash: bool = False):
+    f = partial(ulysses_attention, axis_name=axis_name, causal=causal,
+                use_flash=use_flash)
     return jax.jit(jax.shard_map(
         f, mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
+        check_vma=not use_flash,  # pallas out_shapes carry no vma
     ))
 
 

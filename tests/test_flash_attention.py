@@ -118,3 +118,20 @@ def test_ring_attention_flash_gradients():
     for a, b in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_ulysses_flash_matches_dense():
+    from fedml_tpu.parallel.ring_attention import (full_attention,
+                                                   ulysses_attention_sharded)
+
+    mesh = jax.make_mesh((2,), ("seq",))
+    B, T, H, D = 1, 64, 4, 16
+    key = jax.random.PRNGKey(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, T, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, T, H, D), jnp.float32)
+    f = ulysses_attention_sharded(mesh, "seq", causal=True, use_flash=True)
+    np.testing.assert_allclose(np.asarray(f(q, k, v)),
+                               np.asarray(full_attention(q, k, v, causal=True)),
+                               rtol=3e-5, atol=3e-5)
