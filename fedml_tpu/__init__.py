@@ -16,15 +16,24 @@ reference layer map in SURVEY.md) for TPU hardware:
 Subpackages
 -----------
 mesh        device mesh + sharding helpers                    (L0)
-collectives tested collective wrappers = the "comm backend"   (L1)
+collectives tested collective wrappers (on-TPU "comm backend") (L1)
+comm        cross-process transports: loopback | gRPC | MQTT,
+            Message/Observer/manager pattern                  (L1)
 core        client state, local update, round engine, sampler,
-            partitioner, robust aggregation, topology         (L2)
-models      flax model zoo                                    (L3a)
-data        partitioned dataset loaders (8-tuple contract)    (L3b)
+            partitioner, robust aggregation, topology,
+            checkpointing, schedules                          (L2)
+models      flax model zoo (+ sync-BN, norm-free ResNet)      (L3a)
+data        partitioned dataset loaders (8-tuple contract),
+            vertical tabular, poisoning, augmentation         (L3b)
 algorithms  FedAvg, FedOpt, FedProx, FedNova, hierarchical,
             decentralized, robust, FedDF, SplitNN, VFL,
-            TurboAggregate, FedGKT, FedNAS                    (L4)
-experiments unified CLI launcher                              (L5)
+            TurboAggregate, FedGKT, FedNAS, FedSeg            (L4)
+distributed cross-process 6-file runtimes over ``comm``       (L4)
+parallel    ring / Ulysses sequence parallelism
+ops         Pallas TPU kernels (flash attention)
+native      C++ host data plane (ctypes)
+experiments unified CLI + multi-process launcher              (L5)
+utils       pytree ops, metrics, tracing, condensation
 """
 
 __version__ = "0.1.0"
