@@ -1,0 +1,14 @@
+"""Cross-process SplitNN — per-batch activation/gradient exchange.
+
+Mirror of fedml_api/distributed/split_nn/ (SURVEY.md §3.4): the client owns
+the lower model cut, the server the upper; every batch crosses the process
+boundary twice (activations up, gradients back), and clients take turns in
+a ring. The math is the exact split of the in-process engine's batch_step
+(algorithms/split_nn.py), so the two runtimes converge identically.
+"""
+
+from fedml_tpu.distributed.split_nn.api import run_simulated
+from fedml_tpu.distributed.split_nn.client_manager import SplitNNClientManager
+from fedml_tpu.distributed.split_nn.server_manager import SplitNNServerManager
+
+__all__ = ["run_simulated", "SplitNNClientManager", "SplitNNServerManager"]

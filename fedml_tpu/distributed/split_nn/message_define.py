@@ -1,0 +1,16 @@
+"""SplitNN message vocabulary (split_nn/message_define.py analogue)."""
+
+
+class SplitMessage:
+    MSG_TYPE_S2C_START = "split_s2c_start"       # your turn: (round, client_id)
+    MSG_TYPE_S2C_GRADS = "split_s2c_grads"       # grads for the last acts
+    MSG_TYPE_S2C_FINISH = "split_s2c_finish"
+    MSG_TYPE_C2S_ACTS = "split_c2s_acts"         # acts + labels + mask
+    MSG_TYPE_C2S_TURN_DONE = "split_c2s_done"    # my shard is exhausted
+
+    KEY_ACTS = "acts"
+    KEY_LABELS = "labels"
+    KEY_MASK = "mask"
+    KEY_GRADS = "grads"
+    KEY_ROUND = "round_idx"
+    KEY_CLIENT_ID = "client_id"

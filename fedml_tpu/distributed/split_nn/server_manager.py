@@ -1,0 +1,130 @@
+"""SplitNN server: upper model owner + ring coordinator.
+
+Mirror of split_nn/server.py forward_pass/backward_pass (:40-60) fused into
+one jitted step: loss on incoming activations, server-parameter update, and
+the activation gradient shipped back. Ring turn-taking parity with
+SplitNNAPI.train (algorithms/split_nn.py:106-128): per round, epochs x
+clients turns in rank order.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.comm.managers import ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.distributed.split_nn.message_define import SplitMessage
+
+log = logging.getLogger("fedml_tpu.distributed.split_nn")
+
+
+class SplitNNServerManager(ServerManager):
+    def __init__(self, dataset, server_module, cfg, rank=0, size=0,
+                 backend="LOOPBACK", **kw):
+        self.data, self.sm, self.cfg = dataset, server_module, cfg
+        self.num_clients = size - 1
+        self.round_idx = 0
+        self.epoch_idx = 0
+        self.turn = 0  # which client rank-1 is active
+        self.history: list[dict] = []
+        self._aux = jnp.zeros(3)
+
+        # identical init derivation to SplitNNAPI.__init__ (k2 of the split)
+        key = jax.random.PRNGKey(cfg.seed)
+        k1, k2 = jax.random.split(key)
+        from fedml_tpu.distributed.split_nn.client_manager import client_acts_shape
+
+        acts0 = client_acts_shape(dataset, cfg, k1)
+        svars = server_module.init(k2, acts0, train=False)
+        self.sp = svars["params"]
+        self.stx = optax.sgd(cfg.lr)
+        self.sopt = self.stx.init(self.sp)
+
+        sm, stx = server_module, self.stx
+
+        @jax.jit
+        def server_step(sp, sopt, acts, y, m):
+            def loss_fn(sp_, acts_):
+                logits = sm.apply({"params": sp_}, acts_, train=True)
+                per = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+                n = jnp.maximum(jnp.sum(m), 1.0)
+                l = jnp.sum(per * m) / n
+                correct = jnp.sum((jnp.argmax(logits, -1) == y) * m)
+                return l, (jnp.sum(per * m), correct, jnp.sum(m))
+
+            (l, aux), (gs, g_acts) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(sp, acts)
+            has = jnp.sum(m) > 0
+            upd, sopt_n = stx.update(gs, sopt, sp)
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jax.lax.select(has, a, b), new, old)
+            return (keep(optax.apply_updates(sp, upd), sp), keep(sopt_n, sopt),
+                    g_acts, jnp.stack(aux))
+
+        self._server_step = server_step
+        super().__init__(rank, size, backend, **kw)
+
+    # ------------------------------------------------------------------ flow
+    def run(self):
+        self._start_turn()
+        super().run()
+
+    def _active_rank(self) -> int:
+        return 1 + self.turn
+
+    def _start_turn(self):
+        ids = sample_clients(self.round_idx, self.data.num_clients,
+                             self.num_clients, self.cfg.seed)
+        msg = Message(SplitMessage.MSG_TYPE_S2C_START, self.rank, self._active_rank())
+        msg.add_params(SplitMessage.KEY_ROUND, self.round_idx)
+        msg.add_params(SplitMessage.KEY_CLIENT_ID, int(ids[self.turn]))
+        self.send_message(msg)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(SplitMessage.MSG_TYPE_C2S_ACTS,
+                                              self._on_acts)
+        self.register_message_receive_handler(SplitMessage.MSG_TYPE_C2S_TURN_DONE,
+                                              self._on_turn_done)
+
+    def _on_acts(self, params):
+        acts = jnp.asarray(params[SplitMessage.KEY_ACTS])
+        y = jnp.asarray(params[SplitMessage.KEY_LABELS])
+        m = jnp.asarray(params[SplitMessage.KEY_MASK])
+        self.sp, self.sopt, g_acts, aux = self._server_step(
+            self.sp, self.sopt, acts, y, m)
+        self._aux = self._aux + aux
+        msg = Message(SplitMessage.MSG_TYPE_S2C_GRADS, self.rank,
+                      params[Message.MSG_ARG_KEY_SENDER])
+        msg.add_params(SplitMessage.KEY_GRADS, jax.device_get(g_acts))
+        self.send_message(msg)
+
+    def _on_turn_done(self, _params):
+        self.turn += 1
+        if self.turn < self.num_clients:
+            self._start_turn()
+            return
+        self.turn = 0
+        self.epoch_idx += 1
+        if self.epoch_idx < self.cfg.epochs:
+            self._start_turn()
+            return
+        self.epoch_idx = 0
+        aux = jax.device_get(self._aux)
+        n = max(float(aux[2]), 1.0)
+        self.history.append({"round": self.round_idx,
+                             "train_loss": float(aux[0]) / n,
+                             "train_acc": float(aux[1]) / n})
+        self._aux = jnp.zeros(3)
+        self.round_idx += 1
+        if self.round_idx >= self.cfg.comm_round:
+            for r in range(1, self.size):
+                self.send_message(Message(SplitMessage.MSG_TYPE_S2C_FINISH,
+                                          self.rank, r))
+            self.finish()
+            return
+        self._start_turn()
