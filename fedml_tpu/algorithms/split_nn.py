@@ -35,6 +35,7 @@ class SplitNNConfig:
     batch_size: int = 32
     lr: float = 0.01
     client_num: int = 4
+    comm_round: int = 1        # rounds driven by the cross-process runtime
     max_batches: int | None = None
     seed: int = 0
 

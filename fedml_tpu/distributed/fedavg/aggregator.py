@@ -87,6 +87,8 @@ class FedAvgAggregator:
         )
 
     # ----------------------------------------------------------------- eval
+    ci_eval_cap = 512  # --ci truncation (FedAVGAggregator.py:126-131)
+
     def test_on_server_for_all_clients(self, round_idx: int) -> None:
         cfg = self.cfg
         if round_idx % cfg.frequency_of_the_test != 0 and round_idx != cfg.comm_round - 1:
@@ -94,13 +96,17 @@ class FedAvgAggregator:
         if self._test_cache is None:
             n = len(self.dataset.test_x)
             if cfg.ci:
-                n = min(n, 512)  # --ci truncation (FedAVGAggregator.py:126-131)
+                n = min(n, self.ci_eval_cap)
             self._test_cache = tuple(
                 jnp.asarray(a)
                 for a in batch_global(
                     self.dataset.test_x[:n], self.dataset.test_y[:n], cfg.eval_batch_size
                 )
             )
+        self._record_eval(round_idx)
+
+    def _record_eval(self, round_idx: int) -> None:
+        """Metric hook over the cached test batches (subclasses override)."""
         ev = self.eval_fn(self.net, *self._test_cache)
         rec = {"round": round_idx, "test_loss": float(ev["loss"]), "test_acc": float(ev["acc"])}
         self.history.append(rec)

@@ -10,8 +10,6 @@ reference's "fake cluster = many processes on one box" pattern (SURVEY.md
 
 from __future__ import annotations
 
-import threading
-
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.core.client_data import FederatedData
 from fedml_tpu.core.local import Task
@@ -19,6 +17,7 @@ from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 
 
 def init_server(dataset, task, cfg, size, backend, **kw):
@@ -26,8 +25,8 @@ def init_server(dataset, task, cfg, size, backend, **kw):
     return FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
 
 
-def init_client(dataset, task, cfg, rank, size, backend, **kw):
-    trainer = DistributedTrainer(rank, dataset, task, cfg)
+def init_client(dataset, task, cfg, rank, size, backend, local_spec=None, **kw):
+    trainer = DistributedTrainer(rank, dataset, task, cfg, local_spec=local_spec)
     return FedAvgClientManager(trainer, rank=rank, size=size, backend=backend, **kw)
 
 
@@ -62,17 +61,11 @@ def run_simulated(
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue."""
     size = cfg.client_num_per_round + 1
-    kw = {"job_id": job_id} if backend.upper() == "LOOPBACK" else {"base_port": base_port}
-
+    kw = backend_kwargs(backend, job_id, base_port)
     aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
     server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
     clients = [
         init_client(dataset, task, cfg, rank, size, backend, **kw) for rank in range(1, size)
     ]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=60)
+    launch_simulated(server, clients)
     return aggregator

@@ -18,7 +18,8 @@ from fedml_tpu.core.local import LocalSpec, Task, make_local_update
 
 
 class DistributedTrainer:
-    def __init__(self, client_rank: int, dataset: FederatedData, task: Task, cfg: FedAvgConfig):
+    def __init__(self, client_rank: int, dataset: FederatedData, task: Task,
+                 cfg: FedAvgConfig, local_spec: LocalSpec | None = None):
         self.dataset, self.task, self.cfg = dataset, task, cfg
         self.client_index = client_rank - 1  # re-assigned per round by the server
 
@@ -26,7 +27,9 @@ class DistributedTrainer:
         b_needed = int(np.ceil(max(counts) / cfg.batch_size))
         self.num_batches = min(cfg.max_batches or b_needed, b_needed)
 
-        spec = LocalSpec(optimizer=make_client_optimizer(cfg), epochs=cfg.epochs)
+        spec = local_spec or LocalSpec(
+            optimizer=make_client_optimizer(cfg), epochs=cfg.epochs
+        )
         self.local_update = jax.jit(make_local_update(task, spec))
 
         # template NetState for wire unpacking; derive the init key exactly
@@ -43,17 +46,19 @@ class DistributedTrainer:
     def update_dataset(self, client_index: int) -> None:
         self.client_index = int(client_index)
 
-    def train(self, round_idx: int):
-        """Run the local fit on the currently assigned client's data.
-
-        Returns (wire_leaves, local_sample_number).
-        """
+    def fit(self, round_idx: int) -> int:
+        """Run the local fit on the currently assigned client's data
+        (result in self.net); returns the local sample count."""
         cb = pack_clients(
             self.dataset, [self.client_index], self.cfg.batch_size,
             max_batches=self.num_batches, seed=self.cfg.seed, round_idx=round_idx,
         )
         rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
         rng = jax.random.fold_in(rng, self.client_index)
-        new_net, _metrics = self.local_update(rng, self.net, cb.x[0], cb.y[0], cb.mask[0])
-        self.net = new_net
-        return pack_pytree(new_net), int(cb.num_samples[0])
+        self.net, _metrics = self.local_update(rng, self.net, cb.x[0], cb.y[0], cb.mask[0])
+        return int(cb.num_samples[0])
+
+    def train(self, round_idx: int):
+        """Returns (wire_leaves, local_sample_number)."""
+        n = self.fit(round_idx)
+        return pack_pytree(self.net), n

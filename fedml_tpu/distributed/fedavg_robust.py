@@ -1,0 +1,73 @@
+"""Distributed robust FedAvg — defenses applied at the server aggregator.
+
+Mirror of fedml_api/distributed/fedavg_robust/ (6-file pattern): the message
+flow, trainer, and managers are FedAvg's; FedAvgRobustAggregator.py applies
+the fedml_core/robustness defenses before/after the weighted average
+(--defense_type norm_diff_clipping|weak_dp, --norm_bound, --stddev,
+robust_aggregation.py:33-36). Here each uploaded update is norm-diff-clipped
+against the current global model inside one jitted pass, and weak-DP noise
+is added to the aggregate — the same pure pytree ops the SPMD
+FedAvgRobustAPI runs as engine hooks (algorithms/fedavg_robust.py).
+"""
+
+from __future__ import annotations
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+from fedml_tpu.core.local import NetState
+from fedml_tpu.core.robust import add_gaussian_noise, norm_diff_clipping
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.api import init_client
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+
+class FedAvgRobustAggregator(FedAvgAggregator):
+    def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
+                 defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'none'
+                 norm_bound: float = 30.0, stddev: float = 0.025):
+        super().__init__(dataset, task, cfg, worker_num)
+        self.defense_type = defense_type
+        self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
+
+        @jax.jit
+        def clip(net: NetState, net_global: NetState) -> NetState:
+            return NetState(
+                norm_diff_clipping(net.params, net_global.params, norm_bound),
+                net.extra,
+            )
+
+        @jax.jit
+        def noise(net: NetState, rng) -> NetState:
+            return NetState(add_gaussian_noise(rng, net.params, stddev), net.extra)
+
+        self._clip, self._noise = clip, noise
+
+    def aggregate(self):
+        if self.defense_type in ("norm_diff_clipping", "weak_dp"):
+            for r in list(self.model_dict):
+                net_r = unpack_pytree(self.net, self.model_dict[r])
+                self.model_dict[r] = pack_pytree(self._clip(net_r, self.net))
+        out = super().aggregate()  # weighted average -> self.net
+        if self.defense_type == "weak_dp":
+            self._noise_rng, k = jax.random.split(self._noise_rng)
+            self.net = self._noise(self.net, k)
+            out = pack_pytree(self.net)
+        return out
+
+
+def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
+                  job_id="fedavg-robust-sim", base_port=50000, **defense_kw):
+    """All ranks as threads (mpirun-on-localhost analogue); returns the
+    aggregator with .net/.history."""
+    size = cfg.client_num_per_round + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    aggregator = FedAvgRobustAggregator(dataset, task, cfg, worker_num=size - 1,
+                                        **defense_kw)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    clients = [init_client(dataset, task, cfg, r, size, backend, **kw)
+               for r in range(1, size)]
+    launch_simulated(server, clients)
+    return aggregator

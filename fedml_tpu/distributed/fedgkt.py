@@ -1,0 +1,193 @@
+"""Distributed FedGKT — split computing + group knowledge transfer.
+
+Mirror of fedml_api/distributed/fedgkt/: each client trains its small
+extractor+head locally (with KL distillation from last round's server logits,
+GKTClientTrainer.py:49-60), then ships per-batch feature maps + logits +
+labels to the server (the reference's C2S message); the server trains the
+large trunk on all clients' features with bidirectional KL
+(GKTServerTrainer.train_large_model_on_the_server, GKTServerTrainer.py:233)
+and returns fresh per-client server logits for the next round's KD.
+
+Both phases are the exact jitted programs the SPMD FedGKTAPI builds
+(algorithms/fedgkt.py), borrowed via a shared API instance, so the
+cross-process runtime matches the in-process simulation exactly (tested).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.client_data import FederatedData, pack_clients
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+log = logging.getLogger("fedml_tpu.distributed.fedgkt")
+
+
+class GKTMessage:
+    MSG_TYPE_S2C_SYNC = 1       # server logits (or round-0 empty) + client index
+    MSG_TYPE_C2S_FEATURES = 2   # feats, client logits, labels, mask, n
+    MSG_TYPE_S2C_FINISH = 3
+
+    ARG_ROUND = "round"
+    ARG_CLIENT_INDEX = "client_idx"
+    ARG_S_LOGITS = "s_logits"
+    ARG_FEATS = "feats"
+    ARG_C_LOGITS = "c_logits"
+    ARG_LABELS = "labels"
+    ARG_MASK = "mask"
+
+
+class GKTClientWorker:
+    """One worker slot: persistent extractor+head for whichever client id the
+    server assigns it each round (slot semantics match the SPMD engine's
+    vmapped K axis, so the two runtimes agree bit-for-bit)."""
+
+    def __init__(self, slot: int, dataset: FederatedData, api: FedGKTAPI):
+        self.slot, self.data, self.api = slot, dataset, api
+        cfg = api.cfg
+        counts = [len(v) for v in dataset.train_idx_map.values()]
+        b = int(np.ceil(max(counts) / cfg.batch_size))
+        self.num_batches = min(cfg.max_batches or b, b)
+        # this slot's row of the API's stacked per-client params
+        self.ext_p = jax.tree.map(lambda v: v[slot], api.ext_params)
+        self.head_p = jax.tree.map(lambda v: v[slot], api.head_params)
+        self._phase = api._client_phase  # vmapped; called with K=1
+
+    def train(self, round_idx: int, client_index: int, s_logits):
+        cfg = self.api.cfg
+        cb = pack_clients(self.data, [client_index], cfg.batch_size,
+                          max_batches=self.num_batches, seed=cfg.seed,
+                          round_idx=round_idx)
+        x, y, m = jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)
+        if s_logits is None:
+            sl = jnp.zeros(x.shape[:3] + (self.api.num_classes,))
+            use_kd = 0.0
+        else:
+            sl, use_kd = jnp.asarray(s_logits)[None], 1.0
+        add1 = lambda t: jax.tree.map(lambda v: v[None], t)
+        ep, hp, feats, logits, aux = self._phase(
+            add1(self.ext_p), add1(self.head_p), x, y, m, sl, use_kd)
+        self.ext_p = jax.tree.map(lambda v: v[0], ep)
+        self.head_p = jax.tree.map(lambda v: v[0], hp)
+        return (np.asarray(feats[0]), np.asarray(logits[0]), np.asarray(cb.y[0]),
+                np.asarray(cb.mask[0]))
+
+
+class GKTServerManager(ServerManager):
+    def __init__(self, dataset: FederatedData, api: FedGKTAPI, rank=0, size=0,
+                 backend="LOOPBACK", **kw):
+        self.data, self.api = dataset, api
+        self.round_idx = 0
+        self.round_num = api.cfg.comm_round
+        self._uploads: dict[int, tuple] = {}
+        self._s_logits = None  # [K, B, bs, C] after the first server phase
+        self._lock = threading.Lock()
+        super().__init__(rank, size, backend, **kw)
+
+    def run(self):
+        self._send_sync()
+        super().run()
+
+    def _send_sync(self):
+        cfg = self.api.cfg
+        ids = sample_clients(self.round_idx, cfg.client_num_in_total,
+                             cfg.client_num_per_round, cfg.seed)
+        for rank in range(1, self.size):
+            msg = Message(GKTMessage.MSG_TYPE_S2C_SYNC, self.rank, rank)
+            msg.add_params(GKTMessage.ARG_ROUND, self.round_idx)
+            msg.add_params(GKTMessage.ARG_CLIENT_INDEX, int(ids[rank - 1]))
+            if self._s_logits is not None:
+                msg.add_params(GKTMessage.ARG_S_LOGITS,
+                               np.asarray(self._s_logits[rank - 1]))
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            GKTMessage.MSG_TYPE_C2S_FEATURES, self.handle_features)
+
+    def handle_features(self, msg_params):
+        with self._lock:
+            sender = msg_params[Message.MSG_ARG_KEY_SENDER]
+            self._uploads[sender - 1] = (
+                msg_params[GKTMessage.ARG_FEATS],
+                msg_params[GKTMessage.ARG_C_LOGITS],
+                msg_params[GKTMessage.ARG_LABELS],
+                msg_params[GKTMessage.ARG_MASK],
+            )
+            if len(self._uploads) < self.size - 1:
+                return
+            slots = sorted(self._uploads)
+            stack = lambda i: jnp.stack(
+                [jnp.asarray(self._uploads[s][i]) for s in slots])
+            feats, c_logits, y, m = (stack(i) for i in range(4))
+            api = self.api
+            api.server_params, api.server_opt, self._s_logits = api._server_phase(
+                api.server_params, api.server_opt, feats, c_logits, y, m)
+            self._uploads.clear()
+            self.round_idx += 1
+            if self.round_idx == self.round_num:
+                for rank in range(1, self.size):
+                    self.send_message(
+                        Message(GKTMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+                self.finish()
+                return
+            self._send_sync()
+
+
+class GKTClientManager(ClientManager):
+    def __init__(self, worker: GKTClientWorker, rank, size, backend="LOOPBACK", **kw):
+        self.worker = worker
+        super().__init__(rank, size, backend, **kw)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            GKTMessage.MSG_TYPE_S2C_SYNC, self.handle_sync)
+        self.register_message_receive_handler(
+            GKTMessage.MSG_TYPE_S2C_FINISH, lambda _m: self.finish())
+
+    def handle_sync(self, msg_params):
+        round_idx = int(msg_params[GKTMessage.ARG_ROUND])
+        client_index = int(msg_params[GKTMessage.ARG_CLIENT_INDEX])
+        s_logits = msg_params.get(GKTMessage.ARG_S_LOGITS)
+        feats, logits, y, m = self.worker.train(round_idx, client_index, s_logits)
+        msg = Message(GKTMessage.MSG_TYPE_C2S_FEATURES, self.rank, 0)
+        msg.add_params(GKTMessage.ARG_FEATS, feats)
+        msg.add_params(GKTMessage.ARG_C_LOGITS, logits)
+        msg.add_params(GKTMessage.ARG_LABELS, y)
+        msg.add_params(GKTMessage.ARG_MASK, m)
+        self.send_message(msg)
+
+
+def run_simulated(dataset: FederatedData, extractor, client_head, server_model,
+                  cfg: FedGKTConfig, num_classes: int, backend="LOOPBACK",
+                  job_id="fedgkt-sim", base_port=50000) -> FedGKTAPI:
+    """All ranks as threads (mpirun-on-localhost analogue); returns the shared
+    API whose .server_params hold the trained trunk."""
+    api = FedGKTAPI(dataset, extractor, client_head, server_model, cfg,
+                    num_classes)
+    size = cfg.client_num_per_round + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    server = GKTServerManager(dataset, api, rank=0, size=size, backend=backend, **kw)
+    clients = [
+        GKTClientManager(GKTClientWorker(r - 1, dataset, api),
+                         rank=r, size=size, backend=backend, **kw)
+        for r in range(1, size)
+    ]
+    launch_simulated(server, clients)
+    # expose the trained per-slot client models on the shared API for eval
+    for c in clients:
+        w = c.worker
+        api.ext_params = jax.tree.map(
+            lambda all_, one: all_.at[w.slot].set(one), api.ext_params, w.ext_p)
+        api.head_params = jax.tree.map(
+            lambda all_, one: all_.at[w.slot].set(one), api.head_params, w.head_p)
+    return api

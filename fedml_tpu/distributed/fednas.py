@@ -1,0 +1,73 @@
+"""Distributed FedNAS — federated DARTS search over the cross-process runtime.
+
+Mirror of fedml_api/distributed/fednas/ (6-file pattern): clients run the
+bilevel DARTS search locally (FedNASTrainer.search, FedNASTrainer.py:34-50),
+the server averages weights AND alphas (FedNASAggregator.__aggregate_weight
+:71, __aggregate_alpha :95 — both live in the same params pytree here so one
+weighted average covers both) and records the discovered genotype per round
+(record_model_global_architecture, :173).
+
+The client's alternating w/alpha local update is the exact jitted program
+the SPMD FedNASAPI builds (algorithms/fednas.py), borrowed via a no-mesh
+API instance, so the two runtimes stay numerically aligned.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.algorithms.fednas import FedNASAPI
+from fedml_tpu.models.darts import extract_genotype
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+log = logging.getLogger("fedml_tpu.distributed.fednas")
+
+
+class FedNASTrainer(DistributedTrainer):
+    """DistributedTrainer whose local fit is the bilevel w/alpha search."""
+
+    def __init__(self, client_rank, dataset, cfg, api: FedNASAPI):
+        super().__init__(client_rank, dataset, api.task, cfg)
+        self.local_update = jax.jit(api.local_update)
+
+
+class FedNASAggregator(FedAvgAggregator):
+    """FedAvg collection/average + per-round genotype recording."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.genotype_history: list = []
+
+    def aggregate(self):
+        out = super().aggregate()
+        self.genotype_history.append(extract_genotype(self.net.params))
+        log.info("round genotype: %s", self.genotype_history[-1])
+        return out
+
+
+def run_simulated(dataset, cfg: FedAvgConfig, backend="LOOPBACK",
+                  job_id="fednas-sim", base_port=50000, arch_lr: float = 3e-3,
+                  layers: int = 2, init_filters: int = 8):
+    """All ranks as threads (mpirun-on-localhost analogue); returns the
+    aggregator with .net/.history/.genotype_history."""
+    api = FedNASAPI(dataset, cfg, arch_lr=arch_lr, layers=layers,
+                    init_filters=init_filters)
+    size = cfg.client_num_per_round + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    aggregator = FedNASAggregator(dataset, api.task, cfg, worker_num=size - 1)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    clients = [
+        FedAvgClientManager(FedNASTrainer(r, dataset, cfg, api),
+                            rank=r, size=size, backend=backend, **kw)
+        for r in range(1, size)
+    ]
+    launch_simulated(server, clients)
+    return aggregator

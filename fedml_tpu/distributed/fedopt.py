@@ -10,8 +10,6 @@ runtimes stay numerically aligned.
 
 from __future__ import annotations
 
-import threading
-
 import jax
 import optax
 
@@ -22,6 +20,7 @@ from fedml_tpu.core.local import NetState
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.api import init_client
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 from fedml_tpu.utils.tree import tree_sub
 
 
@@ -55,15 +54,10 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
     """All ranks as threads (mpirun-on-localhost analogue); returns the
     aggregator with .net/.history."""
     size = cfg.client_num_per_round + 1
-    kw = {"job_id": job_id} if backend.upper() == "LOOPBACK" else {"base_port": base_port}
+    kw = backend_kwargs(backend, job_id, base_port)
     aggregator = FedOptAggregator(dataset, task, cfg, worker_num=size - 1, **opt_kw)
     server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
     clients = [init_client(dataset, task, cfg, r, size, backend, **kw)
                for r in range(1, size)]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.run()
-    for t in threads:
-        t.join(timeout=60)
+    launch_simulated(server, clients)
     return aggregator

@@ -1,0 +1,41 @@
+"""Distributed FedProx — the FedAvg cross-process runtime + proximal clients.
+
+Mirror of fedml_api/distributed/fedprox/ (6-file pattern). The reference's
+distributed trainer is byte-identical to FedAvg's, i.e. the proximal term is
+NOT implemented there (SURVEY.md §2.2); here the client's local fit carries
+the published mu/2 ||w - w_global||^2 term via LocalSpec.prox_mu — the same
+jitted local update the SPMD FedProxAPI uses, so the two runtimes stay
+numerically aligned. With mu=0 this is exactly distributed FedAvg (the
+reference's de-facto behavior).
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig, make_client_optimizer
+from fedml_tpu.core.local import LocalSpec
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.api import init_client
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+
+def prox_spec(cfg: FedAvgConfig, mu: float) -> LocalSpec:
+    return LocalSpec(optimizer=make_client_optimizer(cfg), epochs=cfg.epochs,
+                     prox_mu=mu)
+
+
+def run_simulated(dataset, task, cfg: FedAvgConfig, mu: float = 0.1,
+                  backend="LOOPBACK", job_id="fedprox-sim", base_port=50000):
+    """All ranks as threads (mpirun-on-localhost analogue); returns the
+    aggregator with .net/.history."""
+    size = cfg.client_num_per_round + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    clients = [
+        init_client(dataset, task, cfg, r, size, backend,
+                    local_spec=prox_spec(cfg, mu), **kw)
+        for r in range(1, size)
+    ]
+    launch_simulated(server, clients)
+    return aggregator

@@ -1,0 +1,47 @@
+"""Distributed SplitNN entry — role dispatch + localhost simulation.
+
+Mirror of fedml_api/distributed/split_nn/SplitNNAPI.py: rank 0 owns the
+upper model cut (server), ranks 1..K the lower cuts (clients in a ring).
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.algorithms.split_nn import SplitNNConfig
+from fedml_tpu.distributed.split_nn.client_manager import SplitNNClientManager
+from fedml_tpu.distributed.split_nn.server_manager import SplitNNServerManager
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+
+def SplitNN_distributed(process_id: int, worker_number: int, dataset,
+                        client_module, server_module, cfg: SplitNNConfig,
+                        backend: str = "GRPC", **backend_kw):
+    """Launch this process's role and block until the job finishes."""
+    if process_id == 0:
+        mgr = SplitNNServerManager(dataset, client_module, server_module, cfg,
+                                   rank=0, size=worker_number,
+                                   backend=backend, **backend_kw)
+    else:
+        mgr = SplitNNClientManager(dataset, client_module, cfg,
+                                   rank=process_id, size=worker_number,
+                                   backend=backend, **backend_kw)
+    mgr.run()
+    return mgr
+
+
+def run_simulated(dataset, client_module, server_module, cfg: SplitNNConfig,
+                  backend: str = "LOOPBACK", job_id: str = "splitnn-sim",
+                  base_port: int = 50000):
+    """All ranks as threads (mpirun-on-localhost analogue). Returns
+    (server_manager, client_managers) — server holds .history and the upper
+    cut; each client keeps its slot's lower cut."""
+    size = cfg.client_num + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    server = SplitNNServerManager(dataset, client_module, server_module, cfg,
+                                  rank=0, size=size, backend=backend, **kw)
+    clients = [
+        SplitNNClientManager(dataset, client_module, cfg, rank=r, size=size,
+                             backend=backend, **kw)
+        for r in range(1, size)
+    ]
+    launch_simulated(server, clients)
+    return server, clients

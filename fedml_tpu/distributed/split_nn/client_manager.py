@@ -1,0 +1,111 @@
+"""SplitNN client: lower-cut owner — activations up, gradients back.
+
+Mirror of split_nn/client.py: forward_pass ships activations + labels
+(:25-31); on the returned activation gradients the client backprops through
+its cut and steps (:33-35). The lower cut persists per worker slot across
+rounds; batch order/shuffles match the in-process SplitNNAPI exactly
+(grouping-invariant pack_clients), so the distributed ring reproduces the
+fused program's parameters bit-for-bit (tested).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.comm.managers import ClientManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.client_data import pack_clients
+from fedml_tpu.distributed.split_nn.message_define import SplitMessage
+
+
+class SplitNNClientManager(ClientManager):
+    def __init__(self, dataset, client_module, cfg, rank, size,
+                 backend="LOOPBACK", **kw):
+        self.data, self.cm, self.cfg = dataset, client_module, cfg
+
+        # identical init derivation to SplitNNAPI.__init__ (k1 of the split);
+        # every slot starts from the same lower-cut weights, as in-process
+        key = jax.random.PRNGKey(cfg.seed)
+        k1, _ = jax.random.split(key)
+        x0 = jnp.asarray(dataset.train_x[: cfg.batch_size])
+        self.cp = client_module.init(k1, x0, train=False)["params"]
+        self.ctx = optax.sgd(cfg.lr)
+        self.copt = self.ctx.init(self.cp)
+
+        counts = [len(v) for v in dataset.train_idx_map.values()]
+        b = int(np.ceil(max(counts) / cfg.batch_size))
+        self.num_batches = min(cfg.max_batches or b, b)
+
+        cm, ctx = client_module, self.ctx
+
+        @jax.jit
+        def forward(cp, x):
+            return cm.apply({"params": cp}, x, train=True)
+
+        @jax.jit
+        def backward(cp, copt, x, m, cot):
+            def fwd(cp_):
+                return cm.apply({"params": cp_}, x, train=True)
+
+            _, vjp = jax.vjp(fwd, cp)
+            (g,) = vjp(cot)
+            upd, copt_n = ctx.update(g, copt, cp)
+            has = jnp.sum(m) > 0
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jax.lax.select(has, a, b), new, old)
+            return keep(optax.apply_updates(cp, upd), cp), keep(copt_n, copt)
+
+        self._forward, self._backward = forward, backward
+        self._cb = None
+        self._cb_round = None
+        self._bidx = 0
+        super().__init__(rank, size, backend, **kw)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(SplitMessage.MSG_TYPE_S2C_START,
+                                              self._on_start)
+        self.register_message_receive_handler(SplitMessage.MSG_TYPE_S2C_GRADS,
+                                              self._on_grads)
+        self.register_message_receive_handler(SplitMessage.MSG_TYPE_S2C_FINISH,
+                                              lambda _m: self.finish())
+
+    # ------------------------------------------------------------------ turn
+    def _on_start(self, params):
+        round_idx = int(params[SplitMessage.KEY_ROUND])
+        client_id = int(params[SplitMessage.KEY_CLIENT_ID])
+        if self._cb_round != (round_idx, client_id):
+            # one pack per (round, assignment); epochs within the round reuse it
+            self._cb = pack_clients(self.data, [client_id], self.cfg.batch_size,
+                                    max_batches=self.num_batches,
+                                    seed=self.cfg.seed, round_idx=round_idx)
+            self._cb_round = (round_idx, client_id)
+        # pack_clients sizes the block to THIS client's batch count (it
+        # truncates, never pads up to num_batches) — iterate what it built
+        self._n_batches = self._cb.x.shape[1]
+        self._bidx = 0
+        self._send_acts()
+
+    def _send_acts(self):
+        i = self._bidx
+        self._x = jnp.asarray(self._cb.x[0][i])
+        self._m = jnp.asarray(self._cb.mask[0][i])
+        acts = self._forward(self.cp, self._x)
+        msg = Message(SplitMessage.MSG_TYPE_C2S_ACTS, self.rank, 0)
+        msg.add_params(SplitMessage.KEY_ACTS, np.asarray(acts))
+        msg.add_params(SplitMessage.KEY_LABELS, np.asarray(self._cb.y[0][i]))
+        msg.add_params(SplitMessage.KEY_MASK, np.asarray(self._cb.mask[0][i]))
+        self.send_message(msg)
+
+    def _on_grads(self, params):
+        cot = jnp.asarray(params[SplitMessage.KEY_GRADS])
+        self.cp, self.copt = self._backward(self.cp, self.copt, self._x,
+                                            self._m, cot)
+        self._bidx += 1
+        if self._bidx < self._n_batches:
+            self._send_acts()
+            return
+        self.send_message(Message(SplitMessage.MSG_TYPE_C2S_TURN_DONE,
+                                  self.rank, 0))

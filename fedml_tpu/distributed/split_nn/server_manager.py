@@ -24,8 +24,8 @@ log = logging.getLogger("fedml_tpu.distributed.split_nn")
 
 
 class SplitNNServerManager(ServerManager):
-    def __init__(self, dataset, server_module, cfg, rank=0, size=0,
-                 backend="LOOPBACK", **kw):
+    def __init__(self, dataset, client_module, server_module, cfg, rank=0,
+                 size=0, backend="LOOPBACK", **kw):
         self.data, self.sm, self.cfg = dataset, server_module, cfg
         self.num_clients = size - 1
         self.round_idx = 0
@@ -34,12 +34,13 @@ class SplitNNServerManager(ServerManager):
         self.history: list[dict] = []
         self._aux = jnp.zeros(3)
 
-        # identical init derivation to SplitNNAPI.__init__ (k2 of the split)
+        # identical init derivation to SplitNNAPI.__init__ (k1 inits the
+        # lower cut to shape the example activations, k2 the upper)
         key = jax.random.PRNGKey(cfg.seed)
         k1, k2 = jax.random.split(key)
-        from fedml_tpu.distributed.split_nn.client_manager import client_acts_shape
-
-        acts0 = client_acts_shape(dataset, cfg, k1)
+        x0 = jnp.asarray(dataset.train_x[: cfg.batch_size])
+        cvars = client_module.init(k1, x0, train=False)
+        acts0 = client_module.apply(cvars, x0, train=False)
         svars = server_module.init(k2, acts0, train=False)
         self.sp = svars["params"]
         self.stx = optax.sgd(cfg.lr)

@@ -39,3 +39,28 @@ def mapping_to_ip_config_csv(table: dict[int, str], path: str) -> None:
         f.write("receiver_id,ip\n")
         for r in sorted(table):
             f.write(f"{r},{table[r]}\n")
+
+
+def backend_kwargs(backend: str, job_id: str, base_port: int = 50000) -> dict:
+    """Transport-specific kwargs for make_comm_manager: loopback routes by
+    job_id; gRPC by port block (reference: grpc_comm_manager.py:29 port =
+    50000+rank)."""
+    if backend.upper() == "LOOPBACK":
+        return {"job_id": job_id}
+    return {"base_port": base_port}
+
+
+def launch_simulated(server, clients, join_timeout: float = 60.0):
+    """Run all ranks as threads on one host — the mpirun-on-localhost
+    analogue every run_simulated shares (reference SURVEY.md §4.5: "fake
+    cluster = many processes on one box"). Blocks in the server's receive
+    loop; returns once every client thread drained FINISH."""
+    import threading
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=join_timeout)
+    return server

@@ -1,0 +1,244 @@
+"""Distributed classical vertical FL — guest/host logit–gradient exchange.
+
+Mirror of fedml_api/distributed/classical_vertical_fl/ (vfl_api.py:16-42):
+the guest (rank 0) holds the labels and its own feature slice; each host
+rank holds a disjoint feature slice. Per batch the hosts send their logit
+contributions (HostTrainer), the guest sums them with its own, computes the
+loss, and returns dL/dlogits (GuestTrainer.py:10-50); each host backprops
+that cotangent through its tower via the VJP it cached at forward time.
+Labels never leave the guest; raw features never leave any party.
+
+The joint objective is identical to the SPMD VFLAPI's fused step
+(algorithms/vfl.py) — same batch order, same SGD — so the two runtimes
+produce the same parameters (tested to float tolerance).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.vfl import VFLConfig
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+from fedml_tpu.comm.message import Message, pack_pytree
+
+log = logging.getLogger("fedml_tpu.distributed.vfl")
+
+
+class VFLMessage:
+    MSG_TYPE_G2H_BATCH = 1   # batch indices for the next forward
+    MSG_TYPE_H2G_LOGITS = 2  # host logit contribution
+    MSG_TYPE_G2H_GRADS = 3   # dL/dlogits cotangent
+    MSG_TYPE_G2H_FINISH = 4
+    MSG_TYPE_H2G_DONE = 5    # final host params (for evaluation only)
+
+    ARG_SEL = "sel"
+    ARG_LOGITS = "logits"
+    ARG_GRADS = "grads"
+    ARG_PARAMS = "params"
+
+
+class VFLGuestManager(ServerManager):
+    """Rank 0: owns labels + guest tower; drives epochs/batches event-style —
+    each full set of host logits advances one SGD step."""
+
+    def __init__(self, guest_module, x_guest, y, cfg: VFLConfig,
+                 rank=0, size=0, backend="LOOPBACK", **kw):
+        self.gm, self.cfg = guest_module, cfg
+        self.xg = np.asarray(x_guest, np.float32)
+        self.y = np.asarray(y, np.int64)
+        self.H = size - 1
+
+        key = jax.random.PRNGKey(cfg.seed)
+        kg, _ = jax.random.split(key)
+        self.guest_params = guest_module.init(
+            kg, jnp.asarray(self.xg[: cfg.batch_size]), train=False)["params"]
+        self.gtx = optax.sgd(cfg.guest_lr)
+        self.gopt = self.gtx.init(self.guest_params)
+
+        gm = guest_module
+
+        @jax.jit
+        def guest_step(gp, gopt, xg, y, host_sum):
+            def loss_fn(gp_, host_sum_):
+                logits = gm.apply({"params": gp_}, xg, train=True) + host_sum_
+                l = jnp.mean(
+                    optax.softmax_cross_entropy_with_integer_labels(logits, y))
+                acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+                return l, acc
+
+            (l, acc), (gg, glog_grad) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(gp, host_sum)
+            ug, gopt = self.gtx.update(gg, gopt, gp)
+            return optax.apply_updates(gp, ug), gopt, glog_grad, l, acc
+
+        self._guest_step = guest_step
+        self._host_logits: dict[int, np.ndarray] = {}
+        self.host_params_final: dict[int, list] = {}
+        self._order_rng = np.random.RandomState(cfg.seed)
+        self.epoch = 0
+        self.batch_start = 0
+        self.order = self._order_rng.permutation(len(self.y))
+        self.history: list[dict] = []
+        self._epoch_losses: list[float] = []
+        self._epoch_accs: list[float] = []
+        self._lock = threading.Lock()
+        super().__init__(rank, size, backend, **kw)
+
+    def run(self):
+        self._send_batch()
+        super().run()
+
+    def _send_batch(self):
+        sel = self.order[self.batch_start : self.batch_start + self.cfg.batch_size]
+        self._sel = sel
+        for rank in range(1, self.size):
+            msg = Message(VFLMessage.MSG_TYPE_G2H_BATCH, self.rank, rank)
+            msg.add_params(VFLMessage.ARG_SEL, np.asarray(sel, np.int64))
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            VFLMessage.MSG_TYPE_H2G_LOGITS, self.handle_host_logits)
+        self.register_message_receive_handler(
+            VFLMessage.MSG_TYPE_H2G_DONE, self.handle_host_done)
+
+    def handle_host_logits(self, msg_params):
+        with self._lock:
+            sender = msg_params[Message.MSG_ARG_KEY_SENDER]
+            self._host_logits[sender] = msg_params[VFLMessage.ARG_LOGITS]
+            if len(self._host_logits) < self.H:
+                return
+            host_sum = jnp.sum(
+                jnp.stack([jnp.asarray(self._host_logits[r])
+                           for r in sorted(self._host_logits)]), axis=0)
+            self._host_logits.clear()
+            sel = self._sel
+            self.guest_params, self.gopt, glog_grad, l, acc = self._guest_step(
+                self.guest_params, self.gopt, jnp.asarray(self.xg[sel]),
+                jnp.asarray(self.y[sel]), host_sum)
+            self._epoch_losses.append(float(l))
+            self._epoch_accs.append(float(acc))
+            grads = np.asarray(glog_grad)
+            for rank in range(1, self.size):
+                msg = Message(VFLMessage.MSG_TYPE_G2H_GRADS, self.rank, rank)
+                msg.add_params(VFLMessage.ARG_GRADS, grads)
+                self.send_message(msg)
+            self._advance()
+
+    def _advance(self):
+        cfg = self.cfg
+        n, bs = len(self.y), cfg.batch_size
+        self.batch_start += bs
+        if self.batch_start > n - bs:  # epoch done (same bound as VFLAPI.train)
+            self.history.append({
+                "epoch": self.epoch,
+                "loss": float(np.mean(self._epoch_losses)),
+                "acc": float(np.mean(self._epoch_accs)),
+            })
+            self._epoch_losses, self._epoch_accs = [], []
+            self.epoch += 1
+            if self.epoch == cfg.epochs:
+                for rank in range(1, self.size):
+                    self.send_message(
+                        Message(VFLMessage.MSG_TYPE_G2H_FINISH, self.rank, rank))
+                return  # finish once every host returned its params
+            self.batch_start = 0
+            self.order = self._order_rng.permutation(n)
+        self._send_batch()
+
+    def handle_host_done(self, msg_params):
+        with self._lock:
+            sender = msg_params[Message.MSG_ARG_KEY_SENDER]
+            self.host_params_final[sender] = msg_params[VFLMessage.ARG_PARAMS]
+            if len(self.host_params_final) == self.H:
+                self.finish()
+
+
+class VFLHostManager(ClientManager):
+    """Rank h: owns feature slice x_host and its tower; never sees labels."""
+
+    def __init__(self, host_module, x_host, cfg: VFLConfig, rank, size,
+                 backend="LOOPBACK", **kw):
+        self.hm, self.cfg = host_module, cfg
+        self.xh = np.asarray(x_host, np.float32)
+
+        key = jax.random.PRNGKey(cfg.seed)
+        _, kh = jax.random.split(key)
+        self.host_params = host_module.init(
+            jax.random.fold_in(kh, rank - 1),
+            jnp.asarray(self.xh[: cfg.batch_size]), train=False)["params"]
+        self.htx = optax.sgd(cfg.host_lr)
+        self.hopt = self.htx.init(self.host_params)
+
+        hm = host_module
+
+        @jax.jit
+        def forward(hp, xb):
+            return hm.apply({"params": hp}, xb, train=True)
+
+        @jax.jit
+        def backward(hp, hopt, xb, cot):
+            def fwd(hp_):
+                return hm.apply({"params": hp_}, xb, train=True)
+
+            _, vjp = jax.vjp(fwd, hp)
+            (g,) = vjp(cot)
+            u, hopt = self.htx.update(g, hopt, hp)
+            return optax.apply_updates(hp, u), hopt
+
+        self._forward, self._backward = forward, backward
+        self._xb = None
+        super().__init__(rank, size, backend, **kw)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            VFLMessage.MSG_TYPE_G2H_BATCH, self.handle_batch)
+        self.register_message_receive_handler(
+            VFLMessage.MSG_TYPE_G2H_GRADS, self.handle_grads)
+        self.register_message_receive_handler(
+            VFLMessage.MSG_TYPE_G2H_FINISH, self.handle_finish)
+
+    def handle_batch(self, msg_params):
+        sel = np.asarray(msg_params[VFLMessage.ARG_SEL])
+        self._xb = jnp.asarray(self.xh[sel])
+        logits = self._forward(self.host_params, self._xb)
+        msg = Message(VFLMessage.MSG_TYPE_H2G_LOGITS, self.rank, 0)
+        msg.add_params(VFLMessage.ARG_LOGITS, np.asarray(logits))
+        self.send_message(msg)
+
+    def handle_grads(self, msg_params):
+        cot = jnp.asarray(msg_params[VFLMessage.ARG_GRADS])
+        self.host_params, self.hopt = self._backward(
+            self.host_params, self.hopt, self._xb, cot)
+
+    def handle_finish(self, _msg):
+        msg = Message(VFLMessage.MSG_TYPE_H2G_DONE, self.rank, 0)
+        msg.add_params(VFLMessage.ARG_PARAMS, pack_pytree(self.host_params))
+        self.send_message(msg)
+        self.finish()
+
+
+def run_simulated(guest_module, host_module, x_guest, x_hosts, y,
+                  cfg: VFLConfig, backend="LOOPBACK",
+                  job_id="vfl-sim", base_port=50000):
+    """All parties as threads (mpirun-on-localhost analogue). Returns the
+    guest manager: .guest_params, .host_params_final (by rank), .history."""
+    H = np.asarray(x_hosts).shape[0]
+    size = H + 1
+    kw = backend_kwargs(backend, job_id, base_port)
+    guest = VFLGuestManager(guest_module, x_guest, y, cfg, rank=0, size=size,
+                            backend=backend, **kw)
+    hosts = [
+        VFLHostManager(host_module, np.asarray(x_hosts)[h], cfg,
+                       rank=h + 1, size=size, backend=backend, **kw)
+        for h in range(H)
+    ]
+    launch_simulated(guest, hosts)
+    return guest

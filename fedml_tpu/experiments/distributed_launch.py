@@ -1,4 +1,4 @@
-"""Cross-process distributed FedAvg launcher — the mpirun analogue.
+"""Cross-process distributed launcher — the mpirun / fed_launch analogue.
 
 The reference launches `mpirun -np N+1 python3 main_fedavg.py ...`
 (fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh:
@@ -14,6 +14,11 @@ Routing: --ip_config CSV (receiver_id,ip — grpc_ipconfig.csv parity) or
 everything on 127.0.0.1 by default. The server process prints the eval
 history when the job completes; worker count must be
 client_num_per_round (one process per sampled client, FedAvgAPI.py:20-28).
+
+--algo selects the algorithm on the shared runtime (the reference's unified
+multi-algorithm launcher, fedml_experiments/distributed/fed_launch/main.py):
+fedavg | fedopt (server optimizer) | fedprox (proximal clients) |
+fedavg_robust (server defenses) | turboaggregate (Shamir shares on the wire).
 """
 
 from __future__ import annotations
@@ -25,6 +30,19 @@ import logging
 
 def add_args(p: argparse.ArgumentParser):
     p.add_argument("--rank", type=int, required=True, help="0 = server")
+    p.add_argument("--algo", type=str, default="fedavg",
+                   choices=["fedavg", "fedopt", "fedprox", "fedavg_robust",
+                            "turboaggregate"])
+    # fedopt (main_fedopt.py:54-60 flag parity)
+    p.add_argument("--server_optimizer", type=str, default="sgd")
+    p.add_argument("--server_lr", type=float, default=1.0)
+    p.add_argument("--server_momentum", type=float, default=0.9)
+    # fedprox
+    p.add_argument("--fedprox_mu", type=float, default=0.1)
+    # fedavg_robust (robust_aggregation.py:33-36 flag parity)
+    p.add_argument("--defense_type", type=str, default="norm_diff_clipping")
+    p.add_argument("--norm_bound", type=float, default=30.0)
+    p.add_argument("--stddev", type=float, default=0.025)
     p.add_argument("--world_size", type=int, required=True,
                    help="client_num_per_round + 1")
     p.add_argument("--backend", type=str, default="grpc",
@@ -55,6 +73,53 @@ def add_args(p: argparse.ArgumentParser):
     return p
 
 
+def init_role(args, data, task, cfg, backend_kw):
+    """Construct this rank's manager for --algo (does not run it)."""
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+    from fedml_tpu.distributed.fedavg.api import init_client
+    from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+    from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+
+    backend = args.backend.upper()
+    if args.rank == 0:
+        if args.algo == "fedopt":
+            from fedml_tpu.distributed.fedopt import FedOptAggregator
+
+            agg = FedOptAggregator(
+                data, task, cfg, worker_num=args.world_size - 1,
+                server_optimizer=args.server_optimizer, server_lr=args.server_lr,
+                server_momentum=args.server_momentum)
+        elif args.algo == "fedavg_robust":
+            from fedml_tpu.distributed.fedavg_robust import FedAvgRobustAggregator
+
+            agg = FedAvgRobustAggregator(
+                data, task, cfg, worker_num=args.world_size - 1,
+                defense_type=args.defense_type, norm_bound=args.norm_bound,
+                stddev=args.stddev)
+        elif args.algo == "turboaggregate":
+            from fedml_tpu.distributed.turboaggregate import TAAggregator
+
+            agg = TAAggregator(data, task, cfg, worker_num=args.world_size - 1)
+        else:  # fedavg / fedprox share the plain weighted-average server
+            agg = FedAvgAggregator(data, task, cfg, worker_num=args.world_size - 1)
+        return FedAvgServerManager(agg, rank=0, size=args.world_size,
+                                   backend=backend, **backend_kw)
+
+    if args.algo == "fedprox":
+        from fedml_tpu.distributed.fedprox import prox_spec
+
+        return init_client(data, task, cfg, args.rank, args.world_size, backend,
+                           local_spec=prox_spec(cfg, args.fedprox_mu), **backend_kw)
+    if args.algo == "turboaggregate":
+        from fedml_tpu.distributed.turboaggregate import SecureTrainer
+
+        trainer = SecureTrainer(args.rank, data, task, cfg)
+        return FedAvgClientManager(trainer, rank=args.rank, size=args.world_size,
+                                   backend=backend, **backend_kw)
+    return init_client(data, task, cfg, args.rank, args.world_size, backend,
+                       **backend_kw)
+
+
 def main(argv=None):
     args = add_args(argparse.ArgumentParser("fedml_tpu.distributed")).parse_args(argv)
     logging.basicConfig(
@@ -65,7 +130,6 @@ def main(argv=None):
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.core.tasks import classification_task, sequence_task, tag_prediction_task
     from fedml_tpu.data.registry import DATASETS, load_dataset
-    from fedml_tpu.distributed.fedavg import FedML_FedAvg_distributed
     from fedml_tpu.models import create_model
 
     spec = DATASETS[args.dataset]
@@ -93,10 +157,8 @@ def main(argv=None):
     else:
         backend_kw.update(job_id="launch")
 
-    mgr = FedML_FedAvg_distributed(
-        args.rank, args.world_size, data, task, cfg,
-        backend=args.backend.upper(), **backend_kw,
-    )
+    mgr = init_role(args, data, task, cfg, backend_kw)
+    mgr.run()
     if args.rank == 0:
         print(json.dumps(mgr.aggregator.history, default=float))
 

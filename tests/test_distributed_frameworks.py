@@ -70,11 +70,14 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
             "--dataset", "mnist", "--model", "lr", "--comm_round", "2",
             "--client_num_in_total", "6", "--frequency_of_the_test", "1",
             "--ci", "1"]
+    # client stdout goes to files, not PIPE: an undrained PIPE deadlocks the
+    # client once its (gRPC-retry-heavy) logs exceed the 64 KB pipe buffer
+    logs = {r: open(tmp_path / f"client{r}.log", "wb") for r in (1, 2)}
     clients = [
         subprocess.Popen(
             [sys.executable, "-m", "fedml_tpu.experiments.distributed_launch",
              "--rank", str(r)] + base,
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, stdout=logs[r], stderr=subprocess.STDOUT,
         )
         for r in (1, 2)
     ]
@@ -90,17 +93,20 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
         for c in clients:
             c.wait(timeout=max(1.0, deadline - time.time()))
     except subprocess.TimeoutExpired as e:  # surface client logs on failure
-        outs = []
         for c in clients:
             if c.poll() is None:
                 c.kill()
-            out, _ = c.communicate(timeout=10)
-            outs.append(out.decode(errors="replace")[-2000:] if out else "")
+        outs = [
+            (tmp_path / f"client{r}.log").read_bytes().decode(errors="replace")[-2000:]
+            for r in (1, 2)
+        ]
         raise AssertionError(f"launch timeout: {e}\nclient logs:\n" + "\n---\n".join(outs))
     finally:
         for c in clients:
             if c.poll() is None:
                 c.kill()
+        for f in logs.values():
+            f.close()
     assert server.returncode == 0, server.stdout + server.stderr
     assert '"round": 1' in server.stdout.replace("'", '"') or "round" in server.stdout
 
