@@ -42,18 +42,18 @@ def main():
     # ships only the shuffled index block (~KBs) and gathers on device
     api = FedAvgAPI(data, task, cfg, device_data=True)
 
-    # warmup (compile)
-    api.run_round(0)
+    n_rounds = 30
+    # warmup = compile; scan length is a static shape, so warm up with the
+    # same block length as the timed run
+    api.run_rounds(0, n_rounds)
     jax.block_until_ready(api.net.params)
 
-    n_rounds = 30
     t0 = time.perf_counter()
-    total_samples = 0.0
-    for r in range(1, n_rounds + 1):
-        m = api.run_round(r)
+    # the whole block is ONE compiled lax.scan over rounds: no per-round
+    # dispatch, no per-round host->device transfer beyond the index blocks
+    api.run_rounds(n_rounds, n_rounds)
     jax.block_until_ready(api.net.params)
     dt = time.perf_counter() - t0
-    total_samples = float(m["count"]) * n_rounds  # last round's count as per-round proxy
 
     rounds_per_sec = n_rounds / dt
     baseline_rounds_per_sec = 1.0 / 0.3  # MPI poll-loop lower bound, see docstring

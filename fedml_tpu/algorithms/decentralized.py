@@ -164,7 +164,18 @@ class DecentralizedFLAPI:
         W = jnp.asarray(self.W)
         params, losses = self._step(self.params, W, self.worker_x, self.worker_y, key)
         self.params = params
-        return np.asarray(losses)
+        self.loss_stream = np.asarray(losses)
+        return self.loss_stream
+
+    def regret(self) -> np.ndarray:
+        """Average-regret trajectory R_t/t = (1/t) sum_{s<=t} loss_s — the
+        online-learning metric the reference's decentralized clients track
+        (ClientDSGD/ClientPushsum regret accounting, client_dsgd.py:6-101).
+        Decreasing => the gossip stream is learning."""
+        if not hasattr(self, "loss_stream"):
+            raise ValueError("call train() first")
+        t = np.arange(1, len(self.loss_stream) + 1)
+        return np.cumsum(self.loss_stream) / t
 
     def consensus_distance(self) -> float:
         """Mean squared distance of workers' params from their average — the

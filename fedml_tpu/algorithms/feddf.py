@@ -28,12 +28,17 @@ from fedml_tpu.core.local import NetState
 from fedml_tpu.utils.tree import tree_weighted_mean
 
 
-def kl_divergence(student_logits, teacher_probs, temperature: float = 1.0):
+def kl_divergence(student_logits, teacher_probs, temperature: float = 1.0,
+                  mask=None):
     """KL(teacher || student) with temperature, averaged over batch (the
-    reference's utils.KL_Loss, fedml_api/distributed/fedgkt/utils.py)."""
+    reference's utils.KL_Loss, fedml_api/distributed/fedgkt/utils.py).
+    With ``mask`` the mean runs over masked samples only (padded rows must
+    not train — FedGKT's blocks are padded to a static batch budget)."""
     s = jax.nn.log_softmax(student_logits / temperature, axis=-1)
-    t = teacher_probs
-    return -jnp.mean(jnp.sum(t * s, axis=-1)) * (temperature ** 2)
+    per = -jnp.sum(teacher_probs * s, axis=-1) * (temperature ** 2)
+    if mask is None:
+        return jnp.mean(per)
+    return jnp.sum(per * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
 class FedDFAPI(FedAvgAPI):

@@ -24,8 +24,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from fedml_tpu.core.client_data import (FederatedData, pack_clients,
+                                        pad_batches)
 from fedml_tpu.algorithms.feddf import kl_divergence
-from fedml_tpu.core.client_data import FederatedData, pack_clients
 from fedml_tpu.core.sampling import sample_clients
 
 
@@ -103,7 +104,8 @@ class FedGKTAPI:
                     per = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
                     ce = jnp.sum(per * mb) / n
                     t_probs = jax.nn.softmax(sl / T, axis=-1)
-                    kl = kl_divergence(logits, t_probs, T)
+                    # masked KL: padded rows must not train
+                    kl = kl_divergence(logits, t_probs, T, mask=mb)
                     return ce + alpha * use_kd * kl, (jnp.sum(per * mb),
                                                       jnp.sum((jnp.argmax(logits, -1) == yb) * mb),
                                                       jnp.sum(mb))
@@ -160,7 +162,8 @@ class FedGKTAPI:
                     n = jnp.maximum(jnp.sum(mb), 1.0)
                     per = optax.softmax_cross_entropy_with_integer_labels(logits, yb)
                     ce = jnp.sum(per * mb) / n
-                    kl = kl_divergence(logits, jax.nn.softmax(cb / T, -1), T)
+                    kl = kl_divergence(logits, jax.nn.softmax(cb / T, -1), T,
+                                       mask=mb)
                     return ce + alpha * kl
 
                 l, g = jax.value_and_grad(loss_fn)(sp)
@@ -190,6 +193,14 @@ class FedGKTAPI:
         cb = pack_clients(self.data, ids, cfg.batch_size,
                           max_batches=cfg.max_batches, seed=cfg.seed,
                           round_idx=round_idx)
+        # pad the cohort block to the GLOBAL batch budget: ragged cohorts
+        # would otherwise change B per round, resetting the KD cache (and
+        # retracing both phases) every time the sampled max size changes;
+        # padded batches are masked no-ops in both phases
+        counts = [len(v) for v in self.data.train_idx_map.values()]
+        b_all = int(np.ceil(max(counts) / cfg.batch_size))
+        B_glob = min(cfg.max_batches or b_all, b_all)
+        cb = pad_batches(cb, B_glob)
         x, y, m = jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)
         K, B, bs = x.shape[0], x.shape[1], x.shape[2]
         if not hasattr(self, "_s_logits") or self._s_logits.shape[:3] != (K, B, bs):

@@ -49,6 +49,10 @@ class VFLAPI:
         self.xg = np.asarray(x_guest, np.float32)
         self.xh = np.asarray(x_hosts, np.float32)
         self.y = np.asarray(y, np.int64)
+        if len(self.y) < config.batch_size:
+            raise ValueError(
+                f"dataset ({len(self.y)} samples) smaller than one batch "
+                f"({config.batch_size}): zero steps per epoch")
         self.H = self.xh.shape[0]
         self.num_classes = num_classes
 

@@ -58,4 +58,16 @@ class BaseCommManager(abc.ABC):
 
     def _notify(self, msg: "Message") -> None:
         for obs in list(self._observers):
-            obs.receive_message(msg.get_type(), msg.get_params())
+            try:
+                obs.receive_message(msg.get_type(), msg.get_params())
+            except Exception:
+                # log with traceback THEN re-raise: a silently swallowed
+                # handler error turns protocol bugs into eternal hangs, and a
+                # silently dead loop does too. Re-raising fails the server's
+                # run() fast (the reference's MPI.Abort analogue) while the
+                # log names the culprit; client daemon threads die visibly.
+                import logging
+
+                logging.getLogger("fedml_tpu.comm").exception(
+                    "handler for msg_type=%s raised", msg.get_type())
+                raise

@@ -203,6 +203,21 @@ def pack_clients(
     return ClientBatch(x=x, y=y, mask=mask, num_samples=num)
 
 
+def pad_batches(cb: "ClientBatch", num_batches: int) -> "ClientBatch":
+    """Zero-pad a ClientBatch along the batch axis (axis 1) up to
+    ``num_batches``. Padded batches carry mask 0, so they are provable
+    no-ops in every engine; both the SPMD FedGKT engine and the
+    cross-process worker pad through HERE so their blocks stay
+    bit-identical (the padded rows feed the KD teacher next round)."""
+    pad = num_batches - cb.x.shape[1]
+    if pad <= 0:
+        return cb
+    z = lambda a: np.concatenate(
+        [a, np.zeros((a.shape[0], pad) + a.shape[2:], a.dtype)], 1)
+    return ClientBatch(x=z(cb.x), y=z(cb.y), mask=z(cb.mask),
+                       num_samples=cb.num_samples)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class IndexBatch:

@@ -25,7 +25,8 @@ import numpy as np
 from fedml_tpu.algorithms.fedgkt import FedGKTAPI, FedGKTConfig
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message
-from fedml_tpu.core.client_data import FederatedData, pack_clients
+from fedml_tpu.core.client_data import (FederatedData, pack_clients,
+                                        pad_batches)
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 
@@ -67,6 +68,15 @@ class GKTClientWorker:
         cb = pack_clients(self.data, [client_index], cfg.batch_size,
                           max_batches=self.num_batches, seed=cfg.seed,
                           round_idx=round_idx)
+        # pad the INPUT block to the global batch budget before the phase:
+        # per-slot B varies with the client's sample count, the server stacks
+        # uploads into one [K, B, ...] block, and the engine pads the same
+        # way (FedGKTAPI.run_round) — running the phase over the padded
+        # batches (masked no-ops for training) makes the shipped features /
+        # logits of padded rows bit-identical to the in-process oracle's
+        # (they feed next round's KD teacher, so zero-padding uploads
+        # instead would silently diverge the runtimes)
+        cb = pad_batches(cb, self.num_batches)
         x, y, m = jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)
         if s_logits is None:
             sl = jnp.zeros(x.shape[:3] + (self.api.num_classes,))
@@ -78,8 +88,8 @@ class GKTClientWorker:
             add1(self.ext_p), add1(self.head_p), x, y, m, sl, use_kd)
         self.ext_p = jax.tree.map(lambda v: v[0], ep)
         self.head_p = jax.tree.map(lambda v: v[0], hp)
-        return (np.asarray(feats[0]), np.asarray(logits[0]), np.asarray(cb.y[0]),
-                np.asarray(cb.mask[0]))
+        return (np.asarray(feats[0]), np.asarray(logits[0]),
+                np.asarray(cb.y[0]), np.asarray(cb.mask[0]))
 
 
 class GKTServerManager(ServerManager):

@@ -2,8 +2,9 @@
 
 Mirror of fedml_api/distributed/turboaggregate/ (TA_Aggregator.py:56+,
 mpc_function.py:38-76): clients never upload cleartext updates. Each client
-quantizes its trained params into GF(2^31-1), Shamir-encodes them, scales the
-shares by its (public) sample count, and uploads only the share matrix; the
+quantizes its weighted params (weight = its share of the round's public
+sample counts, computable by every party from the deterministic sampler)
+into GF(2^31-1), Shamir-encodes them, and uploads only the share matrix; the
 server sums shares in the field and reconstructs the *sum* by Lagrange
 interpolation at 0 — additive homomorphism means no single update is ever
 visible server-side. BN/extra statistics (not secret) travel in cleartext
@@ -42,16 +43,30 @@ class SecureTrainer(DistributedTrainer):
         self.n_shares, self.threshold_t = n_shares, threshold_t
         self.quant_scale = quant_scale
 
+    def _round_weight(self, round_idx: int, n: int) -> float:
+        """This client's sample-weight n_k / sum_cohort(n_j). Sample counts
+        are public and the sampler is deterministic, so every party computes
+        the same cohort total — keeping encoded field values <= |w|*scale
+        (pre-normalized like the in-process path; an n_k-scaled share would
+        burn mod-p headroom and wrap silently at FEMNIST scale)."""
+        from fedml_tpu.core.sampling import sample_clients
+
+        ids = sample_clients(round_idx, self.cfg.client_num_in_total,
+                             self.cfg.client_num_per_round, self.cfg.seed)
+        cap = self.num_batches * self.cfg.batch_size
+        total = sum(min(len(self.dataset.train_idx_map[int(i)]), cap) for i in ids)
+        return n / max(total, 1)
+
     def train(self, round_idx: int):
         n = self.fit(round_idx)  # self.net now holds the local fit
-        vec = tree_vectorize(self.net.params)
+        w = self._round_weight(round_idx, n)
+        vec = tree_vectorize(self.net.params) * w
         z = ff.field_encode(vec, self.quant_scale)
         key = jax.random.fold_in(
             jax.random.PRNGKey(self.cfg.seed + 1013), round_idx)
         key = jax.random.fold_in(key, self.client_index)
-        shares = ff.shamir_encode(z, key, self.n_shares, self.threshold_t)
-        # scale by the public sample count inside the field (Shamir is linear)
-        shares = (np.asarray(shares, np.int64) * int(n)) % ff.P_DEFAULT
+        shares = np.asarray(
+            ff.shamir_encode(z, key, self.n_shares, self.threshold_t), np.int64)
         extras = pack_pytree(self.net.extra)
         return [shares] + extras, n
 
@@ -67,7 +82,6 @@ class TAAggregator(FedAvgAggregator):
 
     def aggregate(self):
         ranks = sorted(self.model_dict)
-        total = float(sum(self.sample_num_dict[r] for r in ranks))
 
         summed = None
         for r in ranks:
@@ -76,7 +90,9 @@ class TAAggregator(FedAvgAggregator):
         alphas = np.arange(1, self.n_shares + 1, dtype=np.int64)
         z_sum = ff.shamir_decode(jnp.asarray(summed), jnp.asarray(alphas),
                                  self.threshold_t)
-        vec = ff.field_decode(z_sum, self.quant_scale) / max(total, 1e-12)
+        # clients upload pre-normalized weights (weights sum to 1), so the
+        # reconstructed field sum IS the weighted average
+        vec = ff.field_decode(z_sum, self.quant_scale)
         new_params = tree_unvectorize(jnp.asarray(vec, jnp.float32),
                                       self.net.params)
 

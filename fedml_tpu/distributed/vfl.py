@@ -53,6 +53,12 @@ class VFLGuestManager(ServerManager):
         self.gm, self.cfg = guest_module, cfg
         self.xg = np.asarray(x_guest, np.float32)
         self.y = np.asarray(y, np.int64)
+        if len(self.y) < cfg.batch_size:
+            # same contract as VFLAPI: the epoch loop bound (n - bs + 1)
+            # trains zero batches below one batch of data
+            raise ValueError(
+                f"dataset ({len(self.y)} samples) smaller than one batch "
+                f"({cfg.batch_size}): zero steps per epoch")
         self.H = size - 1
 
         key = jax.random.PRNGKey(cfg.seed)

@@ -62,11 +62,19 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False):
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     B, H = q.shape[0], q.shape[2]
 
-    # accumulators start replicated but the loop carry is device-varying
-    var = lambda x: lax.pcast(x, axis_name, to="varying")
-    o = var(jnp.zeros(q.shape, jnp.float32))
-    l = var(jnp.zeros((B, H, T_blk), jnp.float32))
-    m = var(jnp.full((B, H, T_blk), -jnp.inf, jnp.float32))
+    # accumulators must carry q's varying-manual-axes (not just axis_name —
+    # on a multi-axis mesh q may also vary over e.g. a 'clients' axis) or the
+    # fori_loop carry types mismatch after the first update; deriving them
+    # from q*0 inherits the full vma set, pcast adds the ring axis
+    def var(x):  # no-op when q was already varying over the ring axis
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        return x if axis_name in vma else lax.pcast(x, axis_name, to="varying")
+
+    zero_q = (q * 0).astype(jnp.float32)
+    zero_red = jnp.sum(zero_q, axis=-1).transpose(0, 2, 1)  # [B, H, T_blk]
+    o = var(zero_q)
+    l = var(zero_red)
+    m = var(zero_red - jnp.inf)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def body(s, carry):

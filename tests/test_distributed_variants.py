@@ -154,8 +154,10 @@ def test_distributed_fedgkt_equals_inprocess():
         def __call__(self, f, train: bool = False):
             return nn.Dense(4)(nn.relu(nn.Dense(16)(f)))
 
+    # seed 7 -> ragged client sizes (B = 5/3/4 at bs=4): regression cover for
+    # the per-slot pad-to-global-budget path (uploads must stack server-side)
     data = synthetic_images(num_clients=3, image_shape=(10,), num_classes=4,
-                            samples_per_client=12, test_samples=24, seed=1)
+                            samples_per_client=12, test_samples=24, seed=7)
     cfg = FedGKTConfig(comm_round=3, client_num_in_total=3, client_num_per_round=2,
                        epochs_client=1, epochs_server=1, batch_size=4,
                        lr_client=0.1, lr_server=0.05, seed=0)

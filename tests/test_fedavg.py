@@ -225,3 +225,24 @@ def test_evaluate_per_client_matches_global():
     # train split works too and respects max_clients
     pc_train, agg_train = api.evaluate_per_client(split="train", max_clients=3)
     assert len(pc_train) == 3 and agg_train["count"] > 0
+
+
+def test_run_rounds_block_equals_sequential(lr_data, lr_task):
+    """The R-round lax.scan block (one compiled program) is bit-identical to
+    R sequential run_round calls: same sampling, same fold_in key chain,
+    same gathers, same aggregation order."""
+    from fedml_tpu.comm.message import pack_pytree
+
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=8, client_num_per_round=4,
+                       epochs=1, batch_size=8, lr=0.1, frequency_of_the_test=100,
+                       seed=0)
+    seq = FedAvgAPI(lr_data, lr_task, cfg, device_data=True)
+    for r in range(4):
+        seq.run_round(r)
+
+    blk = FedAvgAPI(lr_data, lr_task, cfg, device_data=True)
+    ms = blk.run_rounds(0, 4)
+    assert ms["count"].shape == (4,)
+
+    for a, b in zip(pack_pytree(seq.net), pack_pytree(blk.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
