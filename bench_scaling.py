@@ -40,18 +40,29 @@ def main():
             frequency_of_the_test=10_000, max_batches=28,
         )
         api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
-        api.run_round(0)
-        jax.block_until_ready(api.net.params)
-        t0 = time.perf_counter()
-        for r in range(1, args.rounds + 1):
-            m = api.run_round(r)
-        jax.block_until_ready(api.net.params)
+        if args.device_data:
+            # one compiled scan per block: measures device throughput, not
+            # per-round host dispatch (bench.py uses the same path)
+            api.run_rounds(0, args.rounds)
+            jax.block_until_ready(api.net.params)
+            t0 = time.perf_counter()
+            ms = api.run_rounds(args.rounds, args.rounds)
+            jax.block_until_ready(api.net.params)
+            count = float(ms["count"][-1])
+        else:
+            api.run_round(0)
+            jax.block_until_ready(api.net.params)
+            t0 = time.perf_counter()
+            for r in range(1, args.rounds + 1):
+                m = api.run_round(r)
+            jax.block_until_ready(api.net.params)
+            count = float(m["count"])
         dt = time.perf_counter() - t0
         rps = args.rounds / dt
         print(json.dumps({
             "clients_per_round": k,
             "rounds_per_sec": round(rps, 3),
-            "samples_per_sec": round(float(m["count"]) * rps, 1),
+            "samples_per_sec": round(count * rps, 1),
             "device": jax.devices()[0].platform,
         }))
 

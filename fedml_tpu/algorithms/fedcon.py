@@ -1,0 +1,160 @@
+"""FedCon — federated learning over client-condensed synthetic data.
+
+Reference: fedml_api/standalone/feddf/condense_api.py and
+fedcon_init_api.py (fork additions). Behavior being matched:
+- each client condenses its LOCAL data into a small synthetic set using the
+  current global model (client.condense inside _setup_condense,
+  condense_api.py:164-183; fedcon_init_api.py runs it once at init,
+  _init_condense :164);
+- per round, after the FedAvg aggregate, the server trains the global model
+  on the union of the sampled clients' synthetic sets
+  (_train_condense_server, condense_api.py:315-329), either with plain CE
+  ("ce") or with softened teacher labels ("soft",
+  my_model_trainer_ensemble.train_wth_condense[_soft]).
+
+TPU form: per-client condensation is the jitted gradient-matching loop from
+utils/condense.py, conditioned on the current global NetState (host-driven
+per client since local sets are ragged). Every synthetic set is padded to a
+fixed [class_num * ipc] shape with a validity mask, so the sampled union has
+one static shape across rounds — the server's condensed-training scan
+compiles once and the sets stay on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.feddf import kl_divergence
+from fedml_tpu.core.local import NetState
+from fedml_tpu.utils.condense import condense_dataset
+
+
+class FedConAPI(FedAvgAPI):
+    """FedAvg + per-client dataset condensation + condensed server training.
+
+    ``condense_train_type``: 'ce' (hard labels) | 'soft' (KL toward the
+    pre-update global model's softened predictions on the synthetic set).
+    ``init_only=True`` = fedcon_init_api semantics (condense once up front,
+    at the initial weights); False re-condenses every ``recondense_every``
+    rounds at the CURRENT global weights (condense_api's per-setup flow).
+    """
+
+    def __init__(self, dataset, task, config: FedAvgConfig,
+                 images_per_class: int = 2, condense_iters: int = 20,
+                 condense_steps: int = 10, condense_lr: float = 0.01,
+                 condense_train_type: str = "ce", temperature: float = 3.0,
+                 init_only: bool = True, recondense_every: int = 5,
+                 syn_lr: float = 0.1, **kwargs):
+        if condense_train_type not in ("ce", "soft"):
+            raise ValueError(f"undefined condense train type {condense_train_type!r}"
+                             " (condense_api.py:321-329 offers ce|soft)")
+        super().__init__(dataset, task, config, **kwargs)
+        self.images_per_class = images_per_class
+        self.condense_iters = condense_iters
+        self.condense_steps = condense_steps
+        self.condense_train_type = condense_train_type
+        self.temperature = temperature
+        self.init_only = init_only
+        self.recondense_every = recondense_every
+        self.syn_lr = syn_lr
+        self.last_condense_loss = float("nan")
+        self._ctx = optax.sgd(condense_lr)
+        # per client: (x_syn [C*ipc, ...], y_syn [C*ipc], valid [C*ipc]) on
+        # device at a FIXED shape (absent classes -> zero rows, valid 0)
+        self.syn_data: dict[int, tuple] = {}
+        self._condense_round = -1
+        self._train_syn = jax.jit(self._build_syn_train())
+
+    # -------------------------------------------------------- condensation
+    def setup_condense(self, round_idx: int = 0) -> None:
+        """Condense every client's local set at the current global weights
+        (client.condense parity, condense_api.py:170-178)."""
+        data = self.data
+        C, ipc = data.class_num, self.images_per_class
+        for c, idx in data.train_idx_map.items():
+            idx = np.asarray(idx)
+            x_syn, y_syn, _ = condense_dataset(
+                self.task, data.train_x[idx], data.train_y[idx],
+                num_classes=C, images_per_class=ipc,
+                iters=self.condense_iters, syn_lr=self.syn_lr,
+                seed=self.cfg.seed + 31 * int(c) + round_idx,
+                net=self.net,
+            )
+            # pad to the fixed [C*ipc] layout (condense_dataset skips absent
+            # classes): one static union shape -> one _train_syn compile
+            n = x_syn.shape[0]
+            full = C * ipc
+            xs = np.zeros((full,) + x_syn.shape[1:], np.float32)
+            ys = np.zeros((full,), np.int64)
+            valid = np.zeros((full,), np.float32)
+            xs[:n], ys[:n], valid[:n] = x_syn, y_syn, 1.0
+            self.syn_data[int(c)] = (jnp.asarray(xs), jnp.asarray(ys),
+                                     jnp.asarray(valid))
+        self._condense_round = round_idx
+
+    # ------------------------------------------------------ condensed train
+    def _build_syn_train(self):
+        task = self.task
+        tx = self._ctx
+        T = self.temperature
+        soft = self.condense_train_type == "soft"
+        steps = self.condense_steps
+
+        def run(net: NetState, x_syn, y_syn, valid):
+            teacher = jax.nn.softmax(
+                task.predict(net.params, net.extra, x_syn) / T, axis=-1)
+            opt = tx.init(net.params)
+            denom = jnp.maximum(jnp.sum(valid), 1.0)
+
+            def step(carry, _):
+                params, opt = carry
+
+                def loss_fn(p):
+                    logits = task.predict(p, net.extra, x_syn)
+                    if soft:
+                        return kl_divergence(logits, teacher, T, mask=valid)
+                    per = optax.softmax_cross_entropy_with_integer_labels(
+                        logits, y_syn)
+                    return jnp.sum(per * valid) / denom
+
+                l, g = jax.value_and_grad(loss_fn)(params)
+                upd, opt = tx.update(g, opt, params)
+                return (optax.apply_updates(params, upd), opt), l
+
+            (params, _), losses = jax.lax.scan(
+                step, (net.params, opt), None, length=steps)
+            return NetState(params, net.extra), losses
+
+        return run
+
+    def train_condense_server(self, round_idx: int) -> float:
+        """Train the global net on the sampled clients' synthetic union
+        (_train_condense_server, condense_api.py:315-329). Fixed per-client
+        shapes make the union [K * C * ipc] static across rounds."""
+        ids = self._sampled_ids(round_idx)
+        xs = jnp.concatenate([self.syn_data[int(c)][0] for c in ids])
+        ys = jnp.concatenate([self.syn_data[int(c)][1] for c in ids])
+        valid = jnp.concatenate([self.syn_data[int(c)][2] for c in ids])
+        self.net, losses = self._train_syn(self.net, xs, ys, valid)
+        return float(np.asarray(losses)[-1])
+
+    # ------------------------------------------------------------- rounds
+    def run_round(self, round_idx: int):
+        if not self.syn_data or (
+            not self.init_only
+            and round_idx - self._condense_round >= self.recondense_every
+        ):
+            self.setup_condense(round_idx)
+        metrics = super().run_round(round_idx)
+        self.last_condense_loss = self.train_condense_server(round_idx)
+        return metrics
+
+    def run_rounds(self, start_round: int, num_rounds: int):
+        raise NotImplementedError(
+            "FedCon interleaves host-driven condensation and condensed "
+            "server training with the round program; the R-round scan block "
+            "would silently skip both — use run_round")

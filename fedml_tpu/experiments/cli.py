@@ -30,7 +30,7 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--algo", type=str, default="fedavg",
                         choices=["fedavg", "fedopt", "fedprox", "fednova",
                                  "fedavg_robust", "hierarchical", "feddf",
-                                 "feddf_hard", "fedavg_affinity", "fednas",
+                                 "feddf_hard", "fedcon", "fedavg_affinity", "fednas",
                                  "decentralized", "centralized", "turboaggregate",
                                  "fedseg", "split_nn", "fedgkt", "vfl"])
     parser.add_argument("--model", type=str, default="lr")
@@ -70,6 +70,15 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--group_comm_round", type=int, default=2)
     parser.add_argument("--distill_steps", type=int, default=20)
     parser.add_argument("--distill_lr", type=float, default=1e-3)
+    # fedcon (condense_api.py flag surface: train type + ipc)
+    parser.add_argument("--condense_train_type", type=str, default="ce",
+                        choices=["ce", "soft"])
+    parser.add_argument("--images_per_class", type=int, default=2)
+    parser.add_argument("--condense_iters", type=int, default=20)
+    parser.add_argument("--condense_steps", type=int, default=10)
+    parser.add_argument("--condense_init_only", type=int, default=1,
+                        help="1 = fedcon_init (condense once); 0 = re-condense")
+    parser.add_argument("--recondense_every", type=int, default=5)
     # fedseg (--loss_type/--lr_scheduler surface of the reference fedseg main)
     parser.add_argument("--loss_type", type=str, default="ce")
     parser.add_argument("--lr_scheduler", type=str, default="poly")
@@ -228,6 +237,16 @@ def build_api(args):
                         distill_steps=args.distill_steps,
                         distill_lr=args.distill_lr,
                         hard_label=(algo == "feddf_hard")), data
+    if algo == "fedcon":
+        from fedml_tpu.algorithms.fedcon import FedConAPI
+
+        return FedConAPI(data, task, cfg, mesh=mesh,
+                         images_per_class=args.images_per_class,
+                         condense_iters=args.condense_iters,
+                         condense_steps=args.condense_steps,
+                         condense_train_type=args.condense_train_type,
+                         init_only=bool(args.condense_init_only),
+                         recondense_every=args.recondense_every), data
     if algo == "fedavg_affinity":
         from fedml_tpu.algorithms.fedavg_affinity import FedAvgAffinityAPI
 

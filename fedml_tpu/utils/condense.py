@@ -41,13 +41,16 @@ def condense_dataset(
     syn_lr: float = 0.1,
     batch_per_class: int = 64,
     seed: int = 0,
+    net=None,
 ):
     """Return (x_syn [C*ipc, ...], y_syn [C*ipc]) matching class gradients.
 
     The synthetic set is initialized from real samples (the reference's
-    'real' init mode) and optimized so that, for a freshly-initialized
-    network, per-class gradients of the synthetic set match those of real
-    class batches.
+    'real' init mode) and optimized so that per-class gradients of the
+    synthetic set match those of real class batches — at freshly-initialized
+    networks by default, or at ``net`` (a NetState) when given: the
+    reference's client.condense receives the CURRENT global weights
+    (condense_api.py:170-178), so condensation adapts to the trained model.
     """
     rng = np.random.RandomState(seed)
     key = jax.random.PRNGKey(seed)
@@ -81,7 +84,10 @@ def condense_dataset(
 
         def it(carry, k):
             x_syn, opt = carry
-            net_k = task.init(k, x_syn[: images_per_class])  # fresh random net
+            if net is None:
+                net_k = task.init(k, x_syn[: images_per_class])  # fresh random net
+            else:
+                net_k = net  # condition on the provided (global) weights
 
             def match_loss(xs_):
                 total = 0.0
