@@ -49,6 +49,8 @@ class FedConAPI(FedAvgAPI):
                  condense_train_type: str = "ce", temperature: float = 3.0,
                  init_only: bool = True, recondense_every: int = 5,
                  syn_lr: float = 0.1, **kwargs):
+        if condense_steps < 1:
+            raise ValueError("condense_steps must be >= 1")
         if condense_train_type not in ("ce", "soft"):
             raise ValueError(f"undefined condense train type {condense_train_type!r}"
                              " (condense_api.py:321-329 offers ce|soft)")
@@ -104,22 +106,26 @@ class FedConAPI(FedAvgAPI):
         soft = self.condense_train_type == "soft"
         steps = self.condense_steps
 
-        def run(net: NetState, x_syn, y_syn, valid):
+        def run(net: NetState, teacher_net: NetState, x_syn, y_syn, valid):
+            # teacher = PRE-update global model (captured before the round's
+            # aggregate): a teacher equal to the student would make the KL
+            # gradient exactly zero at step 0 and soft training a no-op
             teacher = jax.nn.softmax(
-                task.predict(net.params, net.extra, x_syn) / T, axis=-1)
+                task.predict(teacher_net.params, teacher_net.extra, x_syn) / T,
+                axis=-1)
             opt = tx.init(net.params)
-            denom = jnp.maximum(jnp.sum(valid), 1.0)
+            key = jax.random.PRNGKey(0)  # eval-mode loss; key unused
 
             def step(carry, _):
                 params, opt = carry
 
                 def loss_fn(p):
-                    logits = task.predict(p, net.extra, x_syn)
                     if soft:
+                        logits = task.predict(p, net.extra, x_syn)
                         return kl_divergence(logits, teacher, T, mask=valid)
-                    per = optax.softmax_cross_entropy_with_integer_labels(
-                        logits, y_syn)
-                    return jnp.sum(per * valid) / denom
+                    # masked CE = the task's own loss definition
+                    return task.loss(p, net.extra, x_syn, y_syn, valid,
+                                     key, False)[0]
 
                 l, g = jax.value_and_grad(loss_fn)(params)
                 upd, opt = tx.update(g, opt, params)
@@ -131,7 +137,7 @@ class FedConAPI(FedAvgAPI):
 
         return run
 
-    def train_condense_server(self, round_idx: int) -> float:
+    def train_condense_server(self, round_idx: int, teacher_net: NetState) -> float:
         """Train the global net on the sampled clients' synthetic union
         (_train_condense_server, condense_api.py:315-329). Fixed per-client
         shapes make the union [K * C * ipc] static across rounds."""
@@ -139,7 +145,7 @@ class FedConAPI(FedAvgAPI):
         xs = jnp.concatenate([self.syn_data[int(c)][0] for c in ids])
         ys = jnp.concatenate([self.syn_data[int(c)][1] for c in ids])
         valid = jnp.concatenate([self.syn_data[int(c)][2] for c in ids])
-        self.net, losses = self._train_syn(self.net, xs, ys, valid)
+        self.net, losses = self._train_syn(self.net, teacher_net, xs, ys, valid)
         return float(np.asarray(losses)[-1])
 
     # ------------------------------------------------------------- rounds
@@ -149,8 +155,9 @@ class FedConAPI(FedAvgAPI):
             and round_idx - self._condense_round >= self.recondense_every
         ):
             self.setup_condense(round_idx)
+        teacher_net = self.net  # pre-update global (soft-label teacher)
         metrics = super().run_round(round_idx)
-        self.last_condense_loss = self.train_condense_server(round_idx)
+        self.last_condense_loss = self.train_condense_server(round_idx, teacher_net)
         return metrics
 
     def run_rounds(self, start_round: int, num_rounds: int):

@@ -53,6 +53,11 @@ class FedDFAPI(FedAvgAPI):
         distill_batch_size: int = 64,
         temperature: float = 3.0,
         hard_label: bool = False,  # FedDF-hard variant
+        hard_sample_ratio: float = 1.0,  # random public subset (--hard_sample)
+        fedmix_server: bool = False,  # distill on per-client batch-mean images
+        val_fraction: float = 0.0,    # >0: val-gated early stop of distillation
+        val_every: int = 10,
+        patience_steps: int | None = None,
         mesh=None,
         **kwargs,
     ):
@@ -61,16 +66,60 @@ class FedDFAPI(FedAvgAPI):
             # reference uses an unlabeled public set (e.g. CIFAR-100 for
             # CIFAR-10 training); default to held-out test inputs
             public_x = dataset.test_x
+        public_x = np.asarray(public_x, np.float32)
+        if fedmix_server and (hard_sample_ratio < 1.0):
+            raise ValueError("fedmix_server replaces the public pool with "
+                             "batch-mean images; combining it with "
+                             "hard_sample_ratio would silently discard the "
+                             "subsetting — pick one")
+        if hard_sample_ratio < 1.0:
+            # the reference's "hard sample mining" is a seeded random subset
+            # of the unlabeled pool (my_model_trainer_ensemble.py:87-104)
+            rng = np.random.RandomState(0)
+            idx = rng.permutation(len(public_x))
+            public_x = public_x[idx[: int(np.floor(len(idx) * hard_sample_ratio))]]
+        if fedmix_server:
+            # FedMix server path (feddf_api.py:360-363, ensemble trainer
+            # train(train_data, average_data, ...)): the distillation inputs
+            # are per-client per-batch MEAN images (generate_mean,
+            # condense_api.py:129-147) — privacy-preserving mixup stand-ins
+            public_x = self._batch_mean_images()
+        if len(public_x) == 0:
+            raise ValueError("public distillation pool is empty "
+                             "(hard_sample_ratio too small?)")
         n = min(len(public_x), distill_steps * distill_batch_size)
-        self.public_x = np.asarray(public_x[:n], np.float32)
+        self.public_x = public_x[:n]
         self.distill_steps = distill_steps
         self.distill_lr = distill_lr
         self.distill_batch_size = distill_batch_size
         self.temperature = temperature
         self.hard_label = hard_label
+        self.val_every = val_every
+        self.patience_steps = patience_steps or distill_steps
+        self._val_cache = None
+        if val_fraction > 0.0:
+            # carve a validation split off the global test set (reference
+            # feeds valid_data_global, feddf_api.py:32-41)
+            n_val = max(1, int(len(dataset.test_x) * val_fraction))
+            self._val_cache = (
+                jnp.asarray(dataset.test_x[:n_val]),
+                jnp.asarray(dataset.test_y[:n_val]),
+            )
+        self.best_val_acc = float("nan")
         self._distill = jax.jit(self._build_distill())
         # keep per-client nets: rebuild a round fn that returns them
         self._local_batch = jax.jit(self._build_local_batch())
+
+    def _batch_mean_images(self) -> np.ndarray:
+        """Per-client per-batch mean images (generate_mean parity): for each
+        client, mean over each local batch of ``batch_size`` samples."""
+        data, bs = self.data, self.cfg.batch_size
+        means = []
+        for c, idx in data.train_idx_map.items():
+            xs = np.asarray(data.train_x[np.asarray(idx)], np.float32)
+            for i in range(0, len(xs), bs):
+                means.append(xs[i : i + bs].mean(axis=0))
+        return np.stack(means)
 
     def _build_local_batch(self):
         local_update = self.local_update
@@ -87,15 +136,27 @@ class FedDFAPI(FedAvgAPI):
     def _build_distill(self):
         task = self.task
         T = self.temperature
-        tx = optax.adam(self.distill_lr)
+        # cosine LR over the distillation budget (the reference pairs Adam
+        # with CosineAnnealingLR(server_steps), ensemble trainer :127-128)
+        schedule = optax.cosine_decay_schedule(self.distill_lr,
+                                               max(self.distill_steps, 1))
+        tx = optax.adam(schedule)
         hard = self.hard_label
+        val = self._val_cache
+        val_every = self.val_every
+        patience = self.patience_steps
+
+        def val_acc(params, extra):
+            logits = task.predict(params, extra, val[0])
+            return jnp.mean((jnp.argmax(logits, -1) == val[1]).astype(jnp.float32))
 
         def distill(student: NetState, client_nets, public_batches):
             # public_batches: [S, bs, ...]
             opt_state = tx.init(student.params)
 
-            def step(carry, xb):
-                params, opt_state = carry
+            def step(carry, inp):
+                params, opt_state, best, since_best, stopped = carry
+                xb, step_idx = inp
                 # ensemble teacher: mean softmax over the K client models
                 t_logits = jax.vmap(
                     lambda p, e: task.predict(p, e, xb)
@@ -113,20 +174,48 @@ class FedDFAPI(FedAvgAPI):
                     return kl_divergence(s_logits, t_probs, T)
 
                 l, g = jax.value_and_grad(loss_fn)(params)
-                upd, opt_state = tx.update(g, opt_state, params)
-                return (optax.apply_updates(params, upd), opt_state), l
+                upd, opt_state_n = tx.update(g, opt_state, params)
+                new_params = optax.apply_updates(params, upd)
+                if val is None:
+                    # no gating machinery in the hot scan body
+                    return (new_params, opt_state_n, best, since_best,
+                            stopped), l
+                # val-gated early stop (ensemble trainer :137-175): check
+                # every val_every steps; stop after `patience` steps without
+                # a new best. Static scan length; stopped steps are no-ops.
+                acc = jax.lax.cond(
+                    ((step_idx + 1) % val_every == 0) & ~stopped,
+                    lambda: val_acc(new_params, student.extra),
+                    lambda: jnp.float32(-1.0))
+                improved = acc > best
+                best = jnp.maximum(best, acc)
+                since_best = jnp.where(acc >= 0,
+                                       jnp.where(improved, 0, since_best + val_every),
+                                       since_best)
+                stopped = stopped | (since_best >= patience)
+                keep = lambda a, b: jax.tree.map(
+                    lambda u, v: jnp.where(stopped, v, u), a, b)
+                return (keep(new_params, params), keep(opt_state_n, opt_state),
+                        best, since_best, stopped), l
 
-            (params, _), losses = jax.lax.scan(
-                step, (student.params, opt_state), public_batches
+            S = public_batches.shape[0]
+            (params, _, best, _, _), losses = jax.lax.scan(
+                step,
+                (student.params, opt_state, jnp.float32(0.0), jnp.int32(0),
+                 jnp.bool_(False)),
+                (public_batches, jnp.arange(S))
             )
-            return NetState(params, student.extra), losses
+            return NetState(params, student.extra), losses, best
 
         return distill
 
     def _public_batches(self, round_idx: int):
         rng = np.random.RandomState(self.cfg.seed * 977 + round_idx)
         idx = rng.permutation(len(self.public_x))
-        bs = self.distill_batch_size
+        # small public pools (e.g. fedmix mean images) shrink the batch
+        # rather than yielding zero distillation steps (pool is non-empty,
+        # enforced at construction, so S >= 1)
+        bs = min(self.distill_batch_size, len(idx))
         S = min(self.distill_steps, len(idx) // bs)
         sel = idx[: S * bs].reshape(S, bs)
         return jnp.asarray(self.public_x[sel])
@@ -138,8 +227,11 @@ class FedDFAPI(FedAvgAPI):
             rk, self.net, jnp.asarray(cb.x), jnp.asarray(cb.y), jnp.asarray(cb.mask)
         )
         avg = tree_weighted_mean(nets, jnp.asarray(cb.num_samples))
-        student, d_losses = self._distill(avg, nets, self._public_batches(round_idx))
+        student, d_losses, best = self._distill(
+            avg, nets, self._public_batches(round_idx))
         self.net = student
+        if self._val_cache is not None:
+            self.best_val_acc = float(best)
         metrics = dict(metrics)
         metrics["distill_loss"] = d_losses[-1]
         return metrics

@@ -70,6 +70,10 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--group_comm_round", type=int, default=2)
     parser.add_argument("--distill_steps", type=int, default=20)
     parser.add_argument("--distill_lr", type=float, default=1e-3)
+    parser.add_argument("--hard_sample_ratio", type=float, default=1.0)
+    parser.add_argument("--fedmix_server", type=int, default=0)
+    parser.add_argument("--val_fraction", type=float, default=0.0,
+                        help=">0: val-gated early stop of distillation")
     # fedcon (condense_api.py flag surface: train type + ipc)
     parser.add_argument("--condense_train_type", type=str, default="ce",
                         choices=["ce", "soft"])
@@ -236,6 +240,9 @@ def build_api(args):
         return FedDFAPI(data, task, cfg, mesh=mesh,
                         distill_steps=args.distill_steps,
                         distill_lr=args.distill_lr,
+                        hard_sample_ratio=args.hard_sample_ratio,
+                        fedmix_server=bool(args.fedmix_server),
+                        val_fraction=args.val_fraction,
                         hard_label=(algo == "feddf_hard")), data
     if algo == "fedcon":
         from fedml_tpu.algorithms.fedcon import FedConAPI

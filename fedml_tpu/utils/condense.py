@@ -75,7 +75,6 @@ def condense_dataset(
     x_real = jnp.asarray(np.stack(real_batches))  # [C, B, ...]
     present = x_real.shape[0]
 
-    net = task.init(key, x_syn[: images_per_class])
     tx = optax.adam(syn_lr)
 
     @jax.jit

@@ -96,3 +96,37 @@ def test_fedgkt_server_logits_flow():
     api = FedGKTAPI(data, _Ext(), _Head(), _ServerTrunk(), cfg, num_classes=4)
     api.run_round(0)
     assert float(jnp.abs(api._s_logits).sum()) > 0
+
+
+def test_feddf_val_gated_hard_sample_and_fedmix():
+    """Fork-feature parity: (a) val-gated early stopping reports best val
+    acc, (b) hard_sample_ratio subsets the public pool, (c) fedmix_server
+    distills on per-client batch-mean images."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.feddf import FedDFAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
+                            samples_per_client=24, test_samples=90, seed=3)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4, client_num_per_round=4,
+                       epochs=1, batch_size=8, lr=0.1, frequency_of_the_test=1)
+
+    api = FedDFAPI(data, task, cfg, distill_steps=8, distill_batch_size=8,
+                   val_fraction=0.3, val_every=2, patience_steps=4)
+    m = api.run_round(0)
+    assert "distill_loss" in m
+    assert 0.0 <= api.best_val_acc <= 1.0  # a val check ran
+
+    sub = FedDFAPI(data, task, cfg, distill_steps=8, distill_batch_size=8,
+                   hard_sample_ratio=0.5)
+    assert len(sub.public_x) <= len(api.public_x)
+
+    mix = FedDFAPI(data, task, cfg, distill_steps=4, distill_batch_size=4,
+                   fedmix_server=True)
+    # one mean image per local batch of bs=8, summed over clients
+    expected = sum(-(-len(v) // 8) for v in data.train_idx_map.values())
+    assert mix._batch_mean_images().shape[0] == expected
+    mix.run_round(0)

@@ -110,6 +110,15 @@ def test_fedcon_trains_on_condensed_union():
                      condense_steps=4, condense_train_type="soft")
     soft.run_round(0)
     assert soft.last_condense_loss >= 0.0
+    # soft training must MOVE params beyond the plain FedAvg aggregate: the
+    # teacher is the pre-update global, so the KL gradient at the
+    # post-aggregate student is nonzero (a teacher equal to the student
+    # would silently no-op — regression cover)
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+    plain = FedAvgAPI(data, task, cfg)
+    plain.run_round(0)
+    d = float(tree_global_norm(tree_sub(soft.net.params, plain.net.params)))
+    assert d > 1e-8
 
     import pytest
     with pytest.raises(ValueError):
