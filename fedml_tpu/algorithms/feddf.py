@@ -62,10 +62,23 @@ class FedDFAPI(FedAvgAPI):
         **kwargs,
     ):
         super().__init__(dataset, task, config, mesh=mesh, **kwargs)
+        # carve the validation split FIRST so the default public pool is
+        # disjoint from it (the reference feeds a separate valid_data_global,
+        # feddf_api.py:32-41; gating the early stop on distillation inputs
+        # would track training fit, not generalization)
+        self._val_cache = None
+        n_val = 0
+        if val_fraction > 0.0:
+            n_val = max(1, int(len(dataset.test_x) * val_fraction))
+            self._val_cache = (
+                jnp.asarray(dataset.test_x[:n_val]),
+                jnp.asarray(dataset.test_y[:n_val]),
+            )
         if public_x is None:
             # reference uses an unlabeled public set (e.g. CIFAR-100 for
-            # CIFAR-10 training); default to held-out test inputs
-            public_x = dataset.test_x
+            # CIFAR-10 training); default to held-out test inputs, minus
+            # the validation rows
+            public_x = dataset.test_x[n_val:]
         public_x = np.asarray(public_x, np.float32)
         if fedmix_server and (hard_sample_ratio < 1.0):
             raise ValueError("fedmix_server replaces the public pool with "
@@ -96,15 +109,6 @@ class FedDFAPI(FedAvgAPI):
         self.hard_label = hard_label
         self.val_every = val_every
         self.patience_steps = patience_steps or distill_steps
-        self._val_cache = None
-        if val_fraction > 0.0:
-            # carve a validation split off the global test set (reference
-            # feeds valid_data_global, feddf_api.py:32-41)
-            n_val = max(1, int(len(dataset.test_x) * val_fraction))
-            self._val_cache = (
-                jnp.asarray(dataset.test_x[:n_val]),
-                jnp.asarray(dataset.test_y[:n_val]),
-            )
         self.best_val_acc = float("nan")
         self._distill = jax.jit(self._build_distill())
         # keep per-client nets: rebuild a round fn that returns them
@@ -199,15 +203,22 @@ class FedDFAPI(FedAvgAPI):
                         best, since_best, stopped), l
 
             S = public_batches.shape[0]
+            # best starts at -1: distinguishes "no val check ever ran"
+            # (e.g. S < val_every) from a genuinely 0%-accurate model
             (params, _, best, _, _), losses = jax.lax.scan(
                 step,
-                (student.params, opt_state, jnp.float32(0.0), jnp.int32(0),
+                (student.params, opt_state, jnp.float32(-1.0), jnp.int32(0),
                  jnp.bool_(False)),
                 (public_batches, jnp.arange(S))
             )
             return NetState(params, student.extra), losses, best
 
         return distill
+
+    def run_rounds(self, start_round: int, num_rounds: int):
+        raise NotImplementedError(
+            "FedDF interleaves ensemble distillation with the round program; "
+            "the R-round scan block would silently skip it — use run_round")
 
     def _public_batches(self, round_idx: int):
         rng = np.random.RandomState(self.cfg.seed * 977 + round_idx)
@@ -231,7 +242,8 @@ class FedDFAPI(FedAvgAPI):
             avg, nets, self._public_batches(round_idx))
         self.net = student
         if self._val_cache is not None:
-            self.best_val_acc = float(best)
+            b = float(best)
+            self.best_val_acc = b if b >= 0 else float("nan")
         metrics = dict(metrics)
         metrics["distill_loss"] = d_losses[-1]
         return metrics

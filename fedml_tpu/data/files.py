@@ -41,6 +41,10 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed)
             fd = _load_landmarks_csv(data_dir, spec, n_clients)
             if fd is not None:
                 return fd
+        if name in ("stackoverflow_nwp", "stackoverflow_lr"):
+            fd = _load_stackoverflow_h5(data_dir, spec, n_clients)
+            if fd is not None:
+                return fd
     except Exception:
         return None
     return None
@@ -203,3 +207,83 @@ def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed):
         TX, TY = X[:1000], Y[:1000]
     idx_map = partition_data(Y, n_clients, method, alpha, seed)
     return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
+
+
+def _load_stackoverflow_h5(data_dir, spec, n_clients):
+    """TFF stackoverflow h5: examples/<uid>/{tokens, tags, ...} byte strings
+    (reference fedml_api/data_preprocessing/stackoverflow_{nwp,lr}). The
+    vocab is built from the loaded clients' corpora via data/stackoverflow.py
+    (the reference ships precomputed top-10000 word / top-500 tag counts;
+    corpus-derived counts converge to them on the same data)."""
+    try:
+        import h5py
+    except ImportError:
+        return None
+
+    from fedml_tpu.data.stackoverflow import (
+        DEFAULT_TAG_VOCAB_SIZE, DEFAULT_WORD_VOCAB_SIZE, build_tag_vocab,
+        build_word_vocab, encode_bow, encode_nwp, encode_tags,
+        tag_counts_from_clients, word_counts_from_clients)
+
+    paths = {p: os.path.join(data_dir, p) for p in os.listdir(data_dir) if p.endswith(".h5")}
+    train_p = next((v for k, v in paths.items() if "train" in k), None)
+    test_p = next((v for k, v in paths.items() if "test" in k), None)
+    if train_p is None:
+        return None
+    nwp = spec.name == "stackoverflow_nwp"
+
+    def read_text(path, limit):
+        sents, tags = {}, {}
+        with h5py.File(path, "r") as f:
+            ex = f["examples"]
+            for k, cid in enumerate(sorted(ex.keys())[:limit]):
+                g = ex[cid]
+                sents[k] = [t.decode() if isinstance(t, bytes) else str(t)
+                            for t in np.asarray(g["tokens"])]
+                if "tags" in g:
+                    tags[k] = [t.decode() if isinstance(t, bytes) else str(t)
+                               for t in np.asarray(g["tags"])]
+        return sents, tags
+
+    tr_s, tr_t = read_text(train_p, n_clients)
+    te_s, te_t = read_text(test_p, n_clients) if test_p else (tr_s, tr_t)
+    vocab = build_word_vocab(word_counts_from_clients(tr_s),
+                             DEFAULT_WORD_VOCAB_SIZE)
+
+    if nwp:
+        def encode_all(sents_by_client):
+            xs, idx_map, off = [], {}, 0
+            for k in sorted(sents_by_client):
+                ids = np.stack([encode_nwp(s, vocab) for s in sents_by_client[k]])
+                xs.append(ids)
+                idx_map[k] = np.arange(off, off + len(ids)); off += len(ids)
+            return np.concatenate(xs), idx_map
+
+        X, idx_map = encode_all(tr_s)
+        TX, te_map = encode_all(te_s)
+        # next-word prediction frame: x = ids[:-1], y = ids[1:]
+        return FederatedData(X[:, :-1], X[:, 1:], TX[:, :-1], TX[:, 1:],
+                             idx_map, te_map, spec.num_classes)
+
+    tag_vocab = build_tag_vocab(tag_counts_from_clients(tr_t),
+                                DEFAULT_TAG_VOCAB_SIZE)
+    # FIXED spec dims (10004-dim bow, 500-dim tags) regardless of how many
+    # distinct words/tags the loaded corpus slice has — the model factory
+    # builds from spec.input_shape/num_classes, and OOV ids sit at the top
+    # of the fixed layout
+    dim_x = DEFAULT_WORD_VOCAB_SIZE + 4
+    num_tags = DEFAULT_TAG_VOCAB_SIZE
+
+    def encode_all(sents_by_client, tags_by_client):
+        xs, ys, idx_map, off = [], [], {}, 0
+        for k in sorted(sents_by_client):
+            xs.append(np.stack([encode_bow(s, vocab, dim=dim_x)
+                                for s in sents_by_client[k]]))
+            ys.append(np.stack([encode_tags(t, tag_vocab, num_tags=num_tags)
+                                for t in tags_by_client.get(k, [""] * len(sents_by_client[k]))]))
+            idx_map[k] = np.arange(off, off + len(xs[-1])); off += len(xs[-1])
+        return np.concatenate(xs), np.concatenate(ys), idx_map
+
+    X, Y, idx_map = encode_all(tr_s, tr_t)
+    TX, TY, te_map = encode_all(te_s, te_t)
+    return FederatedData(X, Y, TX, TY, idx_map, te_map, spec.num_classes)

@@ -52,9 +52,12 @@ def encode_nwp(sentence: str, vocab: dict[str, int], seq_len: int = 20) -> np.nd
     return np.asarray(ids, np.int32)
 
 
-def encode_tags(tags: str, tag_vocab: dict[str, int]) -> np.ndarray:
-    """'|'-separated tag string -> multi-hot [num_tags] float32."""
-    out = np.zeros((len(tag_vocab),), np.float32)
+def encode_tags(tags: str, tag_vocab: dict[str, int],
+                num_tags: int | None = None) -> np.ndarray:
+    """'|'-separated tag string -> multi-hot [num_tags] float32 (pass
+    ``num_tags`` to keep the fixed 500-dim layout when the corpus yields a
+    smaller vocab)."""
+    out = np.zeros((num_tags or len(tag_vocab),), np.float32)
     for t in tags.split("|"):
         i = tag_vocab.get(t)
         if i is not None:
@@ -62,9 +65,14 @@ def encode_tags(tags: str, tag_vocab: dict[str, int]) -> np.ndarray:
     return out
 
 
-def encode_bow(sentence: str, vocab: dict[str, int]) -> np.ndarray:
-    """Normalized bag-of-words over the word vocab (the LR task's input)."""
-    out = np.zeros((len(vocab),), np.float32)
+def encode_bow(sentence: str, vocab: dict[str, int],
+               dim: int | None = None) -> np.ndarray:
+    """Normalized bag-of-words over the word vocab (the LR task's input).
+    The id layout is FIXED at vocab_size+4 (pad/words/bos/eos/oov) even when
+    the corpus has fewer distinct words, so the default dim is max-id+1,
+    NOT len(vocab) — a small corpus + len(vocab) would put OOV out of
+    bounds."""
+    out = np.zeros((dim or max(vocab.values()) + 1,), np.float32)
     words = sentence.split()
     oov = vocab[OOV]
     for w in words:
@@ -81,3 +89,15 @@ def word_counts_from_clients(client_sentences: dict[int, list[str]]):
         for s in sents:
             counts.update(s.split())
     return dict(counts)
+
+
+def tag_counts_from_clients(client_tags: dict[int, list[str]]):
+    """Aggregate tag counts over clients' '|'-separated tag strings (the
+    tag-vocab preprocessing step of stackoverflow_lr)."""
+    counts: collections.Counter = collections.Counter()
+    for tags in client_tags.values():
+        for t in tags:
+            for tag in t.split("|"):
+                if tag:
+                    counts[tag] += 1
+    return counts

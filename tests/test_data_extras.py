@@ -112,3 +112,40 @@ def test_resnet_wo_bn_forward_and_no_extra_state():
     assert logits.shape == (2, 10)
     # fixup zero-init -> finite outputs at init
     assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_stackoverflow_h5_reader(tmp_path):
+    """TFF stackoverflow h5 -> FederatedData for both nwp (next-word ids)
+    and lr (bag-of-words -> multi-hot tags) variants."""
+    h5py = pytest.importorskip("h5py")
+    import numpy as np
+    from fedml_tpu.data.files import _load_stackoverflow_h5
+    from fedml_tpu.data.registry import DATASETS
+
+    d = tmp_path / "so"
+    d.mkdir()
+    for split in ("train", "test"):
+        with h5py.File(d / f"stackoverflow_{split}.h5", "w") as f:
+            ex = f.create_group("examples")
+            for cid in ("userA", "userB", "userC"):
+                g = ex.create_group(cid)
+                g.create_dataset("tokens", data=[
+                    b"how do i sort a list in python",
+                    b"what is a pointer in c",
+                ])
+                g.create_dataset("tags", data=[b"python|list", b"c|pointers"])
+
+    nwp = _load_stackoverflow_h5(str(d), DATASETS["stackoverflow_nwp"], 2)
+    assert nwp.num_clients == 2          # capped by n_clients
+    assert nwp.train_x.shape == (4, 20)  # 2 clients x 2 sentences, seq 20
+    assert nwp.train_y.shape == (4, 20)  # shifted-by-one frame
+    assert nwp.train_x.dtype == np.int32
+    # first token of every x frame is BOS, and y is x shifted left
+    assert (nwp.train_x[:, 0] == nwp.train_x[0, 0]).all()
+    np.testing.assert_array_equal(nwp.train_x[:, 1:], nwp.train_y[:, :-1])
+
+    lr = _load_stackoverflow_h5(str(d), DATASETS["stackoverflow_lr"], 3)
+    assert lr.num_clients == 3
+    assert lr.train_x.shape[0] == 6 and lr.train_y.shape[0] == 6
+    assert lr.train_y.min() >= 0 and lr.train_y.max() == 1.0  # multi-hot
+    assert np.isclose(lr.train_x.sum(-1), 1.0).all()  # normalized bow
