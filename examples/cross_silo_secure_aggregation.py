@@ -1,0 +1,50 @@
+"""Worked example: cross-silo FL where the server NEVER sees a client update.
+
+Runs the cross-process runtime (one manager per party over the in-process
+loopback transport; swap backend="GRPC" for real hosts) with TurboAggregate's
+coded-share wire format: each silo quantizes its weighted update into
+GF(2^31-1), Shamir-encodes it, and uploads only the share matrix; the server
+sums shares and reconstructs the aggregate by Lagrange interpolation —
+additive homomorphism means individual updates stay secret
+(fedml_tpu/distributed/turboaggregate.py).
+
+Run:  JAX_PLATFORMS=cpu python examples/cross_silo_secure_aggregation.py
+"""
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed import turboaggregate
+from fedml_tpu.models.linear import LogisticRegression
+
+
+def main():
+    data = synthetic_images(num_clients=8, image_shape=(8, 8, 1), num_classes=4,
+                            samples_per_client=40, test_samples=160, seed=0)
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=5, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=10,
+                       lr=0.1, frequency_of_the_test=1)
+
+    # secure cross-process run: only Shamir shares travel
+    agg = turboaggregate.run_simulated(data, task, cfg, job_id="secure-demo")
+    print("secure-aggregation eval history:")
+    for rec in agg.history:
+        print(" ", rec)
+
+    # plaintext SPMD oracle: same rounds, cleartext weighted average
+    oracle = FedAvgAPI(data, task, cfg)
+    oracle.train()
+    diff = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(pack_pytree(agg.net.params), pack_pytree(oracle.net.params))
+    )
+    print(f"max |secure - plaintext| parameter gap: {diff:.2e} "
+          f"(quantization only)")
+
+
+if __name__ == "__main__":
+    main()

@@ -46,6 +46,12 @@ def create_model(model_name: str, output_dim: int = 10, **kwargs):
         from fedml_tpu.models.efficientnet import EfficientNet
 
         return EfficientNet(num_classes=output_dim, **kwargs)
+    if name in ("transformer", "transformer_flash"):
+        from fedml_tpu.models.transformer import TransformerLM
+
+        kwargs.setdefault("use_flash", name == "transformer_flash")
+        kwargs.setdefault("vocab_size", output_dim)
+        return TransformerLM(**kwargs)
     if name == "vgg11":
         from fedml_tpu.models.vgg import VGG
 
