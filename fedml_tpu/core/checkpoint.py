@@ -21,7 +21,7 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
         "net": net,
         "server_opt_state": server_opt_state,
         "rng": rng,
-        "round": np.int64(round_idx),
+        "round": np.asarray(round_idx, np.int64),
     }
     try:
         import orbax.checkpoint as ocp
@@ -42,14 +42,30 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
     return path
 
 
+_ROUND_RE = None
+
+
+def _completed_rounds(ckpt_dir: str) -> list[int]:
+    """Only COMPLETED checkpoints: 'round_NNNNNN' dirs or '.npz' files —
+    orbax in-progress temp dirs (round_NNNNNN.orbax-checkpoint-tmp-*) from a
+    crash mid-save must not be offered for resume."""
+    import re
+
+    global _ROUND_RE
+    if _ROUND_RE is None:
+        _ROUND_RE = re.compile(r"^round_(\d{6})(\.npz)?$")
+    out = []
+    for d in os.listdir(ckpt_dir):
+        m = _ROUND_RE.match(d)
+        if m:
+            out.append(int(m.group(1)))
+    return out
+
+
 def latest_round(ckpt_dir: str) -> int | None:
     if not os.path.isdir(ckpt_dir):
         return None
-    rounds = [
-        int(d.split("_")[1].split(".")[0])
-        for d in os.listdir(ckpt_dir)
-        if d.startswith("round_")
-    ]
+    rounds = _completed_rounds(ckpt_dir)
     return max(rounds) if rounds else None
 
 

@@ -58,12 +58,14 @@ def run_simulated(
     backend: str = "LOOPBACK",
     job_id: str = "fedavg-sim",
     base_port: int = 50000,
+    ckpt_dir: str | None = None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port)
     aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
-    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
+                                 ckpt_dir=ckpt_dir, **kw)
     clients = [
         init_client(dataset, task, cfg, rank, size, backend, **kw) for rank in range(1, size)
     ]

@@ -11,11 +11,19 @@ the deadline aggregates over the subset of clients that DID report
 (sample-weighted, so the average stays exact over the participants) and
 moves on; late uploads from superseded rounds are round-tagged and dropped.
 A crashed client therefore degrades throughput instead of hanging the job.
+
+Checkpoint/resume (also absent in the reference): with ``ckpt_dir`` set the
+server saves (net, opt state, round) after every aggregate and, on
+construction, resumes from the latest checkpoint — a server restart
+continues the job exactly where it stopped (clients are stateless between
+rounds: they receive the global model each sync), so crash-resume ≡ an
+uninterrupted run (tested).
 """
 
 from __future__ import annotations
 
 import logging
+import os
 import threading
 
 from fedml_tpu.comm.managers import ServerManager
@@ -28,11 +36,15 @@ log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
 class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0,
-                 backend="LOOPBACK", round_timeout_s: float | None = None, **kw):
+                 backend="LOOPBACK", round_timeout_s: float | None = None,
+                 ckpt_dir: str | None = None, **kw):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
         self.round_timeout_s = round_timeout_s
+        self.ckpt_dir = ckpt_dir
+        if ckpt_dir is not None:
+            self._maybe_resume()
         self._round_lock = threading.Lock()
         if size - 1 != aggregator.cfg.client_num_per_round:
             # one worker process per sampled client (FedAvgAPI.py:20-28
@@ -45,7 +57,58 @@ class FedAvgServerManager(ServerManager):
         ts = kw.pop("timeout_s", None)
         super().__init__(rank, size, backend, timeout_s=round_timeout_s or ts, **kw)
 
+    def _ckpt_state_template(self):
+        import jax
+
+        return {
+            "net": self.aggregator.net,
+            "server_opt_state": getattr(self.aggregator, "_server_opt_state", ()),
+            "rng": jax.random.PRNGKey(0),
+        }
+
+    def _maybe_resume(self):
+        from fedml_tpu.core.checkpoint import latest_round, restore_round
+
+        r = latest_round(self.ckpt_dir)
+        if r is None:
+            return
+        import numpy as np
+
+        template = dict(self._ckpt_state_template(), round=np.asarray(0, np.int64))
+        state = restore_round(self.ckpt_dir, r, template)
+        self.aggregator.net = state["net"]
+        if hasattr(self.aggregator, "_server_opt_state"):
+            self.aggregator._server_opt_state = state["server_opt_state"]
+        self.round_idx = int(state["round"]) + 1
+        # reload persisted eval history so post-resume saves don't rewrite
+        # history.json with only the post-restart records
+        hist_path = os.path.join(self.ckpt_dir, "history.json")
+        if os.path.exists(hist_path):
+            import json
+
+            with open(hist_path) as f:
+                self.aggregator.history = json.load(f)
+        log.info("resumed from checkpoint: next round %d", self.round_idx)
+
+    def _maybe_save(self):
+        if self.ckpt_dir is None:
+            return
+        from fedml_tpu.core.checkpoint import save_round
+
+        st = self._ckpt_state_template()
+        save_round(self.ckpt_dir, self.round_idx, st["net"],
+                   st["server_opt_state"], st["rng"],
+                   history=self.aggregator.history)
+
+    def _broadcast_finish(self):
+        for rank in range(1, self.size):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+        self.finish()
+
     def run(self):
+        if self.round_idx >= self.round_num:  # resumed past the last round
+            self._broadcast_finish()
+            return
         self.send_init_msg()
         super().run()
 
@@ -87,12 +150,11 @@ class FedAvgServerManager(ServerManager):
         finish). Caller holds _round_lock."""
         global_params = self.aggregator.aggregate()
         self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        self._maybe_save()
 
         self.round_idx += 1
         if self.round_idx == self.round_num:
-            for rank in range(1, self.size):
-                self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
-            self.finish()
+            self._broadcast_finish()
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         for rank in range(1, self.size):

@@ -54,6 +54,8 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--broker_port", type=int, default=1883)
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
+    p.add_argument("--ckpt_dir", type=str, default=None,
+                   help="server round checkpoints; restart resumes the job")
     # experiment surface (subset of cli.py, same names)
     p.add_argument("--model", type=str, default="lr")
     p.add_argument("--dataset", type=str, default="mnist")
@@ -103,7 +105,8 @@ def init_role(args, data, task, cfg, backend_kw):
         else:  # fedavg / fedprox share the plain weighted-average server
             agg = FedAvgAggregator(data, task, cfg, worker_num=args.world_size - 1)
         return FedAvgServerManager(agg, rank=0, size=args.world_size,
-                                   backend=backend, **backend_kw)
+                                   backend=backend, ckpt_dir=args.ckpt_dir,
+                                   **backend_kw)
 
     if args.algo == "fedprox":
         from fedml_tpu.distributed.fedprox import prox_spec
