@@ -67,6 +67,21 @@ def _gather_rows(dev_x, dev_y, idx, mask):
     return jnp.where(mx, x, jnp.zeros_like(x)), jnp.where(my, y, jnp.zeros_like(y))
 
 
+def _shard_aggregate(nets, metrics, nsamp, axis):
+    """Per-shard weighted aggregation under shard_map: weighted psum of the
+    client nets (numerator+denominator over the mesh axis) and psum-med
+    metric sums. Single source of truth for the sequential round fn AND the
+    R-round block (their numerical identity is test-enforced)."""
+    wsum = jax.tree.map(
+        lambda t: jax.lax.psum(jnp.tensordot(nsamp, t, axes=([0], [0])), axis),
+        nets,
+    )
+    den = jax.lax.psum(jnp.sum(nsamp), axis)
+    avg = jax.tree.map(lambda t: t / jnp.maximum(den, 1e-12), wsum)
+    msum = {k: jax.lax.psum(jnp.sum(v), axis) for k, v in metrics.items()}
+    return avg, msum
+
+
 def _make_client_keys(seed: int):
     """Per-client training keys, derived inside jit: the same
     fold_in(fold_in(PRNGKey(seed), round), client_id) chain as the
@@ -255,15 +270,7 @@ class FedAvgAPI:
             if self.client_result_hook is not None:
                 hkeys = jax.random.split(hook_key, x.shape[0])
                 nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
-            # weighted psum over ICI: numerator and denominator
-            wsum = jax.tree.map(
-                lambda t: jax.lax.psum(jnp.tensordot(nsamp, t, axes=([0], [0])), axis),
-                nets,
-            )
-            den = jax.lax.psum(jnp.sum(nsamp), axis)
-            avg = jax.tree.map(lambda t: t / jnp.maximum(den, 1e-12), wsum)
-            msum = {k: jax.lax.psum(jnp.sum(v), axis) for k, v in metrics.items()}
-            return avg, msum
+            return _shard_aggregate(nets, metrics, nsamp, axis)
 
         smapped = jax.shard_map(
             shard_body,
@@ -318,22 +325,30 @@ class FedAvgAPI:
         finally:
             self.device_data = was
 
+    def _pack_round_indices_host(self, round_idx: int) -> IndexBatch:
+        """Host-side padded IndexBatch (no device placement) — shared by the
+        per-round path and the R-round block packer."""
+        cfg = self.cfg
+        ids = self._sampled_ids(round_idx)
+        ib = pack_client_indices(
+            self.data, ids, cfg.batch_size, max_batches=self.num_batches,
+            seed=cfg.seed, round_idx=round_idx,
+        )
+        if ib.idx.shape[1] < self.num_batches:
+            pad = self.num_batches - ib.idx.shape[1]
+            K, _, bs = ib.idx.shape
+            ib = IndexBatch(
+                idx=np.concatenate([ib.idx, np.zeros((K, pad, bs), ib.idx.dtype)], 1),
+                mask=np.concatenate([ib.mask, np.zeros((K, pad, bs), ib.mask.dtype)], 1),
+                num_samples=ib.num_samples,
+            )
+        return ib
+
     def _pack_round(self, round_idx: int):
         cfg = self.cfg
         ids = self._sampled_ids(round_idx)
         if self.device_data:
-            ib = pack_client_indices(
-                self.data, ids, cfg.batch_size, max_batches=self.num_batches,
-                seed=cfg.seed, round_idx=round_idx,
-            )
-            if ib.idx.shape[1] < self.num_batches:
-                pad = self.num_batches - ib.idx.shape[1]
-                K, _, bs = ib.idx.shape
-                ib = IndexBatch(
-                    idx=np.concatenate([ib.idx, np.zeros((K, pad, bs), ib.idx.dtype)], 1),
-                    mask=np.concatenate([ib.mask, np.zeros((K, pad, bs), ib.mask.dtype)], 1),
-                    num_samples=ib.num_samples,
-                )
+            ib = self._pack_round_indices_host(round_idx)
             if self.mesh is not None:
                 sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
                 ib = IndexBatch(
@@ -377,38 +392,85 @@ class FedAvgAPI:
         FedAvg-CNN) dispatch dominates, so this is the main throughput lever.
         Client keys are the same fold_in(fold_in(seed, round), client) chain
         as run_round, so a hook-free block is bit-identical to the sequential
-        path (tested)."""
+        path (tested). With a mesh, the scan runs INSIDE shard_map: every
+        device scans its client shard for R rounds and aggregation is a
+        weighted psum per step — the whole block is one SPMD program and the
+        host is out of the loop entirely (the v4-32 north-star path)."""
         client_keys = _make_client_keys(self.cfg.seed)
 
-        def step(carry, inp):
-            rng, net, opt = carry
-            idx_r, mask_r, nsamp_r, ids_r, r = inp
-            keys = client_keys(r, ids_r)
-            rng, kh, kp = jax.random.split(rng, 3)
-            x, y = _gather_rows(self._dev_x, self._dev_y, idx_r, mask_r)
-            nets, metrics, _ = self._round_body(
-                keys, net, opt, x, y, mask_r, nsamp_r, kh
-            )
-            net, opt, m = self._aggregate_and_update(
-                net, opt, nets, metrics, nsamp_r, kp
-            )
-            return (rng, net, opt), m
+        if self.mesh is None:
 
-        @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(carry, inp):
+                rng, net, opt = carry
+                idx_r, mask_r, nsamp_r, ids_r, r = inp
+                keys = client_keys(r, ids_r)
+                rng, kh, kp = jax.random.split(rng, 3)
+                x, y = _gather_rows(self._dev_x, self._dev_y, idx_r, mask_r)
+                nets, metrics, _ = self._round_body(
+                    keys, net, opt, x, y, mask_r, nsamp_r, kh
+                )
+                net, opt, m = self._aggregate_and_update(
+                    net, opt, nets, metrics, nsamp_r, kp
+                )
+                return (rng, net, opt), m
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def block_fn(rng, net, opt, idx, mask, nsamp, ids, round_idxs):
+                (rng, net, opt), ms = jax.lax.scan(
+                    step, (rng, net, opt), (idx, mask, nsamp, ids, round_idxs)
+                )
+                return rng, net, opt, ms
+
+            return block_fn
+
+        mesh = self.mesh
+        axis = mesh.axis_names[0]
+        server_update = self.server_update
+        local_update = self.local_update
+
+        def shard_block(net, opt, dev_x, dev_y, idx, mask, nsamp, ids, rounds):
+            # idx/mask/nsamp/ids carry this device's client slice on axis 1:
+            # [R, K/n, ...]; net/opt/rounds are replicated
+            def step(carry, inp):
+                net, opt = carry
+                idx_r, mask_r, nsamp_r, ids_r, r = inp
+                keys = client_keys(r, ids_r)
+                x, y = _gather_rows(dev_x, dev_y, idx_r, mask_r)
+                net_v = jax.tree.map(
+                    lambda v: jax.lax.pcast(v, axis, to="varying"), net)
+                nets, metrics = jax.vmap(
+                    local_update, in_axes=(0, None, 0, 0, 0))(
+                        keys, net_v, x, y, mask_r)
+                avg, msum = _shard_aggregate(nets, metrics, nsamp_r, axis)
+                net, opt = server_update(net, avg, opt)
+                return (net, opt), msum
+
+            (net, opt), ms = jax.lax.scan(
+                step, (net, opt), (idx, mask, nsamp, ids, rounds))
+            return net, opt, ms
+
+        smapped_block = jax.shard_map(
+            shard_block,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(None, axis), P(None, axis),
+                      P(None, axis), P(None, axis), P()),
+            out_specs=(P(), P(), P()),
+        )
+
+        @partial(jax.jit, donate_argnums=(1, 2))
         def block_fn(rng, net, opt, idx, mask, nsamp, ids, round_idxs):
-            (rng, net, opt), ms = jax.lax.scan(
-                step, (rng, net, opt), (idx, mask, nsamp, ids, round_idxs)
-            )
+            net, opt, ms = smapped_block(net, opt, self._dev_x, self._dev_y,
+                                         idx, mask, nsamp, ids, round_idxs)
             return rng, net, opt, ms
 
         return block_fn
 
     def run_rounds(self, start_round: int, num_rounds: int):
         """Run ``num_rounds`` rounds as one device-side program (requires
-        ``device_data=True`` and no mesh — the single-chip flagship path).
+        ``device_data=True``; works single-chip and over a client mesh).
         Returns per-round metrics stacked along axis 0."""
-        if not self.device_data or self.mesh is not None:
-            raise ValueError("run_rounds needs device_data=True and mesh=None")
+        if not self.device_data:
+            raise ValueError("run_rounds needs device_data=True")
         if self.client_result_hook is not None or self.post_aggregate_hook is not None:
             # the block threads ONE rng through the scan; hooked engines
             # would draw different hook keys than sequential run_round calls
@@ -421,18 +483,24 @@ class FedAvgAPI:
         ids_l, idx_l, mask_l, ns_l = [], [], [], []
         with self.tracer.span("pack"):
             for r in range(start_round, start_round + num_rounds):
-                ib = self._pack_round(r)  # padded IndexBatch (device_data path)
+                # host-side pack: the stacked block is device_put ONCE below
+                # (per-round device_puts would round-trip, and on multi-host
+                # meshes a sharded array cannot come back through np.asarray)
+                ib = self._pack_round_indices_host(r)
                 ids_l.append(np.asarray(self._sampled_ids(r), np.int32))
-                idx_l.append(np.asarray(ib.idx))
-                mask_l.append(np.asarray(ib.mask))
-                ns_l.append(np.asarray(ib.num_samples))
+                idx_l.append(ib.idx)
+                mask_l.append(ib.mask)
+                ns_l.append(ib.num_samples)
         rounds = np.arange(start_round, start_round + num_rounds, dtype=np.int32)
+        blocks = [np.stack(idx_l), np.stack(mask_l), np.stack(ns_l),
+                  np.stack(ids_l)]
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, self.mesh.axis_names[0]))
+            blocks = [jax.device_put(b, sh) for b in blocks]
         with self.tracer.span("round"):
             self.rng, self.net, self.server_opt_state, ms = self._block_fn(
                 self.rng, self.net, self.server_opt_state,
-                jnp.asarray(np.stack(idx_l)), jnp.asarray(np.stack(mask_l)),
-                jnp.asarray(np.stack(ns_l)), jnp.asarray(np.stack(ids_l)),
-                jnp.asarray(rounds),
+                *[jnp.asarray(b) for b in blocks], jnp.asarray(rounds),
             )
         return ms
 

@@ -6,6 +6,7 @@ round checkpoints of server params + optimizer state + round idx + RNG key).
 from __future__ import annotations
 
 import os
+import re
 from typing import Any
 
 import jax
@@ -31,8 +32,13 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
         ckptr.wait_until_finished()
     except Exception:
         leaves, treedef = jax.tree.flatten(state)
-        np.savez(path + ".npz", treedef=str(treedef),
-                 **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+        # atomic: write under a tmp name that _completed_rounds ignores, then
+        # rename — a crash mid-save must not leave a loadable-looking file
+        tmp = path + ".npz.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, treedef=str(treedef),
+                     **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+        os.replace(tmp, path + ".npz")
     if history is not None:
         import json
 
@@ -42,24 +48,16 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
     return path
 
 
-_ROUND_RE = None
+_ROUND_RE = re.compile(r"^round_(\d{6})(\.npz)?$")
 
 
 def _completed_rounds(ckpt_dir: str) -> list[int]:
     """Only COMPLETED checkpoints: 'round_NNNNNN' dirs or '.npz' files —
-    orbax in-progress temp dirs (round_NNNNNN.orbax-checkpoint-tmp-*) from a
-    crash mid-save must not be offered for resume."""
-    import re
-
-    global _ROUND_RE
-    if _ROUND_RE is None:
-        _ROUND_RE = re.compile(r"^round_(\d{6})(\.npz)?$")
-    out = []
-    for d in os.listdir(ckpt_dir):
-        m = _ROUND_RE.match(d)
-        if m:
-            out.append(int(m.group(1)))
-    return out
+    orbax in-progress temp dirs (round_NNNNNN.orbax-checkpoint-tmp-*) and
+    half-written '.npz.tmp' files from a crash mid-save must not be offered
+    for resume."""
+    return [int(m.group(1))
+            for d in os.listdir(ckpt_dir) if (m := _ROUND_RE.match(d))]
 
 
 def latest_round(ckpt_dir: str) -> int | None:

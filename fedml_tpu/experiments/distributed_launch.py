@@ -129,6 +129,10 @@ def main(argv=None):
         level=logging.INFO,
         format=f"%(asctime)s rank{args.rank} %(name)s %(levelname)s %(message)s",
     )
+    from fedml_tpu.utils.metrics import set_process_title
+
+    role = "server" if args.rank == 0 else f"client{args.rank}"
+    set_process_title(f"fedml_tpu:{args.algo}:{role}")
 
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.core.tasks import classification_task, sequence_task, tag_prediction_task

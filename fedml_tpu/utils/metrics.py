@@ -18,6 +18,17 @@ import time
 from typing import Any
 
 
+def set_process_title(title: str) -> None:
+    """Name the OS process (reference: setproctitle at main_fedavg.py:284-285)
+    so ps/top show the role; silently skipped when setproctitle is absent."""
+    try:
+        import setproctitle
+
+        setproctitle.setproctitle(title)
+    except Exception:
+        pass
+
+
 def setup_logging(process_name: str = "fedml-tpu", level=logging.INFO,
                   log_dir: str | None = None):
     """Rank/process-prefixed format (logger.py:8-33 analogue)."""

@@ -246,3 +246,23 @@ def test_run_rounds_block_equals_sequential(lr_data, lr_task):
 
     for a, b in zip(pack_pytree(seq.net), pack_pytree(blk.net)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+
+
+def test_run_rounds_block_mesh_equals_single_device(lr_data, lr_task, mesh8):
+    """The mesh block (scan INSIDE shard_map: R rounds, weighted psum per
+    step, host fully out of the loop) equals the single-device block."""
+    from fedml_tpu.comm.message import pack_pytree
+
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=8, client_num_per_round=8,
+                       epochs=1, batch_size=8, lr=0.1, frequency_of_the_test=100,
+                       seed=0)
+    single = FedAvgAPI(lr_data, lr_task, cfg, device_data=True)
+    single.run_rounds(0, 3)
+
+    meshed = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh8, device_data=True)
+    ms = meshed.run_rounds(0, 3)
+    assert ms["count"].shape == (3,)
+
+    for a, b in zip(pack_pytree(single.net), pack_pytree(meshed.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
