@@ -346,7 +346,6 @@ class FedAvgAPI:
 
     def _pack_round(self, round_idx: int):
         cfg = self.cfg
-        ids = self._sampled_ids(round_idx)
         if self.device_data:
             ib = self._pack_round_indices_host(round_idx)
             if self.mesh is not None:
@@ -356,6 +355,7 @@ class FedAvgAPI:
                     num_samples=jax.device_put(ib.num_samples, sh),
                 )
             return ib
+        ids = self._sampled_ids(round_idx)
         cb = pack_clients(
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,

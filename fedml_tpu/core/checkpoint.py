@@ -35,10 +35,14 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
         # atomic: write under a tmp name that _completed_rounds ignores, then
         # rename — a crash mid-save must not leave a loadable-looking file
         tmp = path + ".npz.tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, treedef=str(treedef),
-                     **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
-        os.replace(tmp, path + ".npz")
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, treedef=str(treedef),
+                         **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
+            os.replace(tmp, path + ".npz")
+        finally:
+            if os.path.exists(tmp):  # don't let an orphan eat a _prune slot
+                os.unlink(tmp)
     if history is not None:
         import json
 
@@ -86,7 +90,8 @@ def _prune(ckpt_dir: str, keep: int):
     import shutil
 
     rounds = sorted(
-        d for d in os.listdir(ckpt_dir) if d.startswith("round_")
+        d for d in os.listdir(ckpt_dir)
+        if d.startswith("round_") and not d.endswith(".tmp")
     )
     for d in rounds[:-keep] if keep else []:
         p = os.path.join(ckpt_dir, d)
