@@ -20,12 +20,11 @@ x_tilde / w_scalar — exact for row-stochastic directed W.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from fedml_tpu.collectives.ops import mix_with_topology
 from fedml_tpu.core.local import NetState, Task

@@ -27,7 +27,7 @@ import dataclasses
 import logging
 import time
 from functools import partial
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
@@ -44,7 +44,7 @@ from fedml_tpu.core.client_data import (
     pack_client_indices,
     pack_clients,
 )
-from fedml_tpu.core.local import LocalSpec, NetState, Task, make_eval_fn, make_local_update
+from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.utils.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean

@@ -13,9 +13,7 @@ Backdoor evaluation = eval_fn on a poisoned test set with target labels.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.core.local import NetState

@@ -23,7 +23,6 @@ import numpy as np
 import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
-from fedml_tpu.core.client_data import batch_global
 from fedml_tpu.core.local import NetState
 from fedml_tpu.utils.tree import tree_weighted_mean
 

@@ -16,11 +16,9 @@ jitted local update.
 
 from __future__ import annotations
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig

@@ -22,7 +22,6 @@ import jax.numpy as jnp
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.core.local import NetState
-from fedml_tpu.utils.tree import tree_weighted_mean
 
 
 class FedNovaAPI(FedAvgAPI):

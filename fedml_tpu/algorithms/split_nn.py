@@ -18,11 +18,9 @@ upper weights, updates per batch, clients in ring order (a lax.scan).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 
 from fedml_tpu.core.client_data import FederatedData, pack_clients

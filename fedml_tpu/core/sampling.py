@@ -12,7 +12,6 @@ use it, and provide a jax.random variant for on-device sampling.
 from __future__ import annotations
 
 import numpy as np
-import jax
 import jax.random as jrandom
 
 

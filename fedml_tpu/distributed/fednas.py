@@ -21,7 +21,6 @@ import jax
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.algorithms.fednas import FedNASAPI
 from fedml_tpu.models.darts import extract_genotype
-from fedml_tpu.comm.message import pack_pytree
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager

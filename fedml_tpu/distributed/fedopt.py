@@ -15,7 +15,7 @@ import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.algorithms.fedopt import make_server_optimizer
-from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+from fedml_tpu.comm.message import pack_pytree
 from fedml_tpu.core.local import NetState
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.api import init_client

@@ -10,7 +10,6 @@ Input layout is NHWC [bs, 28, 28, 1] (TPU-native; torch reference is NCHW).
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class CNNOriginalFedAvg(nn.Module):

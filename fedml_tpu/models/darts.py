@@ -16,7 +16,6 @@ weights (parity) or expose them separately (FedNAS genotype extraction).
 
 from __future__ import annotations
 
-import dataclasses
 
 import flax.linen as nn
 import jax

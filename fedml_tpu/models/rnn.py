@@ -14,7 +14,6 @@ fused matmuls on the MXU. Input: int tokens [bs, T]; output: logits
 from __future__ import annotations
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class RNNOriginalFedAvg(nn.Module):

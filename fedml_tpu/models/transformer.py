@@ -9,7 +9,6 @@ Usable as an FL model through the standard sequence_task wrapper.
 
 from __future__ import annotations
 
-from typing import Any
 
 import flax.linen as nn
 import jax

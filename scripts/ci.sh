@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# CI entry — the reference's Travis script series
+# (CI-install.sh / CI-script-fedavg.sh / CI-script-framework.sh /
+# CI-script-fednas.sh / CI-script-fedavg-robust.sh) folded into one gate:
+#   1. static check (parse+import, the pyflakes analogue)  — test_lint.py
+#   2. unit + oracle suite on the 8-device virtual CPU mesh
+#   3. standalone smoke runs across algorithm/dataset pairs (--ci 1
+#      truncation, CI-script-fedavg.sh:33-38 analogue)
+#   4. cross-process smoke (base framework + decentralized demo + gRPC
+#      launch are inside the suite; an extra end-to-end launch here)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD" JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "== unit + oracle suite =="
+python -m pytest tests/ -q
+
+echo "== standalone smoke matrix =="
+for spec in "fedavg mnist lr" "fedopt femnist cnn" "fedprox cifar10 resnet56" \
+            "fednova shakespeare rnn" "feddf mnist lr"; do
+  set -- $spec
+  echo "-- $1 / $2 / $3"
+  python -m fedml_tpu.experiments.cli --algo "$1" --dataset "$2" --model "$3" \
+    --client_num_in_total 4 --client_num_per_round 2 --comm_round 2 \
+    --batch_size 8 --max_batches 2 --ci 1 --frequency_of_the_test 1
+done
+
+echo "== cross-process smoke (loopback launcher roles) =="
+python - <<'PY'
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+
+data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+agg = run_simulated(data, classification_task(LogisticRegression(num_classes=3)),
+                    FedAvgConfig(comm_round=2, client_num_in_total=4,
+                                 client_num_per_round=2, batch_size=6,
+                                 frequency_of_the_test=1), job_id="ci-smoke")
+assert agg.history, "no eval records"
+print("cross-process smoke ok:", agg.history[-1])
+PY
+echo "CI GREEN"

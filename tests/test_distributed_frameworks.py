@@ -85,11 +85,12 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
         server = subprocess.run(
             [sys.executable, "-m", "fedml_tpu.experiments.distributed_launch",
              "--rank", "0"] + base,
-            env=env, capture_output=True, text=True, timeout=300,
+            env=env, capture_output=True, text=True, timeout=600,
         )
         # the server only exits after broadcasting FINISH; give slow-starting
-        # clients time to drain it, then reap
-        deadline = time.time() + 120
+        # clients time to drain it, then reap (generous: under full-suite
+        # load, three concurrent jax startups + compiles can take minutes)
+        deadline = time.time() + 240
         for c in clients:
             c.wait(timeout=max(1.0, deadline - time.time()))
     except subprocess.TimeoutExpired as e:  # surface client logs on failure

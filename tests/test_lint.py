@@ -1,0 +1,19 @@
+"""Static gate (reference CI runs pyflakes first, CI-script-fedavg.sh:6):
+every module must parse and import cleanly."""
+
+import importlib
+import pkgutil
+
+
+def test_every_module_imports():
+    import fedml_tpu
+
+    bad = []
+    for m in pkgutil.walk_packages(fedml_tpu.__path__, "fedml_tpu."):
+        if m.name.endswith("_packer"):
+            continue  # ctypes .so loaded by fedml_tpu.native, not a module
+        try:
+            importlib.import_module(m.name)
+        except Exception as e:  # pragma: no cover - failure path
+            bad.append((m.name, repr(e)))
+    assert not bad, bad
