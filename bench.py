@@ -4,6 +4,15 @@ Runs the flagship config — FedAvg-paper CNN, 3400 simulated clients, 10
 sampled per round, batch 20, E=1 (benchmark/README.md:54 setting) — on the
 available device(s) and prints ONE JSON line.
 
+Structure (robustness on flaky/remote-compile backends):
+  - Rounds run in fixed-size blocks (FEDML_BENCH_BLOCK, default 10): jit
+    caches by shape, so ONE compiled block executable serves the warmup and
+    every timed block — a single compile regardless of how many rounds are
+    timed.
+  - If the scanned-block path fails (e.g. a remote-compile transport drops
+    mid-flight), the bench falls back to the per-round jitted path and still
+    prints its JSON line.
+
 vs_baseline: the reference publishes no throughput numbers
 (BASELINE.json.published = {}); its round latency is bounded below by the
 MPI manager's 0.3 s receive-poll sleep (mpi/com_manager.py:71-78), so we use
@@ -13,7 +22,27 @@ MPI manager's 0.3 s receive-poll sleep (mpi/com_manager.py:71-78), so we use
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
+
+
+def _emit(rounds_per_sec: float, mode: str) -> None:
+    baseline_rounds_per_sec = 1.0 / 0.3  # MPI poll-loop lower bound, see docstring
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_femnist_rounds_per_sec",
+                "value": round(rounds_per_sec, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 2),
+                # "block" = flagship scanned-block path; "per_round_fallback"
+                # = degraded measurement after a block-path failure — do NOT
+                # compare the two against each other
+                "mode": mode,
+            }
+        )
+    )
 
 
 def main():
@@ -24,11 +53,22 @@ def main():
     from fedml_tpu.data.registry import load_dataset
     from fedml_tpu.models.cnn import CNNOriginalFedAvg
 
+    def _env_int(name: str, default: int) -> int:
+        try:
+            return max(1, int(os.environ.get(name, "") or default))
+        except ValueError:
+            print(f"bench: ignoring non-integer {name}", file=sys.stderr)
+            return default
+
+    block = _env_int("FEDML_BENCH_BLOCK", 10)
+    n_timed = _env_int("FEDML_BENCH_ROUNDS", 20)
+    n_timed = max(block, (n_timed // block) * block)  # whole blocks only
+
     # FEMNIST-shaped: 3400 clients, ~110 samples each (lognormal sizes);
     # uint8 pixels -> 4x less host->device transfer, normalized on device
     data = load_dataset("femnist", seed=0, uint8_pixels=True)
     cfg = FedAvgConfig(
-        comm_round=30,
+        comm_round=block + n_timed,
         client_num_in_total=3400,
         client_num_per_round=10,
         epochs=1,
@@ -42,31 +82,35 @@ def main():
     # ships only the shuffled index block (~KBs) and gathers on device
     api = FedAvgAPI(data, task, cfg, device_data=True)
 
-    n_rounds = 30
-    # warmup = compile; scan length is a static shape, so warm up with the
-    # same block length as the timed run
-    api.run_rounds(0, n_rounds)
-    jax.block_until_ready(api.net.params)
+    try:
+        # warmup block = the one and only compile (jit caches by shape; every
+        # later block of the same length reuses the executable)
+        api.run_rounds(0, block)
+        jax.block_until_ready(api.net.params)
 
+        t0 = time.perf_counter()
+        for start in range(block, block + n_timed, block):
+            # each block is ONE compiled lax.scan over rounds: no per-round
+            # dispatch, no per-round transfer beyond the index blocks
+            api.run_rounds(start, block)
+        jax.block_until_ready(api.net.params)
+        dt = time.perf_counter() - t0
+        _emit(n_timed / dt, "block")
+        return
+    except Exception as e:  # noqa: BLE001 — fall back, still emit a number
+        print(f"bench: block path failed ({type(e).__name__}: {e}); "
+              "falling back to per-round path", file=sys.stderr)
+
+    del api  # free the first engine's HBM (full uint8 train set + params)
+    api2 = FedAvgAPI(data, task, cfg, device_data=True)
+    api2.run_round(0)  # warm: compile the per-round program
+    jax.block_until_ready(api2.net.params)
+    n_seq = max(3, n_timed // 4)
     t0 = time.perf_counter()
-    # the whole block is ONE compiled lax.scan over rounds: no per-round
-    # dispatch, no per-round host->device transfer beyond the index blocks
-    api.run_rounds(n_rounds, n_rounds)
-    jax.block_until_ready(api.net.params)
-    dt = time.perf_counter() - t0
-
-    rounds_per_sec = n_rounds / dt
-    baseline_rounds_per_sec = 1.0 / 0.3  # MPI poll-loop lower bound, see docstring
-    print(
-        json.dumps(
-            {
-                "metric": "fedavg_femnist_rounds_per_sec",
-                "value": round(rounds_per_sec, 3),
-                "unit": "rounds/sec",
-                "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 2),
-            }
-        )
-    )
+    for r in range(1, 1 + n_seq):
+        api2.run_round(r)
+    jax.block_until_ready(api2.net.params)
+    _emit(n_seq / (time.perf_counter() - t0), "per_round_fallback")
 
 
 if __name__ == "__main__":

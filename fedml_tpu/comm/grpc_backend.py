@@ -59,11 +59,45 @@ class GrpcCommManager(BaseCommManager):
         self.ip_table = ip_table or {r: "127.0.0.1" for r in range(size)}
         self._channels: dict[int, object] = {}
         self._grpc = grpc
+        self._send_seq = 0
+        import secrets
+        import threading
+
+        # boot epoch: a restarted peer restarts seq at 0; keying the dedup
+        # set by (src, epoch) keeps redelivery detection restart-safe (the
+        # server checkpoint-resume path relaunches the process mid-job)
+        self._epoch = secrets.randbits(64)
+        self._seen: dict[tuple[int, int], set[int]] = {}
+        self._seen_lock = threading.Lock()
+        self._send_lock = threading.Lock()
 
         from concurrent import futures
 
         def recv(request: bytes, context):
-            self._enqueue(Message.from_bytes(request))
+            # 24-byte transport prefix: (sender_rank, boot_epoch, seq) u64-LE.
+            # Retries make delivery at-least-once (the connection can drop
+            # after the handler ran but before 'ok' reached the sender); the
+            # seen-set makes it exactly-once — a redelivered client upload
+            # must NOT count toward the next round's aggregation. The epoch
+            # distinguishes a restarted peer (fresh seq=1 stream) from a
+            # duplicate of the previous process's frame 1.
+            hdr, frame = request[:24], request[24:]
+            src = int.from_bytes(hdr[:8], "little")
+            epoch = int.from_bytes(hdr[8:16], "little")
+            seq = int.from_bytes(hdr[16:], "little")
+            with self._seen_lock:
+                seen = self._seen.setdefault((src, epoch), set())
+                if seq in seen:
+                    log.warning("drop duplicate frame %d from rank %d", seq, src)
+                    return b"dup"
+                seen.add(seq)
+                if len(seen) > 4096:  # bounded memory; senders are in-order
+                    for s in sorted(seen)[:2048]:
+                        seen.discard(s)
+                stale = [k for k in self._seen if k[0] == src and k != (src, epoch)]
+                for k in stale[:-1]:  # keep at most the 2 newest epochs per src
+                    del self._seen[k]
+            self._enqueue(Message.from_bytes(frame))
             return b"ok"
 
         handler = grpc.method_handlers_generic_handler(
@@ -93,9 +127,48 @@ class GrpcCommManager(BaseCommManager):
         return self._channels[dest].unary_unary(f"/{_SERVICE}/{_METHOD}")
 
     def send_message(self, msg: Message) -> None:
+        """Deliver one frame. ``wait_for_ready`` queues the RPC until the
+        peer's server is actually listening (peers boot in arbitrary order —
+        the reference sidesteps this only because mpirun barriers before
+        main; a raw send here would fail fast with UNAVAILABLE while the
+        receiver is still starting jax). A short retry loop covers the
+        remaining transient-drop window (peer restart between frames)."""
+        import time
+
         dest = int(msg.get_receiver_id())
-        frame = msg.to_bytes()
-        self._stub(dest)(frame, timeout=600)
+        with self._send_lock:
+            self._send_seq += 1
+            seq = self._send_seq
+        frame = (self.rank.to_bytes(8, "little")
+                 + self._epoch.to_bytes(8, "little")
+                 + seq.to_bytes(8, "little") + msg.to_bytes())
+        deadline = time.monotonic() + 600
+        attempt = 0
+        while True:
+            try:
+                self._stub(dest)(
+                    frame, timeout=max(1.0, deadline - time.monotonic()),
+                    wait_for_ready=True,
+                )
+                return
+            except self._grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                retriable = code == self._grpc.StatusCode.UNAVAILABLE
+                if not retriable or time.monotonic() >= deadline:
+                    raise
+                attempt += 1
+                log.warning("send to rank %d unavailable (attempt %d), retrying", dest, attempt)
+                # Drop (don't close) the cached channel: a dead peer's channel
+                # can linger in TRANSIENT_FAILURE with long reconnect backoff,
+                # but close() would cancel another thread's in-flight RPC on
+                # the same channel (CANCELLED is not retriable). The dropped
+                # channel is finalized by GC once all calls on it finish.
+                self._channels.pop(dest, None)
+                # wait_for_ready throttles only connection establishment; if
+                # the peer accepts connections but fails RPCs (restart loop,
+                # GOAWAY during shutdown) each attempt returns immediately —
+                # the capped sleep bounds the spin.
+                time.sleep(min(0.5 * attempt, 5.0))
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()

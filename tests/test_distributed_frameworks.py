@@ -87,6 +87,11 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
              "--rank", "0"] + base,
             env=env, capture_output=True, text=True, timeout=600,
         )
+        # a crashed server leaves the clients waiting forever — fail NOW with
+        # its traceback instead of timing out 240 s later with empty client logs
+        assert server.returncode == 0, (
+            f"server exited {server.returncode}:\n{server.stdout}\n{server.stderr}"
+        )
         # the server only exits after broadcasting FINISH; give slow-starting
         # clients time to drain it, then reap (generous: under full-suite
         # load, three concurrent jax startups + compiles can take minutes)
@@ -108,7 +113,6 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
                 c.kill()
         for f in logs.values():
             f.close()
-    assert server.returncode == 0, server.stdout + server.stderr
     assert '"round": 1' in server.stdout.replace("'", '"') or "round" in server.stdout
 
 
