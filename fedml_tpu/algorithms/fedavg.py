@@ -113,6 +113,11 @@ class FedAvgConfig:
     max_batches: int | None = None  # static per-client batch budget (B)
     ci: bool = False  # truncate eval, reference --ci semantics
     eval_batch_size: int = 256
+    # cap global eval to a seeded random subset of the test set — the
+    # reference's stackoverflow validation subset of 10k samples
+    # (FedAVGAggregator._generate_validation_set, FedAVGAggregator.py:99-107);
+    # None = full test set
+    eval_max_samples: int | None = None
 
 
 def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
@@ -635,14 +640,21 @@ class FedAvgAPI:
         clients, fedavg_api.py:117-180; on a global-shared test set the two
         coincide up to weighting)."""
         if self._test_cache is None:
-            n = len(self.data.test_x)
+            tx, ty = self.data.test_x, self.data.test_y
+            if (self.cfg.eval_max_samples is not None
+                    and len(tx) > self.cfg.eval_max_samples):
+                # seeded random subset (the reference samples a fresh 10k
+                # subset per eval via random.sample; a fixed seeded subset
+                # keeps eval curves comparable across rounds)
+                sel = np.random.RandomState(self.cfg.seed).choice(
+                    len(tx), self.cfg.eval_max_samples, replace=False)
+                tx, ty = tx[sel], ty[sel]
+            n = len(tx)
             if self.cfg.ci:
                 n = min(n, 512)  # --ci truncation analogue (FedAVGAggregator.py:126-131)
             self._test_cache = tuple(
                 jnp.asarray(a)
-                for a in batch_global(
-                    self.data.test_x[:n], self.data.test_y[:n], self.cfg.eval_batch_size
-                )
+                for a in batch_global(tx[:n], ty[:n], self.cfg.eval_batch_size)
             )
         xb, yb, mb = self._test_cache
         return self.eval_fn(self.net, xb, yb, mb)

@@ -88,6 +88,39 @@ class FederatedData:
         )
 
 
+def subset_clients(data: FederatedData, client_ids) -> FederatedData:
+    """Rank-local view holding ONLY the given clients' train rows — the
+    analogue of the reference's ``load_partition_data_distributed_<ds>``
+    variants that load just that rank's shard (e.g.
+    FederatedEMNIST/data_loader.py:70+, cifar10/data_loader.py:433).
+
+    Client ids keep their GLOBAL numbering (the server's sampled index is
+    looked up unchanged); accessing a client outside the subset raises
+    KeyError — loudly, instead of silently training on absent data. The
+    global test set is kept whole (every rank evaluates the same way the
+    reference's distributed loaders do)."""
+    client_ids = [int(c) for c in client_ids]
+    rows = [np.asarray(data.train_idx_map[c], np.int64) for c in client_ids]
+    flat = np.concatenate(rows) if rows else np.zeros((0,), np.int64)
+    new_map: dict[int, np.ndarray] = {}
+    off = 0
+    for c, r in zip(client_ids, rows):
+        new_map[c] = np.arange(off, off + len(r), dtype=np.int64)
+        off += len(r)
+    test_map = None
+    if data.test_idx_map is not None:
+        # test rows stay global-array-indexed; keep only subset keys
+        test_map = {c: data.test_idx_map[c] for c in client_ids
+                    if c in data.test_idx_map}
+    return dataclasses.replace(
+        data,
+        train_x=data.train_x[flat],
+        train_y=data.train_y[flat],
+        train_idx_map=new_map,
+        test_idx_map=test_map,
+    )
+
+
 _U64 = (1 << 64) - 1
 
 

@@ -94,14 +94,20 @@ class FedAvgAggregator:
         if round_idx % cfg.frequency_of_the_test != 0 and round_idx != cfg.comm_round - 1:
             return
         if self._test_cache is None:
-            n = len(self.dataset.test_x)
+            tx, ty = self.dataset.test_x, self.dataset.test_y
+            if (cfg.eval_max_samples is not None
+                    and len(tx) > cfg.eval_max_samples):
+                # seeded validation subset — the reference server's 10k
+                # stackoverflow cap (_generate_validation_set, :99-107)
+                sel = np.random.RandomState(cfg.seed).choice(
+                    len(tx), cfg.eval_max_samples, replace=False)
+                tx, ty = tx[sel], ty[sel]
+            n = len(tx)
             if cfg.ci:
                 n = min(n, self.ci_eval_cap)
             self._test_cache = tuple(
                 jnp.asarray(a)
-                for a in batch_global(
-                    self.dataset.test_x[:n], self.dataset.test_y[:n], cfg.eval_batch_size
-                )
+                for a in batch_global(tx[:n], ty[:n], cfg.eval_batch_size)
             )
         self._record_eval(round_idx)
 

@@ -197,6 +197,10 @@ def build_api(args):
         client_optimizer=args.client_optimizer, lr=args.lr, wd=args.wd,
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
         max_batches=args.max_batches, ci=bool(args.ci),
+        # stackoverflow evals run on a 10k-sample validation subset
+        # (FedAVGAggregator._generate_validation_set, :99-107)
+        eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
+                          else None),
     )
     mesh = None
     if args.mesh:

@@ -148,12 +148,26 @@ def main(argv=None):
     model = create_model(args.model, output_dim=spec.num_classes)
     task = {"classification": classification_task, "sequence": sequence_task,
             "tags": tag_prediction_task}[spec.task](model)
+    n_total = data.num_clients
+    if (args.rank != 0 and args.world_size - 1 == n_total
+            and args.algo != "turboaggregate"):
+        # turboaggregate excluded: SecureTrainer's Shamir-share weights need
+        # every cohort member's sample count (turboaggregate.py _round_weight),
+        # which a rank-local shard no longer holds
+        # full participation: rank r always trains client r-1, so this
+        # process keeps only its own shard (load_partition_data_distributed_*
+        # parity — the reference's per-rank loaders, cifar10/data_loader.py:433)
+        from fedml_tpu.core.client_data import subset_clients
+
+        data = subset_clients(data, [args.rank - 1])
     cfg = FedAvgConfig(
-        comm_round=args.comm_round, client_num_in_total=data.num_clients,
+        comm_round=args.comm_round, client_num_in_total=n_total,
         client_num_per_round=args.world_size - 1, epochs=args.epochs,
         batch_size=args.batch_size, client_optimizer=args.client_optimizer,
         lr=args.lr, wd=args.wd, frequency_of_the_test=args.frequency_of_the_test,
         seed=args.seed, ci=bool(args.ci),
+        eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
+                          else None),
     )
 
     backend_kw: dict = {"timeout_s": args.timeout_s}

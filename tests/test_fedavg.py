@@ -227,6 +227,23 @@ def test_evaluate_per_client_matches_global():
     assert len(pc_train) == 3 and agg_train["count"] > 0
 
 
+def test_eval_max_samples_subset():
+    """eval_max_samples caps global eval to a seeded subset — the reference's
+    10k stackoverflow validation set (FedAVGAggregator.py:99-107)."""
+    data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
+                            samples_per_client=10, test_samples=200, seed=1)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=4, client_num_per_round=2,
+                       batch_size=5, lr=0.1, eval_max_samples=64)
+    api = FedAvgAPI(data, task, cfg)
+    ev = api.evaluate()
+    assert float(ev["count"]) == 64.0
+    # deterministic across a rebuild (seeded subset, not a fresh sample)
+    api2 = FedAvgAPI(data, task, cfg)
+    ev2 = api2.evaluate()
+    np.testing.assert_allclose(float(ev["loss"]), float(ev2["loss"]), rtol=1e-6)
+
+
 def test_run_rounds_block_equals_sequential(lr_data, lr_task):
     """The R-round lax.scan block (one compiled program) is bit-identical to
     R sequential run_round calls: same sampling, same fold_in key chain,

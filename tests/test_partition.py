@@ -54,3 +54,29 @@ def test_deterministic():
     b = dirichlet_partition(labels, 5, 0.5, seed=3)
     for k in a:
         np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_subset_clients_rank_local_view():
+    """subset_clients (load_partition_data_distributed_* parity): the
+    rank-local view packs bit-identical batches for its client, keeps global
+    client numbering, and fails loudly for clients outside the shard."""
+    import numpy as np
+    import pytest
+    from fedml_tpu.core.client_data import pack_clients, subset_clients
+    from fedml_tpu.data.synthetic import synthetic_images
+
+    data = synthetic_images(num_clients=6, image_shape=(5, 5, 1), num_classes=3,
+                            samples_per_client=13, test_samples=20, seed=3)
+    view = subset_clients(data, [4])
+    assert set(view.train_idx_map) == {4}
+    assert len(view.train_x) == len(data.train_idx_map[4])
+    # same packed batches as the full load (order, values, masks)
+    full = pack_clients(data, [4], batch_size=4, seed=0, round_idx=2)
+    local = pack_clients(view, [4], batch_size=4, seed=0, round_idx=2)
+    np.testing.assert_array_equal(full.x, local.x)
+    np.testing.assert_array_equal(full.y, local.y)
+    np.testing.assert_array_equal(full.mask, local.mask)
+    # global test set intact; foreign client lookup raises
+    np.testing.assert_array_equal(view.test_x, data.test_x)
+    with pytest.raises(KeyError):
+        pack_clients(view, [0], batch_size=4, seed=0, round_idx=2)
