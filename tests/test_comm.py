@@ -164,6 +164,54 @@ def test_grpc_backend_roundtrip():
     np.testing.assert_array_equal(got[0][2][0], np.full((4, 4), 2.5, np.float32))
 
 
+def test_grpc_duplicate_frames_dropped():
+    """The (rank, epoch, seq) dedup layer: a redelivered frame (same seq —
+    the retry-after-handler-ran race) is dropped; a restarted peer's fresh
+    stream (same seqs, new epoch) is NOT dropped."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    base = 56600 + (int(time.time()) % 500)
+    a = GrpcCommManager(rank=0, size=2, base_port=base)
+    b = GrpcCommManager(rank=1, size=2, base_port=base)
+    got = []
+
+    class Sink:
+        def receive_message(self, t, p):
+            got.append(p["v"])
+
+    b.add_observer(Sink())
+    t = threading.Thread(target=b.handle_receive_message, daemon=True)
+    t.start()
+    a2 = None
+    try:
+        msg = Message("m", 0, 1)
+        msg.add_params("v", 1)
+        a.send_message(msg)
+        a._send_seq -= 1  # simulate redelivery: next frame reuses the seq
+        msg2 = Message("m", 0, 1)
+        msg2.add_params("v", 2)
+        a.send_message(msg2)  # dropped as duplicate
+        # restart: same rank, same seqs, fresh boot epoch -> accepted
+        a2 = GrpcCommManager(rank=0, size=2, base_port=base + 100)
+        a2.ip_table = a.ip_table
+        a2.base_port = a.base_port  # route to b
+        msg3 = Message("m", 0, 1)
+        msg3.add_params("v", 3)
+        a2.send_message(msg3)
+
+        deadline = time.time() + 10
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        b.stop_receive_message()
+        a.stop_receive_message()
+        if a2 is not None:
+            a2.stop_receive_message()
+        t.join(timeout=5)
+    assert got == [1, 3], got
+
+
 def test_grpc_distributed_fedavg_smoke(lr_setup):
     pytest.importorskip("grpc")
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
