@@ -41,6 +41,7 @@ class HierarchicalFLAPI(FedAvgAPI):
         super().__init__(dataset, task, config, mesh=None, **kwargs)
         self.group_num = group_num
         self.group_comm_round = group_comm_round
+        self.group_mesh = mesh
         rng = np.random.RandomState(config.seed)
         ids = np.arange(config.client_num_in_total)
         if group_method == "random":
@@ -50,22 +51,88 @@ class HierarchicalFLAPI(FedAvgAPI):
         # jitted: one group sub-round vmapped over groups
         local_update = self.local_update
 
-        @jax.jit
-        def group_round(rng, group_nets, x, y, mask, nsamp):
-            # group_nets: stacked [G, ...]; x: [G, K, B, bs, ...]
-            G, K = x.shape[0], x.shape[1]
-            keys = jax.random.split(rng, G * K).reshape(G, K, -1)
+        def grid_keys(rng, G, K):
+            # (g, k)-indexed fold_in chain: key depends only on (rng, g, k),
+            # NOT on the padded grid shape — so the sharded path (which pads
+            # K up to the mesh tile) derives bit-identical keys for real
+            # clients (same trick as the fedavg engine's fold_in chain)
+            return jax.vmap(
+                lambda g: jax.vmap(
+                    lambda k: jax.random.fold_in(jax.random.fold_in(rng, g), k)
+                )(jnp.arange(K))
+            )(jnp.arange(G))
 
-            def per_group(net_g, keys_g, xg, yg, mg, ng):
-                nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
-                    keys_g, net_g, xg, yg, mg
-                )
-                avg = tree_weighted_mean(nets, ng)
-                return avg, {k: jnp.sum(v) for k, v in metrics.items()}
+        if mesh is None:
 
-            return jax.vmap(per_group)(group_nets, keys, x, y, mask, nsamp)
+            @jax.jit
+            def group_round(rng, group_nets, x, y, mask, nsamp):
+                # group_nets: stacked [G, ...]; x: [G, K, B, bs, ...]
+                G, K = x.shape[0], x.shape[1]
+                keys = grid_keys(rng, G, K)
 
-        self._group_round = group_round
+                def per_group(net_g, keys_g, xg, yg, mg, ng):
+                    nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
+                        keys_g, net_g, xg, yg, mg
+                    )
+                    avg = tree_weighted_mean(nets, ng)
+                    return avg, {k: jnp.sum(v) for k, v in metrics.items()}
+
+                return jax.vmap(per_group)(group_nets, keys, x, y, mask, nsamp)
+
+            self._group_round = group_round
+        else:
+            # SURVEY §2.7 two-level mesh: each device holds a [G/gd, K/cd]
+            # block; the GROUP mean is a weighted psum over the 'clients'
+            # axis (ICI), while the global mean over groups happens after the
+            # sub-rounds (on a multislice mesh 'groups' rides DCN — the
+            # hierarchy exists precisely so the frequent intra-group syncs
+            # stay on the fast axis).
+            if "groups" not in mesh.axis_names or "clients" not in mesh.axis_names:
+                raise ValueError(
+                    f"hierarchical mesh needs axes ('groups','clients'), got {mesh.axis_names}")
+            if group_num % mesh.shape["groups"] != 0:
+                raise ValueError(
+                    f"group_num={group_num} not divisible by mesh groups axis "
+                    f"{mesh.shape['groups']}")
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            def body(keys, group_nets, x, y, mask, nsamp):
+                # local block: nets [Gl, ...]; data [Gl, Kl, B, bs, ...]
+                def per_group(net_g, keys_g, xg, yg, mg, ng):
+                    net_v = jax.tree.map(
+                        lambda v: lax.pcast(v, "clients", to="varying"), net_g)
+                    nets, metrics = jax.vmap(local_update, in_axes=(0, None, 0, 0, 0))(
+                        keys_g, net_v, xg, yg, mg)
+                    wsum = jax.tree.map(
+                        lambda t: lax.psum(
+                            jnp.tensordot(ng, t, axes=([0], [0])), "clients"),
+                        nets)
+                    den = lax.psum(jnp.sum(ng), "clients")
+                    avg = jax.tree.map(lambda t: t / jnp.maximum(den, 1e-12), wsum)
+                    msum = {k: lax.psum(jnp.sum(v), "clients")
+                            for k, v in metrics.items()}
+                    return avg, msum
+
+                return jax.vmap(per_group)(group_nets, keys, x, y, mask, nsamp)
+
+            smapped = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P("groups", "clients"), P("groups"),
+                          P("groups", "clients"), P("groups", "clients"),
+                          P("groups", "clients"), P("groups", "clients")),
+                out_specs=(P("groups"), P("groups")),
+            )
+
+            @jax.jit
+            def group_round_mesh(rng, group_nets, x, y, mask, nsamp):
+                G, K = x.shape[0], x.shape[1]
+                # same (g,k) fold_in chain as the single-device path —
+                # bit-identical keys for real clients (test-enforced)
+                keys = grid_keys(rng, G, K)
+                return smapped(keys, group_nets, x, y, mask, nsamp)
+
+            self._group_round = group_round_mesh
 
     def _pack_groups(self, round_idx: int, sub_round: int):
         """Sample cfg.client_num_per_round/G clients per group and pack to
@@ -83,6 +150,9 @@ class HierarchicalFLAPI(FedAvgAPI):
                               round_idx=local_round)
             packs.append(cb)
         K = max(p.x.shape[0] for p in packs)
+        if self.group_mesh is not None:
+            cd = self.group_mesh.shape["clients"]
+            K = ((K + cd - 1) // cd) * cd  # shardable K (pads carry weight 0)
         B = self.num_batches
 
         def pad(cb: ClientBatch):

@@ -94,6 +94,9 @@ def add_args(parser: argparse.ArgumentParser):
     return parser
 
 
+log = logging.getLogger("cli")
+
+
 def build_api(args):
     import jax
     import numpy as np
@@ -203,7 +206,8 @@ def build_api(args):
                           else None),
     )
     mesh = None
-    if args.mesh:
+    if args.mesh and args.algo != "hierarchical":
+        # hierarchical builds its own 2-axis ('groups','clients') mesh below
         mesh = Mesh(np.asarray(jax.devices()[: args.mesh]), ("clients",))
 
     algo = args.algo
@@ -235,8 +239,28 @@ def build_api(args):
     if algo == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
 
+        hmesh = None
+        if args.mesh:
+            # --mesh N with hierarchical: ('groups','clients') 2-axis mesh,
+            # groups on the slow (DCN-able) axis, clients on ICI
+            from fedml_tpu.mesh.mesh import make_hierarchical_mesh
+
+            gd = min(args.group_num, max(1, args.mesh // 2))
+            while args.group_num % gd or args.mesh % gd:
+                gd -= 1
+            if gd == 1:
+                log.warning(
+                    "hierarchical mesh degenerates to (1, %d): group_num=%d "
+                    "shares no factor with --mesh %d, so intra-group syncs "
+                    "span ALL devices instead of staying on the fast axis",
+                    args.mesh, args.group_num, args.mesh)
+            else:
+                log.info("hierarchical mesh: %d groups x %d client-shards",
+                         gd, args.mesh // gd)
+            hmesh = make_hierarchical_mesh(gd, args.mesh // gd)
         return HierarchicalFLAPI(data, task, cfg, group_num=args.group_num,
-                                 group_comm_round=args.group_comm_round), data
+                                 group_comm_round=args.group_comm_round,
+                                 mesh=hmesh), data
     if algo in ("feddf", "feddf_hard"):
         from fedml_tpu.algorithms.feddf import FedDFAPI
 

@@ -142,6 +142,26 @@ def test_hierarchical_one_group_equals_flat(data, task):
     assert float(diff) / float(tree_global_norm(a.net.params)) < 1e-4
 
 
+def test_hierarchical_two_axis_mesh_equals_single_device(data, task):
+    """('groups','clients') mesh path (SURVEY §2.7 two-level axes): the
+    shard_mapped group sub-round — group mean as a weighted psum over the
+    'clients' axis — matches the single-device vmap path, including when K
+    is padded up to the mesh tile (zero-weight slots)."""
+    from fedml_tpu.mesh.mesh import make_hierarchical_mesh
+
+    mesh = make_hierarchical_mesh(2, 4)
+    for per_round in (8, 4):  # 4/group = exact tile; 2/group = padded to 4
+        cfg = _cfg(client_num_per_round=per_round, comm_round=3)
+        a = HierarchicalFLAPI(data, task, cfg, group_num=2, group_comm_round=2)
+        b = HierarchicalFLAPI(data, task, cfg, group_num=2, group_comm_round=2,
+                              mesh=mesh)
+        for r in range(3):
+            a.run_round(r)
+            b.run_round(r)
+        diff = tree_global_norm(tree_sub(a.net.params, b.net.params))
+        assert float(diff) / float(tree_global_norm(a.net.params)) < 1e-5, per_round
+
+
 def test_hierarchical_learns(data, task):
     h = HierarchicalFLAPI(data, task, _cfg(comm_round=6), group_num=2,
                           group_comm_round=2)
