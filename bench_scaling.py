@@ -6,7 +6,8 @@ per round; throughput in samples/sec should grow until the chip saturates.
 
 Usage:  python bench_scaling.py [--device_data 1] [--points 8,32,128,256]
 Prints one JSON line per point (bench.py remains the single-line driver
-benchmark; this script is the scaling study).
+benchmark; this script is the scaling study). A point that fails (e.g. a
+remote-compile drop) prints an error line and the sweep continues.
 """
 
 from __future__ import annotations
@@ -16,6 +17,44 @@ import json
 import time
 
 
+def _one_point(args, data, task, k):
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+
+    cfg = FedAvgConfig(
+        comm_round=args.rounds, client_num_in_total=data.num_clients,
+        client_num_per_round=k, epochs=1, batch_size=20, lr=0.1,
+        frequency_of_the_test=10_000, max_batches=28,
+    )
+    api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
+    if args.device_data:
+        # one compiled scan per block: measures device throughput, not
+        # per-round host dispatch (bench.py uses the same path)
+        api.run_rounds(0, args.rounds)
+        jax.block_until_ready(api.net.params)
+        t0 = time.perf_counter()
+        ms = api.run_rounds(args.rounds, args.rounds)
+        jax.block_until_ready(api.net.params)
+        count = float(ms["count"][-1])
+    else:
+        api.run_round(0)
+        jax.block_until_ready(api.net.params)
+        t0 = time.perf_counter()
+        for r in range(1, args.rounds + 1):
+            m = api.run_round(r)
+        jax.block_until_ready(api.net.params)
+        count = float(m["count"])
+    dt = time.perf_counter() - t0
+    rps = args.rounds / dt
+    print(json.dumps({
+        "clients_per_round": k,
+        "rounds_per_sec": round(rps, 3),
+        "samples_per_sec": round(count * rps, 1),
+        "device": jax.devices()[0].platform,
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=str, default="8,32,128,256")
@@ -23,9 +62,6 @@ def main():
     ap.add_argument("--rounds", type=int, default=10)
     args = ap.parse_args()
 
-    import jax
-
-    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.core.tasks import classification_task
     from fedml_tpu.data.registry import load_dataset
     from fedml_tpu.models.cnn import CNNOriginalFedAvg
@@ -34,37 +70,12 @@ def main():
     task = classification_task(CNNOriginalFedAvg(only_digits=False))
 
     for k in [int(p) for p in args.points.split(",")]:
-        cfg = FedAvgConfig(
-            comm_round=args.rounds, client_num_in_total=data.num_clients,
-            client_num_per_round=k, epochs=1, batch_size=20, lr=0.1,
-            frequency_of_the_test=10_000, max_batches=28,
-        )
-        api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
-        if args.device_data:
-            # one compiled scan per block: measures device throughput, not
-            # per-round host dispatch (bench.py uses the same path)
-            api.run_rounds(0, args.rounds)
-            jax.block_until_ready(api.net.params)
-            t0 = time.perf_counter()
-            ms = api.run_rounds(args.rounds, args.rounds)
-            jax.block_until_ready(api.net.params)
-            count = float(ms["count"][-1])
-        else:
-            api.run_round(0)
-            jax.block_until_ready(api.net.params)
-            t0 = time.perf_counter()
-            for r in range(1, args.rounds + 1):
-                m = api.run_round(r)
-            jax.block_until_ready(api.net.params)
-            count = float(m["count"])
-        dt = time.perf_counter() - t0
-        rps = args.rounds / dt
-        print(json.dumps({
-            "clients_per_round": k,
-            "rounds_per_sec": round(rps, 3),
-            "samples_per_sec": round(count * rps, 1),
-            "device": jax.devices()[0].platform,
-        }))
+        try:
+            _one_point(args, data, task, k)
+        except Exception as e:  # noqa: BLE001 — later points still measured
+            print(json.dumps({"clients_per_round": k,
+                              "error": f"{type(e).__name__}: {e}"[:200]}),
+                  flush=True)
 
 
 if __name__ == "__main__":
