@@ -1,0 +1,89 @@
+"""Results graph — accuracy-vs-rounds curves from run logs.
+
+Parity with the reference's ``notebooks/[7]_results_graph.ipynb`` (which
+pulls the curves from wandb): every CLI run writes
+``runs/<name>/metrics.jsonl`` (RunLogger, the wandb-summary analogue); this
+script overlays any number of runs on one accuracy-vs-round plot, or prints
+a text table with --text.
+
+Usage:
+    python examples/results_graph.py runs/run_A runs/run_B --out curves.png
+    python examples/results_graph.py runs/* --metric test_loss --text
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_run(run_dir: str, metric: str):
+    path = os.path.join(run_dir, "metrics.jsonl")
+    xs, ys = [], []
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # run killed mid-write leaves a truncated last line
+            if metric in rec:
+                xs.append(rec.get("round", rec.get("_step", len(xs))))
+                ys.append(rec[metric])
+    return xs, ys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("results_graph")
+    ap.add_argument("runs", nargs="+", help="run directories (each holding metrics.jsonl)")
+    ap.add_argument("--metric", type=str, default="test_acc")
+    ap.add_argument("--out", type=str, default="results_graph.png")
+    ap.add_argument("--text", action="store_true", help="print a table instead of plotting")
+    args = ap.parse_args(argv)
+
+    curves = []
+    for rd in args.runs:
+        rd = rd.rstrip("/")
+        try:
+            xs, ys = load_run(rd, args.metric)
+        except OSError as e:
+            print(f"skip {rd}: {e}", file=sys.stderr)
+            continue
+        if not xs:
+            print(f"skip {rd}: no '{args.metric}' records", file=sys.stderr)
+            continue
+        curves.append((os.path.basename(rd), xs, ys))
+
+    if not curves:
+        print("no curves found", file=sys.stderr)
+        sys.exit(1)
+
+    if args.text:
+        for name, xs, ys in curves:
+            last = ys[-1]
+            best = max(ys) if "acc" in args.metric else min(ys)
+            print(f"{name:30s} points={len(xs):4d} last={last:.4f} best={best:.4f}")
+        return
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for name, xs, ys in curves:
+        ax.plot(xs, ys, label=name, linewidth=1.5)
+    ax.set_xlabel("communication round")
+    ax.set_ylabel(args.metric)
+    ax.grid(True, alpha=0.3)
+    ax.legend(fontsize=8)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=150)
+    print(f"wrote {args.out} ({len(curves)} curve(s))")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,7 +24,8 @@ from fedml_tpu.core.client_data import FederatedData
 from fedml_tpu.core.partition import partition_data
 
 
-def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed):
+def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed,
+             partition_fix_path=None):
     name = spec.name
     try:
         if name in ("mnist", "shakespeare") and os.path.isdir(os.path.join(data_dir, "train")):
@@ -34,7 +35,8 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed)
             if fd is not None:
                 return fd
         if name in ("cifar10", "cifar100"):
-            fd = _load_cifar_pickle(data_dir, spec, n_clients, partition_method or "hetero", partition_alpha, seed)
+            fd = _load_cifar_pickle(data_dir, spec, n_clients, partition_method or "hetero", partition_alpha, seed,
+                                    fix_path=partition_fix_path)
             if fd is not None:
                 return fd
         if name in ("gld23k", "gld160k"):
@@ -184,7 +186,8 @@ def _load_landmarks_csv(data_dir, spec, n_clients, image_size=(64, 64)):
     return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
 
 
-def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed):
+def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed,
+                       fix_path=None):
     batches = sorted(glob.glob(os.path.join(data_dir, "data_batch*"))) or \
         sorted(glob.glob(os.path.join(data_dir, "train")))
     if not batches:
@@ -205,7 +208,7 @@ def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed):
         TY = np.asarray(d.get(b"labels", d.get(b"fine_labels")), dtype=np.int64)
     else:
         TX, TY = X[:1000], Y[:1000]
-    idx_map = partition_data(Y, n_clients, method, alpha, seed)
+    idx_map = partition_data(Y, n_clients, method, alpha, seed, fix_path=fix_path)
     return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
 
 

@@ -87,6 +87,7 @@ def load_dataset(
     samples_per_client: int | None = None,
     test_samples: int | None = None,
     uint8_pixels: bool = False,
+    partition_fix_path: str | None = None,
 ) -> FederatedData:
     """Load (or synthesize) a federated dataset by reference name.
 
@@ -103,11 +104,15 @@ def load_dataset(
     if spec is None:
         raise ValueError(f"unknown dataset {name}; known: {sorted(DATASETS)}")
     n_clients = client_num or spec.num_clients
+    if partition_fix_path is not None and partition_method is None:
+        partition_method = "hetero-fix"  # a frozen map implies the method
 
     if data_dir is not None and os.path.isdir(data_dir):
         from fedml_tpu.data import files
 
-        fd = files.try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed)
+        fd = files.try_load(spec, data_dir, n_clients, partition_method,
+                            partition_alpha, seed,
+                            partition_fix_path=partition_fix_path)
         if fd is not None:
             if uint8_pixels:
                 fd = _requantize_uint8(fd)
@@ -130,6 +135,7 @@ def load_dataset(
             partition_alpha=partition_alpha,
             seed=seed,
             as_uint8=uint8_pixels,
+            partition_fix_path=partition_fix_path,
         )
     if spec.task == "segmentation":
         # synthetic fallback at reduced resolution: full 513x513 blobs are
@@ -181,7 +187,8 @@ def load_dataset(
     y = np.argmax(x @ W + rng.normal(0, 0.5, (n, spec.num_classes)), 1).astype(np.int64)
     tx = rng.normal(0, 1, (ts, dim)).astype(np.float32)
     ty = np.argmax(tx @ W, 1).astype(np.int64)
-    idx = partition_data(y, n_clients, pm, partition_alpha, seed)
+    idx = partition_data(y, n_clients, pm, partition_alpha, seed,
+                         fix_path=partition_fix_path)
     fd = FederatedData(x, y, tx, ty, idx, None, spec.num_classes)
     fd.synthetic_fallback = True
     return fd

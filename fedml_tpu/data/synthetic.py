@@ -66,6 +66,7 @@ def synthetic_images(
     seed: int = 0,
     size_lognormal: bool = True,
     as_uint8: bool = False,
+    partition_fix_path: str | None = None,
 ) -> FederatedData:
     """Class-conditional Gaussian images, shape-compatible stand-in for
     MNIST/FEMNIST/CIFAR when real files are absent. Each class c has a fixed
@@ -96,7 +97,8 @@ def synthetic_images(
         idx_map = {k: np.arange(offs[k], offs[k + 1]) for k in range(num_clients)}
     else:
         y = rng.choice(num_classes, total).astype(np.int64)
-        idx_map = partition_data(y, num_clients, partition_method, partition_alpha, seed)
+        idx_map = partition_data(y, num_clients, partition_method, partition_alpha,
+                                 seed, fix_path=partition_fix_path)
 
     # noise from a shared pool: generating total*prod(shape) fresh gaussians
     # dominates wall-clock at 3400-client scale and adds nothing for learning

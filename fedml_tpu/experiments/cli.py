@@ -36,8 +36,11 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--dataset", type=str, default="mnist")
     parser.add_argument("--data_dir", type=str, default=None)
     parser.add_argument("--partition_method", type=str, default=None,
-                        help="homo | hetero (LDA) | natural")
+                        help="homo | hetero (LDA) | hetero-bal | hetero-fix | natural")
     parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--partition_fix_path", type=str, default=None,
+                        help="hetero-fix: frozen net_dataidx_map.txt "
+                             "(reference checked-in format)")
     parser.add_argument("--client_num_in_total", type=int, default=None)
     parser.add_argument("--client_num_per_round", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=32)
@@ -133,6 +136,7 @@ def build_api(args):
         args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
         partition_method=args.partition_method, partition_alpha=args.partition_alpha,
         seed=args.seed, uint8_pixels=bool(getattr(args, "uint8_pixels", 0)),
+        partition_fix_path=args.partition_fix_path,
     )
     n_total = data.num_clients
 

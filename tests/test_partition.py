@@ -80,3 +80,45 @@ def test_subset_clients_rank_local_view():
     np.testing.assert_array_equal(view.test_x, data.test_x)
     with pytest.raises(KeyError):
         pack_clients(view, [0], batch_size=4, seed=0, round_idx=2)
+
+
+def test_hetero_balanced_partition_sizes():
+    """hetero-bal (partition_data_equally parity): LDA label skew with
+    near-equal client sizes (min >= 0.5 * N/n by the retry loop)."""
+    import numpy as np
+    from fedml_tpu.core.partition import partition_data, record_data_stats
+
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, 6000)
+    idx = partition_data(labels, 12, method="hetero-bal", alpha=0.3, seed=1)
+    sizes = np.array([len(v) for v in idx.values()])
+    assert sizes.sum() == 6000
+    assert sizes.min() >= 0.5 * 6000 / 12
+    # every sample assigned exactly once
+    allidx = np.concatenate(list(idx.values()))
+    assert len(np.unique(allidx)) == 6000
+    # label skew present (some client misses some class)
+    stats = record_data_stats(labels, idx)
+    assert any(len(h) < 10 for h in stats.values())
+
+
+def test_hetero_fix_partition_is_seed_invariant(tmp_path):
+    """hetero-fix: identical map regardless of --seed (the reference freezes
+    it in a checked-in net_dataidx_map.txt); file-based maps parse the
+    reference's txt format."""
+    import numpy as np
+    from fedml_tpu.core.partition import partition_data, read_net_dataidx_map
+
+    labels = np.random.RandomState(3).randint(0, 5, 500)
+    a = partition_data(labels, 4, method="hetero-fix", seed=0)
+    b = partition_data(labels, 4, method="hetero-fix", seed=999)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+    p = tmp_path / "map.txt"
+    p.write_text("{\n0: [\n1, 2, 3,\n]\n1: [\n4, 5,\n]\n}\n")
+    m = read_net_dataidx_map(str(p))
+    np.testing.assert_array_equal(m[0], [1, 2, 3])
+    np.testing.assert_array_equal(m[1], [4, 5])
+    c = partition_data(labels, 2, method="hetero-fix", fix_path=str(p))
+    np.testing.assert_array_equal(c[0], [1, 2, 3])
