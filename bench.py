@@ -63,6 +63,9 @@ def main():
     block = _env_int("FEDML_BENCH_BLOCK", 10)
     n_timed = _env_int("FEDML_BENCH_ROUNDS", 20)
     n_timed = max(block, (n_timed // block) * block)  # whole blocks only
+    # debug/test knobs — leave unset for the flagship measurement
+    clients_per_round = _env_int("FEDML_BENCH_CLIENTS_PER_ROUND", 10)
+    max_batches = _env_int("FEDML_BENCH_MAX_BATCHES", 28)
 
     # FEMNIST-shaped: 3400 clients, ~110 samples each (lognormal sizes);
     # uint8 pixels -> 4x less host->device transfer, normalized on device
@@ -70,12 +73,12 @@ def main():
     cfg = FedAvgConfig(
         comm_round=block + n_timed,
         client_num_in_total=3400,
-        client_num_per_round=10,
+        client_num_per_round=clients_per_round,
         epochs=1,
         batch_size=20,
         lr=0.1,
         frequency_of_the_test=10_000,  # pure training throughput
-        max_batches=28,  # covers ~[22,550]-sample clients at bs=20
+        max_batches=max_batches,  # 28 covers ~[22,550]-sample clients at bs=20
     )
     task = classification_task(CNNOriginalFedAvg(only_digits=False))
     # device_data: whole train set parked in HBM (~300 MB uint8); a round

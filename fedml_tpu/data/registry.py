@@ -89,6 +89,42 @@ def load_dataset(
     uint8_pixels: bool = False,
     partition_fix_path: str | None = None,
 ) -> FederatedData:
+    fd = _load_dataset_impl(
+        name, data_dir, client_num, partition_method, partition_alpha, seed,
+        samples_per_client, test_samples, uint8_pixels, partition_fix_path,
+    )
+    if partition_fix_path is not None:
+        # post-condition, whichever load route ran: the returned partition IS
+        # the frozen map (a route that can't honor it — natural partitions,
+        # sequence/segmentation synthetics — must fail loudly, not silently
+        # train on a different partition; also catches a typo'd path)
+        from fedml_tpu.core.partition import read_net_dataidx_map
+
+        m = read_net_dataidx_map(partition_fix_path)
+        ok = set(fd.train_idx_map) == set(m) and all(
+            len(fd.train_idx_map[k]) == len(m[k]) for k in m
+        )
+        if not ok:
+            raise ValueError(
+                f"dataset {name!r} (partition_method={partition_method!r}) "
+                f"did not honor partition_fix_path={partition_fix_path!r}; "
+                "frozen maps apply to LDA-partitioned classification datasets "
+                "with method 'hetero-fix'")
+    return fd
+
+
+def _load_dataset_impl(
+    name: str,
+    data_dir: str | None = None,
+    client_num: int | None = None,
+    partition_method: str | None = None,
+    partition_alpha: float = 0.5,
+    seed: int = 0,
+    samples_per_client: int | None = None,
+    test_samples: int | None = None,
+    uint8_pixels: bool = False,
+    partition_fix_path: str | None = None,
+) -> FederatedData:
     """Load (or synthesize) a federated dataset by reference name.
 
     client_num overrides the canonical count (the cross-silo datasets take it

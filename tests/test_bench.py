@@ -1,0 +1,66 @@
+"""bench.py control flow: block path emits the JSON line; a block-path
+failure falls back to the per-round path and STILL emits the JSON line
+(the driver records exactly one line per round — a flaky remote-compile
+transport must not cost the round its metric)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def tiny_bench_env(monkeypatch):
+    """Shrink the flagship config to test scale via bench's env knobs."""
+    monkeypatch.setenv("FEDML_BENCH_BLOCK", "2")
+    monkeypatch.setenv("FEDML_BENCH_ROUNDS", "2")
+    monkeypatch.setenv("FEDML_BENCH_CLIENTS_PER_ROUND", "2")
+    monkeypatch.setenv("FEDML_BENCH_MAX_BATCHES", "2")
+
+    import fedml_tpu.data.registry as registry
+    from fedml_tpu.data.synthetic import synthetic_images
+
+    def tiny_load(name, **kw):
+        assert name == "femnist"
+        return synthetic_images(
+            num_clients=3400, image_shape=(28, 28, 1), num_classes=62,
+            samples_per_client=4, test_samples=8, seed=0,
+            size_lognormal=False, as_uint8=True)
+
+    monkeypatch.setattr(registry, "load_dataset", tiny_load)
+
+
+def _run_bench(capsys):
+    sys.modules.pop("bench", None)
+    sys.path.insert(0, REPO_ROOT)
+    try:
+        import bench
+
+        bench.main()
+    finally:
+        sys.path.remove(REPO_ROOT)
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, out
+    rec = json.loads(out[0])
+    assert rec["metric"] == "fedavg_femnist_rounds_per_sec"
+    assert rec["value"] > 0 and rec["unit"] == "rounds/sec"
+    return rec
+
+
+def test_bench_block_path_emits_json(tiny_bench_env, capsys):
+    rec = _run_bench(capsys)
+    assert rec["mode"] == "block"
+
+
+def test_bench_fallback_emits_json(tiny_bench_env, monkeypatch, capsys):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    def broken_run_rounds(self, start, num):
+        raise RuntimeError("remote_compile: Unexpected EOF")
+
+    monkeypatch.setattr(FedAvgAPI, "run_rounds", broken_run_rounds)
+    rec = _run_bench(capsys)
+    assert rec["mode"] == "per_round_fallback"
