@@ -6,9 +6,10 @@ subscribes to ``fedml_<cid>``; client cid publishes ``fedml_<cid>`` and
 subscribes ``fedml0_<cid>`` (mqtt_comm_manager.py:47-70). Payloads are the
 binary Message frame, not JSON.
 
-Gated: paho-mqtt is not bundled in this image; constructing the manager
-without it raises ImportError with instructions. The class is fully
-implemented so it works wherever paho is installed.
+Transport selection: paho-mqtt when installed (any MQTT 3.1.1 broker);
+otherwise the bundled minimal client (mqtt_mini.py) — same topic scheme,
+same Message frames — so the backend works and is testable in environments
+without paho (pair it with mqtt_mini.MiniMqttBroker for loopback runs).
 """
 
 from __future__ import annotations
@@ -25,16 +26,24 @@ log = logging.getLogger("fedml_tpu.comm.mqtt")
 class MqttCommManager(BaseCommManager):
     def __init__(self, broker_host: str, broker_port: int, client_id: int, client_num: int):
         super().__init__()
-        try:
-            import paho.mqtt.client as mqtt
-        except ImportError as e:  # pragma: no cover - env without paho
-            raise ImportError(
-                "MqttCommManager requires paho-mqtt (pip install paho-mqtt); "
-                "use the 'grpc' or 'loopback' backend in this environment"
-            ) from e
-
         self.client_id, self.client_num = client_id, client_num
         name = f"fedml_tpu-{client_id}-{uuid.uuid4().hex[:6]}"
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError:
+            from fedml_tpu.comm.mqtt_mini import MiniMqttClient
+
+            self._mini = MiniMqttClient(
+                broker_host, broker_port, name,
+                on_message=lambda topic, payload: self._enqueue(
+                    Message.from_bytes(payload)))
+            self._client = None
+            for t in self._sub_topics():
+                self._mini.subscribe(t, qos=1)
+            log.info("mqtt: paho absent, using bundled minimal client")
+            return
+
+        self._mini = None
         if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
             self._client = mqtt.Client(mqtt.CallbackAPIVersion.VERSION2, client_id=name)
         else:  # paho-mqtt 1.x
@@ -64,11 +73,21 @@ class MqttCommManager(BaseCommManager):
         self._enqueue(Message.from_bytes(m.payload))
 
     def send_message(self, msg: Message) -> None:
-        self._client.publish(
-            self._pub_topic(int(msg.get_receiver_id())), payload=msg.to_bytes(), qos=1
-        )
+        # retain=True on BOTH paths: parties boot in arbitrary order and a
+        # pub/sub broker drops messages for not-yet-subscribed topics;
+        # retaining the last frame per topic lets a late subscriber catch up
+        # (the gRPC backend's wait_for_ready analogue). The reference has
+        # this race unhandled (its CI boots the broker before all ranks).
+        topic = self._pub_topic(int(msg.get_receiver_id()))
+        if self._mini is not None:
+            self._mini.publish(topic, msg.to_bytes(), qos=1, retain=True)
+            return
+        self._client.publish(topic, payload=msg.to_bytes(), qos=1, retain=True)
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()
+        if self._mini is not None:
+            self._mini.close()
+            return
         self._client.loop_stop()
         self._client.disconnect()

@@ -102,7 +102,7 @@ def load_dataset(
 
         m = read_net_dataidx_map(partition_fix_path)
         ok = set(fd.train_idx_map) == set(m) and all(
-            len(fd.train_idx_map[k]) == len(m[k]) for k in m
+            np.array_equal(np.asarray(fd.train_idx_map[k]), m[k]) for k in m
         )
         if not ok:
             raise ValueError(

@@ -59,10 +59,12 @@ def run_simulated(
     job_id: str = "fedavg-sim",
     base_port: int = 50000,
     ckpt_dir: str | None = None,
+    broker_host: str = "127.0.0.1",
+    broker_port: int = 1883,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue."""
     size = cfg.client_num_per_round + 1
-    kw = backend_kwargs(backend, job_id, base_port)
+    kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
     server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
                                  ckpt_dir=ckpt_dir, **kw)

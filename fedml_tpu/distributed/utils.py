@@ -41,12 +41,17 @@ def mapping_to_ip_config_csv(table: dict[int, str], path: str) -> None:
             f.write(f"{r},{table[r]}\n")
 
 
-def backend_kwargs(backend: str, job_id: str, base_port: int = 50000) -> dict:
+def backend_kwargs(backend: str, job_id: str, base_port: int = 50000,
+                   broker_host: str = "127.0.0.1",
+                   broker_port: int = 1883) -> dict:
     """Transport-specific kwargs for make_comm_manager: loopback routes by
     job_id; gRPC by port block (reference: grpc_comm_manager.py:29 port =
-    50000+rank)."""
-    if backend.upper() == "LOOPBACK":
+    50000+rank); MQTT by broker address (mqtt_comm_manager.py)."""
+    b = backend.upper()
+    if b == "LOOPBACK":
         return {"job_id": job_id}
+    if b == "MQTT":
+        return {"broker_host": broker_host, "broker_port": broker_port}
     return {"base_port": base_port}
 
 
