@@ -85,7 +85,7 @@ class MiniMqttClient:
         # §3.1.2.10) — this client sends no PINGREQs, and FL rounds can be
         # minutes of silence between messages
         self.on_message = on_message
-        self._sock = socket.create_connection((host, port), timeout=30)
+        self._sock = self._connect_with_retry(host, port)
         self._sock.settimeout(None)
         self._wlock = threading.Lock()
         self._pid = 0
@@ -98,6 +98,30 @@ class MiniMqttClient:
         self._alive = True
         self._thread = threading.Thread(target=self._reader, daemon=True)
         self._thread.start()
+
+    @staticmethod
+    def _connect_with_retry(host: str, port: int,
+                            deadline_s: float = 120.0) -> socket.socket:
+        """Peers boot in arbitrary order; when rank 0 hosts the broker
+        (--serve_broker) a faster-booting client must wait for it instead of
+        dying on ConnectionRefused (the transport-level analogue of the gRPC
+        backend's wait_for_ready)."""
+        import time
+
+        deadline = time.monotonic() + deadline_s
+        attempt = 0
+        while True:
+            try:
+                return socket.create_connection((host, port), timeout=30)
+            except OSError as e:
+                attempt += 1
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"mqtt: broker {host}:{port} unreachable for "
+                        f"{deadline_s:.0f}s: {e}") from e
+                if attempt % 10 == 1:
+                    log.warning("mqtt: broker %s:%d not up yet, retrying", host, port)
+                time.sleep(1.0)
 
     def _send(self, data: bytes) -> None:
         with self._wlock:

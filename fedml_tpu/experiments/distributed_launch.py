@@ -52,6 +52,9 @@ def add_args(p: argparse.ArgumentParser):
                    help="csv receiver_id,ip (grpc_ipconfig.csv parity)")
     p.add_argument("--broker_host", type=str, default="127.0.0.1")
     p.add_argument("--broker_port", type=int, default=1883)
+    p.add_argument("--serve_broker", type=int, default=0,
+                   help="mqtt: rank 0 also hosts the bundled loopback broker "
+                        "(no external mosquitto needed)")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
     p.add_argument("--ckpt_dir", type=str, default=None,
@@ -175,6 +178,12 @@ def main(argv=None):
         backend_kw.update(base_port=args.base_port, ip_table=args.ip_config)
     elif args.backend == "mqtt":
         backend_kw.update(broker_host=args.broker_host, broker_port=args.broker_port)
+        if args.serve_broker and args.rank == 0:
+            from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
+
+            broker = MiniMqttBroker(port=args.broker_port)  # lives with rank 0
+            logging.getLogger("fedml_tpu.launch").info(
+                "serving loopback MQTT broker on :%d", broker.port)
     else:
         backend_kw.update(job_id="launch")
 
