@@ -181,9 +181,11 @@ def main(argv=None):
         if args.serve_broker and args.rank == 0:
             from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
 
-            broker = MiniMqttBroker(port=args.broker_port)  # lives with rank 0
+            # bind all interfaces: clients on other hosts reach the broker
+            # via --broker_host <rank 0's address>
+            broker = MiniMqttBroker(host="0.0.0.0", port=args.broker_port)
             logging.getLogger("fedml_tpu.launch").info(
-                "serving loopback MQTT broker on :%d", broker.port)
+                "serving MQTT broker on 0.0.0.0:%d", broker.port)
     else:
         backend_kw.update(job_id="launch")
 
