@@ -50,22 +50,12 @@ class MqttCommManager(BaseCommManager):
             self._client = mqtt.Client(client_id=name)
         self._client.on_connect = self._on_connect
         self._client.on_message = self._on_message
-        # same boot-order tolerance as the mini client's connect retry: a
-        # rank can come up before the broker (e.g. rank 0 hosting it)
-        import time as _time
+        # same boot-order tolerance as the mini client (shared retry helper)
+        from fedml_tpu.comm.mqtt_mini import retry_connect
 
-        deadline = _time.monotonic() + 120
-        while True:
-            try:
-                self._client.connect(broker_host, broker_port, keepalive=180)
-                break
-            except OSError as e:
-                if _time.monotonic() >= deadline:
-                    raise ConnectionError(
-                        f"mqtt: broker {broker_host}:{broker_port} "
-                        f"unreachable for 120s: {e}") from e
-                log.warning("mqtt: broker not up yet, retrying")
-                _time.sleep(1.0)
+        retry_connect(
+            lambda: self._client.connect(broker_host, broker_port, keepalive=180),
+            f"broker {broker_host}:{broker_port}")
         self._client.loop_start()
 
     # topic scheme parity (mqtt_comm_manager.py:47-70)

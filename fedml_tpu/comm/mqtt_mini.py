@@ -76,6 +76,31 @@ def _mqtt_str(s: str) -> bytes:
     return struct.pack(">H", len(b)) + b
 
 
+def retry_connect(connect, desc: str, deadline_s: float = 120.0):
+    """Run ``connect()`` until it succeeds or ``deadline_s`` passes. Peers
+    boot in arbitrary order — a rank that comes up before the broker (e.g.
+    rank 0 hosting it via --serve_broker) must wait, not die on
+    ConnectionRefused (the transport-level analogue of the gRPC backend's
+    wait_for_ready). Shared by the mini client and the paho path; warnings
+    are throttled to one per ~10 attempts."""
+    import time
+
+    deadline = time.monotonic() + deadline_s
+    attempt = 0
+    while True:
+        try:
+            return connect()
+        except OSError as e:
+            attempt += 1
+            if time.monotonic() >= deadline:
+                raise ConnectionError(
+                    f"mqtt: {desc} unreachable for {deadline_s:.0f}s: {e}"
+                ) from e
+            if attempt % 10 == 1:
+                log.warning("mqtt: %s not up yet, retrying", desc)
+            time.sleep(1.0)
+
+
 class MiniMqttClient:
     """Tiny synchronous-publish / threaded-receive MQTT 3.1.1 client."""
 
@@ -102,26 +127,9 @@ class MiniMqttClient:
     @staticmethod
     def _connect_with_retry(host: str, port: int,
                             deadline_s: float = 120.0) -> socket.socket:
-        """Peers boot in arbitrary order; when rank 0 hosts the broker
-        (--serve_broker) a faster-booting client must wait for it instead of
-        dying on ConnectionRefused (the transport-level analogue of the gRPC
-        backend's wait_for_ready)."""
-        import time
-
-        deadline = time.monotonic() + deadline_s
-        attempt = 0
-        while True:
-            try:
-                return socket.create_connection((host, port), timeout=30)
-            except OSError as e:
-                attempt += 1
-                if time.monotonic() >= deadline:
-                    raise ConnectionError(
-                        f"mqtt: broker {host}:{port} unreachable for "
-                        f"{deadline_s:.0f}s: {e}") from e
-                if attempt % 10 == 1:
-                    log.warning("mqtt: broker %s:%d not up yet, retrying", host, port)
-                time.sleep(1.0)
+        return retry_connect(
+            lambda: socket.create_connection((host, port), timeout=30),
+            f"broker {host}:{port}", deadline_s)
 
     def _send(self, data: bytes) -> None:
         with self._wlock:

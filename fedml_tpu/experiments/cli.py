@@ -92,6 +92,9 @@ def add_args(parser: argparse.ArgumentParser):
     # checkpoint / logging
     parser.add_argument("--ckpt_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
+    parser.add_argument("--trace_dir", type=str, default=None,
+                        help="capture a jax.profiler XLA/TPU trace of the "
+                             "run (TensorBoard/Perfetto; files are large)")
     parser.add_argument("--run_dir", type=str, default="./runs")
     parser.add_argument("--run_name", type=str, default=None)
     return parser
@@ -324,48 +327,62 @@ def main(argv=None):
              data.num_clients if data is not None else "vertical", args.algo,
              args.mesh)
 
-    if args.algo == "centralized":
-        api.train()
-        for rec in api.history:
-            logger.log(rec, step=rec.get("epoch"))
-    elif args.algo in ("vfl", "split_nn"):
-        hist = api.train(args.comm_round) if args.algo == "split_nn" else api.train()
-        for i, rec in enumerate(hist or []):
-            logger.log(rec, step=i)
-            log.info("%s", rec)
-    else:
-        start_round = 0
-        if args.resume and args.ckpt_dir:
-            from fedml_tpu.core.checkpoint import latest_round, restore_round
+    import contextlib
 
-            lr_ = latest_round(args.ckpt_dir)
-            if lr_ is not None:
-                tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
-                        "rng": api.rng, "round": 0}
-                st = restore_round(args.ckpt_dir, lr_, tmpl)
-                api.load_state(st["net"], st["server_opt_state"], st["rng"])
-                start_round = int(st["round"]) + 1
-                log.info("resumed from round %d", start_round - 1)
-        for r in range(start_round, args.comm_round):
-            metrics = api.run_round(r)
-            if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
-                ev = api.evaluate() if hasattr(api, "evaluate") else {}
-                if isinstance(ev, (int, float)):  # FedGKT returns a bare acc
-                    ev = {"acc": float(ev), "loss": 0.0}
-                n = float(max(float(metrics.get("count", 1)), 1))
-                rec = {"round": r,
-                       "train_loss": float(metrics.get("loss_sum", 0)) / n,
-                       "train_acc": float(metrics.get("correct", 0)) / n}
-                if ev:
-                    rec["test_acc"] = float(ev["acc"])
-                    rec["test_loss"] = float(ev["loss"])
-                logger.log(rec, step=r)
-                log.info("round %d: %s", r, rec)
-            if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):
-                from fedml_tpu.core.checkpoint import save_round
+    stack = contextlib.ExitStack()
+    if args.trace_dir:
+        from fedml_tpu.utils.tracing import trace
 
-                save_round(args.ckpt_dir, r, api.net, api.server_opt_state,
-                           api.rng)
+        stack.enter_context(trace(args.trace_dir))
+        log.info("capturing XLA trace to %s", args.trace_dir)
+
+    try:
+        if args.algo == "centralized":
+            api.train()
+            for rec in api.history:
+                logger.log(rec, step=rec.get("epoch"))
+        elif args.algo in ("vfl", "split_nn"):
+            hist = api.train(args.comm_round) if args.algo == "split_nn" else api.train()
+            for i, rec in enumerate(hist or []):
+                logger.log(rec, step=i)
+                log.info("%s", rec)
+        else:
+            start_round = 0
+            if args.resume and args.ckpt_dir:
+                from fedml_tpu.core.checkpoint import latest_round, restore_round
+
+                lr_ = latest_round(args.ckpt_dir)
+                if lr_ is not None:
+                    tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
+                            "rng": api.rng, "round": 0}
+                    st = restore_round(args.ckpt_dir, lr_, tmpl)
+                    api.load_state(st["net"], st["server_opt_state"], st["rng"])
+                    start_round = int(st["round"]) + 1
+                    log.info("resumed from round %d", start_round - 1)
+            for r in range(start_round, args.comm_round):
+                metrics = api.run_round(r)
+                if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
+                    ev = api.evaluate() if hasattr(api, "evaluate") else {}
+                    if isinstance(ev, (int, float)):  # FedGKT returns a bare acc
+                        ev = {"acc": float(ev), "loss": 0.0}
+                    n = float(max(float(metrics.get("count", 1)), 1))
+                    rec = {"round": r,
+                           "train_loss": float(metrics.get("loss_sum", 0)) / n,
+                           "train_acc": float(metrics.get("correct", 0)) / n}
+                    if ev:
+                        rec["test_acc"] = float(ev["acc"])
+                        rec["test_loss"] = float(ev["loss"])
+                    logger.log(rec, step=r)
+                    log.info("round %d: %s", r, rec)
+                if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):
+                    from fedml_tpu.core.checkpoint import save_round
+
+                    save_round(args.ckpt_dir, r, api.net, api.server_opt_state,
+                               api.rng)
+    finally:
+        # stop the XLA trace even when training crashes — the trace
+        # is most wanted precisely when a run misbehaves
+        stack.close()
     logger.finish()
     log.info("done in %.1fs; summary=%s", time.time() - t0,
              json.dumps(logger.summary, default=float))

@@ -55,6 +55,10 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--serve_broker", type=int, default=0,
                    help="mqtt: rank 0 also hosts the bundled loopback broker "
                         "(no external mosquitto needed)")
+    p.add_argument("--broker_bind", type=str, default="127.0.0.1",
+                   help="--serve_broker bind address; the bundled broker is "
+                        "unauthenticated, so widen to 0.0.0.0 only on "
+                        "networks where every peer is trusted")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
     p.add_argument("--ckpt_dir", type=str, default=None,
@@ -181,11 +185,9 @@ def main(argv=None):
         if args.serve_broker and args.rank == 0:
             from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
 
-            # bind all interfaces: clients on other hosts reach the broker
-            # via --broker_host <rank 0's address>
-            broker = MiniMqttBroker(host="0.0.0.0", port=args.broker_port)
+            broker = MiniMqttBroker(host=args.broker_bind, port=args.broker_port)
             logging.getLogger("fedml_tpu.launch").info(
-                "serving MQTT broker on 0.0.0.0:%d", broker.port)
+                "serving MQTT broker on %s:%d", args.broker_bind, broker.port)
     else:
         backend_kw.update(job_id="launch")
 
