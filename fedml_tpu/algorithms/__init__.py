@@ -9,5 +9,6 @@ aggregator is a weighted psum, the trainer is core.local.make_local_update.
 """
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.algorithms.fedavg_seq import FedAvgSeqAPI
 from fedml_tpu.algorithms.fedopt import FedOptAPI
 from fedml_tpu.algorithms.fedprox import FedProxAPI

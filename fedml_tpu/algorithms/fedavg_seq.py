@@ -1,0 +1,195 @@
+"""FedAvg with sequence-parallel clients — long-context federated training.
+
+The reference tops out at 80-token LSTMs (SURVEY.md §2.7: no sequence
+parallelism anywhere); this engine makes long sequences first-class in the
+FL loop itself: a 2-axis ``('clients','seq')`` mesh where
+
+  - the 'clients' axis is the usual FL client parallelism (one shard of the
+    sampled cohort per mesh column; aggregation = weighted psum), and
+  - the 'seq' axis shards every client's ACTIVATIONS over the sequence
+    dimension: the TransformerLM runs ring attention (`parallel/
+    ring_attention.py`, ppermuted kv blocks over ICI) so a context that
+    doesn't fit one device's HBM trains across the axis. The task's loss is
+    psum-ed over 'seq' and params stay seq-invariant, so shard_map's
+    vma-aware transpose produces the full-sequence gradient on every shard
+    with no explicit collective in the update loop.
+
+Equivalence (test-enforced): with T divisible by the 'seq' axis, a round on
+the 2-axis mesh matches the single-device engine on the same config — ring
+attention ≡ full attention, psum-ed grads ≡ unsharded grads, and the
+fold_in key chain is shape-independent.
+
+Labels arrive pre-shifted per position (data convention y[t] = x[t+1],
+data/synthetic.py:synthetic_sequences), so sharding T splits x and y
+consistently and no cross-shard label exchange is needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgConfig,
+    _make_client_keys,
+    _shard_aggregate,
+    make_client_optimizer,
+)
+from fedml_tpu.core.client_data import (
+    FederatedData,
+    batch_global,
+    pack_clients,
+    pad_batches,
+)
+from fedml_tpu.core.local import LocalSpec, make_eval_fn, make_local_update
+from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.tasks import sequence_task
+
+
+class FedAvgSeqAPI:
+    """FedAvg over a ('clients','seq') mesh.
+
+    ``model_ctor(seq_axis)`` builds the language model; it is called twice —
+    with the mesh's seq axis name for the sharded round program, and with
+    ``None`` for init/eval (identical parameter structure; only apply-time
+    collectives differ)."""
+
+    def __init__(
+        self,
+        dataset: FederatedData,
+        model_ctor,
+        config: FedAvgConfig,
+        mesh: Mesh,
+        pad_id: int = 0,
+    ):
+        if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
+            raise ValueError(
+                f"FedAvgSeqAPI needs axes ('clients','seq'), got {mesh.axis_names}")
+        self.data, self.cfg, self.mesh = dataset, config, mesh
+        cd, sd = mesh.shape["clients"], mesh.shape["seq"]
+        T = int(dataset.train_x.shape[1])
+        if T % sd != 0:
+            raise ValueError(f"sequence length {T} not divisible by seq axis {sd}")
+        if config.client_num_per_round % cd != 0:
+            raise ValueError(
+                f"client_num_per_round={config.client_num_per_round} must be "
+                f"a multiple of the clients axis {cd}")
+
+        self.rng = jax.random.PRNGKey(config.seed)
+        self.task_plain = sequence_task(model_ctor(None), pad_id=pad_id)
+        self.task_sharded = sequence_task(model_ctor("seq"), pad_id=pad_id,
+                                          seq_axis="seq")
+        self.eval_fn = make_eval_fn(self.task_plain)
+
+        counts = [len(v) for v in dataset.train_idx_map.values()]
+        b_needed = int(np.ceil(max(counts) / config.batch_size))
+        self.num_batches = min(config.max_batches or b_needed, b_needed)
+
+        # no explicit grad psum: the task's seq-psum-ed loss + seq-invariant
+        # params make shard_map's transpose insert it (see core/local.py)
+        spec = LocalSpec(optimizer=make_client_optimizer(config),
+                         epochs=config.epochs)
+        self.local_update = make_local_update(self.task_sharded, spec)
+
+        self.rng, init_key = jax.random.split(self.rng)
+        x_sample = jnp.asarray(dataset.train_x[: config.batch_size])
+        self.net = self.task_plain.init(init_key, x_sample)
+
+        self.round_fn = self._build_round_fn()
+        self._test_cache = None
+        self.history: list[dict] = []
+
+    # ---------------------------------------------------------------- round
+    def _build_round_fn(self):
+        mesh = self.mesh
+        client_keys = _make_client_keys(self.cfg.seed)
+
+        def body(keys, net, x, y, mask, nsamp):
+            # per-device block: [K/cd] clients x [.., T/sd] sequence slices.
+            # params stay seq-INVARIANT (grad psum restores invariance after
+            # each step) and become clients-varying for the per-client fits.
+            net_v = jax.tree.map(
+                lambda v: jax.lax.pcast(v, "clients", to="varying"), net)
+            nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
+                keys, net_v, x, y, mask)
+            # metrics are already seq-psum-ed inside the task (identical on
+            # every seq shard); aggregate clients with the shared helper
+            return _shard_aggregate(nets, metrics, nsamp, "clients")
+
+        smapped = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P("clients"), P(),
+                      P("clients", None, None, "seq"),
+                      P("clients", None, None, "seq"),
+                      P("clients"), P("clients")),
+            out_specs=(P(), P()),
+        )
+
+        @jax.jit
+        def round_fn(net, x, y, mask, nsamp, round_idx, ids):
+            keys = client_keys(round_idx, ids)
+            # seq shards hold duplicate metric copies psum-ed over 'clients'
+            # only; the seq axis saw identical (invariant) values
+            return smapped(keys, net, x, y, mask, nsamp)
+
+        return round_fn
+
+    def run_round(self, round_idx: int):
+        cfg = self.cfg
+        ids = sample_clients(round_idx, cfg.client_num_in_total,
+                             cfg.client_num_per_round, cfg.seed)
+        cb = pack_clients(self.data, ids, cfg.batch_size,
+                          max_batches=self.num_batches, seed=cfg.seed,
+                          round_idx=round_idx)
+        # fixed B across rounds -> the round program compiles exactly once
+        # (padded batches are exact no-ops in the local fit)
+        cb = pad_batches(cb, self.num_batches)
+        sh = lambda spec: NamedSharding(self.mesh, spec)
+        x = jax.device_put(cb.x, sh(P("clients", None, None, "seq")))
+        y = jax.device_put(cb.y, sh(P("clients", None, None, "seq")))
+        mask = jax.device_put(cb.mask, sh(P("clients")))
+        nsamp = jax.device_put(cb.num_samples, sh(P("clients")))
+        self.net, metrics = self.round_fn(
+            self.net, x, y, mask, nsamp,
+            jnp.int32(round_idx), jnp.asarray(ids, jnp.int32))
+        return metrics
+
+    def train(self, num_rounds: int | None = None):
+        rounds = num_rounds or self.cfg.comm_round
+        for r in range(rounds):
+            metrics = self.run_round(r)
+            if r % self.cfg.frequency_of_the_test == 0 or r == rounds - 1:
+                ev = self.evaluate()
+                n = float(max(float(metrics["count"]), 1.0))
+                self.history.append({
+                    "round": r,
+                    "train_loss": float(metrics["loss_sum"]) / n,
+                    "train_acc": float(metrics["correct"]) / n,
+                    "test_loss": float(ev["loss"]),
+                    "test_acc": float(ev["acc"]),
+                })
+        return self.net
+
+    # ----------------------------------------------------------------- eval
+    def evaluate(self):
+        """Global test eval on the axis-free twin (replicated params; the
+        T-sharded program is only needed where activations must not
+        materialize — for eval-sized batches the plain path is fine)."""
+        if self._test_cache is None:
+            tx, ty = self.data.test_x, self.data.test_y
+            if (self.cfg.eval_max_samples is not None
+                    and len(tx) > self.cfg.eval_max_samples):
+                # same seeded validation subset as FedAvgAPI.evaluate
+                sel = np.random.RandomState(self.cfg.seed).choice(
+                    len(tx), self.cfg.eval_max_samples, replace=False)
+                tx, ty = tx[sel], ty[sel]
+            n = len(tx)
+            if self.cfg.ci:
+                n = min(n, 512)
+            self._test_cache = tuple(
+                jnp.asarray(a) for a in batch_global(
+                    tx[:n], ty[:n], self.cfg.eval_batch_size))
+        xb, yb, mb = self._test_cache
+        return self.eval_fn(self.net, xb, yb, mb)

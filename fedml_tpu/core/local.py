@@ -116,6 +116,10 @@ def make_local_update(task: Task, spec: LocalSpec):
                 loss = loss + 0.5 * spec.prox_mu * sum(jax.tree.leaves(sq))
             return loss, (new_extra, metr)
 
+        # NOTE sequence-parallel fits need no grad psum here: with the task's
+        # loss psum-ed over the seq axis and params entering seq-INVARIANT,
+        # shard_map's vma-aware transpose emits the cross-shard psum of the
+        # cotangent automatically (pinned by test_fedavg_seq equivalence).
         (loss, (new_extra, metr)), grads = jax.value_and_grad(
             total_loss, has_aux=True
         )(params)

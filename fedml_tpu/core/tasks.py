@@ -97,11 +97,18 @@ def classification_task(module) -> Task:
     return Task(init, loss, predict, eval_batch)
 
 
-def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False) -> Task:
+def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False,
+                  seq_axis: str | None = None) -> Task:
     """Next-token prediction: module maps tokens [bs, T] -> logits [bs, T, V];
     labels are the inputs shifted by the module itself or provided as y
     [bs, T]. Tokens equal to ``pad_id`` are masked out of loss and accuracy
-    (the reference masks PAD in nwp, my_model_trainer_nwp.py)."""
+    (the reference masks PAD in nwp, my_model_trainer_nwp.py).
+
+    seq_axis: sequence-parallel mode — x/y carry this device's sequence
+    slice (the module runs ring/Ulysses attention over the axis), so the
+    loss normalizer and the metric sums are psum-ed over it: every seq shard
+    then holds the identical GLOBAL loss/metrics, and the psum-ed gradient
+    (LocalSpec.grad_psum_axis) equals the unsharded gradient exactly."""
 
     def init(rng, x_sample):
         p_rng, d_rng = jax.random.split(rng)
@@ -112,6 +119,9 @@ def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False) -> Ta
         tm = (y != pad_id).astype(jnp.float32)
         return tm * mask[:, None]
 
+    def _seq_sum(v):
+        return jax.lax.psum(v, seq_axis) if seq_axis is not None else v
+
     def loss(params, extra, x, y, mask, rng, train):
         if train:
             logits, new_extra = _apply_train(module, params, extra, x, rng)
@@ -119,10 +129,11 @@ def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False) -> Ta
             logits, new_extra = _apply_eval(module, params, extra, x), extra
         per_tok = optax.softmax_cross_entropy_with_integer_labels(logits, y)
         tm = _tok_mask(y, mask)
-        n = jnp.maximum(jnp.sum(tm), 1.0)
-        l = jnp.sum(per_tok * tm) / n
-        correct = jnp.sum((jnp.argmax(logits, -1) == y) * tm)
-        metrics = {"loss_sum": jnp.sum(per_tok * tm), "correct": correct, "count": jnp.sum(tm)}
+        n = jnp.maximum(_seq_sum(jnp.sum(tm)), 1.0)
+        l = _seq_sum(jnp.sum(per_tok * tm)) / n
+        correct = _seq_sum(jnp.sum((jnp.argmax(logits, -1) == y) * tm))
+        metrics = {"loss_sum": _seq_sum(jnp.sum(per_tok * tm)),
+                   "correct": correct, "count": _seq_sum(jnp.sum(tm))}
         return l, new_extra, metrics
 
     def predict(params, extra, x):

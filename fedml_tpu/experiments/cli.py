@@ -93,8 +93,12 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--ckpt_dir", type=str, default=None)
     parser.add_argument("--resume", action="store_true")
     parser.add_argument("--trace_dir", type=str, default=None,
-                        help="capture a jax.profiler XLA/TPU trace of the "
-                             "run (TensorBoard/Perfetto; files are large)")
+                        help="capture a jax.profiler XLA/TPU trace "
+                             "(TensorBoard/Perfetto; files are large)")
+    parser.add_argument("--trace_rounds", type=int, default=3,
+                        help="round-loop algos: trace only the first N "
+                             "rounds (a whole-run trace of a long job is "
+                             "unloadably large); 0 = whole run")
     parser.add_argument("--run_dir", type=str, default="./runs")
     parser.add_argument("--run_name", type=str, default=None)
     return parser
@@ -329,8 +333,10 @@ def main(argv=None):
 
     import contextlib
 
+    round_loop = args.algo not in ("centralized", "vfl", "split_nn")
     stack = contextlib.ExitStack()
-    if args.trace_dir:
+    if args.trace_dir and not (round_loop and args.trace_rounds > 0):
+        # whole-run trace: single-shot algos, or --trace_rounds 0
         from fedml_tpu.utils.tracing import trace
 
         stack.enter_context(trace(args.trace_dir))
@@ -359,7 +365,19 @@ def main(argv=None):
                     api.load_state(st["net"], st["server_opt_state"], st["rng"])
                     start_round = int(st["round"]) + 1
                     log.info("resumed from round %d", start_round - 1)
+            trace_ctx = None
+            if args.trace_dir and args.trace_rounds > 0:
+                from fedml_tpu.utils.tracing import trace
+
+                trace_ctx = stack.enter_context(contextlib.ExitStack())
+                trace_ctx.enter_context(trace(args.trace_dir))
+                log.info("tracing rounds %d..%d to %s", start_round,
+                         start_round + args.trace_rounds - 1, args.trace_dir)
             for r in range(start_round, args.comm_round):
+                if (trace_ctx is not None
+                        and r - start_round == args.trace_rounds):
+                    trace_ctx.close()  # stop after the trace window
+                    trace_ctx = None
                 metrics = api.run_round(r)
                 if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
                     ev = api.evaluate() if hasattr(api, "evaluate") else {}

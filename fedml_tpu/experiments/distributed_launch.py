@@ -50,7 +50,9 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--base_port", type=int, default=50000)
     p.add_argument("--ip_config", type=str, default=None,
                    help="csv receiver_id,ip (grpc_ipconfig.csv parity)")
-    p.add_argument("--broker_host", type=str, default="127.0.0.1")
+    p.add_argument("--broker_host", type=str, default="127.0.0.1",
+                   help="mqtt broker address; for multi-host --serve_broker "
+                        "runs rank 0 must also widen --broker_bind")
     p.add_argument("--broker_port", type=int, default=1883)
     p.add_argument("--serve_broker", type=int, default=0,
                    help="mqtt: rank 0 also hosts the bundled loopback broker "
