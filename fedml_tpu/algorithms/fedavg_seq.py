@@ -63,6 +63,8 @@ class FedAvgSeqAPI:
         config: FedAvgConfig,
         mesh: Mesh,
         pad_id: int = 0,
+        server_update=None,
+        server_opt_init=None,
     ):
         if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
             raise ValueError(
@@ -79,7 +81,19 @@ class FedAvgSeqAPI:
 
         self.rng = jax.random.PRNGKey(config.seed)
         self.task_plain = sequence_task(model_ctor(None), pad_id=pad_id)
-        self.task_sharded = sequence_task(model_ctor("seq"), pad_id=pad_id,
+        sharded_model = model_ctor("seq")
+        if getattr(sharded_model, "use_flash", False):
+            # the Pallas kernels' custom VJP still trips check_vma's strict
+            # dynamic_slice rule, and check_vma=False would disable the
+            # vma-aware grad transpose this engine's correctness rests on
+            # (see core/local.py NOTE). Flash + sequence sharding remains
+            # available via parallel/ring_attention.py's *_sharded wrappers.
+            raise ValueError(
+                "FedAvgSeqAPI: use_flash is unsupported inside the FL "
+                "engine; use the plain ring or ulysses impls (the Pallas "
+                "flash path is available via the standalone sharded "
+                "attention wrappers)")
+        self.task_sharded = sequence_task(sharded_model, pad_id=pad_id,
                                           seq_axis="seq")
         self.eval_fn = make_eval_fn(self.task_plain)
 
@@ -96,6 +110,12 @@ class FedAvgSeqAPI:
         self.rng, init_key = jax.random.split(self.rng)
         x_sample = jnp.asarray(dataset.train_x[: config.batch_size])
         self.net = self.task_plain.init(init_key, x_sample)
+
+        # server update hook — identity for FedAvg; FedOpt-style server
+        # optimizers plug in exactly as on FedAvgAPI
+        self.server_update = server_update or (lambda old, avg, s: (avg, s))
+        self.server_opt_state = (server_opt_init(self.net.params)
+                                 if server_opt_init else ())
 
         self.round_fn = self._build_round_fn()
         self._test_cache = None
@@ -128,11 +148,13 @@ class FedAvgSeqAPI:
         )
 
         @jax.jit
-        def round_fn(net, x, y, mask, nsamp, round_idx, ids):
+        def round_fn(net, server_opt_state, x, y, mask, nsamp, round_idx, ids):
             keys = client_keys(round_idx, ids)
             # seq shards hold duplicate metric copies psum-ed over 'clients'
             # only; the seq axis saw identical (invariant) values
-            return smapped(keys, net, x, y, mask, nsamp)
+            avg, metrics = smapped(keys, net, x, y, mask, nsamp)
+            new_net, new_opt = self.server_update(net, avg, server_opt_state)
+            return new_net, new_opt, metrics
 
         return round_fn
 
@@ -151,8 +173,8 @@ class FedAvgSeqAPI:
         y = jax.device_put(cb.y, sh(P("clients", None, None, "seq")))
         mask = jax.device_put(cb.mask, sh(P("clients")))
         nsamp = jax.device_put(cb.num_samples, sh(P("clients")))
-        self.net, metrics = self.round_fn(
-            self.net, x, y, mask, nsamp,
+        self.net, self.server_opt_state, metrics = self.round_fn(
+            self.net, self.server_opt_state, x, y, mask, nsamp,
             jnp.int32(round_idx), jnp.asarray(ids, jnp.int32))
         return metrics
 

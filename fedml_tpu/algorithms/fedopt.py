@@ -33,6 +33,23 @@ def make_server_optimizer(name: str, lr: float, momentum: float = 0.9):
     raise ValueError(f"unknown server optimizer {name}")
 
 
+def make_fedopt_server_update(tx):
+    """Server-update hook applying ``tx`` to the FedOpt pseudo-gradient —
+    shared by FedOptAPI and any engine exposing the server_update hook
+    (e.g. FedAvgSeqAPI for long-context FedOpt)."""
+
+    def server_update(old: NetState, avg: NetState, opt_state):
+        # pseudo-gradient points from the average back toward the old
+        # weights (FedOptAggregator.set_model_global_grads:109-121)
+        pseudo_grad = tree_sub(old.params, avg.params)
+        updates, new_state = tx.update(pseudo_grad, opt_state, old.params)
+        new_params = optax.apply_updates(old.params, updates)
+        # non-gradient collections (BN stats) take the plain average
+        return NetState(new_params, avg.extra), new_state
+
+    return server_update
+
+
 class FedOptAPI(FedAvgAPI):
     def __init__(
         self,
@@ -46,15 +63,7 @@ class FedOptAPI(FedAvgAPI):
         **kwargs,
     ):
         tx = make_server_optimizer(server_optimizer, server_lr, server_momentum)
-
-        def server_update(old: NetState, avg: NetState, opt_state):
-            # pseudo-gradient points from the average back toward the old
-            # weights (FedOptAggregator.set_model_global_grads:109-121)
-            pseudo_grad = tree_sub(old.params, avg.params)
-            updates, new_state = tx.update(pseudo_grad, opt_state, old.params)
-            new_params = optax.apply_updates(old.params, updates)
-            # non-gradient collections (BN stats) take the plain average
-            return NetState(new_params, avg.extra), new_state
+        server_update = make_fedopt_server_update(tx)
 
         super().__init__(
             dataset, task, config, mesh=mesh,

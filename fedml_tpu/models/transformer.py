@@ -14,15 +14,21 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.parallel.ring_attention import full_attention, ring_attention
+from fedml_tpu.parallel.ring_attention import (
+    full_attention,
+    ring_attention,
+    ring_attention_flash,
+    ulysses_attention,
+)
 
 
 class SelfAttention(nn.Module):
     num_heads: int
     head_dim: int
     causal: bool = True
-    seq_axis: str | None = None  # set to run ring attention inside shard_map
+    seq_axis: str | None = None  # set to shard attention over a mesh axis
     use_flash: bool = False      # Pallas blockwise kernel (fedml_tpu.ops)
+    seq_impl: str = "ring"       # 'ring' | 'ulysses' (all-to-all head scatter)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -32,7 +38,22 @@ class SelfAttention(nn.Module):
         q, k, v = jnp.split(qkv.reshape(B, T, 3, H, D), 3, axis=2)
         q, k, v = (t.squeeze(2) for t in (q, k, v))
         if self.seq_axis is not None:
-            o = ring_attention(q, k, v, self.seq_axis, causal=self.causal)
+            if self.seq_impl == "ulysses":
+                o = ulysses_attention(q, k, v, self.seq_axis,
+                                      causal=self.causal,
+                                      use_flash=self.use_flash)
+            elif self.seq_impl == "ring":
+                # NOTE the flash ring kernel needs shard_map(check_vma=False)
+                # (pallas outputs carry no vma annotation) — use the wrappers
+                # in parallel/ring_attention.py for that; engines relying on
+                # vma-aware grad transposes (fedavg_seq) reject use_flash.
+                o = (ring_attention_flash(q, k, v, self.seq_axis,
+                                          causal=self.causal)
+                     if self.use_flash else
+                     ring_attention(q, k, v, self.seq_axis, causal=self.causal))
+            else:
+                raise ValueError(
+                    f"unknown seq_impl {self.seq_impl!r} (ring | ulysses)")
         elif self.use_flash:
             from fedml_tpu.ops import flash_attention
 
@@ -49,12 +70,14 @@ class Block(nn.Module):
     causal: bool = True
     seq_axis: str | None = None
     use_flash: bool = False
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.LayerNorm()(x)
         x = x + SelfAttention(self.num_heads, self.head_dim, self.causal,
-                              self.seq_axis, self.use_flash)(h, train)
+                              self.seq_axis, self.use_flash,
+                              self.seq_impl)(h, train)
         h = nn.LayerNorm()(x)
         C = x.shape[-1]
         m = nn.Dense(self.mlp_ratio * C)(h)
@@ -72,6 +95,7 @@ class TransformerLM(nn.Module):
     causal: bool = True
     seq_axis: str | None = None
     use_flash: bool = False
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -89,6 +113,6 @@ class TransformerLM(nn.Module):
         for _ in range(self.depth):
             x = Block(self.num_heads, self.dim // self.num_heads,
                       causal=self.causal, seq_axis=self.seq_axis,
-                      use_flash=self.use_flash)(x, train)
+                      use_flash=self.use_flash, seq_impl=self.seq_impl)(x, train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

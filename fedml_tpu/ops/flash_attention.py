@@ -31,6 +31,17 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct inheriting ``like``'s varying-manual-axes (vma): a
+    pallas_call's out_shape carries no vma by default, which fails
+    shard_map(check_vma=True) — the default in the SPMD engines. Outputs
+    vary exactly as the operand does."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -96,8 +107,8 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
         functools.partial(_fwd_kernel, block_k=block_k, seq_len=T,
                           causal=causal, scale=scale),
         out_shape=(
-            jax.ShapeDtypeStruct((BH, Tpad, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tpad), jnp.float32),
+            _sds((BH, Tpad, D), q.dtype, qf),
+            _sds((BH, Tpad), jnp.float32, qf),
         ),
         grid=grid,
         in_specs=[
@@ -244,7 +255,7 @@ def _bwd_rule(causal, block_q, block_k, res, gs):
     dqf = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, seq_len=T,
                           causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((BH, Tpad, D), q.dtype),
+        out_shape=_sds((BH, Tpad, D), q.dtype, qf),
         grid=(BH, Tpad // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
@@ -263,8 +274,8 @@ def _bwd_rule(causal, block_q, block_k, res, gs):
         functools.partial(_bwd_dkv_kernel, block_q=block_q, seq_len=T,
                           causal=causal, scale=scale),
         out_shape=(
-            jax.ShapeDtypeStruct((BH, Tpad, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, Tpad, D), v.dtype),
+            _sds((BH, Tpad, D), k.dtype, kf),
+            _sds((BH, Tpad, D), v.dtype, vf),
         ),
         grid=(BH, Tpad // block_k),
         in_specs=[

@@ -71,3 +71,46 @@ def test_seq_mesh_validation(seq_data):
                        client_num_per_round=4, batch_size=6, lr=0.1)
     with pytest.raises(ValueError, match="divisible"):
         FedAvgSeqAPI(seq_data, _model_ctor, cfg, mesh=_mesh(1, 3))
+
+
+def test_seq_parallel_ulysses_equals_single_device(seq_data):
+    """Ulysses (all-to-all head scatter) as the seq impl: same mesh ==
+    single-device equivalence as the ring path (heads % seq shards == 0)."""
+    def ctor(seq_axis):
+        return TransformerLM(vocab_size=32, dim=16, depth=1, num_heads=2,
+                             max_len=16, seq_axis=seq_axis, seq_impl="ulysses")
+
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=100, seed=0)
+    oracle = FedAvgAPI(seq_data, sequence_task(ctor(None)), cfg)
+    sp = FedAvgSeqAPI(seq_data, ctor, cfg, mesh=_mesh(2, 2))
+    for r in range(2):
+        oracle.run_round(r)
+        sp.run_round(r)
+    rel = float(tree_global_norm(tree_sub(oracle.net.params, sp.net.params))
+                ) / float(tree_global_norm(oracle.net.params))
+    assert rel < 1e-5, rel
+
+
+def test_seq_parallel_fedopt_server(seq_data):
+    """FedOpt-style server optimizer on the long-context engine: server
+    SGD(lr=1, momentum=0) on the pseudo-gradient == plain FedAvg."""
+    from fedml_tpu.algorithms.fedopt import (make_fedopt_server_update,
+                                             make_server_optimizer)
+
+    tx = make_server_optimizer("sgd", 1.0, 0.0)
+    server_update = make_fedopt_server_update(tx)
+
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=100, seed=0)
+    plain = FedAvgSeqAPI(seq_data, _model_ctor, cfg, mesh=_mesh(2, 2))
+    opt = FedAvgSeqAPI(seq_data, _model_ctor, cfg, mesh=_mesh(2, 2),
+                       server_update=server_update, server_opt_init=tx.init)
+    for r in range(2):
+        plain.run_round(r)
+        opt.run_round(r)
+    rel = float(tree_global_norm(tree_sub(plain.net.params, opt.net.params))
+                ) / float(tree_global_norm(plain.net.params))
+    assert rel < 1e-6, rel
