@@ -93,6 +93,14 @@ class FedAvgSeqAPI:
                 "engine; use the plain ring or ulysses impls (the Pallas "
                 "flash path is available via the standalone sharded "
                 "attention wrappers)")
+        if (getattr(sharded_model, "seq_impl", "ring") == "ulysses"
+                and getattr(sharded_model, "num_heads", None) is not None
+                and sharded_model.num_heads % mesh.shape["seq"] != 0):
+            # fail at construction with the real reason, not a low-level
+            # all_to_all split error mid-trace
+            raise ValueError(
+                f"ulysses needs num_heads ({sharded_model.num_heads}) "
+                f"divisible by the seq axis ({mesh.shape['seq']})")
         self.task_sharded = sequence_task(sharded_model, pad_id=pad_id,
                                           seq_axis="seq")
         self.eval_fn = make_eval_fn(self.task_plain)

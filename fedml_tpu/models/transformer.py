@@ -43,8 +43,8 @@ class SelfAttention(nn.Module):
                                       causal=self.causal,
                                       use_flash=self.use_flash)
             elif self.seq_impl == "ring":
-                # NOTE the flash ring kernel needs shard_map(check_vma=False)
-                # (pallas outputs carry no vma annotation) — use the wrappers
+                # NOTE flash under shard_map needs check_vma=False (its VJP's
+                # dynamic_slices trip the strict vma rule) — use the wrappers
                 # in parallel/ring_attention.py for that; engines relying on
                 # vma-aware grad transposes (fedavg_seq) reject use_flash.
                 o = (ring_attention_flash(q, k, v, self.seq_axis,

@@ -34,8 +34,11 @@ NEG_INF = -1e30
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct inheriting ``like``'s varying-manual-axes (vma): a
     pallas_call's out_shape carries no vma by default, which fails
-    shard_map(check_vma=True) — the default in the SPMD engines. Outputs
-    vary exactly as the operand does."""
+    shard_map(check_vma=True) at the kernel boundary. Necessary but not yet
+    sufficient for flash under check_vma=True — the custom VJP's
+    dynamic_slices still trip the strict vma-match rule, so callers
+    currently wrap flash in shard_map(check_vma=False); this typing is one
+    prerequisite removed for when that rule relaxes."""
     vma = getattr(jax.typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
