@@ -43,6 +43,10 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed,
             fd = _load_landmarks_csv(data_dir, spec, n_clients)
             if fd is not None:
                 return fd
+        if name == "imagenet":
+            fd = _load_imagenet_folder(data_dir, spec, n_clients)
+            if fd is not None:
+                return fd
         if name in ("stackoverflow_nwp", "stackoverflow_lr"):
             fd = _load_stackoverflow_h5(data_dir, spec, n_clients)
             if fd is not None:
@@ -119,6 +123,75 @@ def _load_tff_h5(data_dir, spec, n_clients):
         TX = TX[..., None]
     return FederatedData(X, np.concatenate(tr_y), TX, np.concatenate(te_y),
                          idx_map, te_map, spec.num_classes)
+
+
+def _load_imagenet_folder(data_dir, spec, n_clients, image_size=(64, 64),
+                          max_per_class=64):
+    """ImageNet ILSVRC layout: ``train/<wnid>/*.JPEG`` (+ optional
+    ``val/<wnid>/*``). Mirror of fedml_api/data_preprocessing/ImageNet/
+    data_loader.py: sorted wnids become class ids and clients take whole
+    classes round-robin (the federated-ImageNet convention — each client
+    holds a disjoint label subset). Decoding is PIL-gated; images are
+    resized to ``image_size`` and capped at ``max_per_class`` so a full
+    ILSVRC tree loads at study scale rather than 150 GB."""
+    train_dir = os.path.join(data_dir, "train")
+    if not os.path.isdir(train_dir):
+        return None
+    wnids = sorted(d for d in os.listdir(train_dir)
+                   if os.path.isdir(os.path.join(train_dir, d)))
+    if not wnids:
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+
+    exts = (".jpeg", ".jpg", ".png")
+
+    def read_split(split_dir):
+        xs, ys = [], []
+        for cls, wnid in enumerate(wnids):
+            d = os.path.join(split_dir, wnid)
+            if not os.path.isdir(d):
+                continue
+            names = [n for n in sorted(os.listdir(d))
+                     if n.lower().endswith(exts)]  # filter BEFORE capping so
+            for name in names[:max_per_class]:     # junk can't starve a class
+                try:
+                    with Image.open(os.path.join(d, name)) as im:
+                        arr = np.asarray(
+                            im.convert("RGB").resize(image_size), np.float32
+                        ) / 255.0
+                except OSError:
+                    continue  # truncated image
+                xs.append(arr)
+                ys.append(cls)
+        if not xs:
+            return None, None
+        return np.stack(xs), np.asarray(ys, np.int64)
+
+    X, Y = read_split(train_dir)
+    if X is None:
+        return None
+    TX, TY = read_split(os.path.join(data_dir, "val"))
+    if TX is None:
+        # no val split shipped: hold out every 5th row as test and REMOVE it
+        # from train (train/test must stay disjoint)
+        held = np.zeros(len(X), bool)
+        held[::5] = True
+        TX, TY = X[held], Y[held]
+        X, Y = X[~held], Y[~held]
+
+    # whole classes round-robin; a client count above the class count would
+    # leave empty clients (an all-empty sampled round would zero the model),
+    # so the client count is capped at the number of classes on disk
+    n_eff = min(n_clients, len(wnids))
+    idx_map: dict[int, list] = {k: [] for k in range(n_eff)}
+    for cls in range(len(wnids)):
+        rows = np.nonzero(Y == cls)[0]
+        idx_map[cls % n_eff].extend(rows.tolist())
+    idx_map = {k: np.asarray(v, np.int64) for k, v in idx_map.items()}
+    return FederatedData(X, Y, TX, TY, idx_map, None, len(wnids))
 
 
 def _load_landmarks_csv(data_dir, spec, n_clients, image_size=(64, 64)):

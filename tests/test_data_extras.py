@@ -149,3 +149,57 @@ def test_stackoverflow_h5_reader(tmp_path):
     assert lr.train_x.shape[0] == 6 and lr.train_y.shape[0] == 6
     assert lr.train_y.min() >= 0 and lr.train_y.max() == 1.0  # multi-hot
     assert np.isclose(lr.train_x.sum(-1), 1.0).all()  # normalized bow
+
+
+def test_imagenet_folder_reader(tmp_path):
+    """ILSVRC-layout reader: sorted wnids -> class ids, whole classes
+    round-robin across clients, val split used for test when present."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for split, n_img in (("train", 4), ("val", 2)):
+        for wnid in ("n01440764", "n01443537", "n01484850"):
+            d = tmp_path / split / wnid
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(n_img):
+                Image.fromarray(
+                    rng.randint(0, 255, (80, 90, 3), np.uint8)
+                ).save(d / f"{wnid}_{i}.JPEG")
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("imagenet", data_dir=str(tmp_path), client_num=2)
+    assert fd.class_num == 3
+    assert fd.train_x.shape == (12, 64, 64, 3) and fd.train_x.max() <= 1.0
+    assert fd.test_x.shape == (6, 64, 64, 3)
+    # classes round-robin: client 0 holds classes {0, 2}, client 1 holds {1}
+    assert sorted(np.unique(fd.train_y[fd.train_idx_map[0]])) == [0, 2]
+    assert sorted(np.unique(fd.train_y[fd.train_idx_map[1]])) == [1]
+
+
+def test_imagenet_folder_reader_no_val_and_caps(tmp_path):
+    """Val-missing fallback keeps train/test DISJOINT; client count is
+    capped at the class count (no empty clients); junk files can't starve
+    the per-class cap."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(1)
+    for wnid in ("n1", "n2"):
+        d = tmp_path / "train" / wnid
+        d.mkdir(parents=True)
+        (d / "._junk").write_bytes(b"x" * 10)  # sorts first, not an image
+        (d / "checksums.txt").write_text("abc")
+        for i in range(5):
+            Image.fromarray(
+                rng.randint(0, 255, (40, 40, 3), np.uint8)
+            ).save(d / f"img_{i}.JPEG")
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("imagenet", data_dir=str(tmp_path), client_num=8)
+    assert len(fd.train_idx_map) == 2  # capped at class count, none empty
+    assert all(len(v) > 0 for v in fd.train_idx_map.values())
+    assert len(fd.train_x) + len(fd.test_x) == 10  # junk skipped, disjoint
+    assert len(fd.test_x) == 2  # every 5th of 10 held out
