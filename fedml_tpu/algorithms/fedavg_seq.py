@@ -130,29 +130,36 @@ class FedAvgSeqAPI:
         self.history: list[dict] = []
 
     # ---------------------------------------------------------------- round
+    def _per_round(self, net, opt, keys, x, y, mask, nsamp):
+        """Shared per-round body of the single-round fn AND the scan block
+        (their numeric identity is test-enforced). Runs INSIDE shard_map:
+        per-device block is [K/cd] clients x [.., T/sd] sequence slices.
+        Params stay seq-INVARIANT (the vma-aware grad transpose restores
+        invariance each step) and become clients-varying for the fits."""
+        net_v = jax.tree.map(
+            lambda v: jax.lax.pcast(v, "clients", to="varying"), net)
+        nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
+            keys, net_v, x, y, mask)
+        # metrics are already seq-psum-ed inside the task (identical on
+        # every seq shard); aggregate clients with the shared helper
+        avg, msum = _shard_aggregate(nets, metrics, nsamp, "clients")
+        new_net, new_opt = self.server_update(net, avg, opt)
+        return new_net, new_opt, msum
+
     def _build_round_fn(self):
         mesh = self.mesh
         client_keys = _make_client_keys(self.cfg.seed)
 
-        def body(keys, net, x, y, mask, nsamp):
-            # per-device block: [K/cd] clients x [.., T/sd] sequence slices.
-            # params stay seq-INVARIANT (grad psum restores invariance after
-            # each step) and become clients-varying for the per-client fits.
-            net_v = jax.tree.map(
-                lambda v: jax.lax.pcast(v, "clients", to="varying"), net)
-            nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
-                keys, net_v, x, y, mask)
-            # metrics are already seq-psum-ed inside the task (identical on
-            # every seq shard); aggregate clients with the shared helper
-            return _shard_aggregate(nets, metrics, nsamp, "clients")
+        def body(keys, net, opt, x, y, mask, nsamp):
+            return self._per_round(net, opt, keys, x, y, mask, nsamp)
 
         smapped = jax.shard_map(
             body, mesh=mesh,
-            in_specs=(P("clients"), P(),
+            in_specs=(P("clients"), P(), P(),
                       P("clients", None, None, "seq"),
                       P("clients", None, None, "seq"),
                       P("clients"), P("clients")),
-            out_specs=(P(), P()),
+            out_specs=(P(), P(), P()),
         )
 
         @jax.jit
@@ -160,11 +167,68 @@ class FedAvgSeqAPI:
             keys = client_keys(round_idx, ids)
             # seq shards hold duplicate metric copies psum-ed over 'clients'
             # only; the seq axis saw identical (invariant) values
-            avg, metrics = smapped(keys, net, x, y, mask, nsamp)
-            new_net, new_opt = self.server_update(net, avg, server_opt_state)
-            return new_net, new_opt, metrics
+            return smapped(keys, net, server_opt_state, x, y, mask, nsamp)
 
         return round_fn
+
+    def run_rounds(self, start_round: int, num_rounds: int):
+        """R rounds as ONE compiled program: lax.scan over rounds inside the
+        two-axis shard_map (the long-context analogue of FedAvgAPI.run_rounds
+        — host fully out of the loop for the block). Numerically identical to
+        sequential run_round calls (same key chain; test-enforced)."""
+        cfg = self.cfg
+        xs, ys, ms, ns, ids_l = [], [], [], [], []
+        for r in range(start_round, start_round + num_rounds):
+            ids = sample_clients(r, cfg.client_num_in_total,
+                                 cfg.client_num_per_round, cfg.seed)
+            cb = pad_batches(
+                pack_clients(self.data, ids, cfg.batch_size,
+                             max_batches=self.num_batches, seed=cfg.seed,
+                             round_idx=r),
+                self.num_batches)
+            xs.append(cb.x); ys.append(cb.y); ms.append(cb.mask)
+            ns.append(cb.num_samples)
+            ids_l.append(np.asarray(ids, np.int32))
+        sh = lambda spec: NamedSharding(self.mesh, spec)
+        x = jax.device_put(np.stack(xs), sh(P(None, "clients", None, None, "seq")))
+        y = jax.device_put(np.stack(ys), sh(P(None, "clients", None, None, "seq")))
+        mask = jax.device_put(np.stack(ms), sh(P(None, "clients")))
+        nsamp = jax.device_put(np.stack(ns), sh(P(None, "clients")))
+        ids = jax.device_put(np.stack(ids_l), sh(P(None, "clients")))
+        rounds = jnp.arange(start_round, start_round + num_rounds, dtype=jnp.int32)
+        if not hasattr(self, "_block_fn"):
+            self._block_fn = self._build_block_fn()
+        self.net, self.server_opt_state, metrics = self._block_fn(
+            self.net, self.server_opt_state, x, y, mask, nsamp, ids, rounds)
+        return metrics
+
+    def _build_block_fn(self):
+        mesh = self.mesh
+        client_keys = _make_client_keys(self.cfg.seed)
+
+        def shard_block(net, opt, x, y, mask, nsamp, ids, rounds):
+            def step(carry, inp):
+                net, opt = carry
+                x_r, y_r, m_r, ns_r, ids_r, r = inp
+                keys = client_keys(r, ids_r)
+                net, opt, msum = self._per_round(
+                    net, opt, keys, x_r, y_r, m_r, ns_r)
+                return (net, opt), msum
+
+            (net, opt), ms = jax.lax.scan(
+                step, (net, opt), (x, y, mask, nsamp, ids, rounds))
+            return net, opt, ms
+
+        smapped = jax.shard_map(
+            shard_block, mesh=mesh,
+            in_specs=(P(), P(),
+                      P(None, "clients", None, None, "seq"),
+                      P(None, "clients", None, None, "seq"),
+                      P(None, "clients"), P(None, "clients"),
+                      P(None, "clients"), P()),
+            out_specs=(P(), P(), P()),
+        )
+        return jax.jit(smapped)
 
     def run_round(self, round_idx: int):
         cfg = self.cfg

@@ -162,8 +162,12 @@ def _load_imagenet_folder(data_dir, spec, n_clients, image_size=(64, 64),
                         arr = np.asarray(
                             im.convert("RGB").resize(image_size), np.float32
                         ) / 255.0
-                except OSError:
-                    continue  # truncated image
+                except Exception:  # noqa: BLE001 — truncated/bomb/degenerate
+                    # image; anything narrower (OSError) would let e.g.
+                    # DecompressionBombError escape to try_load's blanket
+                    # except and silently swap the WHOLE dataset for the
+                    # synthetic fallback
+                    continue
                 xs.append(arr)
                 ys.append(cls)
         if not xs:
@@ -182,14 +186,18 @@ def _load_imagenet_folder(data_dir, spec, n_clients, image_size=(64, 64),
         TX, TY = X[held], Y[held]
         X, Y = X[~held], Y[~held]
 
-    # whole classes round-robin; a client count above the class count would
-    # leave empty clients (an all-empty sampled round would zero the model),
-    # so the client count is capped at the number of classes on disk
-    n_eff = min(n_clients, len(wnids))
+    # whole classes round-robin; empty clients are forbidden (an all-empty
+    # sampled round would zero the model), so the cap counts classes with at
+    # least one TRAIN row after the holdout — not wnid directories, which
+    # can be empty or lose their only image to val
+    present = np.unique(Y)
+    n_eff = min(n_clients, len(present))
+    if n_eff == 0:
+        return None
     idx_map: dict[int, list] = {k: [] for k in range(n_eff)}
-    for cls in range(len(wnids)):
+    for j, cls in enumerate(present):
         rows = np.nonzero(Y == cls)[0]
-        idx_map[cls % n_eff].extend(rows.tolist())
+        idx_map[j % n_eff].extend(rows.tolist())
     idx_map = {k: np.asarray(v, np.int64) for k, v in idx_map.items()}
     return FederatedData(X, Y, TX, TY, idx_map, None, len(wnids))
 

@@ -114,3 +114,20 @@ def test_seq_parallel_fedopt_server(seq_data):
     rel = float(tree_global_norm(tree_sub(plain.net.params, opt.net.params))
                 ) / float(tree_global_norm(plain.net.params))
     assert rel < 1e-6, rel
+
+
+def test_seq_run_rounds_block_equals_sequential(seq_data):
+    """The R-round scan block on the two-axis mesh == R sequential
+    run_round calls (same fold_in chain, same packing, same psums)."""
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=100, seed=0)
+    seq = FedAvgSeqAPI(seq_data, _model_ctor, cfg, mesh=_mesh(2, 2))
+    for r in range(3):
+        seq.run_round(r)
+    blk = FedAvgSeqAPI(seq_data, _model_ctor, cfg, mesh=_mesh(2, 2))
+    ms = blk.run_rounds(0, 3)
+    assert ms["count"].shape == (3,)
+    rel = float(tree_global_norm(tree_sub(seq.net.params, blk.net.params))
+                ) / float(tree_global_norm(seq.net.params))
+    assert rel < 1e-6, rel
