@@ -65,6 +65,7 @@ class FedAvgSeqAPI:
         pad_id: int = 0,
         server_update=None,
         server_opt_init=None,
+        local_spec: LocalSpec | None = None,
     ):
         if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
             raise ValueError(
@@ -110,9 +111,13 @@ class FedAvgSeqAPI:
         self.num_batches = min(config.max_batches or b_needed, b_needed)
 
         # no explicit grad psum: the task's seq-psum-ed loss + seq-invariant
-        # params make shard_map's transpose insert it (see core/local.py)
-        spec = LocalSpec(optimizer=make_client_optimizer(config),
-                         epochs=config.epochs)
+        # params make shard_map's transpose insert it (see core/local.py).
+        # local_spec composes variants exactly as on FedAvgAPI — e.g. a
+        # prox_mu>0 spec gives FedProx on long context (the proximal term is
+        # over seq-invariant params: identical on every shard, no collective;
+        # equivalence test-enforced)
+        spec = local_spec or LocalSpec(
+            optimizer=make_client_optimizer(config), epochs=config.epochs)
         self.local_update = make_local_update(self.task_sharded, spec)
 
         self.rng, init_key = jax.random.split(self.rng)
