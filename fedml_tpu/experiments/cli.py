@@ -27,7 +27,8 @@ import time
 def add_args(parser: argparse.ArgumentParser):
     # core flag surface (main_fedavg.py:48-119 parity)
     parser.add_argument("--algo", type=str, default="fedavg",
-                        choices=["fedavg", "fedopt", "fedprox", "fednova",
+                        choices=["fedavg", "fedavg_seq", "fedopt", "fedprox",
+                                 "fednova",
                                  "fedavg_robust", "hierarchical", "feddf",
                                  "feddf_hard", "fedcon", "fedavg_affinity", "fednas",
                                  "decentralized", "centralized", "turboaggregate",
@@ -55,6 +56,14 @@ def add_args(parser: argparse.ArgumentParser):
     # TPU execution surface (replaces --backend/--gpu_mapping/--is_mobile)
     parser.add_argument("--mesh", type=int, default=0,
                         help="devices on the 'clients' mesh axis; 0 = single-device vmap")
+    parser.add_argument("--seq_shards", type=int, default=2,
+                        help="fedavg_seq: devices on the 'seq' axis (the "
+                             "'clients' axis gets --mesh/seq_shards)")
+    parser.add_argument("--seq_impl", type=str, default="ring",
+                        choices=["ring", "ulysses"])
+    parser.add_argument("--lm_dim", type=int, default=64)
+    parser.add_argument("--lm_depth", type=int, default=2)
+    parser.add_argument("--lm_heads", type=int, default=4)
     parser.add_argument("--max_batches", type=int, default=None)
     parser.add_argument("--device_data", type=int, default=0,
                         help="1 = HBM-resident train set + per-round index blocks")
@@ -199,11 +208,6 @@ def build_api(args):
             gcfg, num_classes=spec.num_classes,
         ), data
 
-    model = create_model(args.model, output_dim=spec.num_classes)
-    task = {"classification": classification_task,
-            "sequence": sequence_task,
-            "tags": tag_prediction_task}[spec.task](model)
-
     cfg = FedAvgConfig(
         comm_round=args.comm_round, client_num_in_total=n_total,
         client_num_per_round=min(args.client_num_per_round, n_total),
@@ -216,6 +220,43 @@ def build_api(args):
         eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
                           else None),
     )
+    if args.algo == "fedavg_seq":
+        from fedml_tpu.algorithms.fedavg_seq import FedAvgSeqAPI
+        from fedml_tpu.models.transformer import TransformerLM
+
+        if spec.task != "sequence":
+            raise ValueError("fedavg_seq needs a sequence dataset "
+                             "(shakespeare / fed_shakespeare / stackoverflow_nwp)")
+        avail = len(jax.devices())
+        # NOTE --mesh 0 means "all devices" here (a 2-axis mesh has no
+        # single-device vmap analogue), unlike the 1-axis algos
+        n_dev = args.mesh or avail
+        sd = max(1, args.seq_shards)
+        if n_dev > avail:
+            raise ValueError(f"--mesh {n_dev} exceeds {avail} devices")
+        if n_dev % sd != 0:
+            raise ValueError(
+                f"--mesh {n_dev} not divisible by --seq_shards {sd} "
+                "(devices would be silently dropped)")
+        cd = n_dev // sd
+        smesh = Mesh(np.asarray(jax.devices()[: cd * sd]).reshape(cd, sd),
+                     ("clients", "seq"))
+        T = int(spec.input_shape[0])
+        log.info("fedavg_seq mesh: %d client-shards x %d seq-shards (T=%d)",
+                 cd, sd, T)
+        return FedAvgSeqAPI(
+            data,
+            lambda seq_axis: TransformerLM(
+                vocab_size=spec.num_classes, dim=args.lm_dim,
+                depth=args.lm_depth, num_heads=args.lm_heads, max_len=T,
+                seq_axis=seq_axis, seq_impl=args.seq_impl),
+            cfg, mesh=smesh), data
+
+    model = create_model(args.model, output_dim=spec.num_classes)
+    task = {"classification": classification_task,
+            "sequence": sequence_task,
+            "tags": tag_prediction_task}[spec.task](model)
+
     mesh = None
     if args.mesh and args.algo != "hierarchical":
         # hierarchical builds its own 2-axis ('groups','clients') mesh below
