@@ -26,6 +26,12 @@ for spec in "fedavg mnist lr" "fedopt femnist cnn" "fedprox cifar10 resnet56" \
     --batch_size 8 --max_batches 2 --ci 1 --frequency_of_the_test 1
 done
 
+echo "== long-context smoke (fedavg_seq on a 4x2 mesh) =="
+python -m fedml_tpu.experiments.cli --algo fedavg_seq --dataset fed_shakespeare \
+  --client_num_in_total 8 --client_num_per_round 4 --comm_round 2 \
+  --batch_size 4 --lr 0.3 --mesh 8 --seq_shards 2 --max_batches 2 \
+  --frequency_of_the_test 1 --ci 1
+
 echo "== cross-process smoke (loopback launcher roles) =="
 python - <<'PY'
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
