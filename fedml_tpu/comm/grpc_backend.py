@@ -67,7 +67,12 @@ class GrpcCommManager(BaseCommManager):
         # set by (src, epoch) keeps redelivery detection restart-safe (the
         # server checkpoint-resume path relaunches the process mid-job)
         self._epoch = secrets.randbits(64)
-        self._seen: dict[tuple[int, int], set[int]] = {}
+        # per-(src,epoch) dedup state: (seen-set, watermark). Everything at or
+        # below the watermark is known-seen even after set eviction, so a
+        # frame redelivered arbitrarily late can never be re-accepted — the
+        # window violation is impossible, not just assumed away by in-order
+        # sending.
+        self._seen: dict[tuple[int, int], tuple[set[int], int]] = {}
         self._seen_lock = threading.Lock()
         self._send_lock = threading.Lock()
 
@@ -85,18 +90,9 @@ class GrpcCommManager(BaseCommManager):
             src = int.from_bytes(hdr[:8], "little")
             epoch = int.from_bytes(hdr[8:16], "little")
             seq = int.from_bytes(hdr[16:], "little")
-            with self._seen_lock:
-                seen = self._seen.setdefault((src, epoch), set())
-                if seq in seen:
-                    log.warning("drop duplicate frame %d from rank %d", seq, src)
-                    return b"dup"
-                seen.add(seq)
-                if len(seen) > 4096:  # bounded memory; senders are in-order
-                    for s in sorted(seen)[:2048]:
-                        seen.discard(s)
-                stale = [k for k in self._seen if k[0] == src and k != (src, epoch)]
-                for k in stale[:-1]:  # keep at most the 2 newest epochs per src
-                    del self._seen[k]
+            if not self._accept_frame(src, epoch, seq):
+                log.warning("drop duplicate frame %d from rank %d", seq, src)
+                return b"dup"
             self._enqueue(Message.from_bytes(frame))
             return b"ok"
 
@@ -115,6 +111,36 @@ class GrpcCommManager(BaseCommManager):
             raise RuntimeError(f"grpc: cannot bind {host}:{base_port + rank}")
         self._server.start()
         log.info("rank %d serving on %s:%d", rank, host, self._port)
+
+    def _accept_frame(self, src: int, epoch: int, seq: int) -> bool:
+        """Exactly-once gate. True = first delivery; False = duplicate.
+
+        State per (src, epoch): (gap-set, watermark) where every seq <=
+        watermark is known-seen. The watermark advances over contiguous
+        prefixes (O(1) memory for in-order senders); if pathological gaps
+        grow the set past 4096, the lowest half is evicted INTO the
+        watermark, so evicted seqs remain known-seen — a frame redelivered
+        arbitrarily late can never be re-accepted (the trade is that a
+        genuinely new frame >4096 out of order is dropped, which in-order
+        senders never produce)."""
+        with self._seen_lock:
+            seen, wm = self._seen.setdefault((src, epoch), (set(), -1))
+            if seq <= wm or seq in seen:
+                return False
+            seen.add(seq)
+            while wm + 1 in seen:
+                wm += 1
+                seen.discard(wm)
+            if len(seen) > 4096:
+                evicted = sorted(seen)[:2048]
+                for s in evicted:
+                    seen.discard(s)
+                wm = max(wm, evicted[-1])
+            self._seen[(src, epoch)] = (seen, wm)
+            stale = [k for k in self._seen if k[0] == src and k != (src, epoch)]
+            for k in stale[:-1]:  # keep at most the 2 newest epochs per src
+                del self._seen[k]
+        return True
 
     def _stub(self, dest: int):
         if dest not in self._channels:

@@ -45,7 +45,8 @@ def make_comm_manager(backend: str, rank: int, size: int, **kw) -> BaseCommManag
         from fedml_tpu.comm.mqtt_backend import MqttCommManager
 
         return MqttCommManager(
-            kw.get("broker_host", "127.0.0.1"), kw.get("broker_port", 1883), rank, size - 1
+            kw.get("broker_host", "127.0.0.1"), kw.get("broker_port", 1883),
+            rank, size - 1, job_id=kw.get("job_id"),
         )
     raise ValueError(f"unknown backend {backend!r} (LOOPBACK|GRPC|MQTT)")
 

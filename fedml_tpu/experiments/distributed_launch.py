@@ -61,6 +61,10 @@ def add_args(p: argparse.ArgumentParser):
                    help="--serve_broker bind address; the bundled broker is "
                         "unauthenticated, so widen to 0.0.0.0 only on "
                         "networks where every peer is trusted")
+    p.add_argument("--job_id", type=str, default=None,
+                   help="mqtt: namespaces topics so jobs sharing a "
+                        "persistent broker cannot cross-talk; every rank of "
+                        "a job must pass the same value")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
     p.add_argument("--ckpt_dir", type=str, default=None,
@@ -183,7 +187,8 @@ def main(argv=None):
     if args.backend == "grpc":
         backend_kw.update(base_port=args.base_port, ip_table=args.ip_config)
     elif args.backend == "mqtt":
-        backend_kw.update(broker_host=args.broker_host, broker_port=args.broker_port)
+        backend_kw.update(broker_host=args.broker_host,
+                          broker_port=args.broker_port, job_id=args.job_id)
         if args.serve_broker and args.rank == 0:
             from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
 

@@ -364,3 +364,114 @@ def test_mqtt_retained_init_reaches_late_subscriber():
         assert got == [("s2c_init", 0)]
     finally:
         broker.close()
+
+
+def test_grpc_dedup_watermark_survives_eviction():
+    """A frame redelivered after >4096 newer frames from the same
+    (src, epoch) must still be rejected: eviction folds old seqs into the
+    watermark instead of forgetting them."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    base = 57000 + (int(time.time()) % 500)
+    m = GrpcCommManager(rank=0, size=1, base_port=base)
+    try:
+        assert m._accept_frame(1, 42, 1)
+        assert not m._accept_frame(1, 42, 1)  # plain duplicate
+        # in-order flood: watermark advances, set stays tiny
+        for s in range(2, 5002):
+            assert m._accept_frame(1, 42, s)
+        assert not m._accept_frame(1, 42, 1)      # ancient redelivery
+        assert not m._accept_frame(1, 42, 3000)   # mid-stream redelivery
+        seen, wm = m._seen[(1, 42)]
+        assert wm == 5001 and len(seen) == 0
+        # pathological gaps: >4096 non-contiguous seqs force eviction, and
+        # eviction must fold into the watermark, not re-open old seqs
+        for s in range(10_000, 10_000 + 12_000, 2):  # 6000 gapped inserts
+            assert m._accept_frame(1, 42, s)
+        seen, wm = m._seen[(1, 42)]
+        assert len(seen) <= 4096        # memory stayed bounded -> eviction ran
+        assert wm >= 10_000             # evicted seqs folded into watermark
+        assert not m._accept_frame(1, 42, 10_000)   # evicted seq still dup
+        assert not m._accept_frame(1, 42, wm)       # watermark boundary dup
+    finally:
+        m.stop_receive_message()
+
+
+def test_mqtt_uplink_not_retained_and_downlinks_cleared():
+    """Persistent-broker safety: a client upload must NOT outlive the job as
+    a retained frame (a later run's server would aggregate a stale model),
+    and a cleanly-stopped server clears its retained downlinks."""
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+    from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
+
+    broker = MiniMqttBroker()
+    try:
+        server = MqttCommManager("127.0.0.1", broker.port, client_id=0, client_num=1)
+        c1 = MqttCommManager("127.0.0.1", broker.port, client_id=1, client_num=1)
+        time.sleep(0.2)
+        down = Message("s2c_init", 0, 1)
+        down.add_params("round", 0)
+        server.send_message(down)  # retained (boot-race fix)
+        up = Message("c2s_model", 1, 0)
+        up.add_params("w", [np.ones((2, 2), np.float32)])
+        c1.send_message(up)  # must NOT be retained
+        time.sleep(0.3)
+        assert "fedml0_1" in broker._retained      # downlink retained
+        assert "fedml_1" not in broker._retained   # uplink not retained
+
+        # "next run": a fresh server subscribing must receive nothing
+        got = []
+        server2 = MqttCommManager("127.0.0.1", broker.port, client_id=0, client_num=1)
+
+        class Sink:
+            def receive_message(self, t, p):
+                got.append(t)
+
+        server2.add_observer(Sink())
+        t = threading.Thread(target=server2.handle_receive_message, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert got == []  # no stale final-round upload counted toward round 0
+
+        server.stop_receive_message()  # clears its retained downlinks
+        time.sleep(0.3)
+        assert "fedml0_1" not in broker._retained
+        server2.stop_receive_message()
+        c1.stop_receive_message()
+        t.join(timeout=5)
+    finally:
+        broker.close()
+
+
+def test_mqtt_job_namespace_isolates_runs():
+    """Two jobs sharing one broker with distinct job_ids must not cross-talk
+    even though both use the reference topic scheme underneath."""
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+    from fedml_tpu.comm.mqtt_mini import MiniMqttBroker
+
+    broker = MiniMqttBroker()
+    try:
+        sA = MqttCommManager("127.0.0.1", broker.port, 0, 1, job_id="jobA")
+        cB = MqttCommManager("127.0.0.1", broker.port, 1, 1, job_id="jobB")
+        got = []
+
+        class Sink:
+            def receive_message(self, t, p):
+                got.append(t)
+
+        cB.add_observer(Sink())
+        t = threading.Thread(target=cB.handle_receive_message, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        down = Message("s2c_init", 0, 1)
+        down.add_params("round", 0)
+        sA.send_message(down)  # jobA downlink; jobB client must not see it
+        time.sleep(0.4)
+        assert got == []
+        assert "jobA/fedml0_1" in broker._retained
+        sA.stop_receive_message()
+        cB.stop_receive_message()
+        t.join(timeout=5)
+    finally:
+        broker.close()
