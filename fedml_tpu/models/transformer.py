@@ -43,10 +43,9 @@ class SelfAttention(nn.Module):
                                       causal=self.causal,
                                       use_flash=self.use_flash)
             elif self.seq_impl == "ring":
-                # NOTE flash under shard_map needs check_vma=False (its VJP's
-                # dynamic_slices trip the strict vma rule) — use the wrappers
-                # in parallel/ring_attention.py for that; engines relying on
-                # vma-aware grad transposes (fedavg_seq) reject use_flash.
+                # flash is vma-clean under strict shard_map: Mosaic kernels
+                # carry vma-typed out_shapes on TPU, and off-TPU the op
+                # dispatches to its jnp twin (ops/flash_attention._mode)
                 o = (ring_attention_flash(q, k, v, self.seq_axis,
                                           causal=self.causal)
                      if self.use_flash else

@@ -33,16 +33,33 @@ NEG_INF = -1e30
 
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct inheriting ``like``'s varying-manual-axes (vma): a
-    pallas_call's out_shape carries no vma by default, which fails
-    shard_map(check_vma=True) at the kernel boundary. Necessary but not yet
-    sufficient for flash under check_vma=True — the custom VJP's
-    dynamic_slices still trip the strict vma-match rule, so callers
-    currently wrap flash in shard_map(check_vma=False); this typing is one
-    prerequisite removed for when that rule relaxes."""
+    pallas_call's out_shape carries no vma by default, which would fail
+    shard_map(check_vma=True) at the kernel boundary on TPU."""
     vma = getattr(jax.typeof(like), "vma", None)
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _mode(x) -> str:
+    """Which implementation serves this call.
+
+    - 'pallas' on TPU: the real Mosaic kernels (vma-typed via _sds).
+    - 'jnp' off-TPU when the inputs carry varying-manual-axes, i.e. we are
+      inside shard_map(check_vma=True): Pallas INTERPRET lowering emulates
+      the grid as a while_loop of dynamic_slices whose counters carry no
+      vma, so strict vma checking rejects it (an interpreter artifact, not
+      a property of the kernels). The jnp path is semantically identical
+      (same masking, same lse definition, same lse cotangent) and
+      vma-transparent.
+    - 'interpret' otherwise (off-TPU, no vma): the Pallas interpreter —
+      keeps the kernel logic itself under test on CPU.
+    """
+    if jax.default_backend() == "tpu":
+        return "pallas"
+    if getattr(jax.typeof(x), "vma", None):
+        return "jnp"
+    return "interpret"
 
 
 def _use_interpret() -> bool:
@@ -91,6 +108,46 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, seq_len,
     lse_ref[0] = m + jnp.log(l_safe)
 
 
+def _dense_mask(s, seq_len, causal):
+    """The kernels' _mask on the full [BH, Tpad, Tpad] score tensor."""
+    Tq, Tk = s.shape[-2], s.shape[-1]
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1)
+    ok = kpos < seq_len
+    if causal:
+        ok = jnp.logical_and(ok, kpos <= qpos)
+    return jnp.where(ok[None], s, NEG_INF)
+
+
+def _dense_fwd(qf, kf, vf, seq_len, causal, scale):
+    """jnp twin of _fwd_kernel on the padded [BH, Tpad, D] layout: same
+    masking, same l_safe floor, same lse = m + log(l) definition."""
+    s = jnp.einsum("btd,bsd->bts", qf.astype(jnp.float32),
+                   kf.astype(jnp.float32)) * scale
+    s = _dense_mask(s, seq_len, causal)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = jnp.einsum("bts,bsd->btd", p, vf.astype(jnp.float32)) / l_safe[..., None]
+    return o.astype(qf.dtype), m + jnp.log(l_safe)
+
+
+def _dense_bwd(qf, kf, vf, dof, lse, delta, glse, seq_len, causal, scale):
+    """jnp twin of the two backward kernels (recompute-P flash recurrence)."""
+    f32 = jnp.float32
+    s = jnp.einsum("btd,bsd->bts", qf.astype(f32), kf.astype(f32)) * scale
+    s = _dense_mask(s, seq_len, causal)
+    p = jnp.exp(s - lse[..., None])
+    do = dof.astype(f32)
+    dv = jnp.einsum("bts,btd->bsd", p, do)
+    dp = jnp.einsum("btd,bsd->bts", do, vf.astype(f32))
+    ds = p * (dp + (glse - delta)[..., None])
+    dq = jnp.einsum("bts,bsd->btd", ds, kf.astype(f32)) * scale
+    dk = jnp.einsum("bts,btd->bsd", ds, qf.astype(f32)) * scale
+    return dq.astype(qf.dtype), dk.astype(kf.dtype), dv.astype(vf.dtype)
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k):
     B, T, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
@@ -106,11 +163,33 @@ def _flash_fwd(q, k, v, causal, block_q, block_k):
     BH = B * H
     grid = (BH, Tpad // block_q)
 
+    if _mode(q) == "jnp":
+        o, lse = _dense_fwd(qf, kf, vf, T, causal, scale)
+        return o, lse, (qf, kf, vf)
+
+    if _mode(q) == "pallas" and getattr(jax.typeof(q), "vma", None):
+        # TPU + strict shard_map: the kernels SHOULD pass with the vma-typed
+        # out_shapes (_sds), but that combination hasn't been provable
+        # off-hardware — if Mosaic's vma rule rejects it at trace time, fall
+        # back to the XLA-fused dense path rather than failing the engine.
+        try:
+            return _pallas_fwd(qf, kf, vf, T, Tpad, BH, D, grid, causal,
+                               scale, block_q, block_k)
+        except Exception:  # noqa: BLE001 — trace-time vma rejection
+            o, lse = _dense_fwd(qf, kf, vf, T, causal, scale)
+            return o, lse, (qf, kf, vf)
+
+    return _pallas_fwd(qf, kf, vf, T, Tpad, BH, D, grid, causal, scale,
+                       block_q, block_k)
+
+
+def _pallas_fwd(qf, kf, vf, T, Tpad, BH, D, grid, causal, scale,
+                block_q, block_k):
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, block_k=block_k, seq_len=T,
                           causal=causal, scale=scale),
         out_shape=(
-            _sds((BH, Tpad, D), q.dtype, qf),
+            _sds((BH, Tpad, D), qf.dtype, qf),
             _sds((BH, Tpad), jnp.float32, qf),
         ),
         grid=grid,
@@ -246,6 +325,27 @@ def _bwd_rule(causal, block_q, block_k, res, gs):
     glse = jnp.pad(g_lse.astype(jnp.float32).reshape(BH, T),
                    ((0, 0), (0, Tpad - T)))
 
+    def dense():
+        dqf, dkf, dvf = _dense_bwd(qf, kf, vf, dof, lse, delta, glse,
+                                   T, causal, scale)
+        up = lambda x: jnp.moveaxis(x[:, :T].reshape(B, H, T, D), 1, 2)
+        return up(dqf), up(dkf), up(dvf)
+
+    mode = _mode(q)
+    if mode == "jnp":
+        return dense()
+    if mode == "pallas" and getattr(jax.typeof(q), "vma", None):
+        try:  # same trace-time fallback as _flash_fwd
+            return _pallas_bwd(qf, kf, vf, dof, lse, delta, glse, B, T, H, D,
+                               Tpad, BH, causal, scale, block_q, block_k)
+        except Exception:  # noqa: BLE001 — trace-time vma rejection
+            return dense()
+    return _pallas_bwd(qf, kf, vf, dof, lse, delta, glse, B, T, H, D,
+                       Tpad, BH, causal, scale, block_q, block_k)
+
+
+def _pallas_bwd(qf, kf, vf, dof, lse, delta, glse, B, T, H, D, Tpad, BH,
+                causal, scale, block_q, block_k):
     common_in = [
         pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
         pl.BlockSpec((1, Tpad, D), lambda b, i: (b, 0, 0), memory_space=pltpu.VMEM),
@@ -258,7 +358,7 @@ def _bwd_rule(causal, block_q, block_k, res, gs):
     dqf = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, block_k=block_k, seq_len=T,
                           causal=causal, scale=scale),
-        out_shape=_sds((BH, Tpad, D), q.dtype, qf),
+        out_shape=_sds((BH, Tpad, D), qf.dtype, qf),
         grid=(BH, Tpad // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), memory_space=pltpu.VMEM),
@@ -277,8 +377,8 @@ def _bwd_rule(causal, block_q, block_k, res, gs):
         functools.partial(_bwd_dkv_kernel, block_q=block_q, seq_len=T,
                           causal=causal, scale=scale),
         out_shape=(
-            _sds((BH, Tpad, D), k.dtype, kf),
-            _sds((BH, Tpad, D), v.dtype, vf),
+            _sds((BH, Tpad, D), kf.dtype, kf),
+            _sds((BH, Tpad, D), vf.dtype, vf),
         ),
         grid=(BH, Tpad // block_k),
         in_specs=[

@@ -140,7 +140,6 @@ def ulysses_attention_sharded(mesh: Mesh, axis_name: str = "seq",
         f, mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
-        check_vma=not use_flash,  # pallas out_shapes carry no vma
     ))
 
 
@@ -168,10 +167,15 @@ def ring_attention_flash(q, k, v, axis_name: str, causal: bool = False,
     idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    # no pcast here: the wrapper runs with check_vma=False because
-    # pallas_call out_shapes carry no vma annotation
-    o = jnp.zeros(q.shape, jnp.float32)
-    lse = jnp.full((q.shape[0], q.shape[2], q.shape[1]), -jnp.inf, jnp.float32)
+    # accumulators start device-varying (vma rule): they merge with
+    # per-rotation partials computed from this device's K/V block
+    def vary(x):
+        vma = getattr(jax.typeof(x), "vma", frozenset())
+        return x if axis_name in vma else lax.pcast(x, axis_name, to="varying")
+
+    o = vary(jnp.zeros(q.shape, jnp.float32))
+    lse = vary(jnp.full((q.shape[0], q.shape[2], q.shape[1]), -jnp.inf,
+                        jnp.float32))
 
     def merge(o, lse, o_b, lse_b):
         lse_new = jnp.logaddexp(lse, lse_b)
@@ -208,5 +212,4 @@ def ring_attention_flash_sharded(mesh: Mesh, axis_name: str = "seq",
         f, mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),
-        check_vma=False,
     ))

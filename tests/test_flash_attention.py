@@ -135,3 +135,41 @@ def test_ulysses_flash_matches_dense():
     np.testing.assert_allclose(np.asarray(f(q, k, v)),
                                np.asarray(full_attention(q, k, v, causal=True)),
                                rtol=3e-5, atol=3e-5)
+
+
+def test_flash_gradients_under_strict_vma_shard_map():
+    """flash inside shard_map(check_vma=True): the op must be vma-clean —
+    off-TPU it dispatches to its jnp twin (Pallas interpret lowering is a
+    while_loop of vma-less dynamic_slices and would be rejected), on TPU
+    the Mosaic kernels carry vma-typed out_shapes."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = jax.make_mesh((2,), ("seq",))
+    q, k, v = _rand_qkv(jax.random.PRNGKey(8), B=1, T=64, H=2, D=16)
+
+    def local_grads(q, k, v):
+        # per-shard: full attention over this device's T-slice
+        return jax.grad(
+            lambda q, k, v: jnp.sum(flash_attention(q, k, v, True, 16, 16) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+
+    f = jax.jit(jax.shard_map(
+        local_grads, mesh=mesh,
+        in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        out_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),
+        check_vma=True))
+    gs = f(q, k, v)
+
+    # oracle: the same sliced computation unsharded
+    def ref_grads(q, k, v):
+        half = q.shape[1] // 2
+        tot = 0.0
+        for s in (slice(0, half), slice(half, None)):
+            tot = tot + jnp.sum(
+                full_attention(q[:, s], k[:, s], v[:, s], causal=True) ** 2)
+        return tot
+
+    gr = jax.grad(ref_grads, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
