@@ -82,6 +82,23 @@ def _shard_aggregate(nets, metrics, nsamp, axis):
     return avg, msum
 
 
+def eval_subset(tx, ty, cfg: "FedAvgConfig", call_idx: int):
+    """Apply the eval_max_samples subset policy (see FedAvgConfig).
+    ``call_idx`` only matters in 'fresh' mode, where each eval resamples
+    (reference FedAVGAggregator.py:99-107)."""
+    if cfg.eval_max_samples is None or len(tx) <= cfg.eval_max_samples:
+        return tx, ty
+    if cfg.eval_subset_mode == "fresh":
+        rs = np.random.RandomState((cfg.seed * 1_000_003 + call_idx) & 0x7FFFFFFF)
+    elif cfg.eval_subset_mode == "fixed":
+        rs = np.random.RandomState(cfg.seed)
+    else:
+        raise ValueError(f"eval_subset_mode={cfg.eval_subset_mode!r} "
+                         "(expected 'fixed' or 'fresh')")
+    sel = rs.choice(len(tx), cfg.eval_max_samples, replace=False)
+    return tx[sel], ty[sel]
+
+
 def _make_client_keys(seed: int):
     """Per-client training keys, derived inside jit: the same
     fold_in(fold_in(PRNGKey(seed), round), client_id) chain as the
@@ -113,11 +130,16 @@ class FedAvgConfig:
     max_batches: int | None = None  # static per-client batch budget (B)
     ci: bool = False  # truncate eval, reference --ci semantics
     eval_batch_size: int = 256
-    # cap global eval to a seeded random subset of the test set — the
-    # reference's stackoverflow validation subset of 10k samples
+    # cap global eval to a random subset of the test set — the reference's
+    # stackoverflow validation subset of 10k samples
     # (FedAVGAggregator._generate_validation_set, FedAVGAggregator.py:99-107);
     # None = full test set
     eval_max_samples: int | None = None
+    # 'fixed': ONE seeded subset reused every eval (comparable curves across
+    # rounds); 'fresh': a new subset each eval — the reference's exact
+    # semantics (random.sample per call, FedAVGAggregator.py:99-107),
+    # deterministic here via (seed, eval-call-index)
+    eval_subset_mode: str = "fixed"
 
 
 def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
@@ -207,12 +229,13 @@ class FedAvgAPI:
         'clients'. In standalone mode axis_name is None and the weighted mean
         is local.
         """
-        K = x.shape[0]
         nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
             keys, net, x, y, mask
         )
         if self.client_result_hook is not None:
-            hkeys = jax.random.split(hook_key, K)
+            # x may be a pytree (FedNAS packs (train, val) streams) — take K
+            # from the keys, which are always a flat [K, 2] array
+            hkeys = jax.random.split(hook_key, keys.shape[0])
             nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
         return nets, metrics, nsamp
 
@@ -273,7 +296,7 @@ class FedAvgAPI:
                 keys, net, x, y, mask
             )
             if self.client_result_hook is not None:
-                hkeys = jax.random.split(hook_key, x.shape[0])
+                hkeys = jax.random.split(hook_key, keys.shape[0])
                 nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
             return _shard_aggregate(nets, metrics, nsamp, axis)
 
@@ -639,16 +662,15 @@ class FedAvgAPI:
         """Global test-set eval (the reference evaluates per client over all
         clients, fedavg_api.py:117-180; on a global-shared test set the two
         coincide up to weighting)."""
-        if self._test_cache is None:
-            tx, ty = self.data.test_x, self.data.test_y
-            if (self.cfg.eval_max_samples is not None
-                    and len(tx) > self.cfg.eval_max_samples):
-                # seeded random subset (the reference samples a fresh 10k
-                # subset per eval via random.sample; a fixed seeded subset
-                # keeps eval curves comparable across rounds)
-                sel = np.random.RandomState(self.cfg.seed).choice(
-                    len(tx), self.cfg.eval_max_samples, replace=False)
-                tx, ty = tx[sel], ty[sel]
+        # 'fresh' only forces a rebuild when a subset is actually drawn —
+        # uncapped eval would rebuild+re-upload an identical test set
+        fresh = (self.cfg.eval_subset_mode == "fresh"
+                 and self.cfg.eval_max_samples is not None
+                 and len(self.data.test_x) > self.cfg.eval_max_samples)
+        self._eval_calls = getattr(self, "_eval_calls", 0) + 1
+        if self._test_cache is None or fresh:
+            tx, ty = eval_subset(self.data.test_x, self.data.test_y,
+                                 self.cfg, self._eval_calls)
             n = len(tx)
             if self.cfg.ci:
                 n = min(n, 512)  # --ci truncation analogue (FedAVGAggregator.py:126-131)

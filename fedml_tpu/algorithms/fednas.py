@@ -1,28 +1,46 @@
 """FedNAS — federated differentiable architecture search.
 
 Reference: fedml_api/distributed/fednas/ — clients run DARTS bilevel search
-(FedNASTrainer.search, FedNASTrainer.py:34-50: update alphas on a val split
-via the Architect :28-31, then weights on train), the server averages weights
-AND alphas separately (FedNASAggregator.__aggregate_weight :71,
-__aggregate_alpha :95) and records the discovered genotype per round (:173).
+(FedNASTrainer.search / local_search, FedNASTrainer.py:34-110: per train
+batch, the Architect updates alphas on a batch from the client's HELD-OUT
+split (architect.step_v2, architect.py:58-100), then the weights take an
+SGD step on the train batch), the server averages weights AND alphas
+separately (FedNASAggregator.__aggregate_weight :71, __aggregate_alpha :95)
+and records the discovered genotype per round (:173).
+
+Bilevel semantics parity:
+  - alphas update on a genuinely held-out stream: the client's local test
+    split when the dataset provides one (the reference's ``test_local``
+    valid_queue), else a disjoint seeded half of the client's train data
+    (the original DARTS train/val split) — never the batches the weights
+    train on;
+  - first-order Architect = step_v2: alpha-grad = lambda_valid * g_val +
+    lambda_train * g_train, Adam(arch_lr, betas=(0.5, 0.999)) with L2
+    arch_weight_decay (architect.py:22-25, defaults
+    main_fednas.py:87-92);
+  - optional second-order (``unrolled=True``): the reference approximates
+    d/dα L_val(w - η∇_w L_train(w,α), α) with finite differences
+    (architect.py:_backward_step_unrolled); in JAX the inner SGD step is a
+    pure function, so we differentiate through it EXACTLY.
 
 TPU re-design: alphas are just params of the DARTS supernet (models/darts),
-so the FedAvg engine already vmaps/shard_maps the search. The bilevel step is
-the first-order DARTS approximation (the reference defaults to
---arch_search_method first-order as well): alternate alpha-steps on the
-client's validation half and weight-steps on the train half, all inside the
-jitted local update.
+so the FedAvg engine already vmaps/shard_maps the search; the (train, val)
+streams ride the round batch as a pytree pair, and the whole bilevel
+alternation is one lax.scan inside the jitted local update.
 """
 
 from __future__ import annotations
 
+import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
-from fedml_tpu.core.local import LocalSpec, NetState
+from fedml_tpu.core.client_data import ClientBatch, FederatedData, pack_clients
+from fedml_tpu.core.local import NetState
 from fedml_tpu.core.tasks import classification_task
 from fedml_tpu.models.darts import DARTSNetwork, extract_genotype
 
@@ -33,76 +51,187 @@ def _split_arch(params):
     return weights, arch
 
 
+def _held_out_split(data: FederatedData, seed: int, val_fraction: float):
+    """(w_data, a_data): weight-train stream and held-out alpha stream.
+
+    Clients with a local test split use it as the alpha stream (the
+    reference's valid_queue = test_local); otherwise the client's train
+    indices are split disjointly (seeded, per-client) like the original
+    DARTS search."""
+    if data.test_idx_map:
+        a_map = {c: np.asarray(data.test_idx_map.get(c, np.empty(0, np.int64)),
+                               np.int64)
+                 for c in data.train_idx_map}
+        a_data = dataclasses.replace(
+            data, train_x=data.test_x, train_y=data.test_y,
+            train_idx_map=a_map)
+        return data, a_data
+
+    w_map, a_map = {}, {}
+    for c, idx in data.train_idx_map.items():
+        idx = np.asarray(idx, np.int64)
+        perm = np.random.RandomState((seed * 1_000_003 + int(c)) & 0x7FFFFFFF
+                                     ).permutation(len(idx))
+        n_val = max(1, int(len(idx) * val_fraction)) if len(idx) > 1 else 0
+        a_map[c] = idx[perm[:n_val]]
+        w_map[c] = idx[perm[n_val:]]
+    return (dataclasses.replace(data, train_idx_map=w_map),
+            dataclasses.replace(data, train_idx_map=a_map))
+
+
 class FedNASAPI(FedAvgAPI):
-    """Search phase: FedAvg over the supernet with alternating w/alpha local
-    steps. After search, ``genotype()`` extracts the discovered cell."""
+    """Search phase: FedAvg over the supernet with the reference's bilevel
+    local search. After search, ``genotype()`` extracts the discovered
+    normal+reduce cells."""
 
     def __init__(self, dataset, config: FedAvgConfig, mesh=None,
-                 arch_lr: float = 3e-3, layers: int = 4, init_filters: int = 16,
-                 **kwargs):
+                 arch_lr: float = 3e-4, arch_wd: float = 1e-3,
+                 lambda_train: float = 1.0, lambda_valid: float = 1.0,
+                 unrolled: bool = False, val_fraction: float = 0.5,
+                 layers: int = 4, init_filters: int = 16, steps: int = 4,
+                 multiplier: int = 4, **kwargs):
         module = DARTSNetwork(num_classes=dataset.class_num, layers=layers,
+                              steps=steps, multiplier=multiplier,
                               init_filters=init_filters)
         task = classification_task(module)
-        self.arch_lr = arch_lr
-        super().__init__(dataset, task, config, mesh=mesh, **kwargs)
+        self.arch_lr, self.arch_wd = arch_lr, arch_wd
+        self.steps, self.multiplier = steps, multiplier
+        if kwargs.get("device_data"):
+            raise ValueError("FedNASAPI packs (train, val) stream pairs; the "
+                             "device-resident index plane is not supported")
 
-        # Replace the plain local update with the bilevel variant:
-        # even batches update weights (SGD lr), odd batches update alphas
-        # (Adam arch_lr) on held-out-like data — the first-order DARTS
-        # alternation, expressed as a masked two-optimizer step so control
-        # flow stays static.
-        w_tx = optax.sgd(config.lr, momentum=0.9)
-        a_tx = optax.adam(arch_lr)
+        w_data, a_data = _held_out_split(dataset, config.seed, val_fraction)
+        super().__init__(w_data, task, config, mesh=mesh, **kwargs)
+        self.data_a = a_data
+        a_counts = [len(v) for v in a_data.train_idx_map.values()]
+        b_needed = max(1, int(np.ceil(max(a_counts) / config.batch_size)))
+        self.num_batches_a = min(config.max_batches or b_needed, b_needed)
+
+        w_tx = optax.sgd(config.lr, momentum=config.momentum or 0.9)
+        if config.wd:
+            w_tx = optax.chain(optax.add_decayed_weights(config.wd), w_tx)
+        # torch Adam's weight_decay is L2-into-the-grad (not AdamW), so the
+        # decay feeds the moment estimates: decay first, then adam
+        a_tx = optax.chain(optax.add_decayed_weights(arch_wd),
+                           optax.adam(arch_lr, b1=0.5, b2=0.999))
         t = self.task
         epochs = config.epochs
+        eta = config.lr  # unrolled inner-step size (reference eta = network lr)
 
         def local_update(rng, global_net: NetState, x, y, mask):
-            params = global_net.params
-            w0, a0 = _split_arch(params)
-            w_opt = w_tx.init(w0)
-            a_opt = a_tx.init(a0)
+            xw, xa = x
+            yw, ya = y
+            mw, ma = mask
+            Ba = xa.shape[0]
+            w0, a0 = _split_arch(global_net.params)
+            w_opt, a_opt = w_tx.init(w0), a_tx.init(a0)
+
+            def arch_grad(w, a, xb, yb, mb, xv, yv, mv, key):
+                def loss_a(a_, x_, y_, m_):
+                    l, _, _ = t.loss({**w, **a_}, {}, x_, y_, m_, key, True)
+                    return l
+
+                if unrolled:
+                    def train_loss(w_, a_):
+                        l, _, _ = t.loss({**w_, **a_}, {}, xb, yb, mb, key, True)
+                        return l
+
+                    def val_after_inner(a_):
+                        gw = jax.grad(train_loss)(w, a_)
+                        w_un = jax.tree.map(lambda p, g: p - eta * g, w, gw)
+                        l, _, _ = t.loss({**w_un, **a_}, {}, xv, yv, mv,
+                                         key, True)
+                        return l
+
+                    return jax.grad(val_after_inner)(a)
+                g_val = jax.grad(loss_a)(a, xv, yv, mv)
+                g_tr = jax.grad(loss_a)(a, xb, yb, mb)
+                return jax.tree.map(
+                    lambda gv, gt: lambda_valid * gv + lambda_train * gt,
+                    g_val, g_tr)
 
             def batch_step(carry, inp):
-                params, w_opt, a_opt, rng, idx = carry
+                params, w_opt, a_opt, rng, i = carry
                 xb, yb, mb = inp
-                rng, sub = jax.random.split(rng)
+                rng, k_a, k_w = jax.random.split(rng, 3)
+                w, a = _split_arch(params)
 
-                def loss_fn(p):
-                    l, _, metr = t.loss(p, {}, xb, yb, mb, sub, True)
+                # ---- Architect step FIRST (FedNASTrainer.local_search:
+                # architect.step_v2 precedes the weight step), on the cycled
+                # held-out batch i % Ba
+                j = i % Ba
+                xv, yv, mv = xa[j], ya[j], ma[j]
+                ga = arch_grad(w, a, xb, yb, mb, xv, yv, mv, k_a)
+                has_a = (jnp.sum(mv) > 0) & (jnp.sum(mb) > 0)
+                ua, a_opt_n = a_tx.update(ga, a_opt, a)
+                a = jax.tree.map(lambda p, u: jnp.where(has_a, p + u, p), a, ua)
+                a_opt = jax.tree.map(lambda n_, o: jnp.where(has_a, n_, o),
+                                     a_opt_n, a_opt)
+
+                # ---- weight step on the train batch
+                def loss_w(w_):
+                    l, _, metr = t.loss({**w_, **a}, {}, xb, yb, mb, k_w, True)
                     return l, metr
 
-                (l, metr), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
-                gw, ga = _split_arch(g)
-                w, a = _split_arch(params)
-                is_w_step = (idx % 2) == 0
+                (_, metr), gw = jax.value_and_grad(loss_w, has_aux=True)(w)
+                has_w = jnp.sum(mb) > 0
                 uw, w_opt_n = w_tx.update(gw, w_opt, w)
-                ua, a_opt_n = a_tx.update(ga, a_opt, a)
-                has = jnp.sum(mb) > 0
-                w_new = jax.tree.map(
-                    lambda p_, u: jnp.where(has & is_w_step, p_ + u, p_), w, uw)
-                a_new = jax.tree.map(
-                    lambda p_, u: jnp.where(has & (~is_w_step), p_ + u, p_), a, ua)
-                w_opt = jax.tree.map(
-                    lambda n_, o: jnp.where(has & is_w_step, n_, o), w_opt_n, w_opt)
-                a_opt = jax.tree.map(
-                    lambda n_, o: jnp.where(has & (~is_w_step), n_, o), a_opt_n, a_opt)
-                params = {**w_new, **a_new}
-                return (params, w_opt, a_opt, rng, idx + 1), metr
+                w = jax.tree.map(lambda p, u: jnp.where(has_w, p + u, p), w, uw)
+                w_opt = jax.tree.map(lambda n_, o: jnp.where(has_w, n_, o),
+                                     w_opt_n, w_opt)
+                return ({**w, **a}, w_opt, a_opt, rng, i + 1), metr
 
             def epoch(carry, _):
-                params, w_opt, a_opt, rng, idx = carry
-                carry, metrs = jax.lax.scan(
-                    batch_step, (params, w_opt, a_opt, rng, idx), (x, y, mask))
+                carry, metrs = jax.lax.scan(batch_step, carry, (xw, yw, mw))
                 return carry, metrs
 
             (params, _, _, _, _), metrs = jax.lax.scan(
-                epoch, (params, w_opt, a_opt, rng, 0), None, length=epochs)
+                epoch, (global_net.params, w_opt, a_opt, rng, 0), None,
+                length=epochs)
             metrics = {k: jnp.sum(metrs[k]) for k in ("loss_sum", "correct", "count")}
             return NetState(params, global_net.extra), metrics
 
         self.local_update = local_update
         self.round_fn = self._build_round_fn()
         self.genotype_history: list = []
+
+    # ------------------------------------------------------------------ data
+    def _pack_pair(self, ids, round_idx: int) -> ClientBatch:
+        """Pack BOTH streams as a pytree pair riding one ClientBatch: leaf
+        arrays [K, Bw, ...] for the weight stream, [K, Ba, ...] for the
+        held-out alpha stream. vmap/shard_map treat the pair like any other
+        pytree, so the engine's round program is unchanged. Also the packer
+        for the cross-process runtime (distributed/fednas.py), which packs
+        a single client id — same seeds, same budgets, so the two runtimes
+        stay batch-identical."""
+        cfg = self.cfg
+
+        def pack(data, n_batches, seed_off):
+            cb = pack_clients(data, ids, cfg.batch_size, max_batches=n_batches,
+                              seed=cfg.seed + seed_off, round_idx=round_idx)
+            if cb.num_batches < n_batches:
+                pad = n_batches - cb.num_batches
+                z = lambda arr: np.concatenate(
+                    [arr, np.zeros((arr.shape[0], pad) + arr.shape[2:],
+                                   arr.dtype)], 1)
+                cb = ClientBatch(x=z(cb.x), y=z(cb.y), mask=z(cb.mask),
+                                 num_samples=cb.num_samples)
+            return cb
+
+        cb_w = pack(self.data, self.num_batches, 0)
+        cb_a = pack(self.data_a, self.num_batches_a, 1)
+        return ClientBatch(x=(cb_w.x, cb_a.x), y=(cb_w.y, cb_a.y),
+                           mask=(cb_w.mask, cb_a.mask),
+                           num_samples=cb_w.num_samples)
+
+    def _pack_round(self, round_idx: int):
+        merged = self._pack_pair(self._sampled_ids(round_idx), round_idx)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            merged = jax.tree.map(lambda v: jax.device_put(v, sh), merged)
+        return merged
 
     def run_round(self, round_idx: int):
         m = super().run_round(round_idx)
@@ -111,4 +240,5 @@ class FedNASAPI(FedAvgAPI):
         return m
 
     def genotype(self):
-        return extract_genotype(self.net.params)
+        return extract_genotype(self.net.params, steps=self.steps,
+                                multiplier=self.multiplier)

@@ -200,6 +200,17 @@ def pack_clients(
     bs = batch_size
     seeds = client_shuffle_seeds(client_ids, seed, round_idx)
 
+    if B == 0:
+        # every sampled client is empty (e.g. an empty held-out stream) —
+        # a degenerate but legal batch; the native packer rejects
+        # capacity==0, so build the empty block directly
+        return ClientBatch(
+            x=np.zeros((K, 0, bs) + data.train_x.shape[1:], data.train_x.dtype),
+            y=np.zeros((K, 0, bs) + data.train_y.shape[1:], data.train_y.dtype),
+            mask=np.zeros((K, 0, bs), np.float32),
+            num_samples=np.zeros((K,), np.float32),
+        )
+
     if use_native is not False:
         from fedml_tpu import native
 

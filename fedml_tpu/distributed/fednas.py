@@ -31,11 +31,26 @@ log = logging.getLogger("fedml_tpu.distributed.fednas")
 
 
 class FedNASTrainer(DistributedTrainer):
-    """DistributedTrainer whose local fit is the bilevel w/alpha search."""
+    """DistributedTrainer whose local fit is the bilevel w/alpha search.
+
+    ``fit`` packs the (train, held-out) stream PAIR through the SPMD
+    engine's own packer (FedNASAPI._pack_pair) with identical seeds and
+    batch budgets, so the cross-process search stays batch-identical to the
+    in-process simulation."""
 
     def __init__(self, client_rank, dataset, cfg, api: FedNASAPI):
         super().__init__(client_rank, dataset, api.task, cfg)
+        self.api = api
         self.local_update = jax.jit(api.local_update)
+
+    def fit(self, round_idx: int) -> int:
+        cb = self.api._pack_pair([self.client_index], round_idx)
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
+        rng = jax.random.fold_in(rng, self.client_index)
+        take0 = lambda pair: tuple(a[0] for a in pair)
+        self.net, _metrics = self.local_update(
+            rng, self.net, take0(cb.x), take0(cb.y), take0(cb.mask))
+        return int(cb.num_samples[0])
 
 
 class FedNASAggregator(FedAvgAggregator):

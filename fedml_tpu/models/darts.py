@@ -6,92 +6,193 @@ softmax(alpha)-weighted mixture of candidate ops. FedNAS federates the
 bilevel search: clients optimize (weights w, alphas a) locally, the server
 averages both (FedNASAggregator.__aggregate_weight/:71, __aggregate_alpha/:95).
 
+Search-space parity with the reference:
+  - the full 8-primitive set (genotypes.py:5-14), including the 5x5
+    separable and dilated convs;
+  - normal AND reduction cells (model_search.py Network: reduction at
+    layers//3 and 2*layers//3 with channel doubling, stride-2 on the edges
+    that touch the two input nodes, model_search.py:40-46,204-210);
+  - separate ``alphas_normal`` / ``alphas_reduce`` tensors shared across
+    cells of each type (model_search.py:233-241);
+  - FactorizedReduce / ReLU-conv preprocessing of the two cell inputs and
+    concat of the last ``multiplier`` nodes (operations.py, Cell.forward).
+
 TPU re-design: the reference's MixedOp is a python loop over op modules; here
 all candidate ops for an edge evaluate as a batched branch stack and the
-alpha-softmax contraction is one einsum — XLA fuses the mixture, and the
-whole supernet vmaps over clients like any other model. Alphas live in a
-separate 'arch' param collection so the engine can average them with the
-weights (parity) or expose them separately (FedNAS genotype extraction).
+alpha-softmax contraction is one tensordot — XLA fuses the mixture, and the
+whole supernet vmaps over clients like any other model. Norms are GroupNorm
+(affine-free BatchNorm in the reference): the supernet trains vmapped over
+clients, where BN's mutable batch stats would silently leak across the
+client axis. Alphas live in the same 'params' collection so the engine can
+average them with the weights (parity) or split them out (bilevel search,
+algorithms/fednas.py).
 """
 
 from __future__ import annotations
-
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+# reference genotypes.py:5-14, same order
 PRIMITIVES = (
     "none",
-    "skip_connect",
     "max_pool_3x3",
     "avg_pool_3x3",
+    "skip_connect",
     "sep_conv_3x3",
+    "sep_conv_5x5",
     "dil_conv_3x3",
+    "dil_conv_5x5",
 )
 
 
-class _SepConv(nn.Module):
+def _norm(c: int):
+    g = min(8, c)
+    while c % g:  # GroupNorm needs groups | channels (e.g. stem 3*C)
+        g -= 1
+    return nn.GroupNorm(num_groups=g)
+
+
+class _ReLUConvNorm(nn.Module):
+    """ReLUConvBN analogue (operations.py) — 1x1 projection preprocessing."""
+
     filters: int
-    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        return _norm(self.filters)(x)
+
+
+class FactorizedReduce(nn.Module):
+    """Stride-2 channel-preserving reduction: two offset 1x1/s2 convs
+    concatenated (operations.py FactorizedReduce). Assumes even H/W (same
+    constraint as the reference's pad-0 convs)."""
+
+    filters: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        h1 = nn.Conv(self.filters // 2, (1, 1), strides=(2, 2),
+                     padding="VALID", use_bias=False)(x)
+        h2 = nn.Conv(self.filters - self.filters // 2, (1, 1), strides=(2, 2),
+                     padding="VALID", use_bias=False)(x[:, 1:, 1:, :])
+        return _norm(self.filters)(jnp.concatenate([h1, h2], axis=-1))
+
+
+class _SepConv(nn.Module):
+    """SepConv (operations.py): (ReLU, depthwise k/stride, pointwise, norm)
+    applied twice — the second pass always stride 1."""
+
+    filters: int
+    kernel: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for s in (self.stride, 1):
+            c = x.shape[-1]
+            x = nn.relu(x)
+            x = nn.Conv(c, (self.kernel, self.kernel), strides=(s, s),
+                        padding="SAME", feature_group_count=c, use_bias=False)(x)
+            x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+            x = _norm(self.filters)(x)
+        return x
+
+
+class _DilConv(nn.Module):
+    """DilConv (operations.py): ReLU, depthwise k/stride with dilation 2,
+    pointwise, norm — applied once."""
+
+    filters: int
+    kernel: int
+    stride: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         c = x.shape[-1]
-        x = nn.Conv(c, (3, 3), padding="SAME", feature_group_count=c,
-                    kernel_dilation=(self.dilation, self.dilation),
-                    use_bias=False)(x)
+        x = nn.relu(x)
+        x = nn.Conv(c, (self.kernel, self.kernel), strides=(self.stride,) * 2,
+                    kernel_dilation=(2, 2), padding="SAME",
+                    feature_group_count=c, use_bias=False)(x)
         x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-        x = nn.GroupNorm(num_groups=min(8, self.filters))(x)
-        return nn.relu(x)
+        return _norm(self.filters)(x)
+
+
+def _pool(x, kind: str, stride: int):
+    window, s = (3, 3), (stride, stride)
+    if kind == "max":
+        return nn.max_pool(x, window, strides=s, padding="SAME")
+    return nn.avg_pool(x, window, strides=s, padding="SAME")
 
 
 class MixedOp(nn.Module):
-    """All candidate ops evaluated, alpha-softmax-mixed in one contraction."""
+    """All 8 candidate ops evaluated, alpha-softmax-mixed in one contraction.
+    ``stride=2`` on reduction-cell edges that read the two input nodes."""
 
     filters: int
+    stride: int = 1
 
     @nn.compact
     def __call__(self, x, weights, train: bool = False):
         # weights: [num_ops] softmaxed alphas for this edge
+        s = self.stride
+        down = x[:, ::2, ::2, :] if s == 2 else x
         outs = []
         for prim in PRIMITIVES:
             if prim == "none":
-                outs.append(jnp.zeros_like(x))
+                outs.append(jnp.zeros_like(down))
             elif prim == "skip_connect":
-                outs.append(x)
+                outs.append(FactorizedReduce(self.filters)(x, train)
+                            if s == 2 else x)
             elif prim == "max_pool_3x3":
-                outs.append(nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME"))
+                outs.append(_pool(x, "max", s))
             elif prim == "avg_pool_3x3":
-                outs.append(nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME"))
+                outs.append(_pool(x, "avg", s))
             elif prim == "sep_conv_3x3":
-                outs.append(_SepConv(self.filters)(x, train))
+                outs.append(_SepConv(self.filters, 3, s)(x, train))
+            elif prim == "sep_conv_5x5":
+                outs.append(_SepConv(self.filters, 5, s)(x, train))
             elif prim == "dil_conv_3x3":
-                outs.append(_SepConv(self.filters, dilation=2)(x, train))
-        stacked = jnp.stack(outs)  # [O, B, H, W, C]
+                outs.append(_DilConv(self.filters, 3, s)(x, train))
+            elif prim == "dil_conv_5x5":
+                outs.append(_DilConv(self.filters, 5, s)(x, train))
+        stacked = jnp.stack(outs)  # [O, B, H', W', C]
         return jnp.tensordot(weights, stacked, axes=([0], [0]))
 
 
 class Cell(nn.Module):
-    """DARTS cell: ``steps`` intermediate nodes, each summing mixed ops over
-    all previous nodes; output = concat of intermediate nodes."""
+    """DARTS cell (model_search.py Cell): preprocess the two inputs, then
+    ``steps`` intermediate nodes each summing mixed ops over all previous
+    states; output = concat of the last ``multiplier`` nodes."""
 
     steps: int = 4
+    multiplier: int = 4
     filters: int = 16
+    reduction: bool = False
+    reduction_prev: bool = False
 
     @nn.compact
     def __call__(self, s0, s1, alphas, train: bool = False):
         # alphas: [num_edges, num_ops] (already softmaxed rows)
+        C = self.filters
+        s0 = (FactorizedReduce(C)(s0, train) if self.reduction_prev
+              else _ReLUConvNorm(C)(s0, train))
+        s1 = _ReLUConvNorm(C)(s1, train)
         states = [s0, s1]
         offset = 0
         for i in range(self.steps):
             acc = 0.0
             for j, h in enumerate(states):
-                acc = acc + MixedOp(self.filters)(h, alphas[offset + j], train)
+                stride = 2 if self.reduction and j < 2 else 1
+                acc = acc + MixedOp(C, stride)(h, alphas[offset + j], train)
             offset += len(states)
             states.append(acc)
-        return jnp.concatenate(states[-self.steps:], axis=-1)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
 
 
 def num_edges(steps: int = 4) -> int:
@@ -99,49 +200,82 @@ def num_edges(steps: int = 4) -> int:
 
 
 class DARTSNetwork(nn.Module):
-    """Supernet: stem -> ``layers`` cells -> classifier. Alphas are a single
-    'arch'-collection param shared across cells (normal cells only — the
-    reference's reduced search space for FedNAS)."""
+    """Supernet (model_search.py Network): stem -> ``layers`` cells with
+    reduction cells at layers//3 and 2*layers//3 (channels double there) ->
+    global pool -> classifier. Two alpha tensors — ``alphas_normal`` and
+    ``alphas_reduce`` — each shared across all cells of that type."""
 
     num_classes: int = 10
-    layers: int = 4
+    layers: int = 8
     steps: int = 4
+    multiplier: int = 4
     init_filters: int = 16
+    stem_multiplier: int = 3
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        C = self.init_filters
         E = num_edges(self.steps)
-        alphas = self.param(
-            "alphas_normal",
-            lambda k: 1e-3 * jax.random.normal(k, (E, len(PRIMITIVES))),
-        )
-        aw = jax.nn.softmax(alphas, axis=-1)
-        s = nn.Conv(C, (3, 3), padding="SAME", use_bias=False)(x)
-        s = nn.GroupNorm(num_groups=min(8, C))(s)
-        s0 = s1 = s
-        for l in range(self.layers):
-            s0, s1 = s1, Cell(self.steps, C)(s0, s1, aw, train)
-            # project concat back to C channels to keep the supernet slim
-            s1 = nn.Conv(C, (1, 1), use_bias=False)(s1)
+        a_init = lambda k: 1e-3 * jax.random.normal(k, (E, len(PRIMITIVES)))
+        aw_normal = jax.nn.softmax(self.param("alphas_normal", a_init), -1)
+        aw_reduce = jax.nn.softmax(self.param("alphas_reduce", a_init), -1)
+
+        C_curr = self.stem_multiplier * self.init_filters
+        s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s0 = s1 = _norm(C_curr)(s)
+
+        C_curr = self.init_filters
+        reduction_prev = False
+        # reference: reduction at layers//3 and 2*layers//3. The -{0} guard
+        # only matters for layers<3 (shallow test nets), where a reduction
+        # cell at layer 0 would leave no normal cell and starve
+        # alphas_normal of gradient; real configs (layers>=6) are unaffected.
+        reduce_at = {self.layers // 3, 2 * self.layers // 3} - {0}
+        for i in range(self.layers):
+            reduction = i in reduce_at
+            if reduction:
+                C_curr *= 2
+            cell = Cell(self.steps, self.multiplier, C_curr,
+                        reduction, reduction_prev)
+            s0, s1 = s1, cell(s0, s1, aw_reduce if reduction else aw_normal,
+                              train)
+            reduction_prev = reduction
         y = jnp.mean(s1, axis=(1, 2))
         return nn.Dense(self.num_classes)(y)
 
 
-def extract_genotype(params, steps: int = 4) -> list[list[tuple[str, int]]]:
-    """Discretize alphas -> per-node top-2 (op, predecessor) pairs — the
-    reference's genotype recording (FedNASAggregator.record_model_global_
-    architecture, FedNASAggregator.py:173)."""
-    alphas = np.asarray(params["alphas_normal"])
-    probs = np.exp(alphas) / np.exp(alphas).sum(-1, keepdims=True)
-    geno, offset = [], 0
+def _parse_alphas(probs: np.ndarray, steps: int) -> list[tuple[str, int]]:
+    """The reference's genotype _parse (model_search.py:263-291): per node,
+    top-2 incoming edges ranked by their best non-'none' op weight; per
+    chosen edge, that best op. Flat [(op, predecessor), ...] — 2 per node."""
+    none_idx = PRIMITIVES.index("none")
+    gene: list[tuple[str, int]] = []
+    offset = 0
     for i in range(steps):
         n_in = 2 + i
-        edges = probs[offset : offset + n_in]
-        # best non-'none' op per edge, then top-2 edges by that op's prob
-        best_op = edges[:, 1:].argmax(-1) + 1
-        best_p = edges[np.arange(n_in), best_op]
-        top2 = np.argsort(-best_p)[:2]
-        geno.append([(PRIMITIVES[best_op[j]], int(j)) for j in top2])
+        W = probs[offset : offset + n_in]
+        masked = np.delete(W, none_idx, axis=1)
+        best_per_edge = masked.max(-1)
+        edges = np.argsort(-best_per_edge, kind="stable")[:2]  # ranked, like the reference sort
+        for j in (int(e) for e in edges):
+            ops = [(w, k) for k, w in enumerate(W[j]) if k != none_idx]
+            gene.append((PRIMITIVES[max(ops)[1]], j))
         offset += n_in
-    return geno
+    return gene
+
+
+def extract_genotype(params, steps: int = 4, multiplier: int = 4) -> dict:
+    """Discretize both alpha tensors into the reference's Genotype structure
+    (normal/normal_concat/reduce/reduce_concat, genotypes.py:3;
+    FedNASAggregator.record_model_global_architecture, FedNASAggregator.py:173)."""
+
+    def softmax_np(a):
+        e = np.exp(a - a.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    concat = list(range(2 + steps - multiplier, steps + 2))
+    return {
+        "normal": _parse_alphas(softmax_np(np.asarray(params["alphas_normal"])), steps),
+        "normal_concat": concat,
+        "reduce": _parse_alphas(softmax_np(np.asarray(params["alphas_reduce"])), steps),
+        "reduce_concat": concat,
+    }

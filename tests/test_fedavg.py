@@ -244,6 +244,27 @@ def test_eval_max_samples_subset():
     np.testing.assert_allclose(float(ev["loss"]), float(ev2["loss"]), rtol=1e-6)
 
 
+def test_eval_subset_mode_fresh_resamples():
+    """eval_subset_mode='fresh' draws a NEW validation subset each eval (the
+    reference's random.sample-per-call, FedAVGAggregator.py:99-107);
+    'fixed' reproduces the same subset every call."""
+    data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
+                            samples_per_client=10, test_samples=200, seed=1)
+    task = classification_task(LogisticRegression(num_classes=3))
+    base = dict(comm_round=1, client_num_in_total=4, client_num_per_round=2,
+                batch_size=5, lr=0.1, eval_max_samples=64)
+
+    api_fresh = FedAvgAPI(data, task, FedAvgConfig(eval_subset_mode="fresh", **base))
+    l1 = float(api_fresh.evaluate()["loss"])
+    l2 = float(api_fresh.evaluate()["loss"])
+    assert l1 != l2  # same params, different subset -> different loss
+
+    api_fixed = FedAvgAPI(data, task, FedAvgConfig(**base))
+    f1 = float(api_fixed.evaluate()["loss"])
+    f2 = float(api_fixed.evaluate()["loss"])
+    np.testing.assert_allclose(f1, f2, rtol=1e-6)
+
+
 def test_run_rounds_block_equals_sequential(lr_data, lr_task):
     """The R-round lax.scan block (one compiled program) is bit-identical to
     R sequential run_round calls: same sampling, same fold_in key chain,

@@ -15,12 +15,17 @@ from fedml_tpu.utils.condense import condense_dataset
 
 
 def test_darts_supernet_forward():
+    """Full search space: 8 primitives, separate normal/reduce alphas, and
+    reduction cells (layers=3 -> reduce at 1, 2) halving spatial dims."""
+    assert len(PRIMITIVES) == 8  # genotypes.py:5-14 parity
+    assert {"sep_conv_5x5", "dil_conv_5x5"} <= set(PRIMITIVES)
     x = jnp.zeros((2, 16, 16, 3))
-    net = DARTSNetwork(num_classes=5, layers=2, init_filters=8)
+    net = DARTSNetwork(num_classes=5, layers=3, init_filters=8)
     v = net.init(jax.random.PRNGKey(0), x, train=False)
     out = net.apply(v, x, train=False)
     assert out.shape == (2, 5)
     assert v["params"]["alphas_normal"].shape == (num_edges(4), len(PRIMITIVES))
+    assert v["params"]["alphas_reduce"].shape == (num_edges(4), len(PRIMITIVES))
 
 
 def test_genotype_extraction():
@@ -28,25 +33,81 @@ def test_genotype_extraction():
     net = DARTSNetwork(num_classes=3, layers=1, init_filters=8)
     v = net.init(jax.random.PRNGKey(0), x, train=False)
     geno = extract_genotype(v["params"])
-    assert len(geno) == 4  # steps
-    for node in geno:
-        assert len(node) == 2  # top-2 edges
-        for op, pred in node:
+    # reference Genotype structure: normal/normal_concat/reduce/reduce_concat
+    assert geno["normal_concat"] == [2, 3, 4, 5]
+    assert geno["reduce_concat"] == [2, 3, 4, 5]
+    for cell in ("normal", "reduce"):
+        gene = geno[cell]
+        assert len(gene) == 8  # 2 edges per node x 4 nodes, flat like the reference
+        for op, pred in gene:
             assert op in PRIMITIVES and op != "none"
+        # node i can only read from states 0..i+1
+        for i in range(4):
+            for op, pred in gene[2 * i : 2 * i + 2]:
+                assert 0 <= pred < 2 + i
+
+
+def _nas_setup(seed=0, **api_kw):
+    data = synthetic_images(num_clients=2, image_shape=(12, 12, 3), num_classes=3,
+                            samples_per_client=16, test_samples=24, seed=seed,
+                            size_lognormal=False)
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=2, client_num_per_round=2,
+                       epochs=1, batch_size=4, lr=0.02, seed=seed)
+    return data, FedNASAPI(data, cfg, layers=2, init_filters=8,
+                           arch_lr=3e-3, **api_kw)
 
 
 def test_fednas_search_round():
-    data = synthetic_images(num_clients=2, image_shape=(12, 12, 3), num_classes=3,
-                            samples_per_client=16, test_samples=24, seed=0,
-                            size_lognormal=False)
-    cfg = FedAvgConfig(comm_round=2, client_num_in_total=2, client_num_per_round=2,
-                       epochs=1, batch_size=8, lr=0.02, seed=0)
-    api = FedNASAPI(data, cfg, layers=1, init_filters=8)
+    _, api = _nas_setup()
+    a0 = jax.tree.map(np.copy,
+                      {k: np.asarray(v) for k, v in api.net.params.items()
+                       if k.startswith("alphas")})
+    api.run_round(0)
+    # both cell types' alphas moved (arch search active on each)
+    assert not np.allclose(a0["alphas_normal"], api.net.params["alphas_normal"])
+    assert not np.allclose(a0["alphas_reduce"], api.net.params["alphas_reduce"])
+    assert len(api.genotype_history) == 1
+    assert set(api.genotype_history[0]) == {
+        "normal", "normal_concat", "reduce", "reduce_concat"}
+
+
+def test_fednas_heldout_split_is_disjoint():
+    """Without a per-client test split, the bilevel search must carve a
+    DISJOINT val half out of each client's train data (the reference uses
+    test_local as valid_queue; FedNASTrainer.py:34-50) — alphas never see
+    the batches the weights train on."""
+    data, api = _nas_setup()
+    for c in data.train_idx_map:
+        w_idx = set(map(int, api.data.train_idx_map[c]))
+        a_idx = set(map(int, api.data_a.train_idx_map[c]))
+        assert w_idx and a_idx
+        assert not (w_idx & a_idx)
+        assert w_idx | a_idx == set(map(int, data.train_idx_map[c]))
+
+
+def test_fednas_alphas_move_only_on_heldout_data():
+    """With an EMPTY held-out stream the Architect step must be a no-op:
+    alphas update exclusively from val batches."""
+    data, api = _nas_setup()
+    # empty the alpha stream: no val samples for any client
+    for c in api.data_a.train_idx_map:
+        api.data_a.train_idx_map[c] = np.empty(0, np.int64)
+    a0 = np.asarray(api.net.params["alphas_normal"]).copy()
+    w_key = next(k for k in api.net.params if not k.startswith("alphas"))
+    api.run_round(0)
+    np.testing.assert_array_equal(a0, np.asarray(api.net.params["alphas_normal"]))
+    # ...while the weights still trained on the train stream
+    assert len(api.net.params[w_key])  # sanity: weights exist
+
+
+def test_fednas_unrolled_second_order():
+    """unrolled=True: the second-order Architect (exact autodiff through the
+    inner SGD step, vs the reference's finite-difference approximation,
+    architect.py:96-150) runs and moves the alphas."""
+    _, api = _nas_setup(unrolled=True)
     a0 = np.asarray(api.net.params["alphas_normal"]).copy()
     api.run_round(0)
-    a1 = np.asarray(api.net.params["alphas_normal"])
-    assert not np.allclose(a0, a1)  # alphas moved (arch search active)
-    assert len(api.genotype_history) == 1
+    assert not np.allclose(a0, np.asarray(api.net.params["alphas_normal"]))
 
 
 def test_affinity_matrix_properties():
