@@ -25,8 +25,9 @@ from fedml_tpu.core.partition import partition_data
 
 
 def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed,
-             partition_fix_path=None):
+             partition_fix_path=None, image_size=None):
     name = spec.name
+    isz = (image_size, image_size) if image_size else None
     try:
         if name in ("mnist", "shakespeare") and os.path.isdir(os.path.join(data_dir, "train")):
             return _load_leaf_json(data_dir, spec, n_clients)
@@ -39,12 +40,27 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed,
                                     fix_path=partition_fix_path)
             if fd is not None:
                 return fd
+        if name == "cinic10":
+            fd = _load_cinic_folder(data_dir, spec, n_clients,
+                                    partition_method or "hetero",
+                                    partition_alpha, seed,
+                                    fix_path=partition_fix_path)
+            if fd is not None:
+                return fd
+        if name == "svhn":
+            fd = _load_svhn_mat(data_dir, spec, n_clients,
+                                partition_method or "hetero", partition_alpha,
+                                seed, fix_path=partition_fix_path)
+            if fd is not None:
+                return fd
         if name in ("gld23k", "gld160k"):
-            fd = _load_landmarks_csv(data_dir, spec, n_clients)
+            fd = _load_landmarks_csv(data_dir, spec, n_clients,
+                                     **({"image_size": isz} if isz else {}))
             if fd is not None:
                 return fd
         if name == "imagenet":
-            fd = _load_imagenet_folder(data_dir, spec, n_clients)
+            fd = _load_imagenet_folder(data_dir, spec, n_clients,
+                                       **({"image_size": isz} if isz else {}))
             if fd is not None:
                 return fd
         if name in ("stackoverflow_nwp", "stackoverflow_lr"):
@@ -289,6 +305,96 @@ def _load_cifar_pickle(data_dir, spec, n_clients, method, alpha, seed,
         TY = np.asarray(d.get(b"labels", d.get(b"fine_labels")), dtype=np.int64)
     else:
         TX, TY = X[:1000], Y[:1000]
+    idx_map = partition_data(Y, n_clients, method, alpha, seed, fix_path=fix_path)
+    return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
+
+
+def _load_cinic_folder(data_dir, spec, n_clients, method, alpha, seed,
+                       fix_path=None):
+    """CINIC-10 imagefolder layout: ``{train,valid,test}/<class>/*.png``
+    (reference fedml_api/data_preprocessing/cinic10/data_loader.py — an
+    ImageFolder over the same tree, then the shared LDA partition path).
+    'valid' merges into train like the reference's enlarged train split."""
+    train_dir = os.path.join(data_dir, "train")
+    if not os.path.isdir(train_dir):
+        return None
+    classes = sorted(d for d in os.listdir(train_dir)
+                     if os.path.isdir(os.path.join(train_dir, d)))
+    if not classes:
+        return None
+    try:
+        from PIL import Image
+    except ImportError:
+        return None
+    exts = (".png", ".jpeg", ".jpg")
+
+    def read_split(split):
+        sdir = os.path.join(data_dir, split)
+        if not os.path.isdir(sdir):
+            return None, None
+        xs, ys = [], []
+        for cls, cname in enumerate(classes):
+            d = os.path.join(sdir, cname)
+            if not os.path.isdir(d):
+                continue
+            for name in sorted(os.listdir(d)):
+                if not name.lower().endswith(exts):
+                    continue
+                try:
+                    with Image.open(os.path.join(d, name)) as im:
+                        arr = np.asarray(im.convert("RGB"), np.float32) / 255.0
+                except Exception:  # noqa: BLE001 — skip unreadable images
+                    continue
+                xs.append(arr)
+                ys.append(cls)
+        if not xs:
+            return None, None
+        return np.stack(xs), np.asarray(ys, np.int64)
+
+    X, Y = read_split("train")
+    if X is None:
+        return None
+    VX, VY = read_split("valid")
+    if VX is not None:  # reference merges valid into train
+        X, Y = np.concatenate([X, VX]), np.concatenate([Y, VY])
+    TX, TY = read_split("test")
+    if TX is None:
+        held = np.zeros(len(X), bool)
+        held[::5] = True
+        TX, TY, X, Y = X[held], Y[held], X[~held], Y[~held]
+    idx_map = partition_data(Y, n_clients, method, alpha, seed, fix_path=fix_path)
+    return FederatedData(X, Y, TX, TY, idx_map, None, len(classes))
+
+
+def _load_svhn_mat(data_dir, spec, n_clients, method, alpha, seed,
+                   fix_path=None):
+    """SVHN cropped-digit .mat files (``train_32x32.mat``/``test_32x32.mat``):
+    X is [32, 32, 3, N] uint8, y is [N, 1] with label 10 meaning digit 0
+    (torchvision convention). Partitioned through the shared LDA path like
+    the reference's cifar10/data_loader.py:140-209 family."""
+    train_p = os.path.join(data_dir, "train_32x32.mat")
+    if not os.path.exists(train_p):
+        return None
+    try:
+        from scipy.io import loadmat
+    except ImportError:
+        return None
+
+    def read(path):
+        m = loadmat(path)
+        X = np.transpose(m["X"], (3, 0, 1, 2)).astype(np.float32) / 255.0
+        y = np.asarray(m["y"], np.int64).reshape(-1)
+        y[y == 10] = 0
+        return X, y
+
+    X, Y = read(train_p)
+    test_p = os.path.join(data_dir, "test_32x32.mat")
+    if os.path.exists(test_p):
+        TX, TY = read(test_p)
+    else:
+        held = np.zeros(len(X), bool)
+        held[::5] = True
+        TX, TY, X, Y = X[held], Y[held], X[~held], Y[~held]
     idx_map = partition_data(Y, n_clients, method, alpha, seed, fix_path=fix_path)
     return FederatedData(X, Y, TX, TY, idx_map, None, spec.num_classes)
 

@@ -88,10 +88,12 @@ def load_dataset(
     test_samples: int | None = None,
     uint8_pixels: bool = False,
     partition_fix_path: str | None = None,
+    image_size: int | None = None,
 ) -> FederatedData:
     fd = _load_dataset_impl(
         name, data_dir, client_num, partition_method, partition_alpha, seed,
         samples_per_client, test_samples, uint8_pixels, partition_fix_path,
+        image_size,
     )
     if partition_fix_path is not None:
         # post-condition, whichever load route ran: the returned partition IS
@@ -124,8 +126,14 @@ def _load_dataset_impl(
     test_samples: int | None = None,
     uint8_pixels: bool = False,
     partition_fix_path: str | None = None,
+    image_size: int | None = None,
 ) -> FederatedData:
     """Load (or synthesize) a federated dataset by reference name.
+
+    image_size: decode-time square resize for the folder/csv image readers
+    (imagenet, gld23k/gld160k) — e.g. 224 for the reference-fidelity
+    ImageNet resolution (ImageNet/data_loader.py trains 224x224); None
+    keeps the study-scale default (64).
 
     client_num overrides the canonical count (the cross-silo datasets take it
     from --client_num_in_total in the reference; natural-partition datasets
@@ -148,7 +156,8 @@ def _load_dataset_impl(
 
         fd = files.try_load(spec, data_dir, n_clients, partition_method,
                             partition_alpha, seed,
-                            partition_fix_path=partition_fix_path)
+                            partition_fix_path=partition_fix_path,
+                            image_size=image_size)
         if fd is not None:
             if uint8_pixels:
                 fd = _requantize_uint8(fd)

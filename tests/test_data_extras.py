@@ -203,3 +203,85 @@ def test_imagenet_folder_reader_no_val_and_caps(tmp_path):
     assert all(len(v) > 0 for v in fd.train_idx_map.values())
     assert len(fd.train_x) + len(fd.test_x) == 10  # junk skipped, disjoint
     assert len(fd.test_x) == 2  # every 5th of 10 held out
+
+
+def test_cinic10_folder_reader(tmp_path):
+    """CINIC-10 imagefolder layout ({train,valid,test}/<class>/*.png):
+    valid merges into train (the reference's enlarged split), test read
+    directly, LDA partition over the shared path."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    classes = ("airplane", "automobile", "bird")
+    for split, n_img in (("train", 4), ("valid", 2), ("test", 3)):
+        for cname in classes:
+            d = tmp_path / split / cname
+            d.mkdir(parents=True, exist_ok=True)
+            for i in range(n_img):
+                Image.fromarray(
+                    rng.randint(0, 255, (32, 32, 3), np.uint8)
+                ).save(d / f"{cname}_{i}.png")
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("cinic10", data_dir=str(tmp_path), client_num=2,
+                      partition_method="homo")
+    assert fd.class_num == 3
+    assert fd.train_x.shape == (18, 32, 32, 3)  # train(12) + valid(6) merged
+    assert fd.test_x.shape == (9, 32, 32, 3)
+    assert fd.train_x.max() <= 1.0
+    assert set(fd.train_idx_map) == {0, 1}
+    all_idx = np.concatenate([fd.train_idx_map[0], fd.train_idx_map[1]])
+    assert len(np.unique(all_idx)) == 18  # full disjoint partition
+
+
+def test_svhn_mat_reader(tmp_path):
+    """SVHN cropped-digit .mat files: X [32,32,3,N] uint8, y [N,1] with
+    label 10 meaning digit 0, partitioned via the shared LDA path."""
+    scipy_io = pytest.importorskip("scipy.io")
+
+    rng = np.random.RandomState(0)
+
+    def write(path, n):
+        X = rng.randint(0, 255, (32, 32, 3, n), np.uint8)
+        y = rng.randint(1, 11, (n, 1)).astype(np.uint8)  # torchvision 1..10
+        scipy_io.savemat(path, {"X": X, "y": y})
+        return y.reshape(-1)
+
+    y_tr = write(tmp_path / "train_32x32.mat", 40)
+    write(tmp_path / "test_32x32.mat", 10)
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("svhn", data_dir=str(tmp_path), client_num=4,
+                      partition_method="homo")
+    assert fd.train_x.shape == (40, 32, 32, 3) and fd.train_x.max() <= 1.0
+    assert fd.test_x.shape == (10, 32, 32, 3)
+    # label-10 -> 0 remap
+    expect = y_tr.astype(np.int64)
+    expect[expect == 10] = 0
+    np.testing.assert_array_equal(fd.train_y, expect)
+    assert set(np.unique(fd.train_y)) <= set(range(10))
+
+
+def test_imagenet_image_size_flag(tmp_path):
+    """--image_size wires through load_dataset to the folder reader: 224
+    gives reference-fidelity resolution (ImageNet/data_loader.py)."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for wnid in ("n1", "n2"):
+        d = tmp_path / "train" / wnid
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.fromarray(
+                rng.randint(0, 255, (48, 56, 3), np.uint8)
+            ).save(d / f"img_{i}.JPEG")
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("imagenet", data_dir=str(tmp_path), client_num=2,
+                      image_size=224)
+    assert fd.train_x.shape[1:] == (224, 224, 3)
