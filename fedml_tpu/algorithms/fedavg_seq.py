@@ -83,17 +83,6 @@ class FedAvgSeqAPI:
         self.rng = jax.random.PRNGKey(config.seed)
         self.task_plain = sequence_task(model_ctor(None), pad_id=pad_id)
         sharded_model = model_ctor("seq")
-        if getattr(sharded_model, "use_flash", False):
-            # the Pallas kernels' custom VJP still trips check_vma's strict
-            # dynamic_slice rule, and check_vma=False would disable the
-            # vma-aware grad transpose this engine's correctness rests on
-            # (see core/local.py NOTE). Flash + sequence sharding remains
-            # available via parallel/ring_attention.py's *_sharded wrappers.
-            raise ValueError(
-                "FedAvgSeqAPI: use_flash is unsupported inside the FL "
-                "engine; use the plain ring or ulysses impls (the Pallas "
-                "flash path is available via the standalone sharded "
-                "attention wrappers)")
         if (getattr(sharded_model, "seq_impl", "ring") == "ulysses"
                 and getattr(sharded_model, "num_heads", None) is not None
                 and sharded_model.num_heads % mesh.shape["seq"] != 0):
@@ -271,19 +260,31 @@ class FedAvgSeqAPI:
                 })
         return self.net
 
+    # ---------------------------------------------------------------- state
+    def load_state(self, net, server_opt_state, rng):
+        """Install restored state, re-placing it replicated over the 2-axis
+        mesh (mirrors FedAvgAPI.load_state; the CLI resume path calls this
+        for every engine it checkpoints)."""
+        rep = NamedSharding(self.mesh, P())
+        put = lambda t: jax.tree.map(lambda v: jax.device_put(v, rep), t)
+        self.net, self.server_opt_state, self.rng = (
+            put(net), put(server_opt_state), put(rng))
+
     # ----------------------------------------------------------------- eval
     def evaluate(self):
         """Global test eval on the axis-free twin (replicated params; the
         T-sharded program is only needed where activations must not
         materialize — for eval-sized batches the plain path is fine)."""
-        if self._test_cache is None:
-            tx, ty = self.data.test_x, self.data.test_y
-            if (self.cfg.eval_max_samples is not None
-                    and len(tx) > self.cfg.eval_max_samples):
-                # same seeded validation subset as FedAvgAPI.evaluate
-                sel = np.random.RandomState(self.cfg.seed).choice(
-                    len(tx), self.cfg.eval_max_samples, replace=False)
-                tx, ty = tx[sel], ty[sel]
+        from fedml_tpu.algorithms.fedavg import eval_subset
+
+        fresh = (self.cfg.eval_subset_mode == "fresh"
+                 and self.cfg.eval_max_samples is not None
+                 and len(self.data.test_x) > self.cfg.eval_max_samples)
+        self._eval_calls = getattr(self, "_eval_calls", 0) + 1
+        if self._test_cache is None or fresh:
+            # same validation-subset policy as FedAvgAPI.evaluate
+            tx, ty = eval_subset(self.data.test_x, self.data.test_y,
+                                 self.cfg, self._eval_calls)
             n = len(tx)
             if self.cfg.ci:
                 n = min(n, 512)

@@ -107,8 +107,11 @@ def sequence_task(module, pad_id: int = 0, count_pad_in_acc: bool = False,
     seq_axis: sequence-parallel mode — x/y carry this device's sequence
     slice (the module runs ring/Ulysses attention over the axis), so the
     loss normalizer and the metric sums are psum-ed over it: every seq shard
-    then holds the identical GLOBAL loss/metrics, and the psum-ed gradient
-    (LocalSpec.grad_psum_axis) equals the unsharded gradient exactly."""
+    then holds the identical GLOBAL loss/metrics. No explicit gradient
+    collective is needed: differentiating this psum-ed loss w.r.t.
+    seq-invariant params makes shard_map's vma-aware transpose insert the
+    gradient psum itself (see the NOTE in core/local.py), so the gradient
+    equals the unsharded gradient exactly."""
 
     def init(rng, x_sample):
         p_rng, d_rng = jax.random.split(rng)

@@ -36,6 +36,10 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--model", type=str, default="lr")
     parser.add_argument("--dataset", type=str, default="mnist")
     parser.add_argument("--data_dir", type=str, default=None)
+    parser.add_argument("--image_size", type=int, default=None,
+                        help="square decode resolution for the folder/csv "
+                             "image readers (imagenet/gld): 224 = reference "
+                             "fidelity, default 64 = study scale")
     parser.add_argument("--partition_method", type=str, default=None,
                         help="homo | hetero (LDA) | hetero-bal | hetero-fix | natural")
     parser.add_argument("--partition_alpha", type=float, default=0.5)
@@ -53,6 +57,12 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--ci", type=int, default=0)
+    parser.add_argument("--eval_subset_mode", type=str, default="fixed",
+                        choices=["fixed", "fresh"],
+                        help="validation-subset policy when eval is capped: "
+                             "'fresh' resamples per eval (reference "
+                             "FedAVGAggregator semantics), 'fixed' reuses one "
+                             "seeded subset")
     # TPU execution surface (replaces --backend/--gpu_mapping/--is_mobile)
     parser.add_argument("--mesh", type=int, default=0,
                         help="devices on the 'clients' mesh axis; 0 = single-device vmap")
@@ -152,7 +162,7 @@ def build_api(args):
         args.dataset, data_dir=args.data_dir, client_num=args.client_num_in_total,
         partition_method=args.partition_method, partition_alpha=args.partition_alpha,
         seed=args.seed, uint8_pixels=bool(getattr(args, "uint8_pixels", 0)),
-        partition_fix_path=args.partition_fix_path,
+        partition_fix_path=args.partition_fix_path, image_size=args.image_size,
     )
     n_total = data.num_clients
 
@@ -219,6 +229,7 @@ def build_api(args):
         # (FedAVGAggregator._generate_validation_set, :99-107)
         eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
                           else None),
+        eval_subset_mode=args.eval_subset_mode,
     )
     if args.algo == "fedavg_seq":
         from fedml_tpu.algorithms.fedavg_seq import FedAvgSeqAPI
