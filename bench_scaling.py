@@ -1,13 +1,23 @@
-"""Client-scaling benchmark: rounds/sec and samples/sec vs clients-per-round.
+"""Client-scaling + cross-silo benchmarks: rounds/sec vs clients-per-round.
 
 BASELINE.md north-star row 3: "client scaling 8 -> 256 simulated clients,
 near-linear". The SPMD engine vmaps clients, so scaling K multiplies work
 per round; throughput in samples/sec should grow until the chip saturates.
 
-Usage:  python bench_scaling.py [--device_data 1] [--points 8,32,128,256]
+Workloads:
+  - femnist_cnn (default): the flagship cross-device config (FedAvg CNN,
+    28x28x1, 62 classes) — bench.py's workload at varying K.
+  - cifar_resnet56: the reference's cross-silo setting (ResNet-56 on
+    CIFAR-10 shapes, 10 clients, benchmark/README.md:105 — its RTX-2080Ti
+    x4 distributed row) as one SPMD program on the chip.
+
+Usage:  python bench_scaling.py [--workload cifar_resnet56] [--device_data 1]
+                                [--points 8,32,128,256] [--spans 1]
 Prints one JSON line per point (bench.py remains the single-line driver
 benchmark; this script is the scaling study). A point that fails (e.g. a
 remote-compile drop) prints an error line and the sweep continues.
+--spans 1 adds a host-side span breakdown (pack vs device compute vs eval,
+utils/tracing.RoundTracer) to each point — where round time goes.
 """
 
 from __future__ import annotations
@@ -24,15 +34,24 @@ def _one_point(args, data, task, k):
 
     cfg = FedAvgConfig(
         comm_round=args.rounds, client_num_in_total=data.num_clients,
-        client_num_per_round=k, epochs=1, batch_size=20, lr=0.1,
-        frequency_of_the_test=10_000, max_batches=28,
+        client_num_per_round=k, epochs=1, batch_size=args.batch_size, lr=0.1,
+        frequency_of_the_test=10_000, max_batches=args.max_batches,
     )
     api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
+
+    def span_totals():
+        tot = {}
+        for row in api.tracer.rounds:
+            for k_, v in row.items():
+                tot[k_] = tot.get(k_, 0.0) + v
+        return tot
+
     if args.device_data:
         # one compiled scan per block: measures device throughput, not
         # per-round host dispatch (bench.py uses the same path)
         api.run_rounds(0, args.rounds)
         jax.block_until_ready(api.net.params)
+        base = span_totals()  # warmup holds the one-time compile; exclude
         t0 = time.perf_counter()
         ms = api.run_rounds(args.rounds, args.rounds)
         jax.block_until_ready(api.net.params)
@@ -40,6 +59,7 @@ def _one_point(args, data, task, k):
     else:
         api.run_round(0)
         jax.block_until_ready(api.net.params)
+        base = span_totals()
         t0 = time.perf_counter()
         for r in range(1, args.rounds + 1):
             m = api.run_round(r)
@@ -47,27 +67,70 @@ def _one_point(args, data, task, k):
         count = float(m["count"])
     dt = time.perf_counter() - t0
     rps = args.rounds / dt
-    print(json.dumps({
+    rec = {
+        "workload": args.workload,
         "clients_per_round": k,
         "rounds_per_sec": round(rps, 3),
         "samples_per_sec": round(count * rps, 1),
         "device": jax.devices()[0].platform,
-    }), flush=True)
+    }
+    if args.spans:
+        # where TIMED-window wall-clock goes. Tracer spans give the host
+        # side (index/data packing); everything else is the device program
+        # + dispatch (the engines dispatch asynchronously, so per-span
+        # device timing is not separable host-side — the residual is).
+        # The warmup compile is excluded (delta vs the post-warmup base).
+        end = span_totals()
+        pack = end.get("pack", 0.0) - base.get("pack", 0.0)
+        rec["span_seconds"] = {
+            "host_pack": round(pack, 3),
+            "device_plus_dispatch": round(max(0.0, dt - pack), 3),
+        }
+    print(json.dumps(rec), flush=True)
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--points", type=str, default="8,32,128,256")
+    ap.add_argument("--workload", type=str, default="femnist_cnn",
+                    choices=["femnist_cnn", "cifar_resnet56"])
+    ap.add_argument("--points", type=str, default=None,
+                    help="clients-per-round sweep; default 8,32,128,256 "
+                         "(femnist_cnn) or 10 (cifar_resnet56 = the "
+                         "reference cross-silo client count)")
     ap.add_argument("--device_data", type=int, default=1)
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--batch_size", type=int, default=None)
+    ap.add_argument("--max_batches", type=int, default=None)
+    ap.add_argument("--spans", type=int, default=1)
+    ap.add_argument("--samples_per_client", type=int, default=None)
     args = ap.parse_args()
 
     from fedml_tpu.core.tasks import classification_task
-    from fedml_tpu.data.registry import load_dataset
-    from fedml_tpu.models.cnn import CNNOriginalFedAvg
 
-    data = load_dataset("femnist", seed=0, uint8_pixels=True)
-    task = classification_task(CNNOriginalFedAvg(only_digits=False))
+    if args.workload == "cifar_resnet56":
+        from fedml_tpu.data.synthetic import synthetic_images
+        from fedml_tpu.models.resnet import ResNetCIFAR
+
+        args.points = args.points or "10"
+        args.batch_size = args.batch_size or 64
+        args.max_batches = args.max_batches or 8
+        # 10 silos, CIFAR-10 shapes (benchmark/README.md:105 setting);
+        # uint8 pixels like the flagship path
+        data = synthetic_images(
+            num_clients=10, image_shape=(32, 32, 3), num_classes=10,
+            samples_per_client=args.samples_per_client or 512,
+            test_samples=512, seed=0, size_lognormal=False, as_uint8=True)
+        task = classification_task(ResNetCIFAR(depth=56, num_classes=10,
+                                               norm_type="group"))
+    else:
+        from fedml_tpu.data.registry import load_dataset
+        from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+        args.points = args.points or "8,32,128,256"
+        args.batch_size = args.batch_size or 20
+        args.max_batches = args.max_batches or 28
+        data = load_dataset("femnist", seed=0, uint8_pixels=True)
+        task = classification_task(CNNOriginalFedAvg(only_digits=False))
 
     for k in [int(p) for p in args.points.split(",")]:
         try:

@@ -65,6 +65,10 @@ class RoundTracer:
             }
         return out
 
+    def totals(self) -> dict[str, float]:
+        """name -> total seconds across all rounds (the bench span report)."""
+        return {k: v["total"] for k, v in self.summary().items()}
+
 
 @contextlib.contextmanager
 def trace(logdir: str):

@@ -1,0 +1,103 @@
+"""Real-data accuracy reproduction: FedAvg + LR crossing the reference's
+MNIST-LR threshold shape (>75% test accuracy, benchmark/README.md:12) on
+REAL handwritten-digit data.
+
+This build environment has zero network egress, so the LEAF MNIST download
+cannot run here; the exact reproduction command (run it where downloads
+work) is:
+
+    # LEAF MNIST (power-law, 1000 clients) per the reference's
+    # data/MNIST/download_and_unzip.sh, then:
+    python -m fedml_tpu.experiments.cli --algo fedavg --dataset mnist \
+        --model lr --data_dir <dir-with-train/-test/-json> \
+        --client_num_in_total 1000 --client_num_per_round 10 \
+        --batch_size 10 --lr 0.03 --epochs 1 --comm_round 100 \
+        --frequency_of_the_test 10
+    # expected: test_acc crosses 0.75 well before round 100 (the reference
+    # publishes >75% @ 100+ rounds; LR on MNIST typically ~0.85 by then)
+
+What THIS script runs instead — the same pipeline on the real data that IS
+available offline: scikit-learn's UCI handwritten digits (1,797 genuine
+8x8 grayscale scans, Alpaydin & Kaynak 1995). Same model family (LR), same
+engine, LEAF-like power-law client sizes, same threshold (>75%). This is a
+weaker claim than MNIST parity (smaller images, 1.8k samples) but it is
+REAL data through the identical compiled program — synthetic smoke proves
+plumbing; this proves learning.
+
+Writes runs/repro_digits_lr/metrics.jsonl and prints the crossing round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def build_digits_federation(num_clients: int = 50, seed: int = 0):
+    from sklearn.datasets import load_digits
+
+    from fedml_tpu.core.client_data import FederatedData
+
+    X, y = load_digits(return_X_y=True)
+    X = (X / 16.0).astype(np.float32)  # 4-bit ink counts -> [0, 1]
+    y = y.astype(np.int64)
+    rs = np.random.RandomState(seed)
+    perm = rs.permutation(len(X))
+    X, y = X[perm], y[perm]
+    n_test = len(X) // 5
+    TX, TY, X, y = X[:n_test], y[:n_test], X[n_test:], y[n_test:]
+
+    # LEAF-like power-law client sizes over the real rows
+    raw = rs.lognormal(0.0, 1.0, num_clients)
+    sizes = np.maximum(4, (raw / raw.sum() * len(X)).astype(int))
+    while sizes.sum() > len(X):
+        sizes[np.argmax(sizes)] -= 1
+    off, idx_map = 0, {}
+    for c in range(num_clients):
+        idx_map[c] = np.arange(off, off + sizes[c])
+        off += sizes[c]
+    return FederatedData(X, y, TX, TY, idx_map, None, 10)
+
+
+def main():
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.models.linear import LogisticRegression
+
+    rounds = int(os.environ.get("REPRO_ROUNDS", "100"))
+    data = build_digits_federation()
+    cfg = FedAvgConfig(
+        comm_round=rounds, client_num_in_total=data.num_clients,
+        client_num_per_round=10, epochs=1, batch_size=10, lr=0.03,
+        frequency_of_the_test=5, seed=0,
+    )
+    api = FedAvgAPI(data, classification_task(LogisticRegression(num_classes=10)), cfg)
+    api.train()
+
+    out_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "runs", "repro_digits_lr")
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "metrics.jsonl"), "w") as f:
+        for rec in api.history:
+            f.write(json.dumps(rec) + "\n")
+
+    crossed = next((h["round"] for h in api.history if h["test_acc"] > 0.75), None)
+    final = api.history[-1]
+    print(json.dumps({
+        "dataset": "uci_digits (real, offline)",
+        "threshold": 0.75,
+        "crossed_at_round": crossed,
+        "final_round": final["round"],
+        "final_test_acc": round(final["test_acc"], 4),
+    }))
+    if crossed is None:
+        raise SystemExit("threshold not crossed")
+
+
+if __name__ == "__main__":
+    main()
