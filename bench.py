@@ -109,10 +109,19 @@ def _measure(mode: str) -> None:
         frequency_of_the_test=10_000,  # pure training throughput
         max_batches=max_batches,  # 28 covers ~[22,550]-sample clients at bs=20
     )
-    task = classification_task(CNNOriginalFedAvg(only_digits=False))
+    # FEDML_BENCH_BF16=1: bf16 activations on the MXU (params stay f32) —
+    # the standard TPU mixed-precision recipe; f32 default for exact
+    # reference-comparable numerics
+    dtype = None
+    if os.environ.get("FEDML_BENCH_BF16") == "1":
+        import jax.numpy as jnp
+
+        dtype = jnp.bfloat16
+    task = classification_task(CNNOriginalFedAvg(only_digits=False, dtype=dtype))
     # device_data: whole train set parked in HBM (~300 MB uint8); a round
-    # ships only the shuffled index block (~KBs) and gathers on device
-    api = FedAvgAPI(data, task, cfg, device_data=True)
+    # ships only the shuffled index block (~KBs) and gathers on device;
+    # donate: round programs write outputs into the incoming model buffers
+    api = FedAvgAPI(data, task, cfg, device_data=True, donate=True)
 
     if mode == "per_round":
         # cheap path: ONE small per-round program, compiled once, timed a

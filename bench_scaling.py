@@ -37,21 +37,15 @@ def _one_point(args, data, task, k):
         client_num_per_round=k, epochs=1, batch_size=args.batch_size, lr=0.1,
         frequency_of_the_test=10_000, max_batches=args.max_batches,
     )
-    api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data))
-
-    def span_totals():
-        tot = {}
-        for row in api.tracer.rounds:
-            for k_, v in row.items():
-                tot[k_] = tot.get(k_, 0.0) + v
-        return tot
+    api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data),
+                    donate=True)
 
     if args.device_data:
         # one compiled scan per block: measures device throughput, not
         # per-round host dispatch (bench.py uses the same path)
         api.run_rounds(0, args.rounds)
         jax.block_until_ready(api.net.params)
-        base = span_totals()  # warmup holds the one-time compile; exclude
+        base = api.tracer.totals()  # warmup holds the compile; exclude
         t0 = time.perf_counter()
         ms = api.run_rounds(args.rounds, args.rounds)
         jax.block_until_ready(api.net.params)
@@ -59,7 +53,7 @@ def _one_point(args, data, task, k):
     else:
         api.run_round(0)
         jax.block_until_ready(api.net.params)
-        base = span_totals()
+        base = api.tracer.totals()
         t0 = time.perf_counter()
         for r in range(1, args.rounds + 1):
             m = api.run_round(r)
@@ -80,7 +74,7 @@ def _one_point(args, data, task, k):
         # + dispatch (the engines dispatch asynchronously, so per-span
         # device timing is not separable host-side — the residual is).
         # The warmup compile is excluded (delta vs the post-warmup base).
-        end = span_totals()
+        end = api.tracer.totals()
         pack = end.get("pack", 0.0) - base.get("pack", 0.0)
         rec["span_seconds"] = {
             "host_pack": round(pack, 3),

@@ -175,6 +175,7 @@ class FedAvgAPI:
         post_aggregate_hook: Callable | None = None,
         local_spec: LocalSpec | None = None,
         device_data: bool = False,
+        donate: bool = False,
     ):
         self.data = dataset
         self.task = task
@@ -185,6 +186,13 @@ class FedAvgAPI:
         # device-resident data plane: park the whole train set in HBM once;
         # each round ships only an IndexBatch (KBs) and the row gather runs
         # on device. Batches are bit-identical to the host packer's.
+        # donate=True: the per-round program consumes the incoming net/opt
+        # buffers (XLA writes outputs in place — no second copy of the model
+        # in HBM). Opt-in because a caller may legitimately hold a reference
+        # to api.net across rounds (e.g. comparing against round-0 weights);
+        # the bench paths enable it. The R-round block fns always donate —
+        # their contract never exposed intermediate nets.
+        self.donate = donate
         self.device_data = device_data
         if device_data:
             sh = NamedSharding(mesh, P()) if mesh is not None else None
@@ -261,9 +269,11 @@ class FedAvgAPI:
 
         client_keys = _make_client_keys(cfg.seed)
 
+        donate_args = (1, 2) if self.donate else ()
+
         if self.mesh is None:
 
-            @jax.jit
+            @partial(jax.jit, donate_argnums=donate_args)
             def round_fn(rng, net, server_opt_state, batch, round_idx, ids):
                 x, y, mask, nsamp_in = self._materialize(batch)
                 keys = client_keys(round_idx, ids)
@@ -321,7 +331,7 @@ class FedAvgAPI:
             out_specs=(P(), P()),
         )
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donate_args)
         def round_fn(rng, net, server_opt_state, batch, round_idx, ids):
             keys = client_keys(round_idx, ids)
             rng, kh, kp = jax.random.split(rng, 3)

@@ -66,11 +66,13 @@ class FedAvgSeqAPI:
         server_update=None,
         server_opt_init=None,
         local_spec: LocalSpec | None = None,
+        donate: bool = False,
     ):
         if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
             raise ValueError(
                 f"FedAvgSeqAPI needs axes ('clients','seq'), got {mesh.axis_names}")
         self.data, self.cfg, self.mesh = dataset, config, mesh
+        self.donate = donate  # same opt-in contract as FedAvgAPI
         cd, sd = mesh.shape["clients"], mesh.shape["seq"]
         T = int(dataset.train_x.shape[1])
         if T % sd != 0:
@@ -156,7 +158,9 @@ class FedAvgSeqAPI:
             out_specs=(P(), P(), P()),
         )
 
-        @jax.jit
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0, 1) if self.donate else ())
         def round_fn(net, server_opt_state, x, y, mask, nsamp, round_idx, ids):
             keys = client_keys(round_idx, ids)
             # seq shards hold duplicate metric copies psum-ed over 'clients'
@@ -222,7 +226,7 @@ class FedAvgSeqAPI:
                       P(None, "clients"), P()),
             out_specs=(P(), P(), P()),
         )
-        return jax.jit(smapped)
+        return jax.jit(smapped, donate_argnums=(0, 1))
 
     def run_round(self, round_idx: int):
         cfg = self.cfg

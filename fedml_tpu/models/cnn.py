@@ -9,27 +9,40 @@ Input layout is NHWC [bs, 28, 28, 1] (TPU-native; torch reference is NCHW).
 
 from __future__ import annotations
 
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class CNNOriginalFedAvg(nn.Module):
-    """McMahan et al. CNN (cnn.py:26-97). only_digits=False -> 62 classes."""
+    """McMahan et al. CNN (cnn.py:26-97). only_digits=False -> 62 classes.
+
+    ``dtype=jnp.bfloat16`` runs the convs/matmuls in bf16 on the MXU
+    (PARAMS stay float32 — flax casts per-op and the head below returns
+    f32 logits), the standard TPU mixed-precision recipe. Default float32
+    keeps exact reference-comparable numerics."""
 
     only_digits: bool = False
+    dtype: Any = None  # activation/compute dtype; None = float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         if x.ndim == 3:
             x = x[..., None]
-        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        dt = self.dtype
+        if dt is not None:
+            x = x.astype(dt)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=dt)(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(x)
-        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=dt)(x)
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
-        return nn.Dense(10 if self.only_digits else 62)(x)
+        x = nn.relu(nn.Dense(512, dtype=dt)(x))
+        # head in f32: loss/softmax numerics stay full-precision
+        return nn.Dense(10 if self.only_digits else 62)(x.astype(jnp.float32))
 
 
 class CNNDropOut(nn.Module):
