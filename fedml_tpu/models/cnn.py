@@ -1,7 +1,9 @@
 """FedAvg-paper CNNs (reference: fedml_api/model/cv/cnn.py:26-163).
 
 CNN_OriginalFedAvg: conv5x5(32) -> maxpool -> conv5x5(64) -> maxpool ->
-dense 512 -> softmax head; 1,663,370 params for femnist (62 classes).
+dense 512 -> softmax head; 1,663,370 params with only_digits=True,
+1,690,046 for femnist (62 classes) — both exactly the reference counts
+(pinned in tests/test_param_parity.py).
 CNN_DropOut: the TFF/LEAF variant with 3x3 convs and dropout.
 
 Input layout is NHWC [bs, 28, 28, 1] (TPU-native; torch reference is NCHW).
