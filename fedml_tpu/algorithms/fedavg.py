@@ -135,6 +135,9 @@ class FedAvgConfig:
     # (FedAVGAggregator._generate_validation_set, FedAVGAggregator.py:99-107);
     # None = full test set
     eval_max_samples: int | None = None
+    # rematerialize per-batch forwards under autodiff (jax.checkpoint) in
+    # the default LocalSpec — HBM for FLOPs on deep models/long sequences
+    remat: bool = False
     # 'fixed': ONE seeded subset reused every eval (comparable curves across
     # rounds); 'fresh': a new subset each eval — the reference's exact
     # semantics (random.sample per call, FedAVGAggregator.py:99-107),
@@ -207,7 +210,8 @@ class FedAvgAPI:
         self.num_batches = min(config.max_batches or b_needed, b_needed)
 
         self.local_spec = local_spec or LocalSpec(
-            optimizer=make_client_optimizer(config), epochs=config.epochs
+            optimizer=make_client_optimizer(config), epochs=config.epochs,
+            remat=config.remat,
         )
         self.local_update = make_local_update(task, self.local_spec)
         self.eval_fn = make_eval_fn(task)

@@ -108,7 +108,8 @@ class FedAvgSeqAPI:
         # over seq-invariant params: identical on every shard, no collective;
         # equivalence test-enforced)
         spec = local_spec or LocalSpec(
-            optimizer=make_client_optimizer(config), epochs=config.epochs)
+            optimizer=make_client_optimizer(config), epochs=config.epochs,
+            remat=config.remat)
         self.local_update = make_local_update(self.task_sharded, spec)
 
         self.rng, init_key = jax.random.split(self.rng)

@@ -17,6 +17,7 @@ from fedml_tpu.core.local import LocalSpec
 class FedProxAPI(FedAvgAPI):
     def __init__(self, dataset, task, config: FedAvgConfig, mesh=None, mu: float = 0.1, **kwargs):
         spec = LocalSpec(
-            optimizer=make_client_optimizer(config), epochs=config.epochs, prox_mu=mu
+            optimizer=make_client_optimizer(config), epochs=config.epochs,
+            prox_mu=mu, remat=config.remat,
         )
         super().__init__(dataset, task, config, mesh=mesh, local_spec=spec, **kwargs)

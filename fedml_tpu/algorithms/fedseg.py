@@ -74,7 +74,8 @@ class FedSegAPI(FedAvgAPI):
         tx = optax.sgd(schedule, momentum=config.momentum or None)
         if config.wd:
             tx = optax.chain(optax.add_decayed_weights(config.wd), tx)
-        local_spec = LocalSpec(optimizer=tx, epochs=config.epochs)
+        local_spec = LocalSpec(optimizer=tx, epochs=config.epochs,
+                               remat=config.remat)
 
         super().__init__(dataset, task, config, mesh=mesh,
                          local_spec=local_spec, **kwargs)

@@ -59,6 +59,11 @@ class LocalSpec:
     optimizer: optax.GradientTransformation
     epochs: int = 1
     prox_mu: float = 0.0  # FedProx proximal coefficient (0 = plain FedAvg)
+    # rematerialize the per-batch forward under autodiff (jax.checkpoint):
+    # activations are recomputed in the backward pass instead of living in
+    # HBM across it — the standard TPU memory/FLOPs trade for deep models
+    # or long sequences. Numerics are identical (test-enforced).
+    remat: bool = False
 
 
 def _vma_of(tree) -> frozenset:
@@ -115,6 +120,11 @@ def make_local_update(task: Task, spec: LocalSpec):
                 )
                 loss = loss + 0.5 * spec.prox_mu * sum(jax.tree.leaves(sq))
             return loss, (new_extra, metr)
+
+        if spec.remat:
+            # prevent_cse=False: inside lax.scan the CSE barriers are
+            # unnecessary (per the jax.checkpoint docs) and only cost fusion
+            total_loss = jax.checkpoint(total_loss, prevent_cse=False)
 
         # NOTE sequence-parallel fits need no grad psum here: with the task's
         # loss psum-ed over the seq axis and params entering seq-INVARIANT,

@@ -28,7 +28,8 @@ class DistributedTrainer:
         self.num_batches = min(cfg.max_batches or b_needed, b_needed)
 
         spec = local_spec or LocalSpec(
-            optimizer=make_client_optimizer(cfg), epochs=cfg.epochs
+            optimizer=make_client_optimizer(cfg), epochs=cfg.epochs,
+            remat=cfg.remat,
         )
         self.local_update = jax.jit(make_local_update(task, spec))
 

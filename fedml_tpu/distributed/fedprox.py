@@ -21,7 +21,7 @@ from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 
 def prox_spec(cfg: FedAvgConfig, mu: float) -> LocalSpec:
     return LocalSpec(optimizer=make_client_optimizer(cfg), epochs=cfg.epochs,
-                     prox_mu=mu)
+                     prox_mu=mu, remat=cfg.remat)
 
 
 def run_simulated(dataset, task, cfg: FedAvgConfig, mu: float = 0.1,

@@ -75,6 +75,10 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--lm_depth", type=int, default=2)
     parser.add_argument("--lm_heads", type=int, default=4)
     parser.add_argument("--max_batches", type=int, default=None)
+    parser.add_argument("--remat", type=int, default=0,
+                        help="1 = jax.checkpoint the local-fit forwards "
+                             "(recompute activations in backward; fits "
+                             "deeper models / longer contexts in HBM)")
     parser.add_argument("--device_data", type=int, default=0,
                         help="1 = HBM-resident train set + per-round index blocks")
     parser.add_argument("--uint8_pixels", type=int, default=0,
@@ -225,6 +229,7 @@ def build_api(args):
         client_optimizer=args.client_optimizer, lr=args.lr, wd=args.wd,
         frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
         max_batches=args.max_batches, ci=bool(args.ci),
+        remat=bool(args.remat),
         # stackoverflow evals run on a 10k-sample validation subset
         # (FedAVGAggregator._generate_validation_set, :99-107)
         eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")

@@ -304,3 +304,25 @@ def test_run_rounds_block_mesh_equals_single_device(lr_data, lr_task, mesh8):
     for a, b in zip(pack_pytree(single.net), pack_pytree(meshed.net)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=1e-6)
+
+
+def test_remat_local_update_identical(lr_data, lr_task):
+    """LocalSpec(remat=True) wraps the per-batch forward in jax.checkpoint
+    (recompute activations in backward — HBM for FLOPs); the trained
+    parameters must be IDENTICAL to the non-remat fit."""
+    from fedml_tpu.core.local import LocalSpec
+    from fedml_tpu.algorithms.fedavg import make_client_optimizer
+
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=4, epochs=2, batch_size=8,
+                       lr=0.1, momentum=0.9, seed=0)
+    plain = FedAvgAPI(lr_data, lr_task, cfg)
+    remat = FedAvgAPI(lr_data, lr_task, cfg, local_spec=LocalSpec(
+        optimizer=make_client_optimizer(cfg), epochs=cfg.epochs, remat=True))
+    for r in range(2):
+        plain.run_round(r)
+        remat.run_round(r)
+    for a, b in zip(jax.tree.leaves(plain.net.params),
+                    jax.tree.leaves(remat.net.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
