@@ -12,6 +12,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD" JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+# a dead remote-compile relay must not hang CPU-only CI at interpreter
+# start (sitecustomize dials the relay when this is set)
+unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 
 echo "== unit + oracle suite =="
 python -m pytest tests/ -q
