@@ -60,3 +60,14 @@ def test_rnn_param_counts():
     # TFF stackoverflow NWP: embed(10004,96) + LSTM(670) + proj(96) + head
     # = 960,384 + 2,055,560 + 64,416 + 970,388
     assert _count_int(RNNStackOverflow(), (1, 20)) == 4_050_748
+
+
+def test_resnet18_gn_param_count():
+    from fedml_tpu.models.resnet_gn import ResNet18GN
+
+    # canonical torchvision resnet18 structure with per-CHANNEL GN affine
+    # (the reference's custom GroupNorm2d uses per-GROUP affine, -9,300
+    # params — a deviation from standard GN that we do not copy; see
+    # models/resnet_gn.py docstring)
+    assert _count(ResNet18GN(num_classes=1000, small_input=False),
+                  (1, 64, 64, 3), train=False) == 11_689_512
