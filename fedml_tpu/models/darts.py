@@ -48,11 +48,17 @@ PRIMITIVES = (
 )
 
 
-def _norm(c: int):
+def _norm(c: int, affine: bool = False):
+    """Search-phase norm. affine=False everywhere except the stem — DARTS
+    searches with affine-free norms so the alphas absorb scaling
+    (operations.py OPS all pass affine=False; model_search.py's stem BN is
+    the one affine norm). GroupNorm instead of BN: the supernet trains
+    vmapped over clients, where BN's batch stats would leak across the
+    client axis."""
     g = min(8, c)
     while c % g:  # GroupNorm needs groups | channels (e.g. stem 3*C)
         g -= 1
-    return nn.GroupNorm(num_groups=g)
+    return nn.GroupNorm(num_groups=g, use_scale=affine, use_bias=affine)
 
 
 class _ReLUConvNorm(nn.Module):
@@ -127,7 +133,10 @@ def _pool(x, kind: str, stride: int):
     window, s = (3, 3), (stride, stride)
     if kind == "max":
         return nn.max_pool(x, window, strides=s, padding="SAME")
-    return nn.avg_pool(x, window, strides=s, padding="SAME")
+    # count_include_pad=False: border outputs divide by the VALID element
+    # count, matching the reference AvgPool2d (operations.py:6)
+    return nn.avg_pool(x, window, strides=s, padding="SAME",
+                       count_include_pad=False)
 
 
 class MixedOp(nn.Module):
@@ -150,9 +159,11 @@ class MixedOp(nn.Module):
                 outs.append(FactorizedReduce(self.filters)(x, train)
                             if s == 2 else x)
             elif prim == "max_pool_3x3":
-                outs.append(_pool(x, "max", s))
+                # affine-free norm after pool, like the reference MixedOp's
+                # BatchNorm2d(affine=False) (model_search.py:17-18)
+                outs.append(_norm(x.shape[-1])(_pool(x, "max", s)))
             elif prim == "avg_pool_3x3":
-                outs.append(_pool(x, "avg", s))
+                outs.append(_norm(x.shape[-1])(_pool(x, "avg", s)))
             elif prim == "sep_conv_3x3":
                 outs.append(_SepConv(self.filters, 3, s)(x, train))
             elif prim == "sep_conv_5x5":
@@ -221,7 +232,7 @@ class DARTSNetwork(nn.Module):
 
         C_curr = self.stem_multiplier * self.init_filters
         s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
-        s0 = s1 = _norm(C_curr)(s)
+        s0 = s1 = _norm(C_curr, affine=True)(s)
 
         C_curr = self.init_filters
         reduction_prev = False

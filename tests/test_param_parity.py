@@ -71,3 +71,17 @@ def test_resnet18_gn_param_count():
     # models/resnet_gn.py docstring)
     assert _count(ResNet18GN(num_classes=1000, small_input=False),
                   (1, 64, 64, 3), train=False) == 11_689_512
+
+
+def test_darts_supernet_param_count():
+    from fedml_tpu.models.darts import DARTSNetwork
+
+    # EXACTLY the reference search supernet (model_search.Network with
+    # C=16, layers=8, 10 classes: 1,930,842 incl. 224 arch params —
+    # affine-free norms everywhere but the stem, 8 primitives, separate
+    # normal/reduce alphas)
+    m = DARTSNetwork(num_classes=10, layers=8, init_filters=16)
+    assert _count(m, (1, 32, 32, 3), train=False) == 1_930_842
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    arch = sum(v["params"][k].size for k in ("alphas_normal", "alphas_reduce"))
+    assert arch == 224
