@@ -38,9 +38,11 @@ def create_model(model_name: str, output_dim: int = 10, **kwargs):
         from fedml_tpu.models.mobilenet import MobileNetV1
 
         return MobileNetV1(num_classes=output_dim)
-    if name == "mobilenet_v3":
+    if name in ("mobilenet_v3", "mobilenet_v3_large"):
         from fedml_tpu.models.mobilenet import MobileNetV3
 
+        if name.endswith("_large"):
+            kwargs.setdefault("mode", "large")  # reference default model_mode
         return MobileNetV3(num_classes=output_dim, **kwargs)
     if name == "efficientnet":
         from fedml_tpu.models.efficientnet import EfficientNet

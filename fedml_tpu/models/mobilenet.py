@@ -94,32 +94,58 @@ class _V3Block(nn.Module):
         return x
 
 
+# (expand, out, kernel, stride, se, act) — the paper's Table 1/2 configs
+_V3_SMALL = [
+    (16, 16, 3, 2, True, "relu"),
+    (72, 24, 3, 2, False, "relu"),
+    (88, 24, 3, 1, False, "relu"),
+    (96, 40, 5, 2, True, "hswish"),
+    (240, 40, 5, 1, True, "hswish"),
+    (240, 40, 5, 1, True, "hswish"),
+    (120, 48, 5, 1, True, "hswish"),
+    (144, 48, 5, 1, True, "hswish"),
+    (288, 96, 5, 2, True, "hswish"),
+    (576, 96, 5, 1, True, "hswish"),
+    (576, 96, 5, 1, True, "hswish"),
+]
+_V3_LARGE = [
+    (16, 16, 3, 1, False, "relu"),
+    (64, 24, 3, 2, False, "relu"),
+    (72, 24, 3, 1, False, "relu"),
+    (72, 40, 5, 2, True, "relu"),
+    (120, 40, 5, 1, True, "relu"),
+    (120, 40, 5, 1, True, "relu"),
+    (240, 80, 3, 2, False, "hswish"),
+    (200, 80, 3, 1, False, "hswish"),
+    (184, 80, 3, 1, False, "hswish"),
+    (184, 80, 3, 1, False, "hswish"),
+    (480, 112, 3, 1, True, "hswish"),
+    (672, 112, 3, 1, True, "hswish"),
+    (672, 160, 5, 2, True, "hswish"),
+    (960, 160, 5, 1, True, "hswish"),
+    (960, 160, 5, 1, True, "hswish"),
+]
+
+
 class MobileNetV3(nn.Module):
-    """MobileNetV3-Small (mobilenet_v3.py 'small' mode)."""
+    """MobileNetV3 (mobilenet_v3.py; the reference defaults to
+    model_mode='LARGE', mobilenet_v3.py:138). ``mode`` selects the paper's
+    Small or Large stack; both end in the hswish 1x1 + pooled classifier."""
 
     num_classes: int = 10
+    mode: str = "small"  # 'small' | 'large'
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.mode not in ("small", "large"):
+            raise ValueError(f"mode={self.mode!r} (small|large)")
         x = _ConvBN(16, strides=(2, 2), act="hswish")(x, train)
-        # (expand, out, kernel, stride, se, act)
-        cfg = [
-            (16, 16, 3, 2, True, "relu"),
-            (72, 24, 3, 2, False, "relu"),
-            (88, 24, 3, 1, False, "relu"),
-            (96, 40, 5, 2, True, "hswish"),
-            (240, 40, 5, 1, True, "hswish"),
-            (240, 40, 5, 1, True, "hswish"),
-            (120, 48, 5, 1, True, "hswish"),
-            (144, 48, 5, 1, True, "hswish"),
-            (288, 96, 5, 2, True, "hswish"),
-            (576, 96, 5, 1, True, "hswish"),
-            (576, 96, 5, 1, True, "hswish"),
-        ]
+        cfg = _V3_SMALL if self.mode == "small" else _V3_LARGE
         for e, f, k, s, se, act in cfg:
             x = _V3Block(e, f, k, s, se, act)(x, train)
-        x = _ConvBN(576, (1, 1), act="hswish")(x, train)
+        last, head = (576, 1024) if self.mode == "small" else (960, 1280)
+        x = _ConvBN(last, (1, 1), act="hswish")(x, train)
         x = jnp.mean(x, axis=(1, 2))
-        x = _hard_swish(nn.Dense(1024)(x))
+        x = _hard_swish(nn.Dense(head)(x))
         x = nn.Dropout(0.2, deterministic=not train)(x)
         return nn.Dense(self.num_classes)(x)

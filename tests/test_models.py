@@ -48,6 +48,7 @@ def test_model_forward_shapes(name, shape, classes):
 
 @pytest.mark.parametrize("name,shape,classes", [
     ("mobilenet_v3", (2, 32, 32, 3), 10),
+    ("mobilenet_v3_large", (2, 32, 32, 3), 10),
     ("efficientnet", (2, 32, 32, 3), 10),
 ])
 def test_big_model_forward_shapes(name, shape, classes):
