@@ -1,4 +1,13 @@
-"""VGG (reference: fedml_api/model/cv/vgg.py, 158 LoC — VGG-11/16 baselines)."""
+"""VGG (reference: fedml_api/model/cv/vgg.py, 158 LoC — VGG-11/16 baselines).
+
+Two heads:
+  - imagenet_head=True: the reference's torchvision-style classifier —
+    adaptive-pool to 7x7, 4096-4096-classes MLP with dropout (vgg.py:23-32;
+    vgg16 @ 1000 classes = 138,357,544 params, pinned in
+    tests/test_param_parity.py).
+  - imagenet_head=False (default): the CIFAR-style head (global pool +
+    512-unit MLP) — right-sized for the 32x32 federated configs.
+"""
 
 from __future__ import annotations
 
@@ -15,10 +24,27 @@ _CFGS = {
 }
 
 
+def _adaptive_avg_pool(x, out_hw: int):
+    """AdaptiveAvgPool2d analogue for H, W >= out_hw (integer bins)."""
+    B, H, W, C = x.shape
+    if H == out_hw and W == out_hw:
+        return x
+    if H % out_hw == 0 and W % out_hw == 0:
+        x = x.reshape(B, out_hw, H // out_hw, out_hw, W // out_hw, C)
+        return x.mean(axis=(2, 4))
+    # fallback: resize-style pooling via mean over computed bins is overkill
+    # for VGG's power-of-two maps; pad up to the next multiple instead
+    ph = (-H) % out_hw
+    pw = (-W) % out_hw
+    x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)), mode="edge")
+    return _adaptive_avg_pool(x, out_hw)
+
+
 class VGG(nn.Module):
     depth: int = 11
     num_classes: int = 10
     batch_norm: bool = True
+    imagenet_head: bool = False  # reference torchvision classifier (see module doc)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -31,6 +57,14 @@ class VGG(nn.Module):
                     x = nn.BatchNorm(use_running_average=not train,
                                      momentum=0.9)(x)
                 x = nn.relu(x)
+        if self.imagenet_head:
+            x = _adaptive_avg_pool(x, 7)
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(4096)(x))
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+            x = nn.relu(nn.Dense(4096)(x))
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+            return nn.Dense(self.num_classes)(x)
         x = jnp.mean(x, axis=(1, 2))  # adaptive pool to 1x1 (CIFAR-sized inputs)
         x = nn.relu(nn.Dense(512)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)

@@ -103,3 +103,12 @@ def test_mobilenet_v3_modes_near_canonical():
                      (1, 64, 64, 3), train=False)
     assert abs(n_small - 2_542_856) / 2_542_856 < 0.005
     assert abs(n_large - 5_483_032) / 5_483_032 < 0.005
+
+
+def test_vgg16_imagenet_head_param_count():
+    from fedml_tpu.models.vgg import VGG
+
+    # the reference's torchvision-style VGG-16 (vgg.py:23-32): 138,357,544
+    assert _count(VGG(depth=16, num_classes=1000, batch_norm=False,
+                      imagenet_head=True),
+                  (1, 224, 224, 3), train=False) == 138_357_544
