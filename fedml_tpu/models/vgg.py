@@ -25,19 +25,22 @@ _CFGS = {
 
 
 def _adaptive_avg_pool(x, out_hw: int):
-    """AdaptiveAvgPool2d analogue for H, W >= out_hw (integer bins)."""
-    B, H, W, C = x.shape
-    if H == out_hw and W == out_hw:
-        return x
-    if H % out_hw == 0 and W % out_hw == 0:
-        x = x.reshape(B, out_hw, H // out_hw, out_hw, W // out_hw, C)
-        return x.mean(axis=(2, 4))
-    # fallback: resize-style pooling via mean over computed bins is overkill
-    # for VGG's power-of-two maps; pad up to the next multiple instead
-    ph = (-H) % out_hw
-    pw = (-W) % out_hw
-    x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)), mode="edge")
-    return _adaptive_avg_pool(x, out_hw)
+    """AdaptiveAvgPool2d analogue with torch's exact bin semantics: output
+    bin i averages rows floor(i*H/out) .. ceil((i+1)*H/out)-1 (variable-size
+    bins; degenerates to replication when H < out). Shapes are static under
+    jit, so the bins unroll at trace time."""
+    def pool_axis(x, axis, size):
+        segs = []
+        for i in range(out_hw):
+            lo = (i * size) // out_hw
+            hi = -(-((i + 1) * size) // out_hw)
+            sl = [slice(None)] * x.ndim
+            sl[axis] = slice(lo, max(hi, lo + 1))
+            segs.append(x[tuple(sl)].mean(axis=axis, keepdims=True))
+        return jnp.concatenate(segs, axis=axis)
+
+    x = pool_axis(x, 1, x.shape[1])
+    return pool_axis(x, 2, x.shape[2])
 
 
 class VGG(nn.Module):
