@@ -79,6 +79,86 @@ class GKTServerModel(nn.Module):
         return nn.Dense(self.num_classes)(y)
 
 
+class Bottleneck(nn.Module):
+    """Reference bottleneck (resnet56_gkt/resnet_{client,server}.py):
+    1x1(planes) -> 3x3(planes, stride) -> 1x1(4*planes), projection
+    shortcut on shape change."""
+
+    planes: int
+    strides: tuple[int, int] = (1, 1)
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def norm(y):
+            if self.norm_type == "group":
+                return nn.GroupNorm(num_groups=min(8, y.shape[-1]))(y)
+            return nn.BatchNorm(momentum=0.9,
+                                use_running_average=not train)(y)
+
+        out_c = 4 * self.planes
+        residual = x
+        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        y = nn.relu(norm(y))
+        y = nn.Conv(self.planes, (3, 3), self.strides, padding="SAME",
+                    use_bias=False)(y)
+        y = nn.relu(norm(y))
+        y = nn.Conv(out_c, (1, 1), use_bias=False)(y)
+        y = norm(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(out_c, (1, 1), self.strides,
+                               use_bias=False)(residual)
+            residual = norm(residual)
+        return nn.relu(y + residual)
+
+
+class GKTClientNetRef(nn.Module):
+    """The reference's exact client model (resnet8_56: Bottleneck x2 on the
+    16-plane stage). forward -> (logits, extracted_features): features are
+    the POST-STEM 16-ch maps (resnet_client.py:78-92) — what travels to the
+    server — while the local head continues through layer1 + fc for the
+    client-side CE/KD logits. 10,586 params @ 10 classes, matching the
+    reference count exactly (pinned)."""
+
+    num_classes: int = 10
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        if self.norm_type == "group":
+            y = nn.GroupNorm(num_groups=8)(y)
+        else:
+            y = nn.BatchNorm(momentum=0.9, use_running_average=not train)(y)
+        feats = nn.relu(y)
+        y = feats
+        for _ in range(2):
+            y = Bottleneck(16, norm_type=self.norm_type)(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y), feats
+
+
+class GKTServerNetRef(nn.Module):
+    """The reference's exact server trunk (resnet56_server: Bottleneck
+    [6,6,6] over planes 16/32/64 consuming the client's 16-ch stem
+    features; the reference also constructs a stem it never runs —
+    resnet_server.py:73-85 — which we do not reproduce, so our count is
+    the forward-used 590,858 of its 591,322)."""
+
+    num_classes: int = 10
+    norm_type: str = "batch"
+
+    @nn.compact
+    def __call__(self, feats, train: bool = False):
+        y = feats
+        for planes, stride in [(16, 1), (32, 2), (64, 2)]:
+            for i in range(6):
+                s = (stride, stride) if i == 0 else (1, 1)
+                y = Bottleneck(planes, s, self.norm_type)(y, train)
+        y = jnp.mean(y, axis=(1, 2))
+        return nn.Dense(self.num_classes)(y)
+
+
 class SplitLowerNet(nn.Module):
     """SplitNN default lower cut (client side): norm-free conv features.
 

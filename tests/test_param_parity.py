@@ -112,3 +112,18 @@ def test_vgg16_imagenet_head_param_count():
     assert _count(VGG(depth=16, num_classes=1000, batch_norm=False,
                       imagenet_head=True),
                   (1, 224, 224, 3), train=False) == 138_357_544
+
+
+def test_gkt_reference_split_param_counts():
+    from fedml_tpu.models.gkt import GKTClientNetRef, GKTServerNetRef
+
+    # resnet8_56 client: stem + Bottleneck x2 @ 16 planes + fc = 10,586
+    # exactly (resnet_client.py). Server resnet56_server [6,6,6]: 590,858 =
+    # its 591,322 minus the stem it constructs but never runs
+    # (resnet_server.py forward skips conv1/bn1).
+    m = GKTClientNetRef(num_classes=10)
+    v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)), train=False)
+    assert sum(p.size for p in jax.tree.leaves(v["params"])) == 10_586
+    s = GKTServerNetRef(num_classes=10)
+    vs = s.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 16)), train=False)
+    assert sum(p.size for p in jax.tree.leaves(vs["params"])) == 590_858
