@@ -17,6 +17,7 @@ Usage:
 
 Reference rows (BASELINE.md):
   mnist_lr            MNIST + LR,       1000 clients, 10/round, bs=10,  lr=0.03,    >75%  @ 100+ rounds
+  synthetic_1_1_lr    Synthetic(1,1)+LR,  30 clients, 10/round, bs=10,  lr=0.01,    >60%  @ 200+ rounds (no download needed)
   femnist_cnn         FEMNIST + CNN,    3400 clients, 10/round, bs=20,  lr=0.1,     84.9% @ 1500+ rounds
   fed_cifar100_rn18   ResNet18-GN,       500 clients, 10/round, bs=20,  lr=0.1,     44.7% @ 4000+ rounds
   shakespeare_rnn     Shakespeare RNN,   715 clients, 10/round, bs=4,   lr=1.0,     56.9% @ 1200+ rounds
@@ -49,6 +50,16 @@ CONFIGS: dict[str, list[str]] = {
         "--batch_size", "20", "--lr", "0.1", "--epochs", "1",
         "--comm_round", "1500", "--frequency_of_the_test", "50",
         "--device_data", "1", "--uint8_pixels", "1",
+    ],
+    # benchmark/README.md:14 (Linear Models table) — needs NO download: the
+    # registry regenerates the reference's fixed-seed dataset bit-exactly;
+    # scripts/repro_synthetic.py additionally evaluates on the reference's
+    # committed test split
+    "synthetic_1_1_lr": [
+        "--algo", "fedavg", "--dataset", "synthetic_1_1", "--model", "lr",
+        "--client_num_in_total", "30", "--client_num_per_round", "10",
+        "--batch_size", "10", "--lr", "0.01", "--epochs", "1",
+        "--comm_round", "220", "--frequency_of_the_test", "10",
     ],
     # benchmark/README.md:55
     "fed_cifar100_rn18": [

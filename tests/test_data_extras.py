@@ -1,5 +1,7 @@
 """Vertical tabular datasets, stackoverflow vocab utils, norm-free ResNet."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -285,3 +287,59 @@ def test_imagenet_image_size_flag(tmp_path):
     fd = load_dataset("imagenet", data_dir=str(tmp_path), client_num=2,
                       image_size=224)
     assert fd.train_x.shape[1:] == (224, 224, 3)
+
+
+def test_synthetic_leaf_exact_split_reconstruction():
+    """synthetic_leaf_exact regenerates the reference's synthetic(1,1) data
+    bit-exactly (fixed np seed, generate_synthetic.py:19) and, given the
+    committed mytest.json, reconstructs the reference's exact train/test
+    membership: every committed test row appears verbatim in our test split,
+    none in train."""
+    ref = "/root/reference/data/synthetic_1_1/test/mytest.json"
+    if not os.path.isfile(ref):
+        pytest.skip("reference synthetic_1_1 test json not present")
+    import json
+
+    from fedml_tpu.data.synthetic import synthetic_leaf_exact
+
+    fd = synthetic_leaf_exact(alpha=1.0, beta=1.0, test_json=ref)
+    with open(ref) as f:
+        d = json.load(f)
+    n_ref = sum(len(d["user_data"][u]["y"]) for u in d["users"])
+    assert len(fd.test_y) == n_ref == 2248
+    assert fd.num_clients == 30 and fd.class_num == 10
+    # user f_00000's committed rows == our client-0 test rows, up to order
+    u0 = sorted(d["users"])[0]
+    ours = fd.test_x[fd.test_idx_map[0]].astype(np.float64)
+    theirs = np.asarray(d["user_data"][u0]["x"])
+    assert ours.shape == theirs.shape
+    ours_sorted = ours[np.lexsort(ours.T)]
+    theirs_sorted = theirs[np.lexsort(theirs.T)]
+    np.testing.assert_allclose(ours_sorted, theirs_sorted, atol=1e-6)
+    # train and test are disjoint: a leaked row would sit at float32
+    # round-trip distance (~1e-7) while genuinely distinct rows are >=0.3,
+    # so 1e-4 separates the two regimes
+    tr0 = fd.train_x[fd.train_idx_map[0]].astype(np.float64)
+    d2 = np.abs(tr0[:, None, :] - theirs[None, :, :]).max(-1)
+    assert d2.min() > 1e-4
+
+
+def test_synthetic_leaf_exact_fallback_split():
+    """Without a test json: seeded 90/10 split, deterministic across calls."""
+    from fedml_tpu.data.synthetic import synthetic_leaf_exact
+
+    a = synthetic_leaf_exact(alpha=0.0, beta=0.0)
+    b = synthetic_leaf_exact(alpha=0.0, beta=0.0)
+    np.testing.assert_array_equal(a.train_x, b.train_x)
+    np.testing.assert_array_equal(a.test_y, b.test_y)
+    n0 = len(a.train_idx_map[0]) + len(a.test_idx_map[0])
+    assert len(a.train_idx_map[0]) == int(0.9 * n0)
+
+
+def test_synthetic_registry_variants():
+    """Registry dispatch: synthetic_0.5_0.5 parses (alpha, beta) and returns
+    the canonical 30-client 60-dim federation."""
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("synthetic_0.5_0.5")
+    assert fd.num_clients == 30 and fd.train_x.shape[1] == 60
