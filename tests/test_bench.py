@@ -126,3 +126,56 @@ def test_probe_falls_back_to_cpu(monkeypatch):
     env = bench._probe_backend()
     assert env["JAX_PLATFORMS"] == "cpu"
     assert "PALLAS_AXON_POOL_IPS" not in env
+
+
+def test_main_waits_out_wedged_lease_then_blocks(monkeypatch, capsys):
+    """A timed-out (SIGKILLed) per-round child leaves the accelerator grant
+    wedged; main() must sleep it out before launching the block child, and
+    retry per_round once in between."""
+    bench = _import_bench()
+    events = []
+
+    def fake_run_child(args, env, timeout):
+        if args[0] == "-c":
+            return 0, "probe-ok cpu 1\n"
+        mode = args[-1]
+        events.append(("child", mode))
+        if mode == "per_round":
+            return 124, "noise\n"  # timeout, nothing salvaged
+        return 0, _fake_result("block") + "\n"
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep",
+                        lambda s: events.append(("sleep", s)))
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)  # accelerator env
+    bench.main()
+    out = [l for l in capsys.readouterr().out.strip().splitlines()
+           if l.startswith("{")]
+    assert json.loads(out[-1])["mode"] == "block"
+    # per_round, sleep(recovery), per_round retry, sleep(recovery), block
+    kinds = [e[0] if e[0] == "sleep" else e[1] for e in events]
+    assert kinds == ["per_round", "sleep", "per_round", "sleep", "block"]
+
+
+def test_main_cpu_last_resort(monkeypatch, capsys):
+    """Accelerator children all die without output -> one forced-CPU
+    per-round child still produces a real number."""
+    bench = _import_bench()
+    seen_platforms = []
+
+    def fake_run_child(args, env, timeout):
+        if args[0] == "-c":  # probe: accelerator comes up fine
+            return 0, "probe-ok tpu 1\n"
+        seen_platforms.append(env.get("JAX_PLATFORMS"))
+        if env.get("JAX_PLATFORMS") == "cpu":
+            return 0, _fake_result("per_round") + "\n"
+        return 1, "crash\n"
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    bench.main()
+    out = [l for l in capsys.readouterr().out.strip().splitlines()
+           if l.startswith("{")]
+    assert json.loads(out[-1])["mode"] == "per_round"
+    assert seen_platforms[-1] == "cpu" and None in seen_platforms[:-1]
