@@ -148,8 +148,16 @@ def _measure(mode: str) -> None:
     # host-packed path ships only the sampled clients' rows (~4 MB/round) —
     # the cheap measurement must be cheap in TRANSFER, not just compute.
     # donate: round programs write outputs into the incoming model buffers.
-    api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"), donate=True)
-    _mark(t0, f"api built (device_data={mode == 'block'})")
+    # block mode: working-set park by default — each block uploads only the
+    # rows its sampled clients touch (~tens of MB) instead of parking the
+    # full train set (~330 MB) up front; FEDML_BENCH_FULL_PARK=1 restores
+    # the whole-set park (the right call on a fast local link)
+    working_set = os.environ.get("FEDML_BENCH_FULL_PARK") != "1"
+    api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),
+                    donate=True,
+                    block_working_set=(mode == "block" and working_set))
+    _mark(t0, f"api built (device_data={mode == 'block'}, "
+              f"working_set={mode == 'block' and working_set})")
 
     if mode == "per_round":
         # cheap path: ONE small per-round program, compiled once, timed a
@@ -260,14 +268,25 @@ def _cpu_env(base) -> dict:
     return env
 
 
-def _probe_backend() -> dict:
+def _probe_backend() -> tuple[dict, str]:
     """Find a backend that can actually run a device op, with retries.
 
-    Returns the env dict children should run under. Order: the inherited env
-    (TPU via relay if configured) with retries/backoff, then a forced-CPU
-    env (remote-backend plugin vars dropped so a dead relay can't hang
-    interpreter startup).
+    Returns (env dict children should run under, backend name the probe
+    REPORTED — 'tpu'/'cpu'/...; the name comes from the probe's own
+    jax.default_backend(), not from env-var sniffing, so a CPU-only host
+    with no JAX_PLATFORMS set is still classified as cpu). Order: the
+    inherited env (TPU via relay if configured) with retries/backoff, then
+    a forced-CPU env (remote-backend plugin vars dropped so a dead relay
+    can't hang interpreter startup).
     """
+
+    def _reported(out: str) -> str:
+        for line in reversed(out.strip().splitlines()):
+            if line.startswith("probe-ok"):
+                parts = line.split()
+                if len(parts) >= 2:
+                    return parts[1]
+        return "unknown"
     probe_timeout = _env_int("FEDML_BENCH_PROBE_TIMEOUT", 120)
     # a SIGKILLed TPU holder (e.g. a timed-out earlier bench child) wedges
     # the axon grant for ~2-5 min and every backend init hangs until the
@@ -285,7 +304,7 @@ def _probe_backend() -> dict:
         if rc == 0 and "probe-ok" in out:
             print(f"bench: backend probe ok: {out.strip().splitlines()[-1]}",
                   file=sys.stderr)
-            return env
+            return env, _reported(out)
         print(f"bench: backend probe attempt {i + 1}/{attempts} failed "
               f"(rc={rc})", file=sys.stderr)
         if i < attempts - 1:  # no point sleeping before the CPU fallback
@@ -296,14 +315,14 @@ def _probe_backend() -> dict:
     if rc == 0 and "probe-ok" in out:
         print("bench: accelerator unavailable; falling back to CPU",
               file=sys.stderr)
-        return cpu_env
+        return cpu_env, "cpu"
     raise RuntimeError("bench: no working jax backend (accelerator and CPU "
                        "probes both failed)")
 
 
 def main() -> None:
     here = os.path.abspath(__file__)
-    env = _probe_backend()
+    env, backend = _probe_backend()
 
     cheap_timeout = _env_int("FEDML_BENCH_CHEAP_TIMEOUT", 900)
     block_timeout = _env_int("FEDML_BENCH_BLOCK_TIMEOUT", 1200)
@@ -312,7 +331,7 @@ def main() -> None:
 
     # lease-recovery sleeps only make sense when an accelerator grant exists
     # (forced-CPU children never hold one)
-    on_accel = env.get("JAX_PLATFORMS", "").lower() != "cpu"
+    on_accel = backend != "cpu"
     low_core = (os.cpu_count() or 1) <= 2
     if not on_accel and low_core:
         # the probe already fell back to CPU on a near-coreless box: the full

@@ -179,6 +179,7 @@ class FedAvgAPI:
         local_spec: LocalSpec | None = None,
         device_data: bool = False,
         donate: bool = False,
+        block_working_set: bool = False,
     ):
         self.data = dataset
         self.task = task
@@ -195,9 +196,22 @@ class FedAvgAPI:
         # to api.net across rounds (e.g. comparing against round-0 weights);
         # the bench paths enable it. The R-round block fns always donate —
         # their contract never exposed intermediate nets.
+        # block_working_set: do NOT park the whole train set in HBM. Each
+        # run_rounds block instead uploads only the UNIQUE rows its sampled
+        # clients touch (indices remapped into the compact array, row count
+        # padded to a bucket so jit re-uses one compiled executable across
+        # blocks). Batches stay bit-identical to the full-park plane
+        # (test-enforced); what changes is transfer: ~R*K*samples rows
+        # (tens of MB) per block instead of the full set (hundreds of MB)
+        # up front — the difference between dying and finishing on a slow
+        # host->device link. run_round falls back to the host-packed plane.
         self.donate = donate
         self.device_data = device_data
-        if device_data:
+        self.block_working_set = block_working_set
+        if block_working_set and not device_data:
+            raise ValueError("block_working_set is a device_data mode "
+                             "(pass device_data=True)")
+        if device_data and not block_working_set:
             sh = NamedSharding(mesh, P()) if mesh is not None else None
             put = (lambda a: jax.device_put(a, sh)) if sh else jax.device_put
             self._dev_x = put(dataset.train_x)
@@ -388,7 +402,7 @@ class FedAvgAPI:
 
     def _pack_round(self, round_idx: int):
         cfg = self.cfg
-        if self.device_data:
+        if self.device_data and not self.block_working_set:
             ib = self._pack_round_indices_host(round_idx)
             if self.mesh is not None:
                 sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
@@ -442,24 +456,29 @@ class FedAvgAPI:
 
         if self.mesh is None:
 
-            def step(carry, inp):
-                rng, net, opt = carry
-                idx_r, mask_r, nsamp_r, ids_r, r = inp
-                keys = client_keys(r, ids_r)
-                rng, kh, kp = jax.random.split(rng, 3)
-                x, y = _gather_rows(self._dev_x, self._dev_y, idx_r, mask_r)
-                nets, metrics, _ = self._round_body(
-                    keys, net, opt, x, y, mask_r, nsamp_r, kh
-                )
-                net, opt, m = self._aggregate_and_update(
-                    net, opt, nets, metrics, nsamp_r, kp
-                )
-                return (rng, net, opt), m
+            def make_step(dev_x, dev_y):
+                def step(carry, inp):
+                    rng, net, opt = carry
+                    idx_r, mask_r, nsamp_r, ids_r, r = inp
+                    keys = client_keys(r, ids_r)
+                    rng, kh, kp = jax.random.split(rng, 3)
+                    x, y = _gather_rows(dev_x, dev_y, idx_r, mask_r)
+                    nets, metrics, _ = self._round_body(
+                        keys, net, opt, x, y, mask_r, nsamp_r, kh
+                    )
+                    net, opt, m = self._aggregate_and_update(
+                        net, opt, nets, metrics, nsamp_r, kp
+                    )
+                    return (rng, net, opt), m
+
+                return step
 
             @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def block_fn(rng, net, opt, idx, mask, nsamp, ids, round_idxs):
+            def block_fn(rng, net, opt, dev_x, dev_y, idx, mask, nsamp, ids,
+                         round_idxs):
                 (rng, net, opt), ms = jax.lax.scan(
-                    step, (rng, net, opt), (idx, mask, nsamp, ids, round_idxs)
+                    make_step(dev_x, dev_y), (rng, net, opt),
+                    (idx, mask, nsamp, ids, round_idxs)
                 )
                 return rng, net, opt, ms
 
@@ -500,8 +519,9 @@ class FedAvgAPI:
         )
 
         @partial(jax.jit, donate_argnums=(1, 2))
-        def block_fn(rng, net, opt, idx, mask, nsamp, ids, round_idxs):
-            net, opt, ms = smapped_block(net, opt, self._dev_x, self._dev_y,
+        def block_fn(rng, net, opt, dev_x, dev_y, idx, mask, nsamp, ids,
+                     round_idxs):
+            net, opt, ms = smapped_block(net, opt, dev_x, dev_y,
                                          idx, mask, nsamp, ids, round_idxs)
             return rng, net, opt, ms
 
@@ -534,17 +554,49 @@ class FedAvgAPI:
                 mask_l.append(ib.mask)
                 ns_l.append(ib.num_samples)
         rounds = np.arange(start_round, start_round + num_rounds, dtype=np.int32)
-        blocks = [np.stack(idx_l), np.stack(mask_l), np.stack(ns_l),
+        idx_stack = np.stack(idx_l)
+        if self.block_working_set:
+            with self.tracer.span("pack"):
+                idx_stack, dev_x, dev_y = self._compact_block_rows(idx_stack)
+        else:
+            dev_x, dev_y = self._dev_x, self._dev_y
+        blocks = [idx_stack, np.stack(mask_l), np.stack(ns_l),
                   np.stack(ids_l)]
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(None, self.mesh.axis_names[0]))
             blocks = [jax.device_put(b, sh) for b in blocks]
         with self.tracer.span("round"):
             self.rng, self.net, self.server_opt_state, ms = self._block_fn(
-                self.rng, self.net, self.server_opt_state,
+                self.rng, self.net, self.server_opt_state, dev_x, dev_y,
                 *[jnp.asarray(b) for b in blocks], jnp.asarray(rounds),
             )
         return ms
+
+    _WORKING_SET_BUCKET = 8192  # rows; pad-to-bucket keeps ONE compiled block
+
+    def _compact_block_rows(self, idx_stack: np.ndarray):
+        """Working-set park: upload only the unique train rows this block's
+        index batches touch. Indices are remapped into the compact array and
+        its row count padded up to a _WORKING_SET_BUCKET multiple, so
+        consecutive blocks with similar-sized working sets hit the same
+        compiled executable (jit caches by shape)."""
+        uniq, inv = np.unique(idx_stack, return_inverse=True)
+        remapped = inv.reshape(idx_stack.shape).astype(np.int32)
+        # bucket round-up is >= len(uniq), and uniq indexes train_x so
+        # len(uniq) <= len(train_x): the min never under-allocates
+        n_rows = min(
+            -(-len(uniq) // self._WORKING_SET_BUCKET) * self._WORKING_SET_BUCKET,
+            len(self.data.train_x),
+        )
+        cx = np.zeros((n_rows,) + self.data.train_x.shape[1:],
+                      self.data.train_x.dtype)
+        cy = np.zeros((n_rows,) + self.data.train_y.shape[1:],
+                      self.data.train_y.dtype)
+        cx[: len(uniq)] = self.data.train_x[uniq]
+        cy[: len(uniq)] = self.data.train_y[uniq]
+        sh = (NamedSharding(self.mesh, P()) if self.mesh is not None else None)
+        put = (lambda a: jax.device_put(a, sh)) if sh else jax.device_put
+        return remapped, put(cx), put(cy)
 
     # ------------------------------------------------------------------ train
     def run_round(self, round_idx: int):

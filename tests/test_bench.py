@@ -139,7 +139,8 @@ def test_probe_falls_back_to_cpu(monkeypatch):
     monkeypatch.setattr(bench, "_run_child", fake_run_child)
     monkeypatch.setattr(bench.time, "sleep", lambda s: None)
     monkeypatch.setenv("FEDML_BENCH_PROBE_ATTEMPTS", "2")
-    env = bench._probe_backend()
+    env, backend = bench._probe_backend()
+    assert backend == "cpu"
     assert env["JAX_PLATFORMS"] == "cpu"
     assert "PALLAS_AXON_POOL_IPS" not in env
 
@@ -153,7 +154,7 @@ def test_main_waits_out_wedged_lease_then_blocks(monkeypatch, capsys):
 
     def fake_run_child(args, env, timeout):
         if args[0] == "-c":
-            return 0, "probe-ok cpu 1\n"
+            return 0, "probe-ok tpu 1\n"  # accelerator came up
         mode = args[-1]
         events.append(("child", mode))
         if mode == "per_round":

@@ -6,6 +6,8 @@ Here that's a real test, plus standalone == distributed equivalence — the
 property the reference could only approximate by running mpirun by hand.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -304,6 +306,43 @@ def test_run_rounds_block_mesh_equals_single_device(lr_data, lr_task, mesh8):
     for a, b in zip(pack_pytree(single.net), pack_pytree(meshed.net)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=1e-6)
+
+
+def test_run_rounds_working_set_equals_full_park(lr_data, lr_task, mesh8):
+    """block_working_set uploads only the block's unique rows (remapped
+    indices, bucket-padded) — the trained model must be bit-identical to the
+    full-HBM-park block, single-device and over the client mesh."""
+    from fedml_tpu.comm.message import pack_pytree
+
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=8, client_num_per_round=4,
+                       epochs=1, batch_size=8, lr=0.1, frequency_of_the_test=100,
+                       seed=0)
+    full = FedAvgAPI(lr_data, lr_task, cfg, device_data=True)
+    full.run_rounds(0, 4)
+
+    ws = FedAvgAPI(lr_data, lr_task, cfg, device_data=True,
+                   block_working_set=True)
+    assert not hasattr(ws, "_dev_x")  # the whole-set park must NOT happen
+    ms = ws.run_rounds(0, 4)
+    assert ms["count"].shape == (4,)
+    for a, b in zip(pack_pytree(full.net), pack_pytree(ws.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+    cfg_m = dataclasses.replace(cfg, client_num_per_round=8)
+    full_m = FedAvgAPI(lr_data, lr_task, cfg_m, mesh=mesh8, device_data=True)
+    full_m.run_rounds(0, 3)
+    ws_m = FedAvgAPI(lr_data, lr_task, cfg_m, mesh=mesh8, device_data=True,
+                     block_working_set=True)
+    ws_m.run_rounds(0, 3)
+    for a, b in zip(pack_pytree(full_m.net), pack_pytree(ws_m.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+    # run_round on a working-set api falls back to the host-packed plane
+    ws2 = FedAvgAPI(lr_data, lr_task, cfg, device_data=True,
+                    block_working_set=True)
+    ws2.run_round(0)
 
 
 def test_remat_local_update_identical(lr_data, lr_task):
