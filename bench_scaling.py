@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -38,11 +39,18 @@ def _one_point(args, data, task, k):
         frequency_of_the_test=10_000, max_batches=args.max_batches,
     )
     api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data),
-                    donate=True)
+                    donate=True,
+                    block_working_set=bool(args.device_data)
+                    and bool(args.working_set))
 
     if args.device_data:
-        # one compiled scan per block: measures device throughput, not
-        # per-round host dispatch (bench.py uses the same path)
+        # one compiled scan per block, no per-round host dispatch (bench.py
+        # uses the same path). NOTE: with the working-set plane the timed
+        # window deliberately includes each block's host-side row compaction
+        # + upload — that IS the per-block cost of this plane; the span
+        # breakdown separates it (host_pack). --working_set 0 (or
+        # FEDML_BENCH_FULL_PARK=1) restores pure device throughput with the
+        # whole train set parked before timing starts.
         api.run_rounds(0, args.rounds)
         jax.block_until_ready(api.net.params)
         base = api.tracer.totals()  # warmup holds the compile; exclude
@@ -67,6 +75,8 @@ def _one_point(args, data, task, k):
         "rounds_per_sec": round(rps, 3),
         "samples_per_sec": round(count * rps, 1),
         "device": jax.devices()[0].platform,
+        "data_plane": (("working_set" if api.block_working_set else "full_park")
+                       if args.device_data else "host_pack"),
     }
     if args.spans:
         # where TIMED-window wall-clock goes. Tracer spans give the host
@@ -92,6 +102,14 @@ def main():
                          "(femnist_cnn) or 10 (cifar_resnet56 = the "
                          "reference cross-silo client count)")
     ap.add_argument("--device_data", type=int, default=1)
+    ap.add_argument("--working_set", type=int,
+                    default=0 if os.environ.get("FEDML_BENCH_FULL_PARK") == "1"
+                    else 1,
+                    help="with --device_data: per-block working-set park "
+                         "(upload only the rows a block touches) instead "
+                         "of parking the whole train set up front; "
+                         "FEDML_BENCH_FULL_PARK=1 flips the default like "
+                         "bench.py")
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--batch_size", type=int, default=None)
     ap.add_argument("--max_batches", type=int, default=None)

@@ -577,9 +577,11 @@ class FedAvgAPI:
     def _compact_block_rows(self, idx_stack: np.ndarray):
         """Working-set park: upload only the unique train rows this block's
         index batches touch. Indices are remapped into the compact array and
-        its row count padded up to a _WORKING_SET_BUCKET multiple, so
-        consecutive blocks with similar-sized working sets hit the same
-        compiled executable (jit caches by shape)."""
+        its row count padded up to a _WORKING_SET_BUCKET multiple —
+        GROW-ONLY across blocks (a later, slightly smaller working set pads
+        up to the largest size seen instead of shrinking into a different
+        bucket), so steady-state blocks hit one compiled executable (jit
+        caches by shape) and a recompile can only happen on genuine growth."""
         uniq, inv = np.unique(idx_stack, return_inverse=True)
         remapped = inv.reshape(idx_stack.shape).astype(np.int32)
         # bucket round-up is >= len(uniq), and uniq indexes train_x so
@@ -588,6 +590,8 @@ class FedAvgAPI:
             -(-len(uniq) // self._WORKING_SET_BUCKET) * self._WORKING_SET_BUCKET,
             len(self.data.train_x),
         )
+        n_rows = max(n_rows, getattr(self, "_ws_rows", 0))
+        self._ws_rows = n_rows
         cx = np.zeros((n_rows,) + self.data.train_x.shape[1:],
                       self.data.train_x.dtype)
         cy = np.zeros((n_rows,) + self.data.train_y.shape[1:],

@@ -344,6 +344,15 @@ def test_run_rounds_working_set_equals_full_park(lr_data, lr_task, mesh8):
                     block_working_set=True)
     ws2.run_round(0)
 
+    # grow-only padding: a later block with a smaller working set must keep
+    # the established padded row count (same shape -> same compiled block)
+    ws3 = FedAvgAPI(lr_data, lr_task, cfg, device_data=True,
+                    block_working_set=True)
+    ws3.run_rounds(0, 3)
+    established = ws3._ws_rows
+    ws3.run_rounds(3, 1)  # fewer rounds -> strictly smaller working set
+    assert ws3._ws_rows == established
+
 
 def test_remat_local_update_identical(lr_data, lr_task):
     """LocalSpec(remat=True) wraps the per-batch forward in jax.checkpoint
