@@ -196,3 +196,28 @@ def test_main_cpu_last_resort(monkeypatch, capsys):
            if l.startswith("{")]
     assert json.loads(out[-1])["mode"] == "per_round"
     assert seen_platforms[-1] == "cpu" and None in seen_platforms[:-1]
+
+
+def test_bench_scaling_one_point(tiny_bench_env, monkeypatch, capsys):
+    """bench_scaling sweep: one tiny femnist point through the working-set
+    block plane prints a well-formed record (keeps the scaling study
+    runnable, not just bench.py)."""
+    sys.modules.pop("bench_scaling", None)
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    import bench_scaling
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench_scaling.py", "--workload", "femnist_cnn", "--points", "2",
+         "--rounds", "1", "--batch_size", "4", "--max_batches", "1"])
+    bench_scaling.main()
+    out = [l for l in capsys.readouterr().out.strip().splitlines()
+           if l.startswith("{")]
+    assert len(out) == 1
+    rec = json.loads(out[0])
+    assert "error" not in rec, rec
+    assert rec["clients_per_round"] == 2
+    assert rec["rounds_per_sec"] > 0
+    assert rec["data_plane"] == "working_set"
+    assert rec["span_seconds"]["host_pack"] >= 0
