@@ -8,6 +8,15 @@ TPU form: one jitted epoch (lax.scan over batches); the DDP analogue is the
 same step pjit-ed over a 'data' mesh axis — batch sharded, params replicated,
 XLA inserts the gradient psum (exactly what DDP's allreduce does, minus the
 process management).
+
+Capability-plus (absent from the reference, SURVEY.md §2.7): tensor
+parallelism. Pass a mesh with a 'model' axis — e.g.
+``Mesh(devs.reshape(2, 4), ('data', 'model'))`` — and the parameters are
+placed per Megatron-style PartitionSpecs (parallel/tensor_parallel.py);
+the SAME epoch program then runs DP x TP, with XLA inserting the
+all-reduces/all-gathers the layout implies. No step-function changes:
+sharding is layout, not semantics (TP ≡ single-device oracle in
+tests/test_tensor_parallel.py).
 """
 
 from __future__ import annotations
@@ -45,10 +54,18 @@ class CentralizedTrainer:
         key = jax.random.PRNGKey(config.seed)
         self.rng, init_key = jax.random.split(key)
         self.net = task.init(init_key, jnp.asarray(self.x[: config.batch_size]))
+        self.tp_specs: list | None = None
+        if mesh is not None and "model" in mesh.axis_names:
+            from fedml_tpu.parallel.tensor_parallel import shard_params
+
+            params, self.tp_specs = shard_params(self.net.params, mesh)
+            self.net = self.net._replace(params=params)
         tx = optax.sgd(config.lr, momentum=config.momentum or None)
         if config.wd:
             tx = optax.chain(optax.add_decayed_weights(config.wd), tx)
         self.tx = tx
+        # init over already-placed params: momentum buffers inherit the TP
+        # layout (zeros_like follows the input's sharding)
         self.opt_state = tx.init(self.net.params)
         self._epoch = jax.jit(self._build_epoch())
         self.history: list[dict] = []
@@ -80,16 +97,23 @@ class CentralizedTrainer:
         if self.mesh is None:
             return epoch
 
-        # data-parallel: shard the batch axis over the mesh (DDP analogue)
+        # data-parallel: shard the batch axis over the mesh (DDP analogue).
+        # With a 'model' axis present the batch shards over 'data' only and
+        # params keep their TP placement — the same program is DP x TP.
         mesh = self.mesh
-        axis = mesh.axis_names[0]
+        if "model" in mesh.axis_names:
+            # batch shards over the first non-model axis (pure-TP mesh: none)
+            data_axis = next((a for a in mesh.axis_names if a != "model"), None)
+        else:
+            data_axis = mesh.axis_names[0]
 
         def epoch_dp(rng, net, opt_state, xb, yb, mb):
             # xb: [B, bs, ...] -> shard bs across devices via in_shardings
-            shd = NamedSharding(mesh, P(None, axis))
-            xb = jax.device_put(xb, shd)
-            yb = jax.device_put(yb, shd)
-            mb = jax.device_put(mb, shd)
+            if data_axis is not None:
+                shd = NamedSharding(mesh, P(None, data_axis))
+                xb = jax.device_put(xb, shd)
+                yb = jax.device_put(yb, shd)
+                mb = jax.device_put(mb, shd)
             return epoch(rng, net, opt_state, xb, yb, mb)
 
         return epoch_dp

@@ -1,0 +1,95 @@
+"""Tensor parallelism via pjit auto-sharding (Megatron-style specs).
+
+The reference has no tensor/model parallelism anywhere (SURVEY.md §2.7);
+this is capability-plus, done the idiomatic XLA way: pick a mesh, annotate
+parameter shardings, and let the compiler insert the collectives
+("How to Scale Your Model" recipe). Because pjit/GSPMD preserves program
+semantics for ANY sharding, the specs below only steer layout/performance —
+a wrong match degrades speed, never correctness (pinned by
+tests/test_tensor_parallel.py's TP ≡ single-device oracle).
+
+Spec rules (classic Megatron-LM layout for a transformer block):
+  - MLP in  kernel [C, 4C]  -> column-parallel  P(None, model)
+  - MLP out kernel [4C, C]  -> row-parallel     P(model, None)
+  - attention qkv  [C, 3HD] -> column-parallel (contiguous columns — NOT
+    head-aligned: the (3, H, D) reshape downstream makes GSPMD reshard
+    around the attention core, so attention TP here saves weight memory
+    and the projection FLOPs, not the full Megatron attention pattern)
+  - attention out  [HD, C]  -> row-parallel
+  - lm head        [C, V]   -> column-parallel
+  - embedding      [V, C]   -> vocab-sharded    P(model, None)
+  - norms / biases of row-parallel layers / scalars -> replicated
+A dimension is only sharded when divisible by the mesh axis size;
+otherwise the leaf falls back to replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# path-suffix fragments matched against the flax keystr of each param leaf
+# (flax numbers Dense modules per block: dense_0 = MLP-in / qkv, dense_1 =
+# MLP-out / attention-out — the suffix covers both plain and attention
+# variants). 'embedding' is anchored as a suffix so e.g. a hypothetical
+# patch_embedding/kernel is not silently vocab-sharded.
+_COLUMN = ("dense_0/kernel",)  # shard dim -1
+_ROW = ("dense_1/kernel",)     # shard dim 0
+_EMBED = ("embedding",)        # shard dim 0 (suffix-matched)
+
+
+def _norm_path(path) -> str:
+    return jax.tree_util.keystr(path).replace("'", "").replace("][", "/") \
+        .strip("[]").lower()
+
+
+def tp_spec_for(path, leaf, axis_size: int, model_axis: str) -> P:
+    """PartitionSpec for one param leaf under the Megatron rules."""
+    p = _norm_path(path)
+    shp = np.shape(leaf)
+    if len(shp) < 1:
+        return P()
+
+    def ok(dim):
+        return shp[dim] % axis_size == 0
+
+    if len(shp) >= 2:
+        # attention qkv/out + MLP in/out + lm head kernels
+        if any(p.endswith(s) for s in _ROW) and ok(0):
+            return P(*((model_axis,) + (None,) * (len(shp) - 1)))
+        if any(p.endswith(s) for s in _COLUMN) and ok(len(shp) - 1):
+            return P(*((None,) * (len(shp) - 1) + (model_axis,)))
+        if any(p.endswith(s) for s in _EMBED) and ok(0):
+            return P(*((model_axis,) + (None,) * (len(shp) - 1)))
+        return P()
+    # 1D: bias of a column-parallel layer lives on the sharded output dim
+    if any(p.endswith(s.replace("/kernel", "/bias")) for s in _COLUMN) and ok(0):
+        return P(model_axis)
+    return P()
+
+
+def shard_params(params, mesh: Mesh, model_axis: str = "model"):
+    """device_put every param leaf per the Megatron rules; returns
+    (sharded_params, flat list of (keystr, PartitionSpec)). Specs are
+    returned flat — PartitionSpec's pytree status varies across jax
+    versions, so a spec TREE is a trap for tree_map callers."""
+    axis_size = int(mesh.shape[model_axis])
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    placed, specs = [], []
+    for path, leaf in flat:
+        spec = tp_spec_for(path, leaf, axis_size, model_axis)
+        specs.append((jax.tree_util.keystr(path), spec))
+        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+    return jax.tree_util.tree_unflatten(treedef, placed), specs
+
+
+def num_sharded(params, model_axis: str = "model") -> int:
+    """How many leaves actually carry the model axis (diagnostics/tests)."""
+    count = 0
+    for leaf in jax.tree.leaves(params):
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        if spec is not None and model_axis in jax.tree.leaves(tuple(spec)):
+            count += 1
+    return count
