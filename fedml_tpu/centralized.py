@@ -11,8 +11,9 @@ process management).
 
 Capability-plus (absent from the reference, SURVEY.md §2.7): tensor
 parallelism. Pass a mesh with a 'model' axis — e.g.
-``Mesh(devs.reshape(2, 4), ('data', 'model'))`` — and the parameters are
-placed per Megatron-style PartitionSpecs (parallel/tensor_parallel.py);
+``Mesh(np.asarray(jax.devices()).reshape(2, 4), ('data', 'model'))`` — and
+the parameters are INITIALIZED sharded per Megatron-style PartitionSpecs
+(parallel/tensor_parallel.py, jit out_shardings);
 the SAME epoch program then runs DP x TP, with XLA inserting the
 all-reduces/all-gathers the layout implies. No step-function changes:
 sharding is layout, not semantics (TP ≡ single-device oracle in
@@ -53,13 +54,24 @@ class CentralizedTrainer:
         self.test = batch_global(np.asarray(test_x), np.asarray(test_y), 256)
         key = jax.random.PRNGKey(config.seed)
         self.rng, init_key = jax.random.split(key)
-        self.net = task.init(init_key, jnp.asarray(self.x[: config.batch_size]))
+        x_sample = jnp.asarray(self.x[: config.batch_size])
         self.tp_specs: list | None = None
         if mesh is not None and "model" in mesh.axis_names:
-            from fedml_tpu.parallel.tensor_parallel import shard_params
+            from fedml_tpu.parallel.tensor_parallel import tp_shardings
 
-            params, self.tp_specs = shard_params(self.net.params, mesh)
-            self.net = self.net._replace(params=params)
+            # sharded-at-init: out_shardings makes every device materialize
+            # only ITS shard — the full unsharded tree never exists anywhere
+            # (task.init under plain eager would build it on one device,
+            # which defeats TP for any model big enough to need it)
+            shapes = jax.eval_shape(task.init, init_key, x_sample)
+            p_shard, self.tp_specs = tp_shardings(shapes.params, mesh)
+            rep = NamedSharding(mesh, P())
+            e_shard = jax.tree.map(lambda _: rep, shapes.extra)
+            self.net = jax.jit(
+                task.init, out_shardings=type(shapes)(p_shard, e_shard),
+            )(init_key, x_sample)
+        else:
+            self.net = task.init(init_key, x_sample)
         tx = optax.sgd(config.lr, momentum=config.momentum or None)
         if config.wd:
             tx = optax.chain(optax.add_decayed_weights(config.wd), tx)

@@ -62,6 +62,38 @@ class SelfAttention(nn.Module):
         return nn.Dense(C, use_bias=False)(o.reshape(B, T, H * D))
 
 
+class MoEMLP(nn.Module):
+    """Switch-style top-1 mixture-of-experts MLP, written as expert-stacked
+    einsums: all experts are materialized as one [E, ...] kernel and the
+    token->expert dispatch is a one-hot combine. That formulation is what
+    makes EXPERT PARALLELISM a pure layout choice — shard the leading E dim
+    over a mesh axis (parallel/tensor_parallel.py's *_experts rule) and
+    GSPMD turns the combine into a psum over the expert shards, each device
+    computing only its experts. Top-1 gate scales its expert's output by
+    the gate value (Switch Transformer convention); no capacity dropping —
+    dense dispatch keeps the math exactly equal to an unsharded run (the
+    EP ≡ single-device oracle in test_tensor_parallel.py)."""
+
+    num_experts: int
+    mlp_ratio: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        E, H = self.num_experts, self.mlp_ratio * C
+        w_gate = self.param("w_gate", nn.initializers.normal(0.02), (C, E))
+        w_in = self.param("w_in_experts",
+                          nn.initializers.lecun_normal(), (E, C, H))
+        w_out = self.param("w_out_experts",
+                           nn.initializers.lecun_normal(), (E, H, C))
+        gates = jax.nn.softmax(x @ w_gate)                  # [B,T,E]
+        top1 = jnp.argmax(gates, axis=-1)
+        combine = jax.nn.one_hot(top1, E, dtype=x.dtype) * gates
+        h = nn.gelu(jnp.einsum("btc,ech->bteh", x, w_in))
+        y = jnp.einsum("bteh,ehc->btec", h, w_out)
+        return jnp.einsum("btec,bte->btc", y, combine)
+
+
 class Block(nn.Module):
     num_heads: int
     head_dim: int
@@ -70,6 +102,7 @@ class Block(nn.Module):
     seq_axis: str | None = None
     use_flash: bool = False
     seq_impl: str = "ring"
+    moe_experts: int = 0  # >0: replace the MLP with a switch MoE
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -79,6 +112,8 @@ class Block(nn.Module):
                               self.seq_impl)(h, train)
         h = nn.LayerNorm()(x)
         C = x.shape[-1]
+        if self.moe_experts > 0:
+            return x + MoEMLP(self.moe_experts, self.mlp_ratio)(h)
         m = nn.Dense(self.mlp_ratio * C)(h)
         m = nn.gelu(m)
         x = x + nn.Dense(C)(m)
@@ -95,6 +130,7 @@ class TransformerLM(nn.Module):
     seq_axis: str | None = None
     use_flash: bool = False
     seq_impl: str = "ring"
+    moe_experts: int = 0  # >0: every block's MLP is a switch MoE
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -112,6 +148,7 @@ class TransformerLM(nn.Module):
         for _ in range(self.depth):
             x = Block(self.num_heads, self.dim // self.num_heads,
                       causal=self.causal, seq_axis=self.seq_axis,
-                      use_flash=self.use_flash, seq_impl=self.seq_impl)(x, train)
+                      use_flash=self.use_flash, seq_impl=self.seq_impl,
+                      moe_experts=self.moe_experts)(x, train)
         x = nn.LayerNorm()(x)
         return nn.Dense(self.vocab_size)(x)

@@ -37,6 +37,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _COLUMN = ("dense_0/kernel",)  # shard dim -1
 _ROW = ("dense_1/kernel",)     # shard dim 0
 _EMBED = ("embedding",)        # shard dim 0 (suffix-matched)
+# expert-stacked MoE kernels [E, ...]: shard the expert dim — this IS
+# expert parallelism (each device holds+runs E/n experts; the one-hot
+# combine einsum becomes a psum over expert shards)
+_EXPERT = ("experts",)         # shard dim 0 (suffix-matched)
 
 
 def _norm_path(path) -> str:
@@ -55,13 +59,12 @@ def tp_spec_for(path, leaf, axis_size: int, model_axis: str) -> P:
         return shp[dim] % axis_size == 0
 
     if len(shp) >= 2:
-        # attention qkv/out + MLP in/out + lm head kernels
-        if any(p.endswith(s) for s in _ROW) and ok(0):
+        # the suffix sets are mutually exclusive; dim-0 rules (row-parallel
+        # dense, expert-stacked MoE, vocab-sharded embedding) share one spec
+        if any(p.endswith(s) for s in _ROW + _EXPERT + _EMBED) and ok(0):
             return P(*((model_axis,) + (None,) * (len(shp) - 1)))
         if any(p.endswith(s) for s in _COLUMN) and ok(len(shp) - 1):
             return P(*((None,) * (len(shp) - 1) + (model_axis,)))
-        if any(p.endswith(s) for s in _EMBED) and ok(0):
-            return P(*((model_axis,) + (None,) * (len(shp) - 1)))
         return P()
     # 1D: bias of a column-parallel layer lives on the sharded output dim
     if any(p.endswith(s.replace("/kernel", "/bias")) for s in _COLUMN) and ok(0):
@@ -69,18 +72,35 @@ def tp_spec_for(path, leaf, axis_size: int, model_axis: str) -> P:
     return P()
 
 
-def shard_params(params, mesh: Mesh, model_axis: str = "model"):
-    """device_put every param leaf per the Megatron rules; returns
-    (sharded_params, flat list of (keystr, PartitionSpec)). Specs are
-    returned flat — PartitionSpec's pytree status varies across jax
-    versions, so a spec TREE is a trap for tree_map callers."""
+def tp_shardings(params_or_shapes, mesh: Mesh, model_axis: str = "model"):
+    """NamedSharding tree for a param tree (or its jax.eval_shape result);
+    returns (shardings_tree, flat list of (keystr, PartitionSpec)). Specs
+    are returned flat — PartitionSpec's pytree status varies across jax
+    versions, so a spec TREE is a trap for tree_map callers.
+
+    Pairing this with ``jax.jit(init_fn, out_shardings=...)`` materializes
+    each device's shard directly at init: the full unsharded tree never
+    exists on any single device (the point of TP at real scale)."""
     axis_size = int(mesh.shape[model_axis])
-    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
-    placed, specs = [], []
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params_or_shapes)
+    shardings, specs = [], []
     for path, leaf in flat:
         spec = tp_spec_for(path, leaf, axis_size, model_axis)
         specs.append((jax.tree_util.keystr(path), spec))
-        placed.append(jax.device_put(leaf, NamedSharding(mesh, spec)))
+        shardings.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, shardings), specs
+
+
+def shard_params(params, mesh: Mesh, model_axis: str = "model"):
+    """device_put an ALREADY-materialized param tree per the Megatron rules;
+    returns (sharded_params, flat list of (keystr, PartitionSpec)). For
+    large models prefer tp_shardings + jit(init, out_shardings=...), which
+    never materializes the unsharded tree."""
+    shardings, specs = tp_shardings(params, mesh, model_axis)
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_s = jax.tree_util.tree_flatten(
+        shardings, is_leaf=lambda x: isinstance(x, NamedSharding))[0]
+    placed = [jax.device_put(p, s) for p, s in zip(flat_p, flat_s)]
     return jax.tree_util.tree_unflatten(treedef, placed), specs
 
 
