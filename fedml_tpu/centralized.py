@@ -113,9 +113,12 @@ class CentralizedTrainer:
         # With a 'model' axis present the batch shards over 'data' only and
         # params keep their TP placement — the same program is DP x TP.
         mesh = self.mesh
-        if "model" in mesh.axis_names:
-            # batch shards over the first non-model axis (pure-TP mesh: none)
-            data_axis = next((a for a in mesh.axis_names if a != "model"), None)
+        if "model" in mesh.axis_names or "stage" in mesh.axis_names:
+            # batch shards over the first non-model/non-stage axis (the
+            # 'stage' axis belongs to a PipelineLM's internal gpipe region;
+            # a pure-TP/PP mesh leaves the batch replicated)
+            data_axis = next((a for a in mesh.axis_names
+                              if a not in ("model", "stage")), None)
         else:
             data_axis = mesh.axis_names[0]
 

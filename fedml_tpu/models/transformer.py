@@ -13,6 +13,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh
 
 from fedml_tpu.parallel.ring_attention import (
     full_attention,
@@ -118,6 +119,69 @@ class Block(nn.Module):
         m = nn.gelu(m)
         x = x + nn.Dense(C)(m)
         return x
+
+
+class PipelineLM(nn.Module):
+    """Decoder-only LM with the block stack run as a GPipe PIPELINE over a
+    'stage' mesh axis (parallel/pipeline.py): one transformer Block per
+    stage, stacked into a single [S, ...] param tree; microbatches flow
+    stage-to-stage via ppermute and jax.grad yields the reverse schedule.
+    With ``mesh=None`` the same stacked params are applied sequentially
+    (lax.scan over stages) — the equivalence oracle for the pipeline
+    (test_pipeline_parallel.py). Embedding/head are replicated (cheap, and
+    keeps the pipelined region homogeneous)."""
+
+    vocab_size: int = 256
+    dim: int = 128
+    depth: int = 4  # == number of pipeline stages
+    num_heads: int = 4
+    max_len: int = 2048
+    causal: bool = True
+    mesh: Mesh | None = None
+    stage_axis: str = "stage"
+    num_microbatches: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab_size, self.dim)(tokens)
+        pos = self.param("pos_emb",
+                         nn.initializers.normal(0.02), (self.max_len, self.dim))
+        x = x + pos[:T][None]
+
+        blk = Block(self.num_heads, self.dim // self.num_heads,
+                    causal=self.causal)
+
+        def init_stages(rng):
+            dummy = jnp.zeros((1, 1, self.dim), jnp.float32)
+            return jax.vmap(
+                lambda r: blk.init(r, dummy)["params"]
+            )(jax.random.split(rng, self.depth))
+
+        stages = self.param("stages", init_stages)
+
+        def stage_fn(p, h):
+            return blk.apply({"params": p}, h)
+
+        if self.mesh is not None:
+            from fedml_tpu.parallel.pipeline import (
+                gpipe,
+                microbatch,
+                unmicrobatch,
+            )
+
+            if int(self.mesh.shape[self.stage_axis]) != self.depth:
+                raise ValueError(
+                    f"depth={self.depth} must equal the '{self.stage_axis}' "
+                    f"mesh size {int(self.mesh.shape[self.stage_axis])} "
+                    "(one Block per pipeline stage)")
+            y = unmicrobatch(gpipe(stage_fn, stages,
+                                   microbatch(x, self.num_microbatches),
+                                   self.stage_axis, self.mesh))
+        else:
+            y, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, stages)
+        y = nn.LayerNorm()(y)
+        return nn.Dense(self.vocab_size)(y)
 
 
 class TransformerLM(nn.Module):

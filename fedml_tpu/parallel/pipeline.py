@@ -1,0 +1,99 @@
+"""Pipeline parallelism: GPipe-style microbatch pipeline as scan + ppermute.
+
+Absent from the reference (SURVEY.md §2.7 lists no tensor/pipeline/sequence
+parallelism); this is the TPU-idiomatic formulation: the S pipeline stages
+live one-per-device on a 'stage' mesh axis, microbatches flow stage-to-stage
+over ICI via ``lax.ppermute`` inside a ``lax.scan`` of S+M-1 ticks, and the
+BACKWARD pipeline needs no code at all — differentiating through the
+scan+ppermute schedule gives the exact reverse schedule (ppermute's
+transpose is the reverse permutation), so one ``jax.grad`` runs the full
+GPipe fwd+bwd.
+
+Semantics are exactly sequential-stage application (bubbles compute on
+zeros and are masked out of the collected outputs), pinned by
+tests/test_pipeline_parallel.py's pipeline ≡ sequential oracle — values AND
+gradients.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def gpipe(stage_fn, stacked_params, x_mb, axis: str, mesh: Mesh):
+    """Run a homogeneous S-stage pipeline over M microbatches.
+
+    stage_fn(params_one_stage, x) -> y with ``y.shape == x.shape``;
+    stacked_params: pytree whose leaves are stacked [S, ...] (stage s uses
+    leaf[s]); x_mb: [M, mb, ...] microbatched input, replicated.
+    Returns [M, mb, ...] outputs, replicated (psum-collected from the last
+    stage). S = mesh.shape[axis]; M is independent of S.
+    """
+    S = int(mesh.shape[axis])
+    for leaf in jax.tree.leaves(stacked_params):
+        if np.shape(leaf)[0] != S:
+            # without this check shard_map would hand each device
+            # stage_dim/S stages and body() would keep only the first —
+            # silently SKIPPING the rest (zero gradients, wrong loss)
+            raise ValueError(
+                f"stacked stage dim {np.shape(leaf)[0]} != mesh "
+                f"'{axis}' size {S}: one pipeline stage per device required")
+
+    def body(stacked_local, x):
+        # stacked_local leaves: [1, ...] — this device's stage params
+        p = jax.tree.map(lambda t: t[0], stacked_local)
+        idx = lax.axis_index(axis)
+        M = x.shape[0]
+        pad = jnp.zeros((S - 1,) + x.shape[1:], x.dtype)
+        # tick t: stage 0 consumes stream[t] (a real microbatch for t < M,
+        # bubble zeros after)
+        stream = lax.pcast(jnp.concatenate([x, pad], 0), axis, to="varying")
+        zero_buf = lax.pcast(jnp.zeros_like(x[0]), axis, to="varying")
+        outs0 = lax.pcast(jnp.zeros_like(x), axis, to="varying")
+
+        def tick(carry, t):
+            recv, outs = carry
+            inp = jnp.where(idx == 0,
+                            lax.dynamic_index_in_dim(stream, t, keepdims=False),
+                            recv)
+            out = stage_fn(p, inp)
+            # ring shift: stage s's output becomes stage s+1's next input
+            # (the wrap S-1 -> 0 carries bubble garbage; stage 0 never
+            # reads recv, so it is harmless)
+            recv = lax.ppermute(out, axis,
+                                [(i, (i + 1) % S) for i in range(S)])
+            # the LAST stage emits microbatch t-(S-1) at tick t
+            pos = jnp.clip(t - (S - 1), 0, M - 1)
+            take = (t >= S - 1) & (idx == S - 1)
+            cur = lax.dynamic_index_in_dim(outs, pos, keepdims=False)
+            outs = lax.dynamic_update_index_in_dim(
+                outs, jnp.where(take, out, cur), pos, 0)
+            return (recv, outs), None
+
+        (_, outs), _ = lax.scan(tick, (zero_buf, outs0),
+                                jnp.arange(S + M - 1))
+        # only the last stage holds real outputs; zero the rest and psum so
+        # every stage exits with the replicated result
+        outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, axis)
+
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+    )(stacked_params, x_mb)
+
+
+def microbatch(x, num_microbatches: int):
+    """[N, ...] -> [M, N//M, ...] (N must divide evenly; pipeline
+    microbatches split the BATCH, sequence length stays whole)."""
+    n = x.shape[0]
+    if n % num_microbatches:
+        raise ValueError(f"batch {n} not divisible by M={num_microbatches}")
+    return x.reshape((num_microbatches, n // num_microbatches) + x.shape[1:])
+
+
+def unmicrobatch(y):
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
