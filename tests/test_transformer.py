@@ -59,3 +59,24 @@ def test_transformer_federates():
     api = FedAvgAPI(data, task, cfg)
     api.train()
     assert api.history[-1]["train_loss"] < api.history[0]["train_loss"]
+
+
+def test_moe_transformer_federates():
+    """The switch-MoE LM is an ordinary model to the FL engine: vmapped
+    client fits + weighted psum, experts and gates all averaged."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import sequence_task
+    from fedml_tpu.data.synthetic import synthetic_sequences
+
+    data = synthetic_sequences(num_clients=4, seq_len=16, vocab_size=40,
+                               samples_per_client=24, test_samples=40, seed=0)
+    task = sequence_task(TransformerLM(vocab_size=40, dim=32, depth=1,
+                                       num_heads=4, max_len=32,
+                                       moe_experts=2))
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=4,
+                       client_num_per_round=4, epochs=1, batch_size=8,
+                       lr=0.01, client_optimizer="adam",
+                       frequency_of_the_test=3)
+    api = FedAvgAPI(data, task, cfg)
+    api.train()
+    assert api.history[-1]["train_loss"] < api.history[0]["train_loss"]
