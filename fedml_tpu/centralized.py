@@ -42,6 +42,9 @@ class CentralizedConfig:
     momentum: float = 0.9
     wd: float = 0.0
     seed: int = 0
+    # eval batch rows; models with internal batch-dim sharding constraints
+    # (e.g. PipelineLM's data_axis) need this divisible like batch_size
+    eval_batch_size: int = 256
 
 
 class CentralizedTrainer:
@@ -51,7 +54,8 @@ class CentralizedTrainer:
         self.cfg = config
         self.mesh = mesh
         self.x, self.y = np.asarray(x), np.asarray(y)
-        self.test = batch_global(np.asarray(test_x), np.asarray(test_y), 256)
+        self.test = batch_global(np.asarray(test_x), np.asarray(test_y),
+                                 config.eval_batch_size)
         key = jax.random.PRNGKey(config.seed)
         self.rng, init_key = jax.random.split(key)
         x_sample = jnp.asarray(self.x[: config.batch_size])

@@ -140,6 +140,7 @@ class PipelineLM(nn.Module):
     mesh: Mesh | None = None
     stage_axis: str = "stage"
     num_microbatches: int = 2
+    data_axis: str | None = None  # DP x PP: batch stays sharded over this
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -177,7 +178,8 @@ class PipelineLM(nn.Module):
                     "(one Block per pipeline stage)")
             y = unmicrobatch(gpipe(stage_fn, stages,
                                    microbatch(x, self.num_microbatches),
-                                   self.stage_axis, self.mesh))
+                                   self.stage_axis, self.mesh,
+                                   data_axis=self.data_axis))
         else:
             y, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, stages)
         y = nn.LayerNorm()(y)

@@ -24,14 +24,24 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def gpipe(stage_fn, stacked_params, x_mb, axis: str, mesh: Mesh):
+def gpipe(stage_fn, stacked_params, x_mb, axis: str, mesh: Mesh,
+          data_axis: str | None = None):
     """Run a homogeneous S-stage pipeline over M microbatches.
 
     stage_fn(params_one_stage, x) -> y with ``y.shape == x.shape``;
     stacked_params: pytree whose leaves are stacked [S, ...] (stage s uses
-    leaf[s]); x_mb: [M, mb, ...] microbatched input, replicated.
-    Returns [M, mb, ...] outputs, replicated (psum-collected from the last
-    stage). S = mesh.shape[axis]; M is independent of S.
+    leaf[s]); x_mb: [M, mb, ...] microbatched input — replicated when
+    data_axis is None, batch-sharded over data_axis otherwise.
+    Returns [M, mb, ...] outputs (psum-collected from the last stage),
+    with the same replication/sharding as x_mb.
+    S = mesh.shape[axis]; M is independent of S.
+
+    data_axis: composes the pipeline with DATA parallelism on the same
+    mesh — the microbatch dim (axis 1 of x_mb) stays sharded over it, so a
+    ('data','stage') mesh runs data_axis-many independent pipelines, each
+    on its own batch shard. Stage params are replicated over 'data'
+    (in_specs names only the stage axis), the schedule is unchanged, and
+    the output keeps the batch sharding.
     """
     S = int(mesh.shape[axis])
     for leaf in jax.tree.leaves(stacked_params):
@@ -81,8 +91,9 @@ def gpipe(stage_fn, stacked_params, x_mb, axis: str, mesh: Mesh):
         outs = jnp.where(idx == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(outs, axis)
 
+    x_spec = P(None, data_axis) if data_axis is not None else P()
     return jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
+        body, mesh=mesh, in_specs=(P(axis), x_spec), out_specs=x_spec,
     )(stacked_params, x_mb)
 
 

@@ -90,6 +90,29 @@ def test_gpipe_rejects_stage_mesh_mismatch(mesh_stage4):
                                     jnp.zeros((4, 12), jnp.int32))
 
 
+def test_dp_x_pp_training_equals_single_device():
+    """('data','stage') mesh: 2 independent pipelines on 2 batch shards —
+    DP composed with PP, still exactly single-device math."""
+    mesh2d = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                  ("data", "stage"))
+    rs = np.random.RandomState(0)
+    x = rs.randint(1, 64, size=(192, 12)).astype(np.int32)
+
+    def lm(mesh, data_axis=None):
+        return PipelineLM(vocab_size=64, dim=16, depth=4, num_heads=2,
+                          max_len=12, mesh=mesh, num_microbatches=2,
+                          data_axis=data_axis)
+
+    cfg = CentralizedConfig(epochs=2, lr=0.1, batch_size=24, momentum=0.0)
+    a = CentralizedTrainer(sequence_task(lm(None)), x, x, x[:48], x[:48], cfg)
+    b = CentralizedTrainer(sequence_task(lm(mesh2d, "data")), x, x,
+                           x[:48], x[:48], cfg, mesh=mesh2d)
+    a.train()
+    b.train()
+    d = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(d) / float(tree_global_norm(a.net.params)) < 2e-5
+
+
 def test_pipeline_lm_training_equals_single_device(mesh_stage4):
     """PipelineLM on a 4-stage mesh trains to the SAME parameters as the
     identical module applied sequentially (mesh=None): the pipeline is a
