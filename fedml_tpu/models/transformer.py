@@ -171,12 +171,24 @@ class PipelineLM(nn.Module):
                 unmicrobatch,
             )
 
-            if int(self.mesh.shape[self.stage_axis]) != self.depth:
+            S = int(self.mesh.shape[self.stage_axis])
+            if self.depth % S:
                 raise ValueError(
-                    f"depth={self.depth} must equal the '{self.stage_axis}' "
-                    f"mesh size {int(self.mesh.shape[self.stage_axis])} "
-                    "(one Block per pipeline stage)")
-            y = unmicrobatch(gpipe(stage_fn, stages,
+                    f"depth={self.depth} must be a multiple of the "
+                    f"'{self.stage_axis}' mesh size {S} (equal Blocks per "
+                    "pipeline stage)")
+            k = self.depth // S
+            # stage s runs blocks [s*k, (s+1)*k): group the stacked blocks
+            # [depth, ...] into [S, k, ...] and scan the k sub-blocks
+            # inside each stage — sequential order is preserved
+            staged = jax.tree.map(
+                lambda t: t.reshape((S, k) + t.shape[1:]), stages)
+
+            def staged_fn(p, h):
+                return jax.lax.scan(
+                    lambda hh, pp: (stage_fn(pp, hh), None), h, p)[0]
+
+            y = unmicrobatch(gpipe(staged_fn, staged,
                                    microbatch(x, self.num_microbatches),
                                    self.stage_axis, self.mesh,
                                    data_axis=self.data_axis))

@@ -75,6 +75,26 @@ def test_gpipe_single_stage_degenerates():
                                rtol=1e-6, atol=1e-6)
 
 
+def test_pipeline_lm_multiple_blocks_per_stage(mesh_stage4):
+    """depth = 2x stages: each stage scans its 2 consecutive blocks;
+    still exactly the sequential model."""
+    rs = np.random.RandomState(0)
+    x = rs.randint(1, 64, size=(96, 12)).astype(np.int32)
+
+    def lm(mesh):
+        return PipelineLM(vocab_size=64, dim=16, depth=8, num_heads=2,
+                          max_len=12, mesh=mesh, num_microbatches=2)
+
+    cfg = CentralizedConfig(epochs=1, lr=0.1, batch_size=24, momentum=0.0)
+    a = CentralizedTrainer(sequence_task(lm(None)), x, x, x[:48], x[:48], cfg)
+    b = CentralizedTrainer(sequence_task(lm(mesh_stage4)), x, x, x[:48],
+                           x[:48], cfg, mesh=mesh_stage4)
+    a.train()
+    b.train()
+    d = tree_global_norm(tree_sub(a.net.params, b.net.params))
+    assert float(d) / float(tree_global_norm(a.net.params)) < 2e-5
+
+
 def test_gpipe_rejects_stage_mesh_mismatch(mesh_stage4):
     """depth != mesh size must be a loud error, not silently-skipped stages
     (a 4-deep model on a 2-device mesh would otherwise train blocks 0 and 2
@@ -84,8 +104,10 @@ def test_gpipe_rejects_stage_mesh_mismatch(mesh_stage4):
     x = jnp.asarray(np.random.RandomState(3).randn(4, 3, 8))
     with pytest.raises(ValueError, match="stage"):
         gpipe(_stage_fn, params, microbatch(x, 2), "stage", mesh2)
-    with pytest.raises(ValueError, match="stage"):
-        PipelineLM(vocab_size=64, dim=16, depth=4, num_heads=2, max_len=12,
+    # depth not a MULTIPLE of the stage count (4-on-2 is now valid: 2
+    # blocks per stage)
+    with pytest.raises(ValueError, match="multiple"):
+        PipelineLM(vocab_size=64, dim=16, depth=5, num_heads=2, max_len=12,
                    mesh=mesh2).init(jax.random.PRNGKey(0),
                                     jnp.zeros((4, 12), jnp.int32))
 
