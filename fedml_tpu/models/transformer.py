@@ -123,17 +123,18 @@ class Block(nn.Module):
 
 class PipelineLM(nn.Module):
     """Decoder-only LM with the block stack run as a GPipe PIPELINE over a
-    'stage' mesh axis (parallel/pipeline.py): one transformer Block per
-    stage, stacked into a single [S, ...] param tree; microbatches flow
-    stage-to-stage via ppermute and jax.grad yields the reverse schedule.
-    With ``mesh=None`` the same stacked params are applied sequentially
-    (lax.scan over stages) — the equivalence oracle for the pipeline
-    (test_pipeline_parallel.py). Embedding/head are replicated (cheap, and
-    keeps the pipelined region homogeneous)."""
+    'stage' mesh axis (parallel/pipeline.py): depth/S consecutive
+    transformer Blocks per stage (depth must be a multiple of the stage
+    count S), stacked into a single [depth, ...] param tree; microbatches
+    flow stage-to-stage via ppermute and jax.grad yields the reverse
+    schedule. With ``mesh=None`` the same stacked params are applied
+    sequentially (lax.scan over blocks) — the equivalence oracle for the
+    pipeline (test_pipeline_parallel.py). Embedding/head are replicated
+    (cheap, and keeps the pipelined region homogeneous)."""
 
     vocab_size: int = 256
     dim: int = 128
-    depth: int = 4  # == number of pipeline stages
+    depth: int = 4  # total Blocks; must be a multiple of the stage count
     num_heads: int = 4
     max_len: int = 2048
     causal: bool = True

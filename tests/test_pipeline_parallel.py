@@ -96,9 +96,10 @@ def test_pipeline_lm_multiple_blocks_per_stage(mesh_stage4):
 
 
 def test_gpipe_rejects_stage_mesh_mismatch(mesh_stage4):
-    """depth != mesh size must be a loud error, not silently-skipped stages
-    (a 4-deep model on a 2-device mesh would otherwise train blocks 0 and 2
-    only)."""
+    """A stacked-stage dim that differs from the mesh size must be a loud
+    gpipe error (shard_map would otherwise silently apply a subset), and a
+    PipelineLM depth that is not a MULTIPLE of the stage count must be a
+    loud model error (depth = k x stages is valid: k blocks per stage)."""
     mesh2 = Mesh(np.asarray(jax.devices()[:2]), ("stage",))
     params = _stacked(s=4)
     x = jnp.asarray(np.random.RandomState(3).randn(4, 3, 8))
