@@ -65,12 +65,21 @@ def add_args(parser: argparse.ArgumentParser):
                              "seeded subset")
     # TPU execution surface (replaces --backend/--gpu_mapping/--is_mobile)
     parser.add_argument("--mesh", type=int, default=0,
-                        help="devices on the 'clients' mesh axis; 0 = single-device vmap")
+                        help="devices on the 'clients' mesh axis; 0 = "
+                             "single-device vmap. For --algo centralized "
+                             "the axis is 'data' (0 = ALL devices when "
+                             "--model_parallel > 1 or with fedavg_seq, "
+                             "which have no single-device analogue)")
     parser.add_argument("--seq_shards", type=int, default=2,
                         help="fedavg_seq: devices on the 'seq' axis (the "
                              "'clients' axis gets --mesh/seq_shards)")
     parser.add_argument("--seq_impl", type=str, default="ring",
                         choices=["ring", "ulysses"])
+    parser.add_argument("--model_parallel", type=int, default=1,
+                        help="centralized: devices on a 'model' axis — "
+                             "Megatron-style tensor (+MoE expert) "
+                             "parallelism via GSPMD specs; composes with "
+                             "the remaining devices as the 'data' axis")
     parser.add_argument("--lm_dim", type=int, default=64)
     parser.add_argument("--lm_depth", type=int, default=2)
     parser.add_argument("--lm_heads", type=int, default=4)
@@ -243,20 +252,14 @@ def build_api(args):
         if spec.task != "sequence":
             raise ValueError("fedavg_seq needs a sequence dataset "
                              "(shakespeare / fed_shakespeare / stackoverflow_nwp)")
-        avail = len(jax.devices())
+        from fedml_tpu.mesh.mesh import make_2d_mesh
+
         # NOTE --mesh 0 means "all devices" here (a 2-axis mesh has no
         # single-device vmap analogue), unlike the 1-axis algos
-        n_dev = args.mesh or avail
         sd = max(1, args.seq_shards)
-        if n_dev > avail:
-            raise ValueError(f"--mesh {n_dev} exceeds {avail} devices")
-        if n_dev % sd != 0:
-            raise ValueError(
-                f"--mesh {n_dev} not divisible by --seq_shards {sd} "
-                "(devices would be silently dropped)")
-        cd = n_dev // sd
-        smesh = Mesh(np.asarray(jax.devices()[: cd * sd]).reshape(cd, sd),
-                     ("clients", "seq"))
+        smesh = make_2d_mesh(args.mesh, sd, ("clients", "seq"),
+                             minor_flag="--seq_shards")
+        cd = int(smesh.shape["clients"])
         T = int(spec.input_shape[0])
         log.info("fedavg_seq mesh: %d client-shards x %d seq-shards (T=%d)",
                  cd, sd, T)
@@ -274,8 +277,9 @@ def build_api(args):
             "tags": tag_prediction_task}[spec.task](model)
 
     mesh = None
-    if args.mesh and args.algo != "hierarchical":
-        # hierarchical builds its own 2-axis ('groups','clients') mesh below
+    if args.mesh and args.algo not in ("hierarchical", "centralized"):
+        # hierarchical builds its own 2-axis ('groups','clients') mesh
+        # below; centralized builds a ('data'[,'model']) mesh in its branch
         mesh = Mesh(np.asarray(jax.devices()[: args.mesh]), ("clients",))
 
     algo = args.algo
@@ -367,8 +371,33 @@ def build_api(args):
         ccfg = CentralizedConfig(epochs=args.epochs * args.comm_round,
                                  batch_size=args.batch_size, lr=args.lr,
                                  wd=args.wd, seed=args.seed)
+        cmesh = None
+        if args.mesh or args.model_parallel > 1:
+            from fedml_tpu.mesh.mesh import make_2d_mesh, make_client_mesh
+
+            tp = max(1, args.model_parallel)
+            if tp > 1:
+                cmesh = make_2d_mesh(args.mesh, tp, ("data", "model"),
+                                     minor_flag="--model_parallel")
+            else:
+                cmesh = make_client_mesh(args.mesh or None, axis_name="data")
+            dp = int(cmesh.shape["data"])
+            if ccfg.batch_size % dp:
+                raise ValueError(
+                    f"--batch_size {ccfg.batch_size} not divisible by the "
+                    f"data-parallel degree {dp} (batch rows shard over "
+                    "'data')")
+            if ccfg.eval_batch_size % dp:
+                # eval batches are masked-padded, so rounding the eval
+                # batch up to a divisible size changes layout only
+                import dataclasses as _dc
+
+                ccfg = _dc.replace(
+                    ccfg,
+                    eval_batch_size=-(-ccfg.eval_batch_size // dp) * dp)
         return CentralizedTrainer(task, data.train_x, data.train_y,
-                                  data.test_x, data.test_y, ccfg), data
+                                  data.test_x, data.test_y, ccfg,
+                                  mesh=cmesh), data
     raise ValueError(f"unhandled algo {algo}")
 
 

@@ -30,6 +30,24 @@ def make_client_mesh(num_devices: int | None = None, axis_name: str = "clients")
     return Mesh(np.asarray(devs), (axis_name,))
 
 
+def make_2d_mesh(n_devices: int | None, minor: int,
+                 axes: tuple[str, str],
+                 n_flag: str = "--mesh", minor_flag: str = "") -> Mesh:
+    """2-D (major, minor) mesh over the first n_devices devices (None/0 =
+    all). Raises clear errors naming the CLI flags involved when the
+    device budget is exceeded or not divisible by ``minor``."""
+    avail = len(jax.devices())
+    n = n_devices or avail
+    if n > avail:
+        raise ValueError(f"{n_flag} {n} exceeds {avail} devices")
+    if n % minor:
+        raise ValueError(
+            f"{n_flag} {n} not divisible by {minor_flag or 'minor axis'} "
+            f"{minor} (devices would be silently dropped)")
+    arr = np.asarray(jax.devices()[:n]).reshape(n // minor, minor)
+    return Mesh(arr, axes)
+
+
 def make_hierarchical_mesh(num_groups: int, clients_per_group: int) -> Mesh:
     """2-D ('groups','clients') mesh for hierarchical FL.
 

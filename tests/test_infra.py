@@ -110,6 +110,15 @@ def test_cli_build_api_all_algos():
         api, data = build_api(args)
         assert api is not None
 
+    # centralized with a ('data','model') TP mesh via --model_parallel
+    args = add_args(argparse.ArgumentParser()).parse_args([
+        "--algo", "centralized", "--dataset", "mnist", "--model", "lr",
+        "--client_num_in_total", "6", "--comm_round", "1",
+        "--mesh", "8", "--model_parallel", "4",
+    ])
+    api, _ = build_api(args)
+    assert api.mesh is not None and api.mesh.axis_names == ("data", "model")
+
 
 def test_cli_fedseg_split_gkt_vfl_smoke(tmp_path):
     """CI-script parity: the remaining algorithm entries launch end-to-end
