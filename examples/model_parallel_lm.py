@@ -1,0 +1,67 @@
+"""Model parallelism tour: TP, EP and PP on the TransformerLM.
+
+The reference has no tensor/pipeline/expert parallelism anywhere
+(SURVEY.md §2.7); these are fedml_tpu capability-plus, built the idiomatic
+XLA way — pick a mesh, annotate layouts, let the compiler insert the
+collectives — and each one is pinned to a single-device oracle in
+tests/test_{tensor,pipeline}_parallel.py.
+
+Run on the 8-device virtual CPU mesh:
+
+  env PYTHONPATH=. JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python examples/model_parallel_lm.py
+
+or through the CLI:  python -m fedml_tpu.experiments.cli \
+      --algo centralized --dataset shakespeare --model transformer \
+      --mesh 8 --model_parallel 4 ...
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def main():
+    import jax
+    from jax.sharding import Mesh
+
+    from fedml_tpu.centralized import CentralizedConfig, CentralizedTrainer
+    from fedml_tpu.core.tasks import sequence_task
+    from fedml_tpu.models.transformer import PipelineLM, TransformerLM
+    from fedml_tpu.parallel.tensor_parallel import num_sharded
+
+    rs = np.random.RandomState(0)
+    x = rs.randint(1, 256, size=(512, 32)).astype(np.int32)
+    cfg = CentralizedConfig(epochs=2, batch_size=64, lr=0.1)
+
+    # --- DP x TP x EP: ('data','model') mesh ------------------------------
+    # Megatron-style specs shard the MLP/attention/embedding kernels over
+    # 'model'; the switch-MoE expert-stacked kernels shard their expert dim
+    # over the same axis (expert parallelism); batch shards over 'data'.
+    mesh_tp = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                   ("data", "model"))
+    lm = TransformerLM(vocab_size=256, dim=64, depth=2, num_heads=4,
+                       max_len=32, moe_experts=4)
+    tr = CentralizedTrainer(sequence_task(lm), x, x, x[:128], x[:128], cfg,
+                            mesh=mesh_tp)
+    print(f"TP/EP: {num_sharded(tr.net.params)} model-sharded param leaves")
+    tr.train()
+    print("TP/EP history:", tr.history[-1])
+
+    # --- DP x PP: ('data','stage') mesh -----------------------------------
+    # 4 pipeline stages (2 Blocks each), 2 microbatches, batch sharded over
+    # 'data' — the GPipe schedule runs via ppermute inside one jit.
+    mesh_pp = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                   ("data", "stage"))
+    plm = PipelineLM(vocab_size=256, dim=64, depth=8, num_heads=4,
+                     max_len=32, mesh=mesh_pp, num_microbatches=2,
+                     data_axis="data")
+    tr2 = CentralizedTrainer(sequence_task(plm), x, x, x[:128], x[:128], cfg,
+                             mesh=mesh_pp)
+    tr2.train()
+    print("PP history:", tr2.history[-1])
+
+
+if __name__ == "__main__":
+    main()
