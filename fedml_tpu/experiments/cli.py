@@ -90,6 +90,10 @@ def add_args(parser: argparse.ArgumentParser):
                              "deeper models / longer contexts in HBM)")
     parser.add_argument("--device_data", type=int, default=0,
                         help="1 = HBM-resident train set + per-round index blocks")
+    parser.add_argument("--working_set", type=int, default=0,
+                        help="with --device_data 1: per-block working-set "
+                             "park (upload only the rows a block touches) "
+                             "instead of parking the whole train set")
     parser.add_argument("--uint8_pixels", type=int, default=0,
                         help="1 = ship image pixels as uint8, normalize on device")
     # algorithm-specific
@@ -284,8 +288,11 @@ def build_api(args):
 
     algo = args.algo
     if algo == "fedavg":
-        return FedAvgAPI(data, task, cfg, mesh=mesh,
-                         device_data=bool(getattr(args, "device_data", 0))), data
+        return FedAvgAPI(
+            data, task, cfg, mesh=mesh,
+            device_data=bool(getattr(args, "device_data", 0)),
+            block_working_set=bool(getattr(args, "device_data", 0))
+            and bool(getattr(args, "working_set", 0))), data
     if algo == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptAPI
 
