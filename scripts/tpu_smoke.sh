@@ -16,16 +16,47 @@ cd "$(dirname "$0")/.."
 export PYTHONPATH="$PWD"
 TS=$(date +%Y%m%d_%H%M%S)
 OUT="runs/tpu_smoke_${TS}"
+export OUT
 mkdir -p "$OUT"
 
-echo "== 1/3 flagship bench =="
+echo "== 1/5 flagship bench =="
 timeout 1800 python -u bench.py 2>"$OUT/bench.stderr" | tee "$OUT/bench.json"
 
-echo "== 2/3 cross-silo bench (ResNet-56) =="
+echo "== 2/5 cross-silo bench (ResNet-56) =="
 timeout 1800 python -u bench_scaling.py --workload cifar_resnet56 --rounds 5 \
   2>"$OUT/cross_silo.stderr" | tee "$OUT/cross_silo.json"
 
-echo "== 3/3 flash under strict vma on TPU =="
+echo "== 3/5 client-scaling sweep (BASELINE north-star row 3) =="
+timeout 1800 python -u bench_scaling.py --points 8,32,128 --rounds 5 \
+  2>"$OUT/scaling.stderr" | tee "$OUT/scaling.json"
+
+echo "== 4/5 jax.profiler trace of the flagship round =="
+timeout 900 env FEDML_BENCH_ROUNDS_CHEAP=4 python -u - <<'PY' 2>"$OUT/trace.stderr" | tee "$OUT/trace.txt"
+import os, time, jax
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.registry import load_dataset
+from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+out = os.environ.get("OUT", "runs/tpu_smoke") + "/trace"
+data = load_dataset("femnist", seed=0, uint8_pixels=True)
+cfg = FedAvgConfig(comm_round=40, client_num_in_total=3400,
+                   client_num_per_round=10, epochs=1, batch_size=20, lr=0.1,
+                   frequency_of_the_test=10_000, max_batches=28)
+api = FedAvgAPI(data, classification_task(CNNOriginalFedAvg(only_digits=False)),
+                cfg, device_data=True, donate=True, block_working_set=True)
+api.run_rounds(0, 10); jax.block_until_ready(api.net.params)  # warm compile
+with jax.profiler.trace(out):
+    api.run_rounds(10, 10)
+    jax.block_until_ready(api.net.params)
+t0 = time.perf_counter(); api.run_rounds(20, 10)
+jax.block_until_ready(api.net.params)
+dt = time.perf_counter() - t0
+print(f"traced 10-round block; untraced block: {10/dt:.1f} rounds/s; "
+      f"spans: {api.tracer.totals()}")
+PY
+
+echo "== 5/5 flash under strict vma on TPU =="
 timeout 900 python -u - <<'PY' 2>&1 | tee "$OUT/flash_vma.txt"
 import jax, jax.numpy as jnp
 import numpy as np
