@@ -65,6 +65,27 @@ def test_megatron_specs_on_transformer(mesh_dp_tp):
     assert shard_shape == (mlp_in.shape[0], mlp_in.shape[1] // 4)
 
 
+def test_non_transformer_models_stay_replicated(mesh_dp_tp):
+    """The Megatron suffix rules must not accidentally shard a CNN/ResNet:
+    applying the specs to a non-transformer tree yields all-replicated
+    placement (still correct under GSPMD either way, but surprise layout
+    changes on unrelated models would waste memory/collectives)."""
+    from fedml_tpu.models.cnn import CNNOriginalFedAvg
+
+    m = CNNOriginalFedAvg(only_digits=False)
+    params = m.init(jax.random.PRNGKey(0),
+                    jnp.zeros((2, 28, 28, 1), jnp.float32))["params"]
+    placed, specs = shard_params(params, mesh_dp_tp)
+    # flax names the CNN's dense layers Dense_0/Dense_1 — their kernels
+    # match the generic suffix rules BY DESIGN (column/row-parallel works
+    # for any MLP head); everything convolutional must stay replicated
+    for k, s in specs:
+        if "conv" in k.lower():
+            assert tuple(s) == (), (k, s)
+    # at most the dense head: column kernel + its bias, row kernel
+    assert num_sharded(placed) <= 3
+
+
 def test_non_divisible_dims_fall_back_replicated():
     leaf = np.zeros((32, 97))  # 97 not divisible by 4
     spec = tp_spec_for((jax.tree_util.DictKey("Dense_0"),
