@@ -39,6 +39,12 @@ import time
 _BASELINE_ROUNDS_PER_SEC = 1.0 / 0.3  # MPI poll-loop lower bound, see docstring
 
 
+def _cpu_cheap_rounds() -> str:
+    """Timed rounds for a CPU-degraded measurement (a 1-core box fits ~2
+    rounds + the 215 s compile in a stretched child budget)."""
+    return os.environ.get("FEDML_BENCH_ROUNDS_CHEAP_CPU", "2")
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return max(1, int(os.environ.get(name, "") or default))
@@ -337,8 +343,7 @@ def main() -> None:
         # the probe already fell back to CPU on a near-coreless box: the full
         # 8-round cheap measurement (~215 s compile + >80 s/round here) and
         # the block compile cannot fit any child budget — degrade up front
-        env.setdefault("FEDML_BENCH_ROUNDS_CHEAP",
-                       os.environ.get("FEDML_BENCH_ROUNDS_CHEAP_CPU", "2"))
+        env.setdefault("FEDML_BENCH_ROUNDS_CHEAP", _cpu_cheap_rounds())
         cheap_timeout = max(cheap_timeout, 1500)
 
     cheap, rc = None, 0
@@ -388,8 +393,7 @@ def main() -> None:
         print("bench: accelerator measurements failed; CPU last resort",
               file=sys.stderr)
         cpu_env = _cpu_env(env)
-        cpu_env["FEDML_BENCH_ROUNDS_CHEAP"] = os.environ.get(
-            "FEDML_BENCH_ROUNDS_CHEAP_CPU", "2")
+        cpu_env["FEDML_BENCH_ROUNDS_CHEAP"] = _cpu_cheap_rounds()
         rc, out = _run_child([here, "--measure", "per_round"], cpu_env,
                              max(cheap_timeout, 1500))
         best = _last_json_line(out)

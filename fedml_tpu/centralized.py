@@ -126,13 +126,15 @@ class CentralizedTrainer:
         else:
             data_axis = mesh.axis_names[0]
 
+        if data_axis is None:
+            return epoch  # pure TP/PP mesh: batch stays replicated
+
         def epoch_dp(rng, net, opt_state, xb, yb, mb):
             # xb: [B, bs, ...] -> shard bs across devices via in_shardings
-            if data_axis is not None:
-                shd = NamedSharding(mesh, P(None, data_axis))
-                xb = jax.device_put(xb, shd)
-                yb = jax.device_put(yb, shd)
-                mb = jax.device_put(mb, shd)
+            shd = NamedSharding(mesh, P(None, data_axis))
+            xb = jax.device_put(xb, shd)
+            yb = jax.device_put(yb, shd)
+            mb = jax.device_put(mb, shd)
             return epoch(rng, net, opt_state, xb, yb, mb)
 
         return epoch_dp
@@ -158,6 +160,10 @@ class CentralizedTrainer:
     def evaluate(self):
         from fedml_tpu.core.local import make_eval_fn
 
+        if not hasattr(self, "_eval_fn"):
+            # cache: a fresh make_eval_fn per call would re-trace (and
+            # recompile) the eval program on every evaluation
+            self._eval_fn = make_eval_fn(self.task)
         xb, yb, mb = (jnp.asarray(a) for a in self.test)
-        ev = make_eval_fn(self.task)(self.net, xb, yb, mb)
+        ev = self._eval_fn(self.net, xb, yb, mb)
         return {"test_loss": float(ev["loss"]), "test_acc": float(ev["acc"])}
