@@ -1,18 +1,16 @@
-"""Sequence/context parallelism (first-class TPU capability).
+"""Parallelism strategies beyond client-DP (all absent from the reference,
+SURVEY.md §2.7; each pinned to an exact single-device oracle).
 
-The reference has NO long-context machinery (its longest sequence is 80
-chars, SURVEY.md §2.7) — this package is the TPU-native headroom the
-framework is designed around: a 'seq' mesh axis with
-
-- ring_attention: blockwise attention with K/V blocks rotating over the ICI
-  ring (lax.ppermute) and online-softmax accumulation — memory per device is
-  O(T/N), enabling sequences far beyond one chip's HBM.
-- ulysses_attention: all-to-all sequence<->head re-sharding so each device
-  computes full-sequence attention for a head subset (DeepSpeed-Ulysses
-  pattern) — cheaper at moderate T, needs heads % N == 0.
-
-Both are pure shard_map bodies usable inside any jitted train step, tested
-for exactness against single-device full attention on a CPU mesh.
+- Sequence/context parallelism ('seq' axis): ring_attention — blockwise
+  attention with K/V blocks rotating over the ICI ring (lax.ppermute),
+  online-softmax accumulation, O(T/N) memory per device; ulysses_attention
+  — all-to-all sequence<->head re-sharding (DeepSpeed-Ulysses pattern).
+- Tensor + expert parallelism ('model' axis): tensor_parallel.py —
+  Megatron-style PartitionSpecs placed at init (GSPMD inserts the
+  collectives); the switch-MoE expert-stacked kernels shard their expert
+  dim over the same axis.
+- Pipeline parallelism ('stage' axis): pipeline.py — GPipe microbatch
+  schedule as scan+ppermute; the backward schedule comes from jax.grad.
 """
 
 from fedml_tpu.parallel.ring_attention import (
@@ -20,4 +18,10 @@ from fedml_tpu.parallel.ring_attention import (
     ring_attention_sharded,
     ulysses_attention_sharded,
     full_attention,
+)
+from fedml_tpu.parallel.pipeline import gpipe, microbatch, unmicrobatch
+from fedml_tpu.parallel.tensor_parallel import (
+    num_sharded,
+    shard_params,
+    tp_shardings,
 )
