@@ -239,6 +239,21 @@ class FedAvgAPI:
         self.rng, init_key = jax.random.split(self.rng)
         x_sample = jnp.asarray(dataset.train_x[: config.batch_size])
         self.net = task.init(init_key, x_sample)
+        # federated TENSOR parallelism: a ('clients','model') mesh shards
+        # each client's local fit over 'model' (Megatron specs, GSPMD
+        # collectives) while 'clients' stays the manual FL axis — the
+        # round program is shard_map(axis_names={'clients'}) so the model
+        # axis remains auto and the compiler partitions the vmapped local
+        # SGD. Params are placed TP-sharded up front.
+        self._tp = mesh is not None and "model" in mesh.axis_names
+        if self._tp:
+            from fedml_tpu.parallel.tensor_parallel import shard_params
+
+            params, self.tp_specs = shard_params(self.net.params, mesh)
+            rep = NamedSharding(mesh, P())
+            extra = jax.tree.map(lambda v: jax.device_put(v, rep),
+                                 self.net.extra)
+            self.net = self.net._replace(params=params, extra=extra)
         self.server_opt_state = server_opt_init(self.net.params) if server_opt_init else ()
 
         self.round_fn = self._build_round_fn()
@@ -308,11 +323,19 @@ class FedAvgAPI:
 
         mesh = self.mesh
         axis = mesh.axis_names[0]
-        ndev = int(np.prod(mesh.devices.shape))
+        if axis == "model":
+            raise ValueError("the first mesh axis is the client axis; put "
+                             "'model' second: Mesh(..., ('clients','model'))")
+        # clients shard over the FIRST axis only; a 'model' axis (federated
+        # TP) is left auto for GSPMD and contributes no client slots
+        ndev = int(mesh.shape[axis])
+        self._smap_kw = (dict(mesh=mesh, axis_names={axis}) if self._tp
+                         else dict(mesh=mesh))
         if cfg.client_num_per_round % ndev != 0:
             raise ValueError(
                 f"client_num_per_round={cfg.client_num_per_round} must be a "
-                f"multiple of mesh size {ndev} (pad with zero-weight clients)"
+                f"multiple of the '{axis}' mesh size {ndev} (pad with "
+                "zero-weight clients)"
             )
 
         def shard_body(keys, net, x, y, mask, nsamp, hook_key):
@@ -330,9 +353,9 @@ class FedAvgAPI:
 
         smapped = jax.shard_map(
             shard_body,
-            mesh=mesh,
             in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P()),
+            **self._smap_kw,
         )
 
         def shard_body_devdata(keys, net, dev_x, dev_y, idx, mask, nsamp, hook_key):
@@ -344,9 +367,9 @@ class FedAvgAPI:
 
         smapped_dd = jax.shard_map(
             shard_body_devdata,
-            mesh=mesh,
             in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P(axis), P()),
             out_specs=(P(), P()),
+            **self._smap_kw,
         )
 
         @partial(jax.jit, donate_argnums=donate_args)
@@ -512,10 +535,10 @@ class FedAvgAPI:
 
         smapped_block = jax.shard_map(
             shard_block,
-            mesh=mesh,
             in_specs=(P(), P(), P(), P(), P(None, axis), P(None, axis),
                       P(None, axis), P(None, axis), P()),
             out_specs=(P(), P(), P()),
+            **self._smap_kw,
         )
 
         @partial(jax.jit, donate_argnums=(1, 2))
@@ -642,11 +665,20 @@ class FedAvgAPI:
     def load_state(self, net, server_opt_state, rng):
         """Install restored state, re-placing it for the engine's mesh (a
         checkpoint restored host-side lands on one device; the round program
-        expects replicated layout when a mesh is active)."""
+        expects replicated layout when a mesh is active — or the Megatron
+        TP layout on a ('clients','model') mesh, which a blanket
+        replicated placement would silently discard)."""
         if self.mesh is not None:
             rep = NamedSharding(self.mesh, P())
             put = lambda t: jax.tree.map(lambda v: jax.device_put(v, rep), t)
-            net, server_opt_state, rng = put(net), put(server_opt_state), put(rng)
+            if self._tp:
+                from fedml_tpu.parallel.tensor_parallel import shard_params
+
+                params, self.tp_specs = shard_params(net.params, self.mesh)
+                net = net._replace(params=params, extra=put(net.extra))
+            else:
+                net = put(net)
+            server_opt_state, rng = put(server_opt_state), put(rng)
         self.net, self.server_opt_state, self.rng = net, server_opt_state, rng
 
     # ------------------------------------------------------------------ eval
