@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
+import sys
 import time
 
 
@@ -102,20 +102,24 @@ def main():
                          "(femnist_cnn) or 10 (cifar_resnet56 = the "
                          "reference cross-silo client count)")
     ap.add_argument("--device_data", type=int, default=1)
-    ap.add_argument("--working_set", type=int,
-                    default=0 if os.environ.get("FEDML_BENCH_FULL_PARK") == "1"
-                    else 1,
+    ap.add_argument("--working_set", type=int, default=0,
                     help="with --device_data: per-block working-set park "
                          "(upload only the rows a block touches) instead "
-                         "of parking the whole train set up front; "
-                         "FEDML_BENCH_FULL_PARK=1 flips the default like "
-                         "bench.py")
+                         "of parking the whole train set up front. Opt-in "
+                         "(like the CLI's --working_set): it moves per-block "
+                         "host compaction+upload INTO the timed window, so "
+                         "sweep numbers are only comparable to other "
+                         "working-set sweeps")
     ap.add_argument("--rounds", type=int, default=10)
     ap.add_argument("--batch_size", type=int, default=None)
     ap.add_argument("--max_batches", type=int, default=None)
     ap.add_argument("--spans", type=int, default=1)
     ap.add_argument("--samples_per_client", type=int, default=None)
     args = ap.parse_args()
+    if args.device_data and args.working_set:
+        print("bench_scaling: working-set plane ON — the timed window now "
+              "includes per-block host compaction+upload; numbers are not "
+              "comparable to full-park sweeps", file=sys.stderr)
 
     from fedml_tpu.core.tasks import classification_task
 

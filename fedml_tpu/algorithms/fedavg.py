@@ -614,7 +614,22 @@ class FedAvgAPI:
             len(self.data.train_x),
         )
         n_rows = max(n_rows, getattr(self, "_ws_rows", 0))
+        if (n_rows == getattr(self, "_ws_rows", 0)
+                and getattr(self, "_ws_uniq", None) is not None
+                and np.array_equal(uniq, self._ws_uniq)):
+            # same unique-row set as the previous block: the parked device
+            # buffers are already exactly right — skip the host gather AND
+            # the upload entirely
+            return remapped, self._ws_dev_x, self._ws_dev_y
         self._ws_rows = n_rows
+        self._ws_uniq = uniq
+        # FRESH host buffers every refill: device_put may alias (CPU) or
+        # asynchronously read (accelerator) the numpy buffer, so a cached
+        # staging buffer refilled in place could corrupt the previous
+        # block's parked rows while its round program is still in flight.
+        # np.zeros is calloc'd (near-free); the real cost here is the row
+        # gather, which only happens when the working set actually changed
+        # (the unchanged case short-circuits above).
         cx = np.zeros((n_rows,) + self.data.train_x.shape[1:],
                       self.data.train_x.dtype)
         cy = np.zeros((n_rows,) + self.data.train_y.shape[1:],
@@ -623,7 +638,8 @@ class FedAvgAPI:
         cy[: len(uniq)] = self.data.train_y[uniq]
         sh = (NamedSharding(self.mesh, P()) if self.mesh is not None else None)
         put = (lambda a: jax.device_put(a, sh)) if sh else jax.device_put
-        return remapped, put(cx), put(cy)
+        self._ws_dev_x, self._ws_dev_y = put(cx), put(cy)
+        return remapped, self._ws_dev_x, self._ws_dev_y
 
     # ------------------------------------------------------------------ train
     def run_round(self, round_idx: int):

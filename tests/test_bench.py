@@ -210,7 +210,9 @@ def test_bench_scaling_one_point(tiny_bench_env, monkeypatch, capsys):
     monkeypatch.setattr(
         sys, "argv",
         ["bench_scaling.py", "--workload", "femnist_cnn", "--points", "2",
-         "--rounds", "1", "--batch_size", "4", "--max_batches", "1"])
+         "--rounds", "1", "--batch_size", "4", "--max_batches", "1",
+         "--working_set", "1"])  # opt-in since ADVICE r2 #2 (default is
+    #                             full_park for sweep comparability)
     bench_scaling.main()
     out = [l for l in capsys.readouterr().out.strip().splitlines()
            if l.startswith("{")]
