@@ -33,11 +33,19 @@ class SelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        B, T, C = x.shape
+        C = x.shape[-1]
         H, D = self.num_heads, self.head_dim
-        qkv = nn.Dense(3 * H * D, use_bias=False)(x)
-        q, k, v = jnp.split(qkv.reshape(B, T, 3, H, D), 3, axis=2)
-        q, k, v = (t.squeeze(2) for t in (q, k, v))
+        # Head-aligned projections, Megatron-style: DenseGeneral keeps the
+        # head dim a REAL kernel dim ([C, H, D], not a flattened [C, 3HD]
+        # column block), so tensor parallelism shards heads whole
+        # (P(None,'model',None), parallel/tensor_parallel.py) and the
+        # attention core runs fully sharded — the only TP collective is the
+        # psum o_proj's row-parallel contraction inserts. The explicit
+        # names are the TP spec-matching contract (rename-robust: specs key
+        # on these leaf names, not flax auto-numbering).
+        q = nn.DenseGeneral((H, D), use_bias=False, name="q_proj")(x)
+        k = nn.DenseGeneral((H, D), use_bias=False, name="k_proj")(x)
+        v = nn.DenseGeneral((H, D), use_bias=False, name="v_proj")(x)
         if self.seq_axis is not None:
             if self.seq_impl == "ulysses":
                 o = ulysses_attention(q, k, v, self.seq_axis,
@@ -60,7 +68,10 @@ class SelfAttention(nn.Module):
             o = flash_attention(q, k, v, self.causal)
         else:
             o = full_attention(q, k, v, causal=self.causal)
-        return nn.Dense(C, use_bias=False)(o.reshape(B, T, H * D))
+        # row-parallel over heads: kernel [H, D, C]; contracting the sharded
+        # H dim is the single Megatron all-reduce per attention layer
+        return nn.DenseGeneral(C, axis=(-2, -1), use_bias=False,
+                               name="o_proj")(o)
 
 
 class MoEMLP(nn.Module):
@@ -115,9 +126,11 @@ class Block(nn.Module):
         C = x.shape[-1]
         if self.moe_experts > 0:
             return x + MoEMLP(self.moe_experts, self.mlp_ratio)(h)
-        m = nn.Dense(self.mlp_ratio * C)(h)
+        # explicit names = the TP spec contract: mlp_in column-parallel,
+        # mlp_out row-parallel (parallel/tensor_parallel.py)
+        m = nn.Dense(self.mlp_ratio * C, name="mlp_in")(h)
         m = nn.gelu(m)
-        x = x + nn.Dense(C)(m)
+        x = x + nn.Dense(C, name="mlp_out")(m)
         return x
 
 
@@ -196,7 +209,7 @@ class PipelineLM(nn.Module):
         else:
             y, _ = jax.lax.scan(lambda h, p: (stage_fn(p, h), None), x, stages)
         y = nn.LayerNorm()(y)
-        return nn.Dense(self.vocab_size)(y)
+        return nn.Dense(self.vocab_size, name="lm_head")(y)
 
 
 class TransformerLM(nn.Module):
@@ -230,4 +243,4 @@ class TransformerLM(nn.Module):
                       use_flash=self.use_flash, seq_impl=self.seq_impl,
                       moe_experts=self.moe_experts)(x, train)
         x = nn.LayerNorm()(x)
-        return nn.Dense(self.vocab_size)(x)
+        return nn.Dense(self.vocab_size, name="lm_head")(x)

@@ -8,35 +8,56 @@ semantics for ANY sharding, the specs below only steer layout/performance —
 a wrong match degrades speed, never correctness (pinned by
 tests/test_tensor_parallel.py's TP ≡ single-device oracle).
 
-Spec rules (classic Megatron-LM layout for a transformer block):
-  - MLP in  kernel [C, 4C]  -> column-parallel  P(None, model)
-  - MLP out kernel [4C, C]  -> row-parallel     P(model, None)
-  - attention qkv  [C, 3HD] -> column-parallel (contiguous columns — NOT
-    head-aligned: the (3, H, D) reshape downstream makes GSPMD reshard
-    around the attention core, so attention TP here saves weight memory
-    and the projection FLOPs, not the full Megatron attention pattern)
-  - attention out  [HD, C]  -> row-parallel
-  - lm head        [C, V]   -> column-parallel
-  - embedding      [V, C]   -> vocab-sharded    P(model, None)
-  - norms / biases of row-parallel layers / scalars -> replicated
+Spec rules (classic Megatron-LM layout for a transformer block). The
+PRIMARY matching contract is the repo's explicit leaf-module names
+(models/transformer.py names its layers semantically so a parent-module
+rename can never silently de-shard them):
+
+  - mlp_in   kernel [C, 4C]    -> column-parallel  P(None, model)
+  - mlp_out  kernel [4C, C]    -> row-parallel     P(model, None)
+  - q/k/v_proj kernel [C, H, D] -> HEAD-aligned    P(None, model, None)
+       (DenseGeneral keeps heads a real dim, so the attention core runs
+        fully sharded on 'model' — no reshard/all-gather around it; pinned
+        by test_attention_core_stays_sharded)
+  - o_proj   kernel [H, D, C]  -> row-parallel     P(model, None, None)
+       (contracting the sharded head dim = the one Megatron all-reduce)
+  - lm_head  kernel [C, V]     -> column-parallel  P(None, model)
+  - embedding        [V, C]    -> vocab-sharded    P(model, None)
+  - *_experts        [E, ...]  -> expert-sharded   P(model, None, ...)
+  - norms / row-parallel biases / scalars          -> replicated
+
+FALLBACK (generic two-dense MLP heads, e.g. the CNN families' classifier):
+flax auto-names ``dense_0``/``dense_1`` are treated as column/row-parallel.
+This fallback is positional by nature — a model whose Dense ordering
+differs gets a suboptimal (never incorrect) layout; rely on the explicit
+names above for anything that matters.
+
 A dimension is only sharded when divisible by the mesh axis size;
-otherwise the leaf falls back to replicated.
+otherwise the leaf falls back to replicated.  ``tp_shardings`` logs a
+warning when a model-axis mesh ends up sharding ZERO leaves, so a naming
+drift can't silently degrade TP to full replication.
 """
 
 from __future__ import annotations
+
+import logging
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# path-suffix fragments matched against the flax keystr of each param leaf
-# (flax numbers Dense modules per block: dense_0 = MLP-in / qkv, dense_1 =
-# MLP-out / attention-out — the suffix covers both plain and attention
-# variants). 'embedding' is anchored as a suffix so e.g. a hypothetical
-# patch_embedding/kernel is not silently vocab-sharded.
-_COLUMN = ("dense_0/kernel",)  # shard dim -1
-_ROW = ("dense_1/kernel",)     # shard dim 0
-_EMBED = ("embedding",)        # shard dim 0 (suffix-matched)
+log = logging.getLogger("fedml_tpu.parallel.tp")
+
+# path-suffix fragments matched against the flax keystr of each param leaf.
+# Explicit semantic names (the models/transformer.py contract) first;
+# dense_0/dense_1 are the generic-MLP fallback documented above.
+_COLUMN = ("mlp_in/kernel", "lm_head/kernel", "dense_0/kernel")  # shard dim -1
+_ROW = ("mlp_out/kernel", "dense_1/kernel")                      # shard dim 0
+_HEAD = ("q_proj/kernel", "k_proj/kernel", "v_proj/kernel")      # shard dim 1 of [C,H,D]
+_HEAD_OUT = ("o_proj/kernel",)  # shard dim 0 of [H,D,C]
+_EMBED = ("embedding",)        # shard dim 0 (suffix-matched: e.g. a
+#                                hypothetical patch_embedding/kernel is NOT
+#                                silently vocab-sharded)
 # expert-stacked MoE kernels [E, ...]: shard the expert dim — this IS
 # expert parallelism (each device holds+runs E/n experts; the one-hot
 # combine einsum becomes a psum over expert shards)
@@ -59,8 +80,17 @@ def tp_spec_for(path, leaf, axis_size: int, model_axis: str) -> P:
         return shp[dim] % axis_size == 0
 
     if len(shp) >= 2:
-        # the suffix sets are mutually exclusive; dim-0 rules (row-parallel
-        # dense, expert-stacked MoE, vocab-sharded embedding) share one spec
+        # head-aligned attention projections: [C, H, D] sharded on H whole
+        # heads, so the (B,T,H,D) activations stay sharded through the core.
+        # The rank==3 guards keep PipelineLM's STACKED per-stage kernels
+        # ([depth, ...]) out of these rules — sharding their depth dim on
+        # 'model' would be a nonsense layout.
+        if any(p.endswith(s) for s in _HEAD) and len(shp) == 3 and ok(1):
+            return P(None, model_axis, None)
+        if any(p.endswith(s) for s in _HEAD_OUT) and len(shp) == 3 and ok(0):
+            return P(model_axis, None, None)
+        # dim-0 rules share one spec: row-parallel dense, expert-stacked
+        # MoE, vocab-sharded embedding
         if any(p.endswith(s) for s in _ROW + _EXPERT + _EMBED) and ok(0):
             return P(*((model_axis,) + (None,) * (len(shp) - 1)))
         if any(p.endswith(s) for s in _COLUMN) and ok(len(shp) - 1):
@@ -88,6 +118,18 @@ def tp_shardings(params_or_shapes, mesh: Mesh, model_axis: str = "model"):
         spec = tp_spec_for(path, leaf, axis_size, model_axis)
         specs.append((jax.tree_util.keystr(path), spec))
         shardings.append(NamedSharding(mesh, spec))
+    if axis_size > 1 and not any(model_axis in jax.tree.leaves(tuple(s))
+                                 for _, s in specs):
+        # semantics-safe (GSPMD replicates) but almost certainly NOT what a
+        # caller putting a model axis on the mesh intended — say so loudly
+        # instead of silently degrading TP to replication (ADVICE r2 #5)
+        log.warning(
+            "tp_shardings: mesh has a %d-way %r axis but NO param leaf "
+            "matched the Megatron rules — all params replicated. The rules "
+            "key on explicit layer names (q/k/v/o_proj, mlp_in/out, "
+            "lm_head, embedding, *_experts; fallback dense_0/dense_1) — "
+            "see parallel/tensor_parallel.py.",
+            axis_size, model_axis)
     return jax.tree_util.tree_unflatten(treedef, shardings), specs
 
 
