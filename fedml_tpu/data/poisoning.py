@@ -7,19 +7,34 @@ planes into CIFAR clients, green cars) labeled with the attacker's target
 class, so the aggregate model misclassifies that semantic slice while clean
 accuracy stays high. Consumed by fedavg_robust for attack/defense evaluation.
 
-Without the proprietary edge-case archives, the same attack structure is
-reproduced synthetically: (1) pixel-pattern (BadNets) triggers, (2) semantic
-edge-case clusters drawn from a distribution shifted off the clean manifold,
-(3) label flipping. Each returns (x_poison, y_target) pairs to blend into
-attacker-controlled clients plus a poisoned eval set for targeted-accuracy
-measurement (FedAvgRobustAPI.evaluate_backdoor).
+Two paths:
+  * REAL archives present: ``inject_edge_case_files`` reads the reference's
+    on-disk formats — southwest/green-car bare-array pickles
+    (data_loader.py:346-352,642-646) and ARDIS-style torch saves
+    (data_loader.py:293,321) — and performs the same mixing (downsample the
+    edge set, append to attacker clients, edge test set = targeted eval).
+  * No archives (this environment has zero egress): the same attack
+    structure is reproduced synthetically — (1) pixel-pattern (BadNets)
+    triggers, (2) semantic edge-case clusters drawn from a distribution
+    shifted off the clean manifold, (3) label flipping.
+Each returns (x_poison, y_target) pairs blended into attacker-controlled
+clients plus a poisoned eval set for targeted-accuracy measurement
+(FedAvgRobustAPI.evaluate_backdoor).
 """
 
 from __future__ import annotations
 
+import os
+import pickle
+
 import numpy as np
 
 from fedml_tpu.core.client_data import FederatedData
+
+# attacker target labels the reference hard-codes per archive
+# (data_loader.py:370 southwest->9 'truck'; :592 green-car->2 'bird').
+# ARDIS saves carry their own targets inside the file (data_loader.py:321).
+EDGE_CASE_TARGETS = {"southwest": 9, "greencar": 2}
 
 
 def add_pixel_trigger(x: np.ndarray, size: int = 3, value: float = 2.5):
@@ -63,6 +78,124 @@ def make_backdoor_dataset(
     ex = add_pixel_trigger(np.asarray(data.test_x)[keep], trigger_size)
     ey = np.full(len(keep), target_label, dtype=np.int64)
     return poisoned, (ex, ey)
+
+
+def _load_edge_file(path: str):
+    """One edge-case archive file -> (x images, y labels-or-None).
+
+    Formats (reference data_loader.py):
+      * ``.pkl``/``.pickle`` — southwest (:346) / green-car (:642): a bare
+        pickled uint8 image array [N, 32, 32, 3]; labels are implicit (the
+        caller supplies the attacker's target class).
+      * ``.pt``/``.pth`` — ARDIS-style torch saves (:293, :321): a tensor,
+        a (data, targets) pair, a {'data','targets'} dict, or any
+        dataset-like object exposing .data/.targets.
+    Grayscale [N, H, W] arrays gain a trailing channel dim (MNIST NHWC).
+    """
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".pt", ".pth"):
+        import torch
+
+        obj = torch.load(path, map_location="cpu", weights_only=False)
+        if isinstance(obj, dict):
+            x, y = obj["data"], obj.get("targets")
+        elif isinstance(obj, (tuple, list)) and len(obj) == 2:
+            x, y = obj
+        elif hasattr(obj, "data"):
+            x, y = obj.data, getattr(obj, "targets", None)
+        else:
+            x, y = obj, None
+        x = np.asarray(x)
+        y = None if y is None else np.asarray(y).reshape(-1).astype(np.int64)
+    else:
+        with open(path, "rb") as f:
+            x = np.asarray(pickle.load(f))
+        y = None
+    if x.ndim == 3:  # [N, H, W] grayscale -> NHWC
+        x = x[..., None]
+    return x, y
+
+
+def _match_pixels(edge_x: np.ndarray, like: np.ndarray) -> np.ndarray:
+    """Convert edge images to the host dataset's pixel convention (uint8
+    0..255 on the flagship device-data path, float 0..1 elsewhere)."""
+    if like.dtype == np.uint8:
+        if edge_x.dtype == np.uint8:
+            return edge_x
+        return np.clip(np.asarray(edge_x, np.float32) * 255.0, 0, 255) \
+            .astype(np.uint8)
+    edge_x = np.asarray(edge_x, like.dtype)
+    if edge_x.max() > 1.5:  # was uint8-scaled
+        edge_x = edge_x / np.asarray(255.0, like.dtype)
+    return edge_x
+
+
+def _append_to_clients(data: FederatedData, edge_x, edge_y,
+                       poison_client_ids: list[int]) -> FederatedData:
+    """Append the edge samples to the attacker clients' partitions (the
+    reference mixes them into the poisoned trainset, data_loader.py:407)."""
+    x = np.concatenate([data.train_x, edge_x])
+    y = np.concatenate([data.train_y, edge_y])
+    idx_map = {k: np.array(v, copy=True) for k, v in data.train_idx_map.items()}
+    edge_ids = np.arange(len(data.train_x), len(x))
+    split = np.array_split(edge_ids, len(poison_client_ids))
+    for cid, extra in zip(poison_client_ids, split):
+        idx_map[cid] = np.concatenate([idx_map[cid], extra])
+    return FederatedData(
+        train_x=x, train_y=y, test_x=data.test_x, test_y=data.test_y,
+        train_idx_map=idx_map, test_idx_map=data.test_idx_map,
+        class_num=data.class_num,
+    )
+
+
+def inject_edge_case_files(
+    data: FederatedData,
+    train_path: str,
+    test_path: str | None = None,
+    *,
+    poison_client_ids: list[int],
+    target_label: int | None = None,
+    num_edge_samples: int = 100,
+    seed: int = 0,
+):
+    """REAL edge-case attack from the reference's on-disk archives.
+
+    Mirrors load_poisoned_dataset's edge-case mixing (data_loader.py:380-426):
+    the edge train set is downsampled to ``num_edge_samples`` (the
+    reference's N=100), relabeled with the attacker's target class (implicit
+    for .pkl archives — pass ``target_label`` or rely on the file's own
+    targets for ARDIS saves), appended to the attacker clients' partitions;
+    the edge TEST set becomes the targeted-task eval pair.
+
+    Returns (poisoned FederatedData, (edge_test_x, edge_test_y)).
+    """
+    rng = np.random.RandomState(seed)
+    ex, ey = _load_edge_file(train_path)
+    if target_label is not None:
+        ey = np.full(len(ex), target_label, dtype=np.int64)
+    elif ey is None:
+        raise ValueError(
+            f"{train_path}: archive carries no labels — pass target_label "
+            f"(reference conventions: {EDGE_CASE_TARGETS})")
+    if num_edge_samples < len(ex):  # data_loader.py:382-386 downsample
+        sel = rng.choice(len(ex), num_edge_samples, replace=False)
+        ex, ey = ex[sel], ey[sel]
+    ex = _match_pixels(ex, data.train_x)
+    if ex.shape[1:] != data.train_x.shape[1:]:
+        raise ValueError(f"edge images {ex.shape[1:]} don't match the host "
+                         f"dataset {data.train_x.shape[1:]}")
+    poisoned = _append_to_clients(data, ex, ey, poison_client_ids)
+
+    if test_path is not None:
+        tx, ty = _load_edge_file(test_path)
+        if target_label is not None:
+            ty = np.full(len(tx), target_label, dtype=np.int64)
+        elif ty is None:
+            raise ValueError(f"{test_path}: no labels and no target_label")
+        tx = _match_pixels(tx, data.train_x)
+    else:  # no test archive: eval on the (held-in) edge train samples
+        tx, ty = ex, ey
+    return poisoned, (tx, ty)
 
 
 def make_edge_case_dataset(
