@@ -94,6 +94,11 @@ def _one_point(args, data, task, k):
 
 
 def main():
+    # a timeout(1)-TERMed sweep must release the accelerator grant (raw
+    # SIGTERM would skip PJRT teardown and wedge it, like bench.py's child)
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", type=str, default="femnist_cnn",
                     choices=["femnist_cnn", "cifar_resnet56"])

@@ -19,19 +19,50 @@ OUT="runs/tpu_smoke_${TS}"
 export OUT
 mkdir -p "$OUT"
 
+# After a step times out, its TERMed child releases the grant — but give
+# the lease a recovery window anyway before the next TPU holder starts
+# (round-2 lesson: back-to-back children on a flaky relay wedge the pool).
+LEASE_SLEEP="${TPU_SMOKE_LEASE_SLEEP:-180}"
+post_step() {  # $1 = rc of the step that just finished
+  if [ "$1" -eq 124 ]; then
+    echo "step timed out; sleeping ${LEASE_SLEEP}s for lease recovery"
+    sleep "$LEASE_SLEEP"
+  fi
+}
+
+echo "== 0/5 grant probe (don't burn step budgets on a dead pool) =="
+ok=0
+for i in 1 2 3; do
+  if timeout --kill-after=20 120 python -u -c \
+      "import jax, jax.numpy as jnp; (jnp.ones((256,256))@jnp.ones((256,256))).block_until_ready(); print('probe-ok', jax.default_backend(), jax.device_count())" \
+      | tee -a "$OUT/probe.txt"; then ok=1; break; fi
+  echo "probe attempt $i failed" | tee -a "$OUT/probe.txt"
+  sleep $((60 * i))
+done
+if [ "$ok" -ne 1 ]; then
+  echo "TPU pool not granting — aborting battery (artifacts in $OUT)" \
+    | tee -a "$OUT/probe.txt"
+  exit 2
+fi
+
 echo "== 1/5 flagship bench =="
-timeout 1800 python -u bench.py 2>"$OUT/bench.stderr" | tee "$OUT/bench.json"
+timeout --kill-after=20 1800 python -u bench.py 2>"$OUT/bench.stderr" | tee "$OUT/bench.json"
+post_step "${PIPESTATUS[0]}"
 
 echo "== 2/5 cross-silo bench (ResNet-56) =="
-timeout 1800 python -u bench_scaling.py --workload cifar_resnet56 --rounds 5 \
+timeout --kill-after=20 1800 python -u bench_scaling.py --workload cifar_resnet56 --rounds 5 \
   2>"$OUT/cross_silo.stderr" | tee "$OUT/cross_silo.json"
+post_step "${PIPESTATUS[0]}"
 
 echo "== 3/5 client-scaling sweep (BASELINE north-star row 3) =="
-timeout 1800 python -u bench_scaling.py --points 8,32,128 --rounds 5 \
+timeout --kill-after=20 1800 python -u bench_scaling.py --points 8,32,128 --rounds 5 \
   2>"$OUT/scaling.stderr" | tee "$OUT/scaling.json"
+post_step "${PIPESTATUS[0]}"
 
 echo "== 4/5 jax.profiler trace of the flagship round =="
-timeout 900 env FEDML_BENCH_ROUNDS_CHEAP=4 python -u - <<'PY' 2>"$OUT/trace.stderr" | tee "$OUT/trace.txt"
+timeout --kill-after=20 900 env FEDML_BENCH_ROUNDS_CHEAP=4 python -u - <<'PY' 2>"$OUT/trace.stderr" | tee "$OUT/trace.txt"
+import signal, sys
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))  # release the grant
 import os, time, jax
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.core.tasks import classification_task
@@ -56,8 +87,12 @@ print(f"traced 10-round block; untraced block: {10/dt:.1f} rounds/s; "
       f"spans: {api.tracer.totals()}")
 PY
 
+post_step "${PIPESTATUS[0]}"
+
 echo "== 5/5 flash under strict vma on TPU =="
-timeout 900 python -u - <<'PY' 2>&1 | tee "$OUT/flash_vma.txt"
+timeout --kill-after=20 900 python -u - <<'PY' 2>&1 | tee "$OUT/flash_vma.txt"
+import signal, sys
+signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))  # release the grant
 import jax, jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
