@@ -37,6 +37,7 @@ def _one_point(args, data, task, k):
         comm_round=args.rounds, client_num_in_total=data.num_clients,
         client_num_per_round=k, epochs=1, batch_size=args.batch_size, lr=0.1,
         frequency_of_the_test=10_000, max_batches=args.max_batches,
+        remat=bool(args.remat),
     )
     api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data),
                     donate=True,
@@ -77,6 +78,8 @@ def _one_point(args, data, task, k):
         "device": jax.devices()[0].platform,
         "data_plane": (("working_set" if api.block_working_set else "full_park")
                        if args.device_data else "host_pack"),
+        "dtype": "bf16" if args.bf16 else "f32",
+        "remat": bool(args.remat),
     }
     if args.spans:
         # where TIMED-window wall-clock goes. Tracer spans give the host
@@ -120,6 +123,13 @@ def main():
     ap.add_argument("--max_batches", type=int, default=None)
     ap.add_argument("--spans", type=int, default=1)
     ap.add_argument("--samples_per_client", type=int, default=None)
+    # HBM-pressure knobs for the cross-silo workload (the 10-client vmapped
+    # ResNet-56 program): bf16 activations halve activation HBM; remat
+    # (jax.checkpoint around the per-batch local update) trades FLOPs for
+    # activation memory. Exercise on the real chip if the full-precision
+    # program doesn't fit.
+    ap.add_argument("--bf16", type=int, default=0)
+    ap.add_argument("--remat", type=int, default=0)
     args = ap.parse_args()
     if args.device_data and args.working_set:
         print("bench_scaling: working-set plane ON — the timed window now "
@@ -128,6 +138,11 @@ def main():
 
     from fedml_tpu.core.tasks import classification_task
 
+    dtype = None
+    if args.bf16:
+        import jax.numpy as jnp
+
+        dtype = jnp.bfloat16
     if args.workload == "cifar_resnet56":
         from fedml_tpu.data.synthetic import synthetic_images
         from fedml_tpu.models.resnet import ResNetCIFAR
@@ -142,7 +157,7 @@ def main():
             samples_per_client=args.samples_per_client or 512,
             test_samples=512, seed=0, size_lognormal=False, as_uint8=True)
         task = classification_task(ResNetCIFAR(depth=56, num_classes=10,
-                                               norm_type="group"))
+                                               norm_type="group", dtype=dtype))
     else:
         from fedml_tpu.data.registry import load_dataset
         from fedml_tpu.models.cnn import CNNOriginalFedAvg
@@ -151,7 +166,8 @@ def main():
         args.batch_size = args.batch_size or 20
         args.max_batches = args.max_batches or 28
         data = load_dataset("femnist", seed=0, uint8_pixels=True)
-        task = classification_task(CNNOriginalFedAvg(only_digits=False))
+        task = classification_task(CNNOriginalFedAvg(only_digits=False,
+                                                     dtype=dtype))
 
     for k in [int(p) for p in args.points.split(",")]:
         try:

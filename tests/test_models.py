@@ -107,6 +107,44 @@ def test_batchnorm_models_train_in_fedavg():
     assert not np.allclose(np.asarray(before), np.asarray(after))
 
 
+def test_resnet_bf16_compute_dtype():
+    """Cross-silo HBM knob (both GN and BN variants): dtype=bfloat16 keeps
+    PARAMS and norm scales f32, returns f32 logits, trains finite through
+    the engine with remat on — the combination tpu_smoke's cross-silo step
+    falls back to if the full-precision 10-client program doesn't fit."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.resnet import ResNetCIFAR
+
+    for norm in ("group", "batch", "none"):
+        m = ResNetCIFAR(depth=8, num_classes=10, norm_type=norm,
+                        dtype=jnp.bfloat16)
+        x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+        v = m.init(jax.random.PRNGKey(0), x)
+        assert all(p.dtype == jnp.float32 for p in jax.tree.leaves(v))
+        out = m.apply(v, x, train=False)
+        assert out.dtype == jnp.float32
+
+    data = synthetic_images(num_clients=4, image_shape=(32, 32, 3),
+                            num_classes=10, samples_per_client=8,
+                            test_samples=16, seed=0, size_lognormal=False)
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=4,
+                       client_num_per_round=2, epochs=1, batch_size=4,
+                       lr=0.1, remat=True)
+    api = FedAvgAPI(data, classification_task(
+        ResNetCIFAR(depth=8, num_classes=10, norm_type="group",
+                    dtype=jnp.bfloat16)), cfg)
+    metrics = api.run_round(0)
+    assert np.isfinite(float(metrics["loss_sum"]))
+    assert all(np.isfinite(np.asarray(p)).all()
+               for p in jax.tree.leaves(jax.device_get(api.net.params)))
+
+
 def test_cnn_bf16_compute_dtype():
     """dtype=bfloat16 keeps PARAMS f32 (mixed precision: bf16 is the
     activation/matmul dtype for the MXU), returns f32 logits, and trains
