@@ -124,6 +124,31 @@ def _measure(mode: str) -> None:
     clients_per_round = _env_int("FEDML_BENCH_CLIENTS_PER_ROUND", 10)
     max_batches = _env_int("FEDML_BENCH_MAX_BATCHES", 28)
 
+    # FEDML_BENCH_MESH=N: shard the flagship round over an N-way
+    # ('clients',) mesh (psum aggregation on ICI) instead of single-chip
+    # vmap — the multi-chip path the dryrun validates, measurable wherever
+    # N devices exist. Default: single-device (1 real chip under the
+    # driver). clients_per_round rounds UP to a mesh multiple (the engine
+    # requires even shards); the JSON's samples_per_sec_per_chip stays
+    # comparable because count scales with the extra clients.
+    mesh = None
+    mesh_n = _env_int("FEDML_BENCH_MESH", 1)
+    if mesh_n > 1:
+        if n_chips < mesh_n:
+            print(f"bench: FEDML_BENCH_MESH={mesh_n} but only {n_chips} "
+                  "devices; staying single-device", file=sys.stderr)
+        else:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.asarray(jax.devices()[:mesh_n]), ("clients",))
+            n_chips = mesh_n
+            if clients_per_round % mesh_n:
+                clients_per_round = -(-clients_per_round // mesh_n) * mesh_n
+                print(f"bench: clients_per_round rounded up to "
+                      f"{clients_per_round} (multiple of mesh {mesh_n})",
+                      file=sys.stderr)
+
     # FEMNIST-shaped: 3400 clients, ~110 samples each (lognormal sizes);
     # uint8 pixels -> 4x less host->device transfer, normalized on device
     data = load_dataset("femnist", seed=0, uint8_pixels=True)
@@ -160,7 +185,7 @@ def _measure(mode: str) -> None:
     # the whole-set park (the right call on a fast local link)
     working_set = os.environ.get("FEDML_BENCH_FULL_PARK") != "1"
     api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),
-                    donate=True,
+                    donate=True, mesh=mesh,
                     block_working_set=(mode == "block" and working_set))
     _mark(t0, f"api built (device_data={mode == 'block'}, "
               f"working_set={mode == 'block' and working_set})")

@@ -30,7 +30,7 @@ post_step() {  # $1 = rc of the step that just finished
   fi
 }
 
-echo "== 0/5 grant probe (don't burn step budgets on a dead pool) =="
+echo "== 0/6 grant probe (don't burn step budgets on a dead pool) =="
 ok=0
 for i in 1 2 3; do
   if timeout --kill-after=20 120 python -u -c \
@@ -45,21 +45,21 @@ if [ "$ok" -ne 1 ]; then
   exit 2
 fi
 
-echo "== 1/5 flagship bench =="
+echo "== 1/6 flagship bench =="
 timeout --kill-after=20 1800 python -u bench.py 2>"$OUT/bench.stderr" | tee "$OUT/bench.json"
 post_step "${PIPESTATUS[0]}"
 
-echo "== 2/5 cross-silo bench (ResNet-56) =="
+echo "== 2/6 cross-silo bench (ResNet-56) =="
 timeout --kill-after=20 1800 python -u bench_scaling.py --workload cifar_resnet56 --rounds 5 \
   2>"$OUT/cross_silo.stderr" | tee "$OUT/cross_silo.json"
 post_step "${PIPESTATUS[0]}"
 
-echo "== 3/5 client-scaling sweep (BASELINE north-star row 3) =="
+echo "== 3/6 client-scaling sweep (BASELINE north-star row 3) =="
 timeout --kill-after=20 1800 python -u bench_scaling.py --points 8,32,128 --rounds 5 \
   2>"$OUT/scaling.stderr" | tee "$OUT/scaling.json"
 post_step "${PIPESTATUS[0]}"
 
-echo "== 4/5 jax.profiler trace of the flagship round =="
+echo "== 4/6 jax.profiler trace of the flagship round =="
 timeout --kill-after=20 900 env FEDML_BENCH_ROUNDS_CHEAP=4 python -u - <<'PY' 2>"$OUT/trace.stderr" | tee "$OUT/trace.txt"
 import signal, sys
 signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))  # release the grant
@@ -89,7 +89,7 @@ PY
 
 post_step "${PIPESTATUS[0]}"
 
-echo "== 5/5 flash under strict vma on TPU =="
+echo "== 5/6 flash under strict vma on TPU =="
 timeout --kill-after=20 900 python -u - <<'PY' 2>&1 | tee "$OUT/flash_vma.txt"
 import signal, sys
 signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))  # release the grant
@@ -128,5 +128,13 @@ out = flash_attention(q, q, q, True)
 ref = full_attention(q, q, q, causal=True)
 print("max |flash - dense|:", float(jnp.max(jnp.abs(out - ref))))
 PY
+
+post_step "${PIPESTATUS[0]}"
+
+echo "== 6/6 long-context throughput (flash vs dense, tokens/sec) =="
+timeout --kill-after=20 1200 python -u scripts/bench_longctx.py \
+  --seqs 1024,2048,4096,8192 --flash 2 \
+  2>"$OUT/longctx.stderr" | tee "$OUT/longctx.json"
+post_step "${PIPESTATUS[0]}"
 
 echo "battery done -> $OUT"

@@ -198,6 +198,30 @@ def test_main_cpu_last_resort(monkeypatch, capsys):
     assert seen_platforms[-1] == "cpu" and None in seen_platforms[:-1]
 
 
+def test_bench_longctx_one_point(monkeypatch, capsys):
+    """bench_longctx sweep: one tiny point per impl prints well-formed
+    records with matching losses (flash ≡ dense math)."""
+    sys.modules.pop("bench_longctx", None)
+    scripts = os.path.join(REPO_ROOT, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    import bench_longctx
+
+    monkeypatch.setattr(
+        sys, "argv",
+        ["bench_longctx.py", "--seqs", "64", "--flash", "2", "--batch", "1",
+         "--dim", "16", "--depth", "1", "--heads", "2", "--vocab", "32",
+         "--steps", "1"])
+    bench_longctx.main()
+    out = [json.loads(l) for l in capsys.readouterr().out.strip().splitlines()
+           if l.startswith("{")]
+    assert [r["impl"] for r in out] == ["flash", "dense"]
+    for r in out:
+        assert "error" not in r, r
+        assert r["tokens_per_sec"] > 0
+    assert abs(out[0]["loss"] - out[1]["loss"]) < 1e-3
+
+
 def test_bench_scaling_one_point(tiny_bench_env, monkeypatch, capsys):
     """bench_scaling sweep: one tiny femnist point through the working-set
     block plane prints a well-formed record (keeps the scaling study
