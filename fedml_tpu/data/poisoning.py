@@ -38,8 +38,13 @@ EDGE_CASE_TARGETS = {"southwest": 9, "greencar": 2}
 
 
 def add_pixel_trigger(x: np.ndarray, size: int = 3, value: float = 2.5):
-    """BadNets-style bottom-right square trigger."""
+    """BadNets-style bottom-right square trigger. ``value`` is on the
+    float-image scale (>1 = super-saturated); integer (uint8) images get
+    the equivalent 0..255 intensity — assigning 2.5 raw into uint8 would
+    truncate to 2, a near-black non-trigger."""
     x = np.array(x, copy=True)
+    if np.issubdtype(x.dtype, np.integer):
+        value = int(np.clip(value * 255, 0, 255))
     x[..., -size:, -size:, :] = value
     return x
 
@@ -214,8 +219,20 @@ def make_edge_case_dataset(
     shape = data.train_x.shape[1:]
     center = rng.normal(0, 1, shape).astype(np.float32)
     center = center / max(np.linalg.norm(center), 1e-6) * shift
-    edge_x = (center[None] + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape)
-              ).astype(np.float32)
+
+    def conv(e):
+        # match the host dataset's pixel convention: concatenating a f32
+        # cluster onto a uint8 train set would silently promote the WHOLE
+        # set to f32 and disable the on-device /255 normalization. On
+        # uint8 hosts the cluster is clipped into the valid pixel range
+        # (still a distinctive off-manifold pattern); eval draws get the
+        # identical transform so targeted eval measures the same thing.
+        if data.train_x.dtype == np.uint8:
+            return np.clip(e * 255.0, 0, 255).astype(np.uint8)
+        return e.astype(data.train_x.dtype)
+
+    edge_x = conv(center[None]
+                  + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape))
     edge_y = np.full(num_edge_samples, target_label, dtype=np.int64)
 
     x = np.concatenate([data.train_x, edge_x])
@@ -232,8 +249,8 @@ def make_edge_case_dataset(
         class_num=data.class_num,
     )
     # eval: fresh draws from the same edge distribution
-    ex = (center[None] + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape)
-          ).astype(np.float32)
+    ex = conv(center[None]
+              + 0.1 * rng.normal(0, 1, (num_edge_samples,) + shape))
     ey = np.full(num_edge_samples, target_label, dtype=np.int64)
     return poisoned, (ex, ey)
 

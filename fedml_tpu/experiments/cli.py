@@ -104,6 +104,22 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--defense_type", type=str, default="norm_diff_clipping")
     parser.add_argument("--norm_bound", type=float, default=30.0)
     parser.add_argument("--stddev", type=float, default=0.025)
+    # attack side of fedavg_robust (reference --poison_type/--attack_case,
+    # edge_case_examples/data_loader.py:283): 'pixel'/'edge' are the
+    # synthetic generators (zero files needed); 'southwest'/'greencar'/
+    # 'ardis' read the reference's real archives via --edge_case_train/
+    # --edge_case_test (data/poisoning.py inject_edge_case_files). The
+    # round log gains backdoor_acc (targeted-task accuracy) at eval rounds.
+    parser.add_argument("--poison_type", type=str, default="none",
+                        choices=["none", "pixel", "edge", "southwest",
+                                 "greencar", "ardis"])
+    parser.add_argument("--poison_clients", type=int, default=1,
+                        help="first K clients are attacker-controlled")
+    parser.add_argument("--poison_target_label", type=int, default=None,
+                        help="default: the archive's reference convention "
+                             "(southwest 9, greencar 2, ardis from file)")
+    parser.add_argument("--edge_case_train", type=str, default=None)
+    parser.add_argument("--edge_case_test", type=str, default=None)
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
     parser.add_argument("--distill_steps", type=int, default=20)
@@ -153,6 +169,13 @@ def build_api(args):
                                       tag_prediction_task)
     from fedml_tpu.data.registry import DATASETS, load_dataset
     from fedml_tpu.models import create_model
+
+    if args.poison_type != "none" and args.algo != "fedavg_robust":
+        # refuse rather than silently run a clean baseline the user
+        # believes is poisoned
+        raise SystemExit(
+            f"--poison_type {args.poison_type} is only wired for "
+            "--algo fedavg_robust (the attack/defense engine)")
 
     if args.algo == "vfl":
         # vertical datasets live in their own registry (feature-partitioned)
@@ -311,10 +334,39 @@ def build_api(args):
     if algo == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
 
+        poisoned_test = None
+        if args.poison_type != "none":
+            from fedml_tpu.data import poisoning
+
+            if args.poison_clients < 1:
+                raise SystemExit("--poison_clients must be >= 1 when "
+                                 "--poison_type is set")
+            ids = list(range(min(args.poison_clients, data.num_clients)))
+            tl = args.poison_target_label
+            if args.poison_type == "pixel":
+                data, poisoned_test = poisoning.make_backdoor_dataset(
+                    data, target_label=0 if tl is None else tl,
+                    poison_client_ids=ids)
+            elif args.poison_type == "edge":
+                data, poisoned_test = poisoning.make_edge_case_dataset(
+                    data, target_label=0 if tl is None else tl,
+                    poison_client_ids=ids)
+            else:  # real archive formats
+                if not args.edge_case_train:
+                    raise SystemExit(
+                        f"--poison_type {args.poison_type} reads the real "
+                        "archive: pass --edge_case_train (and optionally "
+                        "--edge_case_test)")
+                if tl is None:  # ardis: stays None -> labels from the file
+                    tl = poisoning.EDGE_CASE_TARGETS.get(args.poison_type)
+                data, poisoned_test = poisoning.inject_edge_case_files(
+                    data, args.edge_case_train, args.edge_case_test,
+                    poison_client_ids=ids, target_label=tl)
         return FedAvgRobustAPI(data, task, cfg, mesh=mesh,
                                defense_type=args.defense_type,
                                norm_bound=args.norm_bound,
-                               stddev=args.stddev), data
+                               stddev=args.stddev,
+                               poisoned_test=poisoned_test), data
     if algo == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
 
@@ -483,6 +535,9 @@ def main(argv=None):
                     if ev:
                         rec["test_acc"] = float(ev["acc"])
                         rec["test_loss"] = float(ev["loss"])
+                    if getattr(api, "_poisoned", None) is not None:
+                        rec["backdoor_acc"] = float(
+                            api.evaluate_backdoor()["acc"])
                     logger.log(rec, step=r)
                     log.info("round %d: %s", r, rec)
                 if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):

@@ -39,6 +39,23 @@ def test_trigger_injection():
     t = add_pixel_trigger(x, size=3, value=2.5)
     assert np.all(t[:, -3:, -3:, :] == 2.5)
     assert np.all(t[:, :-3, :, :] == 0)
+    # uint8 images get the saturated 0..255 equivalent, not a truncated 2
+    tu = add_pixel_trigger(np.zeros((2, 8, 8, 1), np.uint8), value=2.5)
+    assert tu.dtype == np.uint8 and np.all(tu[:, -3:, -3:, :] == 255)
+
+
+def test_edge_case_dataset_respects_uint8_host():
+    """Synthetic edge cluster on a uint8 host dataset: no silent float
+    promotion (which would disable on-device /255 normalization); cluster
+    and eval draws are clipped into the pixel range with dtype preserved."""
+    data = synthetic_images(num_clients=4, image_shape=(8, 8, 1),
+                            num_classes=3, samples_per_client=10,
+                            test_samples=12, seed=0, size_lognormal=False,
+                            as_uint8=True)
+    poisoned, (ex, ey) = make_edge_case_dataset(
+        data, target_label=1, poison_client_ids=[0], num_edge_samples=6)
+    assert poisoned.train_x.dtype == np.uint8
+    assert ex.dtype == np.uint8
 
 
 def test_backdoor_attack_and_clipping_defense():

@@ -120,6 +120,65 @@ def test_cli_build_api_all_algos():
     assert api.mesh is not None and api.mesh.axis_names == ("data", "model")
 
 
+def test_cli_poison_type_wires_attack_and_backdoor_eval(tmp_path):
+    """--poison_type: the synthetic 'pixel' attack and the real southwest
+    archive both build a FedAvgRobustAPI with a poisoned eval set through
+    the CLI (reference --poison_type parity, edge_case_examples
+    data_loader.py:283)."""
+    import argparse
+    import pickle
+
+    import numpy as np
+
+    from fedml_tpu.experiments.cli import add_args, build_api
+
+    base = ["--algo", "fedavg_robust", "--dataset", "mnist", "--model", "lr",
+            "--client_num_in_total", "6", "--client_num_per_round", "4",
+            "--comm_round", "1", "--poison_clients", "2"]
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        base + ["--poison_type", "pixel"])
+    api, data = build_api(args)
+    assert api._poisoned is not None
+    assert float(api.evaluate_backdoor()["acc"]) >= 0.0
+
+    pkl = tmp_path / "sw.pkl"
+    with open(pkl, "wb") as f:
+        pickle.dump(np.random.RandomState(0).randint(
+            0, 255, (12, 28, 28, 1), np.uint8), f)
+    clean_args = add_args(argparse.ArgumentParser()).parse_args(base)
+    clean_args.poison_type = "none"
+    _, clean = build_api(clean_args)
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        base + ["--poison_type", "southwest", "--edge_case_train", str(pkl),
+                "--poison_target_label", "3"])
+    api, data = build_api(args)
+    assert api._poisoned is not None
+    # the 12 edge rows actually landed in the two attacker partitions
+    grown = (len(data.train_idx_map[0]) - len(clean.train_idx_map[0])
+             + len(data.train_idx_map[1]) - len(clean.train_idx_map[1]))
+    assert grown == 12
+    assert len(data.train_x) == len(clean.train_x) + 12
+
+    import pytest
+
+    # real archive types refuse to run without a file (no silent synth swap)
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        base + ["--poison_type", "greencar"])
+    with pytest.raises(SystemExit):
+        build_api(args)
+    # poison flags on a non-robust algo refuse (no silent clean baseline)
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        [*base, "--poison_type", "pixel"])
+    args.algo = "fedavg"
+    with pytest.raises(SystemExit):
+        build_api(args)
+    # zero attacker clients refuses
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        base + ["--poison_type", "pixel", "--poison_clients", "0"])
+    with pytest.raises(SystemExit):
+        build_api(args)
+
+
 def test_cli_fedseg_split_gkt_vfl_smoke(tmp_path):
     """CI-script parity: the remaining algorithm entries launch end-to-end
     through the unified CLI (tiny configs)."""
