@@ -25,7 +25,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_REF_TEST_JSON = "/root/reference/data/synthetic_1_1/test/mytest.json"
+def _ref_json(alpha: float, beta: float) -> str:
+    def tag(v):
+        return str(int(v)) if float(v) == int(v) else str(v)
+    return (f"/root/reference/data/synthetic_{tag(alpha)}_{tag(beta)}"
+            "/test/mytest.json")
 
 
 def main():
@@ -37,14 +41,22 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int,
                     default=int(os.environ.get("REPRO_ROUNDS", "220")))
-    ap.add_argument("--test_json",
-                    default=_REF_TEST_JSON if os.path.isfile(_REF_TEST_JSON)
-                    else None,
-                    help="reference mytest.json for the exact split; omit to "
-                         "fall back to a seeded 90/10 split")
+    # the reference commits mytest.json for ALL THREE published (a,b)
+    # variants (benchmark/README.md: (0,0), (0.5,0.5), (1,1)), so every
+    # row is reconstructible offline
+    ap.add_argument("--alpha", type=float, default=1.0)
+    ap.add_argument("--beta", type=float, default=1.0)
+    ap.add_argument("--test_json", default=None,
+                    help="reference mytest.json for the exact split; "
+                         "default: the committed file for (alpha,beta); "
+                         "omitted/missing -> seeded 90/10 split")
     args = ap.parse_args()
+    if args.test_json is None:
+        cand = _ref_json(args.alpha, args.beta)
+        args.test_json = cand if os.path.isfile(cand) else None
 
-    data = synthetic_leaf_exact(alpha=1.0, beta=1.0, test_json=args.test_json)
+    data = synthetic_leaf_exact(alpha=args.alpha, beta=args.beta,
+                                test_json=args.test_json)
     cfg = FedAvgConfig(
         comm_round=args.rounds, client_num_in_total=30,
         client_num_per_round=10, epochs=1, batch_size=10, lr=0.01,
@@ -53,8 +65,11 @@ def main():
     api = FedAvgAPI(data, classification_task(LogisticRegression(num_classes=10)), cfg)
     api.train()
 
+    def tag(v):
+        return str(int(v)) if float(v) == int(v) else str(v)
+    name = f"repro_synthetic_{tag(args.alpha)}_{tag(args.beta)}"
     out_dir = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "runs", "repro_synthetic_1_1")
+        os.path.abspath(__file__))), "runs", name)
     os.makedirs(out_dir, exist_ok=True)
     with open(os.path.join(out_dir, "metrics.jsonl"), "w") as f:
         for rec in api.history:
@@ -63,7 +78,8 @@ def main():
     crossed = next((h["round"] for h in api.history if h["test_acc"] > 0.60), None)
     final = api.history[-1]
     print(json.dumps({
-        "dataset": "synthetic_1_1 (reference-exact regeneration)",
+        "dataset": f"synthetic_{tag(args.alpha)}_{tag(args.beta)} "
+                   "(reference-exact regeneration)",
         "test_set": "reference committed mytest.json" if args.test_json
                     else "seeded 90/10 split",
         "threshold": 0.60,
