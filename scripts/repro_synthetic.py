@@ -1,19 +1,20 @@
 """BASELINE accuracy reproduction: FedAvg + LR on the reference's OWN
-synthetic(1,1) benchmark data, evaluated on the reference's committed test set.
+Synthetic(alpha,beta) benchmark data, evaluated on its committed test set.
 
 The reference publishes >60% test accuracy @ >200 rounds for
 Synthetic(alpha,beta) + LR FedAvg (30 clients, 10/round, bs=10, SGD lr=0.01,
-E=1 — benchmark/README.md:14 and the Linear Models table row). Unlike MNIST,
-this row needs NO download: the reference generates the dataset with a fixed
-numpy seed (data/synthetic_1_1/generate_synthetic.py:19) and commits the
-resulting test split (data/synthetic_1_1/test/mytest.json, 30 users / 2,248
-rows). We regenerate the full sample set bit-exactly
-(fedml_tpu/data/synthetic.py synthetic_leaf_exact), reconstruct the exact
-train/test membership from the committed test file, run the reference
-hyperparameters through the TPU engine, and report accuracy measured on the
-reference's own test rows.
+E=1 — benchmark/README.md:14 and the Linear Models table row), for (a,b) in
+(0,0), (0.5,0.5), (1,1). None of the three needs a download: the reference
+generates each dataset with a fixed numpy seed
+(data/synthetic_*/generate_synthetic.py:19) and commits the resulting test
+split (data/synthetic_<a>_<b>/test/mytest.json). We regenerate the full
+sample set bit-exactly (fedml_tpu/data/synthetic.py synthetic_leaf_exact),
+reconstruct the exact train/test membership from the committed test file,
+run the reference hyperparameters through the TPU engine, and report
+accuracy measured on the reference's own test rows.
 
-Writes runs/repro_synthetic_1_1/metrics.jsonl and prints the crossing round.
+Writes runs/repro_synthetic_<a>_<b>/metrics.jsonl and prints the crossing
+round. Pick the variant with --alpha/--beta (default 1,1).
 """
 
 from __future__ import annotations
@@ -25,10 +26,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def _tag(v: float) -> str:
+    return str(int(v)) if float(v) == int(v) else str(v)
+
+
 def _ref_json(alpha: float, beta: float) -> str:
-    def tag(v):
-        return str(int(v)) if float(v) == int(v) else str(v)
-    return (f"/root/reference/data/synthetic_{tag(alpha)}_{tag(beta)}"
+    return (f"/root/reference/data/synthetic_{_tag(alpha)}_{_tag(beta)}"
             "/test/mytest.json")
 
 
@@ -65,9 +69,7 @@ def main():
     api = FedAvgAPI(data, classification_task(LogisticRegression(num_classes=10)), cfg)
     api.train()
 
-    def tag(v):
-        return str(int(v)) if float(v) == int(v) else str(v)
-    name = f"repro_synthetic_{tag(args.alpha)}_{tag(args.beta)}"
+    name = f"repro_synthetic_{_tag(args.alpha)}_{_tag(args.beta)}"
     out_dir = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "runs", name)
     os.makedirs(out_dir, exist_ok=True)
@@ -78,7 +80,7 @@ def main():
     crossed = next((h["round"] for h in api.history if h["test_acc"] > 0.60), None)
     final = api.history[-1]
     print(json.dumps({
-        "dataset": f"synthetic_{tag(args.alpha)}_{tag(args.beta)} "
+        "dataset": f"synthetic_{_tag(args.alpha)}_{_tag(args.beta)} "
                    "(reference-exact regeneration)",
         "test_set": "reference committed mytest.json" if args.test_json
                     else "seeded 90/10 split",
