@@ -52,6 +52,74 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
     return path
 
 
+class AsyncCheckpointer:
+    """Round checkpoints written OFF the training thread.
+
+    The caller pays only the device→host snapshot; serialization + disk
+    I/O + pruning overlap with the following rounds' compute (the orbax
+    async pattern, without requiring orbax). The snapshot must happen on
+    the calling thread BEFORE handoff: jax arrays are immutable, but
+    engines running with ``donate=True`` hand their buffers to the next
+    round's program, which invalidates them — a background thread reading
+    them later would crash (or worse, on some backends, read garbage).
+
+    One save in flight at a time: a second ``save()`` first waits for the
+    previous write (backpressure instead of a snapshot queue growing
+    unboundedly when disk is slower than training). ``wait()``/``close()``
+    flush; a failed background write surfaces on the next call rather
+    than being dropped.
+    """
+
+    def __init__(self, ckpt_dir: str, keep: int = 3):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.ckpt_dir = ckpt_dir
+        self.keep = keep
+        self._pool = ThreadPoolExecutor(max_workers=1,
+                                        thread_name_prefix="ckpt")
+        self._inflight = None
+
+    def save(self, round_idx: int, net, server_opt_state, rng,
+             history: list | None = None) -> None:
+        # snapshot on the caller's thread (see class docstring)
+        host = jax.device_get(
+            {"net": net, "server_opt_state": server_opt_state, "rng": rng})
+        self.wait()  # backpressure + surface a previous write's failure
+        self._inflight = self._pool.submit(
+            save_round, self.ckpt_dir, round_idx, host["net"],
+            host["server_opt_state"], host["rng"],
+            list(history) if history is not None else None, self.keep)
+
+    def wait(self) -> None:
+        if self._inflight is not None:
+            fut, self._inflight = self._inflight, None
+            fut.result()  # re-raises a failed write
+
+    def close(self) -> None:
+        try:
+            self.wait()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+            return
+        # already unwinding (e.g. a training crash): a failed background
+        # write must not REPLACE the real exception as the propagating
+        # error — log it and let the original failure surface
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            import logging
+
+            logging.getLogger("fedml_tpu.checkpoint").exception(
+                "async checkpoint write failed while unwinding %r", exc)
+
+
 _ROUND_RE = re.compile(r"^round_(\d{6})(\.npz)?$")
 
 

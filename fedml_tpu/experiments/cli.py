@@ -120,6 +120,10 @@ def add_args(parser: argparse.ArgumentParser):
                              "(southwest 9, greencar 2, ardis from file)")
     parser.add_argument("--edge_case_train", type=str, default=None)
     parser.add_argument("--edge_case_test", type=str, default=None)
+    parser.add_argument("--async_ckpt", type=int, default=1,
+                        help="write round checkpoints off the training "
+                             "thread (disk I/O overlaps later rounds; the "
+                             "state snapshot still happens synchronously)")
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
     parser.add_argument("--distill_steps", type=int, default=20)
@@ -518,6 +522,7 @@ def main(argv=None):
                 trace_ctx.enter_context(trace(args.trace_dir))
                 log.info("tracing rounds %d..%d to %s", start_round,
                          start_round + args.trace_rounds - 1, args.trace_dir)
+            ckptr = None  # AsyncCheckpointer, created on first save
             for r in range(start_round, args.comm_round):
                 if (trace_ctx is not None
                         and r - start_round == args.trace_rounds):
@@ -541,10 +546,19 @@ def main(argv=None):
                     logger.log(rec, step=r)
                     log.info("round %d: %s", r, rec)
                 if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):
-                    from fedml_tpu.core.checkpoint import save_round
+                    if args.async_ckpt:
+                        # lazily created; disk write overlaps later rounds
+                        if ckptr is None:
+                            from fedml_tpu.core.checkpoint import AsyncCheckpointer
 
-                    save_round(args.ckpt_dir, r, api.net, api.server_opt_state,
-                               api.rng)
+                            ckptr = stack.enter_context(
+                                AsyncCheckpointer(args.ckpt_dir))
+                        ckptr.save(r, api.net, api.server_opt_state, api.rng)
+                    else:
+                        from fedml_tpu.core.checkpoint import save_round
+
+                        save_round(args.ckpt_dir, r, api.net,
+                                   api.server_opt_state, api.rng)
     finally:
         # stop the XLA trace even when training crashes — the trace
         # is most wanted precisely when a run misbehaves

@@ -45,6 +45,53 @@ def test_checkpoint_roundtrip(tmp_path):
     assert float(d) < 1e-7
 
 
+def test_async_checkpointer_equals_sync(tmp_path):
+    """AsyncCheckpointer: background writes produce byte-equivalent
+    restorable state (snapshot happens on the caller's thread, so donated
+    buffers invalidated by later rounds can't corrupt it), one save in
+    flight at a time, close() flushes, and a failed write surfaces."""
+    import pytest
+
+    from fedml_tpu.core.checkpoint import AsyncCheckpointer
+
+    data = synthetic_lr(num_clients=4, dim=10, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=4,
+                       client_num_per_round=4, epochs=1, batch_size=16,
+                       lr=0.05, seed=0)
+    api = FedAvgAPI(data, task, cfg, donate=True)
+    sync_ck, async_ck = str(tmp_path / "sync"), str(tmp_path / "async")
+    with AsyncCheckpointer(async_ck) as ck:
+        for r in range(3):
+            api.run_round(r)
+            save_round(sync_ck, r, api.net, api.server_opt_state, api.rng)
+            ck.save(r, api.net, api.server_opt_state, api.rng)
+            # keep training while the write is (possibly) still in flight
+    assert latest_round(async_ck) == latest_round(sync_ck) == 2
+    tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
+            "rng": api.rng, "round": 0}
+    a = restore_round(async_ck, 2, tmpl)
+    s = restore_round(sync_ck, 2, tmpl)
+    d = tree_global_norm(tree_sub(a["net"].params, s["net"].params))
+    assert float(d) == 0.0
+
+    # a failed background write raises on the next save/wait, not silently
+    bad = AsyncCheckpointer(str(tmp_path))
+    bad._inflight = bad._pool.submit(lambda: (_ for _ in ()).throw(
+        OSError("disk gone")))
+    with pytest.raises(OSError):
+        bad.wait()
+    bad.close()
+
+    # ...but must not REPLACE an in-flight exception during unwinding
+    bad2 = AsyncCheckpointer(str(tmp_path))
+    with pytest.raises(RuntimeError, match="training crashed"):
+        with bad2:
+            bad2._inflight = bad2._pool.submit(lambda: (_ for _ in ()).throw(
+                OSError("disk gone")))
+            raise RuntimeError("training crashed")
+
+
 def test_checkpoint_prune(tmp_path):
     data = synthetic_lr(num_clients=2, dim=6, num_classes=2, seed=0)
     task = classification_task(LogisticRegression(num_classes=2))
