@@ -85,6 +85,12 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--frequency_of_the_test", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ci", type=int, default=0)
+    p.add_argument("--compression", type=str, default="none",
+                   choices=["none", "f16", "zlib", "f16+zlib"],
+                   help="wire codec for outgoing frames (comm/message.py): "
+                        "f16 halves float32 payloads (lossy ~1e-3 rel), "
+                        "zlib deflates losslessly; receivers auto-detect, "
+                        "so ranks may mix settings")
     return p
 
 
@@ -146,6 +152,12 @@ def main(argv=None):
 
     role = "server" if args.rank == 0 else f"client{args.rank}"
     set_process_title(f"fedml_tpu:{args.algo}:{role}")
+
+    # unconditional: an explicit --compression none must also OVERRIDE a
+    # codec inherited from the FEDML_COMM_CODEC env var
+    from fedml_tpu.comm.message import set_wire_codec
+
+    set_wire_codec(args.compression)
 
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.core.tasks import classification_task, sequence_task, tag_prediction_task
