@@ -61,6 +61,7 @@ def run_simulated(
     ckpt_dir: str | None = None,
     broker_host: str = "127.0.0.1",
     broker_port: int = 1883,
+    sparsify_ratio: float | None = None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue."""
     size = cfg.client_num_per_round + 1
@@ -69,7 +70,9 @@ def run_simulated(
     server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
                                  ckpt_dir=ckpt_dir, **kw)
     clients = [
-        init_client(dataset, task, cfg, rank, size, backend, **kw) for rank in range(1, size)
+        init_client(dataset, task, cfg, rank, size, backend,
+                    sparsify_ratio=sparsify_ratio, **kw)
+        for rank in range(1, size)
     ]
     launch_simulated(server, clients)
     return aggregator

@@ -14,9 +14,20 @@ from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
 
 
 class FedAvgClientManager(ClientManager):
-    def __init__(self, trainer: DistributedTrainer, rank, size, backend="LOOPBACK", **kw):
+    def __init__(self, trainer: DistributedTrainer, rank, size,
+                 backend="LOOPBACK", sparsify_ratio: float | None = None,
+                 **kw):
         self.trainer = trainer
         self.round_idx = 0
+        # top-k sparsified uplinks with per-rank error feedback
+        # (comm/sparse.py); None = dense protocol. Validate HERE so a bad
+        # ratio fails at launch, not inside the receive-loop handler after
+        # a full local fit (where it would hang the server instead)
+        if sparsify_ratio is not None and not 0.0 < sparsify_ratio <= 1.0:
+            raise ValueError(
+                f"sparsify_ratio must be in (0, 1], got {sparsify_ratio}")
+        self.sparsify_ratio = sparsify_ratio
+        self._residual = None
         super().__init__(rank, size, backend, **kw)
 
     def register_message_receive_handlers(self):
@@ -42,11 +53,22 @@ class FedAvgClientManager(ClientManager):
         # trust the server's round counter (keeps stragglers aligned after an
         # elastic partial aggregation skipped them)
         self.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx))
-        self.trainer.update_model(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS])
+        global_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        self.trainer.update_model(global_leaves)
         self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
+        if self.sparsify_ratio:
+            from fedml_tpu.comm.sparse import (topk_delta, topk_encode,
+                                               topk_residual)
+
+            delta = topk_delta(wire_leaves, global_leaves, self._residual)
+            idx, vals = topk_encode(delta, self.sparsify_ratio)
+            self._residual = topk_residual(delta, idx)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_IDX, idx)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_VAL, vals)
+        else:
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
         self.send_message(msg)

@@ -16,3 +16,7 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_ROUND = "round_idx"
+    # sparse uplink (comm/sparse.py): flat top-k indices + values per leaf,
+    # replacing MODEL_PARAMS; the server densifies against its global
+    MSG_ARG_KEY_SPARSE_IDX = "sparse_idx"
+    MSG_ARG_KEY_SPARSE_VAL = "sparse_val"

@@ -41,6 +41,7 @@ class FedAvgServerManager(ServerManager):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
+        self._bcast_leaves = None  # this round's packed broadcast (sparse)
         self.round_timeout_s = round_timeout_s
         self.ckpt_dir = ckpt_dir
         if ckpt_dir is not None:
@@ -115,6 +116,7 @@ class FedAvgServerManager(ServerManager):
     def send_init_msg(self):
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         global_params = self.aggregator.get_global_model_params()
+        self._bcast_leaves = global_params  # sparse decodes reuse this pack
         for rank in range(1, self.size):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
@@ -136,9 +138,22 @@ class FedAvgServerManager(ServerManager):
                 log.warning("drop stale upload from rank %d (round %s, now %d)",
                             sender, msg_round, self.round_idx)
                 return
+            if MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params:
+                # sparse uplink: densify against the global this round
+                # broadcast — the ALREADY-PACKED leaves stashed at send
+                # time (re-packing the full model per upload would cost N
+                # device→host materializations per round under this lock)
+                from fedml_tpu.comm.sparse import topk_decode
+
+                wire_leaves = topk_decode(
+                    self._bcast_leaves,
+                    msg_params[MyMessage.MSG_ARG_KEY_SPARSE_IDX],
+                    msg_params[MyMessage.MSG_ARG_KEY_SPARSE_VAL])
+            else:
+                wire_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
             self.aggregator.add_local_trained_result(
                 sender - 1,
-                msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS],
+                wire_leaves,
                 msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
             )
             if not self.aggregator.check_whether_all_receive():
@@ -157,6 +172,7 @@ class FedAvgServerManager(ServerManager):
             self._broadcast_finish()
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
+        self._bcast_leaves = global_params  # sparse decodes reuse this pack
         for rank in range(1, self.size):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)

@@ -85,6 +85,11 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--frequency_of_the_test", type=int, default=5)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ci", type=int, default=0)
+    p.add_argument("--sparsify_ratio", type=float, default=None,
+                   help="top-k sparsified uplinks with error feedback "
+                        "(comm/sparse.py): ship only this fraction of the "
+                        "model delta per upload; 1.0 = exact dense "
+                        "equivalence, unset = dense protocol")
     p.add_argument("--compression", type=str, default="none",
                    choices=["none", "f16", "zlib", "f16+zlib"],
                    help="wire codec for outgoing frames (comm/message.py): "
@@ -127,11 +132,16 @@ def init_role(args, data, task, cfg, backend_kw):
                                    backend=backend, ckpt_dir=args.ckpt_dir,
                                    **backend_kw)
 
+    # sparse uplinks apply where the upload is plain weights; a
+    # turboaggregate share is a masked tensor whose top-k entries are
+    # meaningless (the mask dominates), so it stays dense
+    sp = getattr(args, "sparsify_ratio", None) or None
     if args.algo == "fedprox":
         from fedml_tpu.distributed.fedprox import prox_spec
 
         return init_client(data, task, cfg, args.rank, args.world_size, backend,
-                           local_spec=prox_spec(cfg, args.fedprox_mu), **backend_kw)
+                           local_spec=prox_spec(cfg, args.fedprox_mu),
+                           sparsify_ratio=sp, **backend_kw)
     if args.algo == "turboaggregate":
         from fedml_tpu.distributed.turboaggregate import SecureTrainer
 
@@ -139,7 +149,7 @@ def init_role(args, data, task, cfg, backend_kw):
         return FedAvgClientManager(trainer, rank=args.rank, size=args.world_size,
                                    backend=backend, **backend_kw)
     return init_client(data, task, cfg, args.rank, args.world_size, backend,
-                       **backend_kw)
+                       sparsify_ratio=sp, **backend_kw)
 
 
 def main(argv=None):

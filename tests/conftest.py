@@ -73,8 +73,11 @@ _SMOKE_TESTS = {
     "test_flash_attention.py::test_flash_gradients_match_dense",
     "test_flash_attention.py::test_flash_gradients_under_strict_vma_shard_map",
     "test_sync_bn.py::test_sync_bn_equals_global_batch_bn",
-    # round-3 additions: wire codec, async ckpt, bf16 resnet, CLI attack
+    # round-3 additions: wire codec, sparse uplink, async ckpt, bf16
+    # resnet, CLI attack
     "test_comm.py::test_wire_codecs_roundtrip_and_shrink",
+    "test_comm.py::test_topk_sparse_encode_decode_conservation",
+    "test_comm.py::test_sparse_uplink_ratio1_equals_dense_protocol",
     "test_infra.py::test_async_checkpointer_equals_sync",
     "test_models.py::test_resnet_bf16_compute_dtype",
     "test_infra.py::test_cli_poison_type_wires_attack_and_backdoor_eval",

@@ -122,6 +122,84 @@ def test_distributed_loopback_with_compression_still_learns(lr_setup):
     assert agg.history and agg.history[-1]["round"] == cfg.comm_round - 1
 
 
+def test_topk_sparse_encode_decode_conservation():
+    """comm/sparse.py: shipped + residual == full delta (error feedback
+    conserves mass); decode(global, encode(delta)) == global + shipped;
+    non-float leaves ride dense via the sentinel."""
+    from fedml_tpu.comm.sparse import topk_decode, topk_encode, topk_residual
+
+    rs = np.random.RandomState(0)
+    delta = [rs.randn(32, 16).astype(np.float32),
+             rs.randn(7).astype(np.float32),
+             np.arange(5, dtype=np.int64)]  # non-float -> dense
+    g = [rs.randn(32, 16).astype(np.float32),
+         rs.randn(7).astype(np.float32),
+         np.zeros(5, np.int64)]
+    idx, vals = topk_encode(delta, 0.25)
+    assert len(idx[0]) == 128  # ceil(512 * 0.25)
+    res = topk_residual(delta, idx)
+    dec = topk_decode(g, idx, vals)
+    for d, r, gg, de in zip(delta[:2], res[:2], g[:2], dec[:2]):
+        np.testing.assert_allclose(de - gg + r, d, rtol=1e-6, atol=1e-6)
+        # top-k really selected the largest-|.| entries
+        assert np.abs(r).max() <= np.abs(de - gg)[np.abs(de - gg) > 0].min() + 1e-6
+    np.testing.assert_array_equal(dec[2], delta[2])  # dense sentinel path
+
+    # ratio=1: everything ships, residual is zero, decode is exact
+    idx, vals = topk_encode(delta, 1.0)
+    assert all(np.abs(r).max() == 0 for r in topk_residual(delta, idx)[:2])
+    for d, gg, de in zip(delta[:2], g[:2], topk_decode(g, idx, vals)[:2]):
+        np.testing.assert_allclose(de, gg + d, rtol=1e-6, atol=1e-6)
+
+    # a bad ratio fails at CLIENT CONSTRUCTION (launch time), not inside
+    # the receive loop after a full local fit
+    import pytest
+
+    from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+
+    with pytest.raises(ValueError, match="sparsify_ratio"):
+        FedAvgClientManager(None, rank=1, size=2, backend="LOOPBACK",
+                            sparsify_ratio=1.5, job_id="t-badratio")
+
+
+def test_sparse_uplink_ratio1_equals_dense_protocol(lr_setup):
+    """sparsify_ratio=1.0 ships every delta entry — the distributed run
+    must equal the standalone engine exactly (same oracle as the dense
+    protocol; float32 add/subtract of the same values is bitwise-stable
+    enough for the 2e-5 tolerance used by the dense test)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=8,
+                       lr=0.1, frequency_of_the_test=1, seed=0)
+    standalone = FedAvgAPI(data, task, cfg)
+    standalone.train()
+    agg = run_simulated(data, task, cfg, backend="LOOPBACK",
+                        job_id="t-sparse1", sparsify_ratio=1.0)
+    for a, b in zip(pack_pytree(standalone.net), pack_pytree(agg.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_sparse_uplink_with_error_feedback_learns(lr_setup):
+    """10%-of-entries uplinks: error feedback keeps FedAvg converging —
+    the run reaches the dense run's accuracy ballpark over a few more
+    rounds (the residual ships the rest later)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    cfg = FedAvgConfig(comm_round=8, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=8,
+                       lr=0.1, frequency_of_the_test=1, seed=0)
+    agg = run_simulated(data, task, cfg, backend="LOOPBACK",
+                        job_id="t-sparse01", sparsify_ratio=0.1)
+    assert agg.history[-1]["round"] == cfg.comm_round - 1
+    assert agg.history[-1]["test_acc"] > 0.9, agg.history[-1]
+
+
 def test_loopback_dispatch_between_managers():
     got = []
 
