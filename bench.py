@@ -98,15 +98,12 @@ def _measure(mode: str) -> None:
 
     _mark(t0, f"jax imported; backend={jax.default_backend()}")
 
-    try:
-        # persistent compile cache: repeat bench runs (and driver re-runs)
-        # skip the expensive first compile when the program is unchanged
-        cache_dir = os.environ.get("FEDML_COMPILE_CACHE",
-                                   os.path.expanduser("~/.cache/fedml_tpu_xla"))
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # noqa: BLE001 — cache is an optimization only
-        print(f"bench: compile cache unavailable ({e})", file=sys.stderr)
+    # persistent compile cache: repeat bench runs (and driver re-runs)
+    # skip the expensive first compile when the program is unchanged;
+    # shared setup with every other entry point so they HIT the same cache
+    from fedml_tpu.utils.metrics import enable_compile_cache
+
+    enable_compile_cache()
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.core.tasks import classification_task

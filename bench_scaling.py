@@ -97,6 +97,9 @@ def _one_point(args, data, task, k):
 
 
 def main():
+    from fedml_tpu.utils.metrics import enable_compile_cache
+
+    enable_compile_cache()
     # a timeout(1)-TERMed sweep must release the accelerator grant (raw
     # SIGTERM would skip PJRT teardown and wedge it, like bench.py's child)
     import signal

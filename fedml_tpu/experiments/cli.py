@@ -465,12 +465,13 @@ def build_api(args):
 
 
 def main(argv=None):
-    from fedml_tpu.utils.metrics import (RunLogger, set_process_title,
-                                         setup_logging)
+    from fedml_tpu.utils.metrics import (RunLogger, enable_compile_cache,
+                                         set_process_title, setup_logging)
 
     args = add_args(argparse.ArgumentParser("fedml_tpu")).parse_args(argv)
     setup_logging(f"fedml-tpu-{args.algo}")
     set_process_title(f"fedml_tpu:{args.algo}:{args.dataset}")
+    enable_compile_cache()
     log = logging.getLogger("cli")
     t0 = time.time()
     api, data = build_api(args)

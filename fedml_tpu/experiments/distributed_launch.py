@@ -162,6 +162,9 @@ def main(argv=None):
 
     role = "server" if args.rank == 0 else f"client{args.rank}"
     set_process_title(f"fedml_tpu:{args.algo}:{role}")
+    from fedml_tpu.utils.metrics import enable_compile_cache
+
+    enable_compile_cache()
 
     # unconditional: an explicit --compression none must also OVERRIDE a
     # codec inherited from the FEDML_COMM_CODEC env var

@@ -75,6 +75,9 @@ def _one_point(args, T: int, use_flash: bool) -> None:
 
 
 def main():
+    from fedml_tpu.utils.metrics import enable_compile_cache
+
+    enable_compile_cache()
     # release the accelerator grant on a timeout(1) TERM (tpu_smoke battery)
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
     ap = argparse.ArgumentParser()
