@@ -180,11 +180,18 @@ class FedAvgAPI:
         device_data: bool = False,
         donate: bool = False,
         block_working_set: bool = False,
+        uniform_avg: bool = False,
     ):
         self.data = dataset
         self.task = task
         self.cfg = config
         self.mesh = mesh
+        # uniform_avg: aggregate with weight 1 per REAL client (0 for
+        # zero-sample padding) instead of sample counts. DP-FedAvg needs
+        # this: with sample-weighted averaging a clipped update's influence
+        # is (n_k/Σn)·C, unbounded by C/m on unbalanced data, which
+        # invalidates the sensitivity the DP noise is calibrated for.
+        self.uniform_avg = uniform_avg
         self.rng = jax.random.PRNGKey(config.seed)
 
         # device-resident data plane: park the whole train set in HBM once;
@@ -280,8 +287,16 @@ class FedAvgAPI:
             nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
         return nets, metrics, nsamp
 
+    def _agg_weights(self, nsamp):
+        """Aggregation weights: sample counts (FedAvg default) or, with
+        uniform_avg, 1 per participating client / 0 for padding."""
+        if not self.uniform_avg:
+            return nsamp
+        return jnp.where(nsamp > 0, jnp.ones_like(nsamp),
+                         jnp.zeros_like(nsamp))
+
     def _aggregate_and_update(self, net, server_opt_state, nets, metrics, nsamp, post_key):
-        avg = tree_weighted_mean(nets, nsamp)
+        avg = tree_weighted_mean(nets, self._agg_weights(nsamp))
         new_net, new_opt = self.server_update(net, avg, server_opt_state)
         if self.post_aggregate_hook is not None:
             new_net = self.post_aggregate_hook(new_net, post_key)
@@ -349,7 +364,8 @@ class FedAvgAPI:
             if self.client_result_hook is not None:
                 hkeys = jax.random.split(hook_key, keys.shape[0])
                 nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
-            return _shard_aggregate(nets, metrics, nsamp, axis)
+            return _shard_aggregate(nets, metrics, self._agg_weights(nsamp),
+                                    axis)
 
         smapped = jax.shard_map(
             shard_body,
@@ -525,7 +541,8 @@ class FedAvgAPI:
                 nets, metrics = jax.vmap(
                     local_update, in_axes=(0, None, 0, 0, 0))(
                         keys, net_v, x, y, mask_r)
-                avg, msum = _shard_aggregate(nets, metrics, nsamp_r, axis)
+                avg, msum = _shard_aggregate(
+                    nets, metrics, self._agg_weights(nsamp_r), axis)
                 net, opt = server_update(net, avg, opt)
                 return (net, opt), msum
 

@@ -28,22 +28,49 @@ class FedAvgRobustAPI(FedAvgAPI):
         task,
         config: FedAvgConfig,
         mesh=None,
-        defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'none'
+        defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'dp' | 'none'
         norm_bound: float = 30.0,
         stddev: float = 0.025,
+        noise_multiplier: float = 1.0,  # z, for defense_type='dp'
         poisoned_test: tuple | None = None,  # (x, y_target) backdoor eval set
         **kwargs,
     ):
+        """defense_type='dp' is REAL DP-FedAvg (McMahan et al. 2018),
+        unlike the reference's hand-tuned 'weak_dp'
+        (robust_aggregation.py:51-55): per-client updates clip to L2 ball
+        norm_bound (=C), the server adds N(0, (z*C/m)^2) to the m-client
+        average, and ``self.accountant`` tracks cumulative Rényi DP —
+        ``self.epsilon(delta)`` gives the (ε, δ) spent so far
+        (core/privacy.py)."""
         self.defense_type = defense_type
+        self.accountant = None
         hooks = {}
-        if defense_type in ("norm_diff_clipping", "weak_dp"):
+        if defense_type in ("norm_diff_clipping", "weak_dp", "dp"):
             def clip_hook(net_k: NetState, net_global: NetState, rng):
                 return NetState(
                     norm_diff_clipping(net_k.params, net_global.params, norm_bound),
                     net_k.extra,
                 )
             hooks["client_result_hook"] = clip_hook
-        if defense_type == "weak_dp":
+        if defense_type in ("weak_dp", "dp"):
+            if defense_type == "dp":
+                from fedml_tpu.core.privacy import DPAccountant
+
+                if noise_multiplier <= 0:
+                    raise ValueError("defense_type='dp' needs "
+                                     f"noise_multiplier > 0, got {noise_multiplier}")
+                # noise on the AVERAGED update: z * C / m. Sensitivity C/m
+                # only holds under a UNIFORM client average — sample-
+                # weighted averaging lets one data-rich client move the
+                # mean by up to (n_k/Σn)·C — so dp forces uniform_avg.
+                stddev = (noise_multiplier * norm_bound
+                          / config.client_num_per_round)
+                kwargs["uniform_avg"] = True
+                self.accountant = DPAccountant()
+                self._dp_q = (config.client_num_per_round
+                              / config.client_num_in_total)
+                self._dp_z = noise_multiplier
+
             def noise_hook(net: NetState, rng):
                 return NetState(add_gaussian_noise(rng, net.params, stddev), net.extra)
             hooks["post_aggregate_hook"] = noise_hook
@@ -55,6 +82,18 @@ class FedAvgRobustAPI(FedAvgAPI):
             self._poisoned = tuple(
                 jnp.asarray(a) for a in batch_global(px, py, config.eval_batch_size)
             )
+
+    def run_round(self, round_idx: int):
+        m = super().run_round(round_idx)
+        if self.accountant is not None:
+            self.accountant.step(self._dp_q, self._dp_z)
+        return m
+
+    def epsilon(self, delta: float = 1e-5) -> float:
+        """Cumulative (ε, δ)-DP spent by the rounds run so far."""
+        if self.accountant is None:
+            raise ValueError("defense_type='dp' required for accounting")
+        return self.accountant.epsilon(delta)
 
     def evaluate_backdoor(self):
         """Targeted-task accuracy on the poisoned set: fraction of poisoned

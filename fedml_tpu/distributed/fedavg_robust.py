@@ -29,6 +29,12 @@ class FedAvgRobustAggregator(FedAvgAggregator):
                  defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'none'
                  norm_bound: float = 30.0, stddev: float = 0.025):
         super().__init__(dataset, task, cfg, worker_num)
+        if defense_type not in ("norm_diff_clipping", "weak_dp", "none"):
+            # 'dp' (accounted DP-FedAvg) is the in-process engine's
+            # (algorithms/fedavg_robust.py); an unknown value silently
+            # running defenseless would be worse than refusing
+            raise ValueError(f"unknown defense_type {defense_type!r} for the "
+                             "cross-process robust runtime")
         self.defense_type = defense_type
         self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
 

@@ -101,9 +101,13 @@ def add_args(parser: argparse.ArgumentParser):
     parser.add_argument("--server_lr", type=float, default=1.0)
     parser.add_argument("--server_momentum", type=float, default=0.9)
     parser.add_argument("--mu", type=float, default=0.1, help="FedProx mu")
-    parser.add_argument("--defense_type", type=str, default="norm_diff_clipping")
+    parser.add_argument("--defense_type", type=str, default="norm_diff_clipping",
+                        choices=["norm_diff_clipping", "weak_dp", "dp", "none"])
     parser.add_argument("--norm_bound", type=float, default=30.0)
     parser.add_argument("--stddev", type=float, default=0.025)
+    # defense_type=dp (real DP-FedAvg with RDP accounting, core/privacy.py)
+    parser.add_argument("--noise_multiplier", type=float, default=1.0)
+    parser.add_argument("--dp_delta", type=float, default=1e-5)
     # attack side of fedavg_robust (reference --poison_type/--attack_case,
     # edge_case_examples/data_loader.py:283): 'pixel'/'edge' are the
     # synthetic generators (zero files needed); 'southwest'/'greencar'/
@@ -370,6 +374,7 @@ def build_api(args):
                                defense_type=args.defense_type,
                                norm_bound=args.norm_bound,
                                stddev=args.stddev,
+                               noise_multiplier=args.noise_multiplier,
                                poisoned_test=poisoned_test), data
     if algo == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
@@ -514,6 +519,13 @@ def main(argv=None):
                     st = restore_round(args.ckpt_dir, lr_, tmpl)
                     api.load_state(st["net"], st["server_opt_state"], st["rng"])
                     start_round = int(st["round"]) + 1
+                    if getattr(api, "accountant", None) is not None:
+                        # the epsilon claim is CUMULATIVE over the whole
+                        # training run: re-charge the pre-resume rounds
+                        # (q and z are static per run) so the logged budget
+                        # doesn't silently understate the true spend
+                        api.accountant.step(api._dp_q, api._dp_z,
+                                            rounds=start_round)
                     log.info("resumed from round %d", start_round - 1)
             trace_ctx = None
             if args.trace_dir and args.trace_rounds > 0:
@@ -544,6 +556,8 @@ def main(argv=None):
                     if getattr(api, "_poisoned", None) is not None:
                         rec["backdoor_acc"] = float(
                             api.evaluate_backdoor()["acc"])
+                    if getattr(api, "accountant", None) is not None:
+                        rec["epsilon"] = round(api.epsilon(args.dp_delta), 4)
                     logger.log(rec, step=r)
                     log.info("round %d: %s", r, rec)
                 if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):

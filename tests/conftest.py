@@ -78,6 +78,8 @@ _SMOKE_TESTS = {
     "test_comm.py::test_wire_codecs_roundtrip_and_shrink",
     "test_comm.py::test_topk_sparse_encode_decode_conservation",
     "test_comm.py::test_sparse_uplink_ratio1_equals_dense_protocol",
+    "test_privacy.py::test_q1_reduces_to_gaussian",
+    "test_privacy.py::test_dp_forces_uniform_average",
     "test_infra.py::test_async_checkpointer_equals_sync",
     "test_models.py::test_resnet_bf16_compute_dtype",
     "test_infra.py::test_cli_poison_type_wires_attack_and_backdoor_eval",

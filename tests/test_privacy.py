@@ -1,0 +1,153 @@
+"""DP-FedAvg privacy accounting (core/privacy.py) — the RDP math is
+checked against its own exact endpoints and structural laws rather than a
+memorized table: q=1 must reduce to the closed-form Gaussian RDP, tiny-q
+behavior must be O(q²), composition must be additive, and ε must be
+monotone the right way in every knob."""
+
+import math
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.privacy import (DEFAULT_ALPHAS, DPAccountant,
+                                    gaussian_rdp, rdp_to_epsilon,
+                                    subsampled_gaussian_rdp)
+
+
+def test_q1_reduces_to_gaussian():
+    for z in (0.5, 1.0, 2.0):
+        for a in (2, 5, 32):
+            assert subsampled_gaussian_rdp(1.0, z, a) == pytest.approx(
+                gaussian_rdp(z, a))
+
+
+def test_q0_is_free():
+    assert subsampled_gaussian_rdp(0.0, 1.0, 8) == 0.0
+
+
+def test_subsampling_amplifies():
+    """Subsampled RDP is below the full-mechanism RDP and increases with
+    q; for q -> 0 it scales ~ q^2 (privacy amplification by sampling)."""
+    z, a = 1.0, 8
+    full = gaussian_rdp(z, a)
+    prev = 0.0
+    for q in (0.001, 0.01, 0.1, 0.5):
+        r = subsampled_gaussian_rdp(q, z, a)
+        assert 0.0 < r < full
+        assert r > prev
+        prev = r
+    r1 = subsampled_gaussian_rdp(1e-3, z, a)
+    r2 = subsampled_gaussian_rdp(2e-3, z, a)
+    assert r2 / r1 == pytest.approx(4.0, rel=0.15)  # quadratic in q
+
+
+def test_composition_is_additive_and_eps_monotone():
+    acc1 = DPAccountant().step(0.1, 1.0, rounds=10)
+    acc2 = DPAccountant()
+    for _ in range(10):
+        acc2.step(0.1, 1.0)
+    np.testing.assert_allclose(acc1._rdp, acc2._rdp, rtol=1e-12)
+
+    # more rounds cost more; more noise costs less; looser delta costs less
+    e10 = acc1.epsilon(1e-5)
+    e20 = DPAccountant().step(0.1, 1.0, rounds=20).epsilon(1e-5)
+    e10_z2 = DPAccountant().step(0.1, 2.0, rounds=10).epsilon(1e-5)
+    assert e20 > e10 > e10_z2 > 0
+    assert acc1.epsilon(1e-3) < acc1.epsilon(1e-7)
+
+
+def test_eps_conversion_uses_best_order():
+    rdp = [gaussian_rdp(1.0, a) for a in DEFAULT_ALPHAS]
+    eps = rdp_to_epsilon(rdp, DEFAULT_ALPHAS, 1e-5)
+    # the min over orders beats (or ties) any single order's bound
+    for r, a in zip(rdp, DEFAULT_ALPHAS):
+        assert eps <= r + math.log(1e5) / (a - 1) + 1e-12
+
+
+def test_bad_noise_multiplier_rejected():
+    with pytest.raises(ValueError, match="noise_multiplier"):
+        subsampled_gaussian_rdp(0.1, 0.0, 8)
+    with pytest.raises(ValueError, match="noise_multiplier"):
+        DPAccountant().step(0.1, -1.0)
+
+
+def test_dp_forces_uniform_average():
+    """The C/m sensitivity the DP noise is calibrated for only holds under
+    a UNIFORM client average: defense_type='dp' must flip the engine to
+    uniform_avg, and uniform vs sample-weighted must actually differ on
+    unbalanced data (while matching exactly on balanced data)."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.utils.tree import tree_global_norm, tree_sub
+
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=4,
+                       client_num_per_round=4, epochs=1, batch_size=4,
+                       lr=0.2, seed=0, frequency_of_the_test=100)
+
+    dp = FedAvgRobustAPI(
+        synthetic_images(num_clients=4, image_shape=(6,), num_classes=3,
+                         samples_per_client=8, test_samples=8, seed=0),
+        task, cfg, defense_type="dp", norm_bound=10.0, noise_multiplier=1.0)
+    assert dp.uniform_avg
+
+    # unbalanced sizes (lognormal): the two weightings disagree
+    data_unbal = synthetic_images(num_clients=4, image_shape=(6,),
+                                  num_classes=3, samples_per_client=8,
+                                  test_samples=8, seed=1,
+                                  size_lognormal=True)
+    a = FedAvgAPI(data_unbal, task, cfg)
+    b = FedAvgAPI(data_unbal, task, cfg, uniform_avg=True)
+    a.run_round(0)
+    b.run_round(0)
+    assert float(tree_global_norm(tree_sub(a.net.params, b.net.params))) > 1e-6
+
+    # balanced sizes: identical math either way
+    data_bal = synthetic_images(num_clients=4, image_shape=(6,),
+                                num_classes=3, samples_per_client=8,
+                                test_samples=8, seed=1, size_lognormal=False)
+    c = FedAvgAPI(data_bal, task, cfg)
+    d = FedAvgAPI(data_bal, task, cfg, uniform_avg=True)
+    c.run_round(0)
+    d.run_round(0)
+    assert float(tree_global_norm(tree_sub(c.net.params, d.net.params))) < 1e-6
+
+
+def test_dp_fedavg_trains_and_accounts():
+    """End-to-end: defense_type='dp' clips + adds calibrated noise, the
+    accountant advances per round, and the model still learns at a
+    loose-but-real noise level."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_lr
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_lr(num_clients=20, dim=10, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=6, client_num_in_total=20,
+                       client_num_per_round=5, epochs=1, batch_size=16,
+                       lr=0.1, seed=0, frequency_of_the_test=100)
+    api = FedAvgRobustAPI(data, task, cfg, defense_type="dp",
+                          norm_bound=1.0, noise_multiplier=0.8)
+    eps_seen = []
+    for r in range(6):
+        api.run_round(r)
+        eps_seen.append(api.epsilon(1e-5))
+    assert all(b > a for a, b in zip(eps_seen, eps_seen[1:]))  # spends ε
+    # q=5/20, z=0.8, 6 rounds: a small-but-nonzero budget
+    assert 0.1 < eps_seen[-1] < 50.0
+    acc = float(api.evaluate()["acc"])
+    assert acc > 0.5, acc  # clipped+noised FedAvg still learns
+
+    # weak_dp / clipping configs don't grow an accountant
+    api2 = FedAvgRobustAPI(data, task, cfg, defense_type="weak_dp",
+                           norm_bound=1.0, stddev=0.01)
+    assert api2.accountant is None
+    with pytest.raises(ValueError):
+        api2.epsilon()
