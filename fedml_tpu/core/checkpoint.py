@@ -14,8 +14,12 @@ import numpy as np
 
 
 def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
-               history: list | None = None, keep: int = 3):
-    """Save a round checkpoint via orbax (falls back to npz if orbax breaks)."""
+               history: list | None = None, keep: int = 3,
+               extra_state: dict | None = None):
+    """Save a round checkpoint via orbax (falls back to npz if orbax breaks).
+
+    ``extra_state``: additional top-level entries (e.g. the DP accountant's
+    RDP totals) — restore templates must declare the same keys."""
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"round_{round_idx:06d}")
     state = {
@@ -24,6 +28,8 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
         "rng": rng,
         "round": np.asarray(round_idx, np.int64),
     }
+    if extra_state:
+        state.update(extra_state)
     try:
         import orbax.checkpoint as ocp
 

@@ -61,11 +61,20 @@ class FedAvgServerManager(ServerManager):
     def _ckpt_state_template(self):
         import jax
 
-        return {
+        st = {
             "net": self.aggregator.net,
             "server_opt_state": getattr(self.aggregator, "_server_opt_state", ()),
-            "rng": jax.random.PRNGKey(0),
+            # dp runs store the server noise RNG here so a resumed job
+            # continues the key stream instead of REPLAYING the same noise
+            "rng": getattr(self.aggregator, "_noise_rng",
+                           jax.random.PRNGKey(0)),
         }
+        if getattr(self.aggregator, "accountant", None) is not None:
+            import numpy as np
+
+            # cumulative RDP totals: epsilon() must cover pre-restart rounds
+            st["dp_rdp"] = np.asarray(self.aggregator.accountant._rdp)
+        return st
 
     def _maybe_resume(self):
         from fedml_tpu.core.checkpoint import latest_round, restore_round
@@ -80,6 +89,13 @@ class FedAvgServerManager(ServerManager):
         self.aggregator.net = state["net"]
         if hasattr(self.aggregator, "_server_opt_state"):
             self.aggregator._server_opt_state = state["server_opt_state"]
+        if hasattr(self.aggregator, "_noise_rng"):
+            self.aggregator._noise_rng = state["rng"]
+        if "dp_rdp" in state and getattr(self.aggregator, "accountant",
+                                         None) is not None:
+            import numpy as np
+
+            self.aggregator.accountant._rdp = np.asarray(state["dp_rdp"])
         self.round_idx = int(state["round"]) + 1
         # reload persisted eval history so post-resume saves don't rewrite
         # history.json with only the post-restart records
@@ -97,9 +113,12 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.core.checkpoint import save_round
 
         st = self._ckpt_state_template()
+        extra = {k: v for k, v in st.items()
+                 if k not in ("net", "server_opt_state", "rng")}
         save_round(self.ckpt_dir, self.round_idx, st["net"],
                    st["server_opt_state"], st["rng"],
-                   history=self.aggregator.history)
+                   history=self.aggregator.history,
+                   extra_state=extra or None)
 
     def _broadcast_finish(self):
         for rank in range(1, self.size):

@@ -8,6 +8,14 @@ robust_aggregation.py:33-36). Here each uploaded update is norm-diff-clipped
 against the current global model inside one jitted pass, and weak-DP noise
 is added to the aggregate — the same pure pytree ops the SPMD
 FedAvgRobustAPI runs as engine hooks (algorithms/fedavg_robust.py).
+
+Beyond the reference, ``defense_type='dp'`` is ACCOUNTED DP-FedAvg
+(core/privacy.py): clip to C, UNIFORM average over the m clients that
+actually reported (elastic rounds shrink m — the noise z*C/m and the
+accountant's sampling rate both use the realized m), Gaussian noise on
+the aggregate, cumulative (ε, δ) via ``epsilon()``. DP state (RDP totals
++ noise RNG) rides in the server checkpoint so a resumed job neither
+under-reports ε nor replays noise keys.
 """
 
 from __future__ import annotations
@@ -26,17 +34,32 @@ from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 
 class FedAvgRobustAggregator(FedAvgAggregator):
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
-                 defense_type: str = "norm_diff_clipping",  # | 'weak_dp' | 'none'
-                 norm_bound: float = 30.0, stddev: float = 0.025):
+                 defense_type: str = "norm_diff_clipping",  # |'weak_dp'|'dp'|'none'
+                 norm_bound: float = 30.0, stddev: float = 0.025,
+                 noise_multiplier: float = 1.0):
         super().__init__(dataset, task, cfg, worker_num)
-        if defense_type not in ("norm_diff_clipping", "weak_dp", "none"):
-            # 'dp' (accounted DP-FedAvg) is the in-process engine's
-            # (algorithms/fedavg_robust.py); an unknown value silently
-            # running defenseless would be worse than refusing
+        if defense_type not in ("norm_diff_clipping", "weak_dp", "dp", "none"):
+            # an unknown value silently running defenseless would be worse
+            # than refusing
             raise ValueError(f"unknown defense_type {defense_type!r} for the "
                              "cross-process robust runtime")
         self.defense_type = defense_type
+        self.accountant = None
+        if defense_type == "dp":
+            # accounted DP-FedAvg (see algorithms/fedavg_robust.py): clip
+            # to C, UNIFORM average, noise z*C/m. m is the clients that
+            # ACTUALLY reported (elastic partial aggregation may shrink a
+            # round) — the noise is calibrated per aggregate, and the
+            # accountant is charged with the realized sampling rate.
+            from fedml_tpu.core.privacy import DPAccountant
+
+            if noise_multiplier <= 0:
+                raise ValueError("defense_type='dp' needs noise_multiplier "
+                                 f"> 0, got {noise_multiplier}")
+            self.accountant = DPAccountant()
+            self._dp_z, self._dp_C = noise_multiplier, norm_bound
         self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
+        self._stddev = stddev
 
         @jax.jit
         def clip(net: NetState, net_global: NetState) -> NetState:
@@ -45,34 +68,54 @@ class FedAvgRobustAggregator(FedAvgAggregator):
                 net.extra,
             )
 
-        @jax.jit
-        def noise(net: NetState, rng) -> NetState:
-            return NetState(add_gaussian_noise(rng, net.params, stddev), net.extra)
+        # sd is a TRACED scalar: elastic rounds vary m (and hence the dp
+        # stddev) round to round — a static arg would recompile each time
+        def noise(net: NetState, rng, sd) -> NetState:
+            return NetState(add_gaussian_noise(rng, net.params, sd), net.extra)
 
-        self._clip, self._noise = clip, noise
+        self._clip, self._noise = clip, jax.jit(noise)
 
     def aggregate(self):
-        if self.defense_type in ("norm_diff_clipping", "weak_dp"):
+        if self.defense_type in ("norm_diff_clipping", "weak_dp", "dp"):
             for r in list(self.model_dict):
                 net_r = unpack_pytree(self.net, self.model_dict[r])
                 self.model_dict[r] = pack_pytree(self._clip(net_r, self.net))
+        m_received = len(self.model_dict)
+        if self.defense_type == "dp":
+            # uniform average: the C/m sensitivity the noise assumes does
+            # not survive sample-count weighting on unbalanced data
+            self.sample_num_dict = {r: 1 for r in self.sample_num_dict}
         out = super().aggregate()  # weighted average -> self.net
-        if self.defense_type == "weak_dp":
+        if self.defense_type in ("weak_dp", "dp"):
+            if self.defense_type == "dp":
+                sd = self._dp_z * self._dp_C / max(m_received, 1)
+                self.accountant.step(
+                    m_received / self.cfg.client_num_in_total, self._dp_z)
+            else:
+                sd = self._stddev
             self._noise_rng, k = jax.random.split(self._noise_rng)
-            self.net = self._noise(self.net, k)
+            self.net = self._noise(self.net, k, sd)
             out = pack_pytree(self.net)
         return out
 
+    def epsilon(self, delta: float = 1e-5) -> float:
+        """Cumulative (ε, δ)-DP spent so far (defense_type='dp')."""
+        if self.accountant is None:
+            raise ValueError("defense_type='dp' required for accounting")
+        return self.accountant.epsilon(delta)
+
 
 def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
-                  job_id="fedavg-robust-sim", base_port=50000, **defense_kw):
+                  job_id="fedavg-robust-sim", base_port=50000,
+                  ckpt_dir: str | None = None, **defense_kw):
     """All ranks as threads (mpirun-on-localhost analogue); returns the
     aggregator with .net/.history."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port)
     aggregator = FedAvgRobustAggregator(dataset, task, cfg, worker_num=size - 1,
                                         **defense_kw)
-    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
+    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
+                                 ckpt_dir=ckpt_dir, **kw)
     clients = [init_client(dataset, task, cfg, r, size, backend, **kw)
                for r in range(1, size)]
     launch_simulated(server, clients)

@@ -43,6 +43,8 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--defense_type", type=str, default="norm_diff_clipping")
     p.add_argument("--norm_bound", type=float, default=30.0)
     p.add_argument("--stddev", type=float, default=0.025)
+    p.add_argument("--noise_multiplier", type=float, default=1.0,
+                   help="z for --defense_type dp (accounted DP-FedAvg)")
     p.add_argument("--world_size", type=int, required=True,
                    help="client_num_per_round + 1")
     p.add_argument("--backend", type=str, default="grpc",
@@ -121,7 +123,7 @@ def init_role(args, data, task, cfg, backend_kw):
             agg = FedAvgRobustAggregator(
                 data, task, cfg, worker_num=args.world_size - 1,
                 defense_type=args.defense_type, norm_bound=args.norm_bound,
-                stddev=args.stddev)
+                stddev=args.stddev, noise_multiplier=args.noise_multiplier)
         elif args.algo == "turboaggregate":
             from fedml_tpu.distributed.turboaggregate import TAAggregator
 
