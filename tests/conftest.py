@@ -73,16 +73,16 @@ _SMOKE_TESTS = {
     "test_flash_attention.py::test_flash_gradients_match_dense",
     "test_flash_attention.py::test_flash_gradients_under_strict_vma_shard_map",
     "test_sync_bn.py::test_sync_bn_equals_global_batch_bn",
-    # round-3 additions: wire codec, sparse uplink, async ckpt, bf16
-    # resnet, CLI attack
+    # round-3 additions: wire codec, sparse uplink, async ckpt, DP.
+    # (bf16-resnet / CLI-attack knob tests stay full-tier: their oracles —
+    # model forward, backdoor flow — are covered above, and the smoke
+    # budget is a hard <5 min)
     "test_comm.py::test_wire_codecs_roundtrip_and_shrink",
     "test_comm.py::test_topk_sparse_encode_decode_conservation",
     "test_comm.py::test_sparse_uplink_ratio1_equals_dense_protocol",
     "test_privacy.py::test_q1_reduces_to_gaussian",
     "test_privacy.py::test_dp_forces_uniform_average",
     "test_infra.py::test_async_checkpointer_equals_sync",
-    "test_models.py::test_resnet_bf16_compute_dtype",
-    "test_infra.py::test_cli_poison_type_wires_attack_and_backdoor_eval",
     # infra: checkpoint/CLI/tracing/packer/partition/data/params
     "test_infra.py::test_checkpoint_roundtrip",
     "test_infra.py::test_cli_build_api_all_algos",
