@@ -143,6 +143,11 @@ class FedAvgConfig:
     # semantics (random.sample per call, FedAVGAggregator.py:99-107),
     # deterministic here via (seed, eval-call-index)
     eval_subset_mode: str = "fixed"
+    # 'uniform' (reference parity): uniform without replacement +
+    # sample-weighted aggregate. 'size_weighted': P(k) ∝ n_k + UNIFORM
+    # aggregate (the FedAvg paper's alternative scheme — both are
+    # unbiased; size-weighting concentrates rounds on data-rich clients)
+    sampling: str = "uniform"
 
 
 def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
@@ -191,7 +196,10 @@ class FedAvgAPI:
         # this: with sample-weighted averaging a clipped update's influence
         # is (n_k/Σn)·C, unbounded by C/m on unbalanced data, which
         # invalidates the sensitivity the DP noise is calibrated for.
-        self.uniform_avg = uniform_avg
+        # size_weighted sampling FORCES it: P(k) ∝ n_k + uniform average
+        # is the unbiased pairing (sampling twice — by probability AND by
+        # weight — would double-count data-rich clients).
+        self.uniform_avg = uniform_avg or config.sampling == "size_weighted"
         self.rng = jax.random.PRNGKey(config.seed)
 
         # device-resident data plane: park the whole train set in HBM once;
@@ -475,6 +483,19 @@ class FedAvgAPI:
 
     def _sampled_ids(self, round_idx: int):
         cfg = self.cfg
+        if cfg.sampling == "size_weighted":
+            from fedml_tpu.core.sampling import sample_clients_weighted
+
+            if not hasattr(self, "_client_sizes"):  # static; build once
+                self._client_sizes = np.asarray(
+                    [len(self.data.train_idx_map[c])
+                     for c in range(cfg.client_num_in_total)])
+            return sample_clients_weighted(
+                round_idx, self._client_sizes, cfg.client_num_per_round,
+                cfg.seed)
+        if cfg.sampling != "uniform":
+            raise ValueError(f"unknown sampling {cfg.sampling!r} "
+                             "(uniform | size_weighted)")
         return sample_clients(
             round_idx, cfg.client_num_in_total, cfg.client_num_per_round, cfg.seed
         )

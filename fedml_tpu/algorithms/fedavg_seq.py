@@ -71,6 +71,12 @@ class FedAvgSeqAPI:
         if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
             raise ValueError(
                 f"FedAvgSeqAPI needs axes ('clients','seq'), got {mesh.axis_names}")
+        if config.sampling != "uniform":
+            # refuse rather than silently sample uniformly with the
+            # sample-weighted aggregate (the biased pairing)
+            raise ValueError(
+                f"sampling={config.sampling!r} is not wired for the "
+                "long-context engine; use uniform")
         self.data, self.cfg, self.mesh = dataset, config, mesh
         self.donate = donate  # same opt-in contract as FedAvgAPI
         cd, sd = mesh.shape["clients"], mesh.shape["seq"]

@@ -39,6 +39,13 @@ class HierarchicalFLAPI(FedAvgAPI):
         **kwargs,
     ):
         super().__init__(dataset, task, config, mesh=None, **kwargs)
+        if config.sampling != "uniform":
+            # group sub-rounds sample WITHIN groups (sample_clients over
+            # members); size weighting is not wired there — refuse rather
+            # than silently ignore the flag
+            raise ValueError(
+                f"sampling={config.sampling!r} is not wired for "
+                "hierarchical FL; use uniform")
         self.group_num = group_num
         self.group_comm_round = group_comm_round
         self.group_mesh = mesh

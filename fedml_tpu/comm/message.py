@@ -36,15 +36,20 @@ _ZMAGIC = b"FMZ1"  # zlib-wrapped frame: FMZ1 | u32 raw_len | deflate bytes
 #            classic FL uplink compression; manifest records the original
 #            dtype so receivers restore f32 — a ~1e-3-relative quantization
 #            of the weights, NOT bit-exact)
+#   'q8'   — symmetric int8 quantization of float32 payloads (4x; scale =
+#            max|x|/127 per array, kept in the manifest; ~0.4% of the
+#            array's max absolute value per entry — the aggressive tier)
 #   'zlib' — lossless deflate of the whole frame (big wins on int/uint8
 #            payloads and sparse updates; modest on dense f32)
-#   'f16+zlib' — both.
-_CODECS = ("none", "f16", "zlib", "f16+zlib")
+#   '+zlib' composes with either lossy tier. f16 and q8 are mutually
+#   exclusive (both re-encode the same f32 payloads).
+_CODECS = ("none", "f16", "q8", "zlib", "f16+zlib", "q8+zlib")
 
 
 def set_wire_codec(codec: str) -> None:
-    """Process-wide default codec for Message.to_bytes ('none', 'f16',
-    'zlib', 'f16+zlib'). Exposed on the CLI as --compression."""
+    """Process-wide default codec for Message.to_bytes (one of _CODECS:
+    'none', 'f16', 'q8', 'zlib', 'f16+zlib', 'q8+zlib'). Exposed on the
+    CLI as --compression."""
     global _CODEC
     if codec not in _CODECS:
         raise ValueError(f"unknown wire codec {codec!r} (one of {_CODECS})")
@@ -114,7 +119,9 @@ class Message:
 
     def to_bytes(self, codec: str | None = None) -> bytes:
         codec = _CODEC if codec is None else codec
-        f16 = "f16" in codec
+        if codec not in _CODECS:
+            raise ValueError(f"unknown wire codec {codec!r} (one of {_CODECS})")
+        f16, q8 = "f16" in codec, "q8" in codec
         scalars: dict[str, Any] = {}
         manifest: list[dict] = []
         buffers: list[bytes] = []
@@ -129,6 +136,21 @@ class Message:
                 # weight, unscaled statistic) must degrade to ±65504, not
                 # become inf and poison every peer's aggregate
                 arr = np.clip(arr, -65504.0, 65504.0).astype(np.float16)
+            elif q8 and arr.dtype == np.float32:
+                # non-finite guard (same motivation as the f16 clip): nan→0
+                # and ±inf saturate to the largest FINITE magnitude so one
+                # diverged entry can't blow the scale up / NaN the decode
+                finite = np.isfinite(arr)
+                if not finite.all():
+                    amax = (float(np.max(np.abs(arr[finite])))
+                            if finite.any() else 0.0)
+                    arr = np.nan_to_num(arr, nan=0.0, posinf=amax,
+                                        neginf=-amax)
+                scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
+                ent["orig"], ent["dtype"] = arr.dtype.str, "|i1"
+                ent["scale"] = scale
+                arr = (np.zeros(arr.shape, np.int8) if scale == 0.0 else
+                       np.clip(np.rint(arr / scale), -127, 127).astype(np.int8))
             manifest.append(ent)
             buffers.append(arr.tobytes())
 
@@ -182,7 +204,10 @@ class Message:
                 offset=off,
             ).reshape(ent["shape"])
             off += arr.nbytes
-            if "orig" in ent:  # f16-on-the-wire: restore the sender's dtype
+            if "scale" in ent:  # q8: dequantize back to the sender's dtype
+                arr = (arr.astype(np.dtype(ent["orig"]))
+                       * np.dtype(ent["orig"]).type(ent["scale"]))
+            elif "orig" in ent:  # f16-on-the-wire: restore the dtype
                 arr = arr.astype(np.dtype(ent["orig"]))
             if ent["idx"] is None:
                 msg.msg_params[ent["key"]] = arr

@@ -20,14 +20,44 @@ def sample_clients(
     client_num_in_total: int,
     client_num_per_round: int,
     seed: int = 0,
+    p=None,
 ) -> np.ndarray:
-    """Host-side deterministic sampler (numpy RandomState(seed + round))."""
+    """Host-side deterministic sampler (numpy RandomState(seed + round));
+    ``p`` optionally weights the draw (shared seeding/sort/dtype contract
+    for the uniform and weighted variants)."""
     if client_num_in_total == client_num_per_round:
         return np.arange(client_num_in_total, dtype=np.int64)
     rng = np.random.RandomState(seed * 1_000_003 + round_idx)
     return np.sort(
-        rng.choice(client_num_in_total, client_num_per_round, replace=False)
+        rng.choice(client_num_in_total, client_num_per_round, replace=False,
+                   p=p)
     ).astype(np.int64)
+
+
+def sample_clients_weighted(
+    round_idx: int,
+    client_sizes,
+    client_num_per_round: int,
+    seed: int = 0,
+) -> np.ndarray:
+    """Size-weighted sampler: P(client k) ∝ its sample count, without
+    replacement (the FedAvg paper's second sampling scheme — pair it with
+    a UNIFORM aggregate, FedAvgConfig.sampling='size_weighted', for the
+    unbiasedness argument; the reference only implements uniform).
+
+    Degenerate sizes are handled rather than crashed on: zero-size clients
+    get a vanishing (not zero) probability so a skewed partition with
+    fewer nonzero clients than the round needs still draws a full round;
+    all-zero sizes fall back to uniform."""
+    sizes = np.asarray(client_sizes, np.float64)
+    n = len(sizes)
+    if not np.any(sizes > 0):
+        p = None  # uniform fallback
+    else:
+        floor = sizes[sizes > 0].min() * 1e-9
+        p = np.maximum(sizes, floor)
+        p = p / p.sum()
+    return sample_clients(round_idx, n, client_num_per_round, seed, p=p)
 
 
 def sample_clients_device(key, round_idx, client_num_in_total: int, client_num_per_round: int):

@@ -30,6 +30,12 @@ log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
 class FedAvgAggregator:
     def __init__(self, dataset: FederatedData, task: Task, cfg: FedAvgConfig, worker_num: int):
+        if cfg.sampling != "uniform":
+            # this runtime's client_sampling + weighted aggregate implement
+            # the uniform scheme only — refuse rather than silently ignore
+            raise ValueError(
+                f"sampling={cfg.sampling!r} is not wired for the "
+                "cross-process runtime; use uniform")
         self.dataset, self.task, self.cfg = dataset, task, cfg
         self.worker_num = worker_num
         self.model_dict: dict[int, list] = {}

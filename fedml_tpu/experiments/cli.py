@@ -124,6 +124,12 @@ def add_args(parser: argparse.ArgumentParser):
                              "(southwest 9, greencar 2, ardis from file)")
     parser.add_argument("--edge_case_train", type=str, default=None)
     parser.add_argument("--edge_case_test", type=str, default=None)
+    parser.add_argument("--sampling", type=str, default="uniform",
+                        choices=["uniform", "size_weighted"],
+                        help="per-round client sampling: uniform (reference "
+                             "parity, sample-weighted aggregate) or "
+                             "size_weighted (P ∝ client size, uniform "
+                             "aggregate — the FedAvg paper's alt scheme)")
     parser.add_argument("--async_ckpt", type=int, default=1,
                         help="write round checkpoints off the training "
                              "thread (disk I/O overlaps later rounds; the "
@@ -279,6 +285,7 @@ def build_api(args):
         eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
                           else None),
         eval_subset_mode=args.eval_subset_mode,
+        sampling=args.sampling,
     )
     if args.algo == "fedavg_seq":
         from fedml_tpu.algorithms.fedavg_seq import FedAvgSeqAPI

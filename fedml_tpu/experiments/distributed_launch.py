@@ -93,11 +93,13 @@ def add_args(p: argparse.ArgumentParser):
                         "model delta per upload; 1.0 = exact dense "
                         "equivalence, unset = dense protocol")
     p.add_argument("--compression", type=str, default="none",
-                   choices=["none", "f16", "zlib", "f16+zlib"],
+                   choices=["none", "f16", "q8", "zlib", "f16+zlib",
+                            "q8+zlib"],
                    help="wire codec for outgoing frames (comm/message.py): "
-                        "f16 halves float32 payloads (lossy ~1e-3 rel), "
-                        "zlib deflates losslessly; receivers auto-detect, "
-                        "so ranks may mix settings")
+                        "f16 halves float32 payloads (lossy ~1e-3 rel), q8 "
+                        "quarters them (int8, the aggressive tier), zlib "
+                        "deflates losslessly; receivers auto-detect, so "
+                        "ranks may mix settings")
     return p
 
 

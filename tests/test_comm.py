@@ -68,7 +68,7 @@ def test_wire_codecs_roundtrip_and_shrink():
     m.add_params("num_samples", 17)
 
     plain = m.to_bytes("none")
-    for codec in ("zlib", "f16", "f16+zlib"):
+    for codec in ("zlib", "f16", "f16+zlib", "q8", "q8+zlib"):
         frame = m.to_bytes(codec)
         back = Message.from_bytes(frame)  # receiver never told the codec
         got = back.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
@@ -79,9 +79,29 @@ def test_wire_codecs_roundtrip_and_shrink():
             for a, g in zip(w, got):
                 np.testing.assert_array_equal(a, g)  # lossless
             assert len(frame) < len(plain)  # the int payload deflates
+        elif "q8" in codec:
+            # int8: error bounded by half a quantization step of max|x|
+            for a, g in zip(w, got):
+                assert np.max(np.abs(a - g)) <= np.abs(a).max() / 127
         else:
             for a, g in zip(w, got):
                 np.testing.assert_allclose(a, g, rtol=2e-3, atol=1e-3)
+    # q8 quarters the f32 payload (+ the manifest scale entries)
+    f32_bytes_all = sum(a.nbytes for a in w)
+    assert len(m.to_bytes("q8")) <= len(plain) - 3 * f32_bytes_all // 4 + 128
+    # all-zero arrays survive (scale 0 -> zeros, no divide)
+    z = Message("z", 0, 1)
+    z.add_params("w", np.zeros((5, 5), np.float32))
+    np.testing.assert_array_equal(
+        Message.from_bytes(z.to_bytes("q8")).get("w"),
+        np.zeros((5, 5), np.float32))
+    # a non-finite entry saturates to the largest finite magnitude instead
+    # of NaN-ing the whole decoded array
+    nf = Message("nf", 0, 1)
+    nf.add_params("w", np.array([1.0, -2.0, np.inf, np.nan], np.float32))
+    got_nf = np.asarray(Message.from_bytes(nf.to_bytes("q8")).get("w"))
+    assert np.isfinite(got_nf).all()
+    np.testing.assert_allclose(got_nf, [1.0, -2.0, 2.0, 0.0], atol=0.02)
     # f16 halves exactly the f32 payload bytes (the int payload is untouched)
     f32_bytes = sum(a.nbytes for a in w)
     assert len(m.to_bytes("f16")) <= len(plain) - f32_bytes // 2 + 64

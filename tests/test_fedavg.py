@@ -88,6 +88,41 @@ def test_fedavg_learns(lr_data, lr_task):
     assert last["test_acc"] > 0.5
 
 
+def test_size_weighted_sampling():
+    """P(k) ∝ n_k + uniform aggregate (the FedAvg paper's alt scheme):
+    deterministic per (seed, round), data-rich clients sampled more often
+    across rounds, and the engine pairing forces the uniform average."""
+    from fedml_tpu.core.sampling import sample_clients_weighted
+
+    sizes = [100, 100, 100, 1, 1, 1, 1, 1]
+    a = sample_clients_weighted(3, sizes, 4, seed=0)
+    b = sample_clients_weighted(3, sizes, 4, seed=0)
+    np.testing.assert_array_equal(a, b)
+    assert len(np.unique(a)) == 4  # without replacement
+    big = sum(int(np.isin([0, 1, 2], sample_clients_weighted(r, sizes, 4)).sum())
+              for r in range(40))
+    small = 40 * 4 - big
+    assert big > 2 * small  # 300:5 size ratio dominates the draws
+
+    data = synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=10,
+                            test_samples=20, seed=0, size_lognormal=True)
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=4,
+                       lr=0.1, seed=0, frequency_of_the_test=100,
+                       sampling="size_weighted")
+    api = FedAvgAPI(data, classification_task(LogisticRegression(num_classes=3)), cfg)
+    assert api.uniform_avg  # the unbiased pairing is forced
+    m = api.run_round(0)
+    assert float(m["count"]) > 0
+
+    with pytest.raises(ValueError, match="sampling"):
+        bad = FedAvgConfig(comm_round=1, client_num_in_total=8,
+                           client_num_per_round=4, sampling="nope")
+        FedAvgAPI(data, classification_task(LogisticRegression(num_classes=3)),
+                  bad)._sampled_ids(0)
+
+
 def test_client_sampling_deterministic(lr_data, lr_task):
     from fedml_tpu.core.sampling import sample_clients
 
