@@ -32,15 +32,6 @@ import numpy as np
 DEFAULT_ALPHAS = tuple(range(2, 64)) + (128, 256, 512)
 
 
-def _log_add(a: float, b: float) -> float:
-    if a == -math.inf:
-        return b
-    if b == -math.inf:
-        return a
-    hi, lo = max(a, b), min(a, b)
-    return hi + math.log1p(math.exp(lo - hi))
-
-
 def gaussian_rdp(noise_multiplier: float, alpha: int) -> float:
     """RDP of the (unsubsampled) Gaussian mechanism at order alpha."""
     return alpha / (2.0 * noise_multiplier ** 2)
@@ -63,15 +54,15 @@ def subsampled_gaussian_rdp(q: float, noise_multiplier: float,
     if q == 1.0:
         return gaussian_rdp(noise_multiplier, alpha)
     z2 = noise_multiplier ** 2
-    log_sum = -math.inf
-    log_q, log_1q = math.log(q), math.log1p(-q)
-    for k in range(alpha + 1):
-        log_term = (math.lgamma(alpha + 1) - math.lgamma(k + 1)
-                    - math.lgamma(alpha - k + 1)
-                    + k * log_q + (alpha - k) * log_1q
-                    + k * (k - 1) / (2.0 * z2))
-        log_sum = _log_add(log_sum, log_term)
-    return max(0.0, log_sum / (alpha - 1))
+    k = np.arange(alpha + 1, dtype=np.float64)
+    # log C(alpha, k) from cumulative log-factorials; terms summed in log
+    # space with logaddexp so large alpha / tiny q never underflow
+    log_fact = np.concatenate(
+        [[0.0], np.cumsum(np.log(np.arange(1, alpha + 1)))])
+    log_binom = log_fact[alpha] - log_fact - log_fact[::-1]
+    log_terms = (log_binom + k * math.log(q) + (alpha - k) * math.log1p(-q)
+                 + k * (k - 1) / (2.0 * z2))
+    return max(0.0, float(np.logaddexp.reduce(log_terms)) / (alpha - 1))
 
 
 def rdp_to_epsilon(rdp_by_alpha, alphas, delta: float) -> float:
