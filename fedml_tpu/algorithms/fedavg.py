@@ -45,7 +45,7 @@ from fedml_tpu.core.client_data import (
     pack_clients,
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import prepare_sampling, sample_for
 from fedml_tpu.utils.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
 
@@ -65,6 +65,16 @@ def _gather_rows(dev_x, dev_y, idx, mask):
     mx = mask.reshape(mask.shape + (1,) * (x.ndim - mask.ndim)) > 0
     my = mask.reshape(mask.shape + (1,) * (y.ndim - mask.ndim)) > 0
     return jnp.where(mx, x, jnp.zeros_like(x)), jnp.where(my, y, jnp.zeros_like(y))
+
+
+def agg_weights(nsamp, uniform: bool):
+    """Aggregation weights: sample counts (FedAvg default) or, with
+    ``uniform``, 1 per participating client / 0 for zero-sample padding —
+    the pairing DP and size-weighted sampling require. Shared by the
+    FedAvg and long-context engines."""
+    if not uniform:
+        return nsamp
+    return jnp.where(nsamp > 0, jnp.ones_like(nsamp), jnp.zeros_like(nsamp))
 
 
 def _shard_aggregate(nets, metrics, nsamp, axis):
@@ -200,6 +210,7 @@ class FedAvgAPI:
         # is the unbiased pairing (sampling twice — by probability AND by
         # weight — would double-count data-rich clients).
         self.uniform_avg = uniform_avg or config.sampling == "size_weighted"
+        self._client_sizes = prepare_sampling(config, dataset)
         self.rng = jax.random.PRNGKey(config.seed)
 
         # device-resident data plane: park the whole train set in HBM once;
@@ -296,12 +307,7 @@ class FedAvgAPI:
         return nets, metrics, nsamp
 
     def _agg_weights(self, nsamp):
-        """Aggregation weights: sample counts (FedAvg default) or, with
-        uniform_avg, 1 per participating client / 0 for padding."""
-        if not self.uniform_avg:
-            return nsamp
-        return jnp.where(nsamp > 0, jnp.ones_like(nsamp),
-                         jnp.zeros_like(nsamp))
+        return agg_weights(nsamp, self.uniform_avg)
 
     def _aggregate_and_update(self, net, server_opt_state, nets, metrics, nsamp, post_key):
         avg = tree_weighted_mean(nets, self._agg_weights(nsamp))
@@ -482,23 +488,7 @@ class FedAvgAPI:
         return cb
 
     def _sampled_ids(self, round_idx: int):
-        cfg = self.cfg
-        if cfg.sampling == "size_weighted":
-            from fedml_tpu.core.sampling import sample_clients_weighted
-
-            if not hasattr(self, "_client_sizes"):  # static; build once
-                self._client_sizes = np.asarray(
-                    [len(self.data.train_idx_map[c])
-                     for c in range(cfg.client_num_in_total)])
-            return sample_clients_weighted(
-                round_idx, self._client_sizes, cfg.client_num_per_round,
-                cfg.seed)
-        if cfg.sampling != "uniform":
-            raise ValueError(f"unknown sampling {cfg.sampling!r} "
-                             "(uniform | size_weighted)")
-        return sample_clients(
-            round_idx, cfg.client_num_in_total, cfg.client_num_per_round, cfg.seed
-        )
+        return sample_for(self.cfg, round_idx, self._client_sizes)
 
     # ----------------------------------------------------------- round block
     def _build_block_fn(self):

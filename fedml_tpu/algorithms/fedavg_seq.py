@@ -35,6 +35,7 @@ from fedml_tpu.algorithms.fedavg import (
     FedAvgConfig,
     _make_client_keys,
     _shard_aggregate,
+    agg_weights,
     make_client_optimizer,
 )
 from fedml_tpu.core.client_data import (
@@ -44,7 +45,7 @@ from fedml_tpu.core.client_data import (
     pad_batches,
 )
 from fedml_tpu.core.local import LocalSpec, make_eval_fn, make_local_update
-from fedml_tpu.core.sampling import sample_clients
+from fedml_tpu.core.sampling import prepare_sampling, sample_for
 from fedml_tpu.core.tasks import sequence_task
 
 
@@ -71,13 +72,12 @@ class FedAvgSeqAPI:
         if "clients" not in mesh.axis_names or "seq" not in mesh.axis_names:
             raise ValueError(
                 f"FedAvgSeqAPI needs axes ('clients','seq'), got {mesh.axis_names}")
-        if config.sampling != "uniform":
-            # refuse rather than silently sample uniformly with the
-            # sample-weighted aggregate (the biased pairing)
-            raise ValueError(
-                f"sampling={config.sampling!r} is not wired for the "
-                "long-context engine; use uniform")
         self.data, self.cfg, self.mesh = dataset, config, mesh
+        # sampling dispatch is shared with FedAvgAPI (core/sampling
+        # sample_for); size_weighted forces the uniform aggregate (the
+        # unbiased pairing — see FedAvgAPI.uniform_avg)
+        self.uniform_avg = config.sampling == "size_weighted"
+        self._client_sizes = prepare_sampling(config, dataset)
         self.donate = donate  # same opt-in contract as FedAvgAPI
         cd, sd = mesh.shape["clients"], mesh.shape["seq"]
         T = int(dataset.train_x.shape[1])
@@ -133,6 +133,9 @@ class FedAvgSeqAPI:
         self.history: list[dict] = []
 
     # ---------------------------------------------------------------- round
+    def _sampled_ids(self, round_idx: int):
+        return sample_for(self.cfg, round_idx, self._client_sizes)
+
     def _per_round(self, net, opt, keys, x, y, mask, nsamp):
         """Shared per-round body of the single-round fn AND the scan block
         (their numeric identity is test-enforced). Runs INSIDE shard_map:
@@ -145,7 +148,8 @@ class FedAvgSeqAPI:
             keys, net_v, x, y, mask)
         # metrics are already seq-psum-ed inside the task (identical on
         # every seq shard); aggregate clients with the shared helper
-        avg, msum = _shard_aggregate(nets, metrics, nsamp, "clients")
+        avg, msum = _shard_aggregate(
+            nets, metrics, agg_weights(nsamp, self.uniform_avg), "clients")
         new_net, new_opt = self.server_update(net, avg, opt)
         return new_net, new_opt, msum
 
@@ -184,8 +188,7 @@ class FedAvgSeqAPI:
         cfg = self.cfg
         xs, ys, ms, ns, ids_l = [], [], [], [], []
         for r in range(start_round, start_round + num_rounds):
-            ids = sample_clients(r, cfg.client_num_in_total,
-                                 cfg.client_num_per_round, cfg.seed)
+            ids = self._sampled_ids(r)
             cb = pad_batches(
                 pack_clients(self.data, ids, cfg.batch_size,
                              max_batches=self.num_batches, seed=cfg.seed,
@@ -237,8 +240,7 @@ class FedAvgSeqAPI:
 
     def run_round(self, round_idx: int):
         cfg = self.cfg
-        ids = sample_clients(round_idx, cfg.client_num_in_total,
-                             cfg.client_num_per_round, cfg.seed)
+        ids = self._sampled_ids(round_idx)
         cb = pack_clients(self.data, ids, cfg.batch_size,
                           max_batches=self.num_batches, seed=cfg.seed,
                           round_idx=round_idx)

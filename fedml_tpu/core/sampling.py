@@ -60,6 +60,38 @@ def sample_clients_weighted(
     return sample_clients(round_idx, n, client_num_per_round, seed, p=p)
 
 
+def prepare_sampling(cfg, data) -> np.ndarray | None:
+    """Construction-time half of the sampling dispatch: validate
+    ``cfg.sampling`` (fail fast, not at the first round after an
+    expensive engine build) and precompute what the per-round draw needs
+    — per-client sizes for size_weighted, nothing for uniform."""
+    if cfg.sampling == "size_weighted":
+        return np.asarray([len(data.train_idx_map[c])
+                           for c in range(cfg.client_num_in_total)])
+    if cfg.sampling != "uniform":
+        raise ValueError(f"unknown sampling {cfg.sampling!r} "
+                         "(uniform | size_weighted)")
+    return None
+
+
+def sample_for(cfg, round_idx: int, client_sizes=None) -> np.ndarray:
+    """Per-round half of the dispatch — the shared entry for every engine
+    that honors the flag (uniform | size_weighted; the weighted scheme
+    needs prepare_sampling's sizes and must pair with a uniform
+    aggregate)."""
+    if cfg.sampling == "size_weighted":
+        if client_sizes is None:
+            raise ValueError("size_weighted sampling needs the per-client "
+                             "sizes — pass prepare_sampling(cfg, data)")
+        return sample_clients_weighted(
+            round_idx, client_sizes, cfg.client_num_per_round, cfg.seed)
+    if cfg.sampling != "uniform":
+        raise ValueError(f"unknown sampling {cfg.sampling!r} "
+                         "(uniform | size_weighted)")
+    return sample_clients(round_idx, cfg.client_num_in_total,
+                          cfg.client_num_per_round, cfg.seed)
+
+
 def sample_clients_device(key, round_idx, client_num_in_total: int, client_num_per_round: int):
     """On-device sampler: fold the round index into the key and take a
     without-replacement choice. Shapes are static; usable under jit."""

@@ -60,6 +60,40 @@ def test_seq_parallel_fedavg_equals_single_device(seq_data):
                                rtol=1e-4)
 
 
+def test_seq_size_weighted_equals_single_device(seq_data):
+    """--sampling size_weighted on the long-context engine: same sampler +
+    forced-uniform aggregate as FedAvgAPI, so mesh ≡ single device holds
+    for the weighted scheme too. Client sizes are SKEWED so the uniform
+    aggregate is numerically observable — if the seq engine regressed to
+    the sample-weighted mean, the oracle comparison would diverge."""
+    from fedml_tpu.core.client_data import FederatedData
+
+    rs = np.random.RandomState(0)
+    perm = rs.permutation(len(seq_data.train_x))
+    cuts = np.cumsum([30, 20, 14, 10, 8, 6, 5])  # sizes 30..3 over 96 rows
+    idx_map = {c: np.sort(part) for c, part in
+               enumerate(np.split(perm, cuts))}
+    skewed = FederatedData(seq_data.train_x, seq_data.train_y,
+                           seq_data.test_x, seq_data.test_y,
+                           idx_map, seq_data.test_idx_map,
+                           seq_data.class_num)
+
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=100, seed=0,
+                       sampling="size_weighted")
+    oracle = FedAvgAPI(skewed, sequence_task(_model_ctor(None)), cfg)
+    sp = FedAvgSeqAPI(skewed, _model_ctor, cfg, mesh=_mesh(2, 2))
+    assert oracle.uniform_avg and sp.uniform_avg
+    for r in range(2):
+        np.testing.assert_array_equal(  # same draws from the shared sampler
+            oracle._sampled_ids(r), sp._sampled_ids(r))
+        oracle.run_round(r)
+        sp.run_round(r)
+    rel = _rel(oracle.net, sp.net)
+    assert rel < 1e-5, rel
+
+
 def test_seq_parallel_learns_and_evaluates(seq_data):
     cfg = FedAvgConfig(comm_round=6, client_num_in_total=8,
                        client_num_per_round=4, epochs=1, batch_size=6,
