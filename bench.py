@@ -298,9 +298,16 @@ def _last_json_line(out: str) -> dict | None:
 
 
 def _cpu_env(base) -> dict:
-    """Forced-CPU child env: remote-backend plugin vars dropped so a dead
-    relay can't hang interpreter startup."""
-    env = {k: v for k, v in dict(base).items() if k != "PALLAS_AXON_POOL_IPS"}
+    """Forced-CPU child env: every accelerator/relay env var scrubbed (same
+    anchored-prefix rule as the dryrun entrypoint — one var left behind is
+    enough for a site hook to dial a dead relay and hang interpreter
+    startup) and PYTHONPATH repointed at the repo, which both drops any
+    site-hook dir AND keeps fedml_tpu importable for ``--measure``
+    children."""
+    import __graft_entry__ as ge
+
+    env = {k: v for k, v in dict(base).items() if not ge._is_scrubbed(k)}
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) or "."
     env["JAX_PLATFORMS"] = "cpu"
     return env
 

@@ -59,6 +59,18 @@ class FedAvgRobustAPI(FedAvgAPI):
                 if noise_multiplier <= 0:
                     raise ValueError("defense_type='dp' needs "
                                      f"noise_multiplier > 0, got {noise_multiplier}")
+                # the accountant charges the Poisson-subsampled-Gaussian
+                # bound at q = m/N, which assumes UNIFORM sampling; under
+                # size-weighted sampling a data-rich client's inclusion
+                # probability exceeds q and the reported epsilon would
+                # silently understate its true loss (the cross-process
+                # aggregator enforces the same rule)
+                if getattr(config, "sampling", "uniform") != "uniform":
+                    raise ValueError(
+                        "defense_type='dp' requires config.sampling="
+                        f"'uniform' (got {config.sampling!r}): the RDP "
+                        "accountant's q=m/N subsampling bound does not "
+                        "hold for non-uniform client sampling")
                 # noise on the AVERAGED update: z * C / m. Sensitivity C/m
                 # only holds under a UNIFORM client average — sample-
                 # weighted averaging lets one data-rich client move the

@@ -72,6 +72,27 @@ def _codec_from_env() -> str:
 _CODEC = _codec_from_env()
 
 
+def _f16_wire(arr: np.ndarray) -> np.ndarray:
+    """float32 -> its f16 wire form. Saturates at the f16 range: a stray
+    huge value (diverging weight, unscaled statistic) must degrade to
+    ±65504, not become inf and poison every peer's aggregate."""
+    return np.clip(arr, -65504.0, 65504.0).astype(np.float16)
+
+
+def _q8_wire(arr: np.ndarray) -> tuple[np.ndarray, float]:
+    """float32 -> (int8 wire form, scale). Non-finite guard: nan→0 and
+    ±inf saturate to the largest FINITE magnitude so one diverged entry
+    can't blow the scale up / NaN the decode."""
+    finite = np.isfinite(arr)
+    if not finite.all():
+        amax = float(np.max(np.abs(arr[finite]))) if finite.any() else 0.0
+        arr = np.nan_to_num(arr, nan=0.0, posinf=amax, neginf=-amax)
+    scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
+    q = (np.zeros(arr.shape, np.int8) if scale == 0.0 else
+         np.clip(np.rint(arr / scale), -127, 127).astype(np.int8))
+    return q, scale
+
+
 class Message:
     MSG_ARG_KEY_TYPE = "msg_type"
     MSG_ARG_KEY_SENDER = "sender"
@@ -132,25 +153,10 @@ class Message:
                    "shape": list(arr.shape)}
             if f16 and arr.dtype == np.float32:
                 ent["orig"], ent["dtype"] = arr.dtype.str, "<f2"
-                # saturate at the f16 range: a stray huge value (diverging
-                # weight, unscaled statistic) must degrade to ±65504, not
-                # become inf and poison every peer's aggregate
-                arr = np.clip(arr, -65504.0, 65504.0).astype(np.float16)
+                arr = _f16_wire(arr)
             elif q8 and arr.dtype == np.float32:
-                # non-finite guard (same motivation as the f16 clip): nan→0
-                # and ±inf saturate to the largest FINITE magnitude so one
-                # diverged entry can't blow the scale up / NaN the decode
-                finite = np.isfinite(arr)
-                if not finite.all():
-                    amax = (float(np.max(np.abs(arr[finite])))
-                            if finite.any() else 0.0)
-                    arr = np.nan_to_num(arr, nan=0.0, posinf=amax,
-                                        neginf=-amax)
-                scale = float(np.max(np.abs(arr))) / 127.0 if arr.size else 0.0
                 ent["orig"], ent["dtype"] = arr.dtype.str, "|i1"
-                ent["scale"] = scale
-                arr = (np.zeros(arr.shape, np.int8) if scale == 0.0 else
-                       np.clip(np.rint(arr / scale), -127, 127).astype(np.int8))
+                arr, ent["scale"] = _q8_wire(arr)
             manifest.append(ent)
             buffers.append(arr.tobytes())
 
@@ -217,6 +223,40 @@ class Message:
 
     def __repr__(self):  # message-size print parity (message.py:64)
         return f"Message(type={self.get_type()}, {self.get_sender_id()}->{self.get_receiver_id()})"
+
+
+def codec_roundtrip(leaves, codec: str | None = None) -> list:
+    """The lossy transform each float32 array experiences on the wire under
+    ``codec`` (encode then decode), without building a frame — identity for
+    lossless codecs.
+
+    A server that stashes its broadcast pack to densify sparse client
+    deltas must stash THIS, not the pre-codec arrays: clients compute their
+    delta against the broadcast they RECEIVED (the decoded, lossy copy), so
+    densifying against the exact pack would add an untracked
+    ``g_exact - g_lossy`` offset to every transmitted entry each round and
+    break the ratio=1.0 dense-equivalence contract. Built from the same
+    ``_f16_wire``/``_q8_wire`` helpers ``to_bytes`` encodes with, and the
+    same f32*f32(scale) dequant ``from_bytes`` applies."""
+    codec = _CODEC if codec is None else codec
+    if codec not in _CODECS:
+        raise ValueError(f"unknown wire codec {codec!r} (one of {_CODECS})")
+    f16, q8 = "f16" in codec, "q8" in codec
+    if not (f16 or q8):
+        return list(leaves)
+    out = []
+    for arr in leaves:
+        arr = np.asarray(arr)
+        if arr.dtype != np.float32:
+            out.append(arr)
+            continue
+        if f16:
+            arr = _f16_wire(arr).astype(np.float32)
+        else:
+            q, scale = _q8_wire(arr)
+            arr = q.astype(np.float32) * np.float32(scale)
+        out.append(arr)
+    return out
 
 
 def pack_pytree(tree) -> list[np.ndarray]:

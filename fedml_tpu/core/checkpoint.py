@@ -86,15 +86,18 @@ class AsyncCheckpointer:
         self._inflight = None
 
     def save(self, round_idx: int, net, server_opt_state, rng,
-             history: list | None = None) -> None:
+             history: list | None = None,
+             extra_state: dict | None = None) -> None:
         # snapshot on the caller's thread (see class docstring)
         host = jax.device_get(
-            {"net": net, "server_opt_state": server_opt_state, "rng": rng})
+            {"net": net, "server_opt_state": server_opt_state, "rng": rng,
+             "extra": extra_state})
         self.wait()  # backpressure + surface a previous write's failure
         self._inflight = self._pool.submit(
             save_round, self.ckpt_dir, round_idx, host["net"],
             host["server_opt_state"], host["rng"],
-            list(history) if history is not None else None, self.keep)
+            list(history) if history is not None else None, self.keep,
+            host["extra"])
 
     def wait(self) -> None:
         if self._inflight is not None:
@@ -156,7 +159,24 @@ def restore_round(ckpt_dir: str, round_idx: int, template: Any):
         return ckptr.restore(os.path.abspath(path), target=template)
     npz = np.load(path + ".npz", allow_pickle=False)
     leaves, treedef = jax.tree.flatten(template)
+    # the npz fallback maps leaves to the template purely by index, so a
+    # template whose structure differs from the saved one (e.g. a dp run's
+    # checkpoint — which carries a dp_rdp leaf that sorts FIRST — resumed
+    # without dp) would silently shift every leaf by one and install the
+    # RDP totals as model weights; fail loudly instead
+    n_saved = sum(1 for k in npz.files if k.startswith("leaf_"))
+    if n_saved != len(leaves) or str(npz["treedef"]) != str(treedef):
+        raise ValueError(
+            f"checkpoint structure mismatch at {path}.npz: saved "
+            f"{n_saved} leaves / treedef {npz['treedef']}, template has "
+            f"{len(leaves)} leaves / treedef {treedef} — was the run "
+            "configuration (e.g. --defense_type) changed across resume?")
     restored = [npz[f"leaf_{i}"] for i in range(len(leaves))]
+    for i, (t, r) in enumerate(zip(leaves, restored)):
+        if np.shape(t) != np.shape(r):
+            raise ValueError(
+                f"checkpoint leaf {i} shape mismatch at {path}.npz: "
+                f"saved {np.shape(r)}, template {np.shape(t)}")
     return jax.tree.unflatten(treedef, restored)
 
 

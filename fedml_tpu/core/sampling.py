@@ -40,10 +40,20 @@ def sample_clients_weighted(
     client_num_per_round: int,
     seed: int = 0,
 ) -> np.ndarray:
-    """Size-weighted sampler: P(client k) ∝ its sample count, without
-    replacement (the FedAvg paper's second sampling scheme — pair it with
-    a UNIFORM aggregate, FedAvgConfig.sampling='size_weighted', for the
-    unbiasedness argument; the reference only implements uniform).
+    """Size-weighted sampler in the spirit of the FedAvg paper's second
+    sampling scheme (P(client k) ∝ n_k, paired with a UNIFORM aggregate —
+    FedAvgConfig.sampling='size_weighted'; the reference only implements
+    uniform).
+
+    Honesty note on the unbiasedness argument: the paper samples WITH
+    replacement, where P∝n_k + uniform averaging is exactly unbiased.
+    This draws ``np.random.choice(replace=False, p=...)``, which selects
+    sequentially — inclusion probabilities are then NOT exactly ∝ n_k
+    (large clients saturate), so the uniform-average estimator carries a
+    small bias unless m << N. Without replacement is kept deliberately:
+    duplicate client fits would waste round compute, and for the m << N
+    cross-device regime this targets, the approximation error is far below
+    sampling noise.
 
     Degenerate sizes are handled rather than crashed on: zero-size clients
     get a vanishing (not zero) probability so a skewed partition with

@@ -101,7 +101,19 @@ def _load_edge_file(path: str):
     if ext in (".pt", ".pth"):
         import torch
 
-        obj = torch.load(path, map_location="cpu", weights_only=False)
+        try:
+            obj = torch.load(path, map_location="cpu", weights_only=True)
+        except Exception:
+            # legacy archives (the reference's ARDIS saves predate
+            # weights_only) need full unpickling, which EXECUTES code from
+            # the file — only load archives from a trusted source
+            import warnings
+
+            warnings.warn(
+                f"{path}: falling back to torch.load(weights_only=False); "
+                "this executes arbitrary code from the archive — make sure "
+                "it comes from a trusted source", stacklevel=2)
+            obj = torch.load(path, map_location="cpu", weights_only=False)
         if isinstance(obj, dict):
             x, y = obj["data"], obj.get("targets")
         elif isinstance(obj, (tuple, list)) and len(obj) == 2:

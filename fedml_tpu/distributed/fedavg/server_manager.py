@@ -27,7 +27,7 @@ import os
 import threading
 
 from fedml_tpu.comm.managers import ServerManager
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import Message, codec_roundtrip
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 
@@ -135,7 +135,9 @@ class FedAvgServerManager(ServerManager):
     def send_init_msg(self):
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         global_params = self.aggregator.get_global_model_params()
-        self._bcast_leaves = global_params  # sparse decodes reuse this pack
+        # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
+        # codec their deltas are relative to the decoded broadcast
+        self._bcast_leaves = codec_roundtrip(global_params)
         for rank in range(1, self.size):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
@@ -191,7 +193,9 @@ class FedAvgServerManager(ServerManager):
             self._broadcast_finish()
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
-        self._bcast_leaves = global_params  # sparse decodes reuse this pack
+        # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
+        # codec their deltas are relative to the decoded broadcast
+        self._bcast_leaves = codec_roundtrip(global_params)
         for rank in range(1, self.size):
             msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)

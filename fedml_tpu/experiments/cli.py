@@ -521,18 +521,38 @@ def main(argv=None):
 
                 lr_ = latest_round(args.ckpt_dir)
                 if lr_ is not None:
+                    import numpy as np
+
                     tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
                             "rng": api.rng, "round": 0}
-                    st = restore_round(args.ckpt_dir, lr_, tmpl)
+                    has_dp = getattr(api, "accountant", None) is not None
+                    st = None
+                    if has_dp:
+                        # prefer the checkpoint's persisted RDP totals: a
+                        # recompute with THIS run's q/z misstates epsilon
+                        # when --noise_multiplier or client counts changed
+                        # across the resume (server_manager persists the
+                        # same key)
+                        try:
+                            st = restore_round(
+                                args.ckpt_dir, lr_,
+                                dict(tmpl, dp_rdp=np.asarray(
+                                    api.accountant._rdp)))
+                            api.accountant._rdp = np.asarray(st["dp_rdp"])
+                        except Exception:
+                            st = None  # pre-dp checkpoint: recompute below
+                    if st is None:
+                        st = restore_round(args.ckpt_dir, lr_, tmpl)
+                        if has_dp:
+                            # the epsilon claim is CUMULATIVE over the whole
+                            # training run: re-charge the pre-resume rounds
+                            # (only correct when q and z are unchanged; the
+                            # persisted-totals path above avoids even that
+                            # assumption)
+                            api.accountant.step(api._dp_q, api._dp_z,
+                                                rounds=int(st["round"]) + 1)
                     api.load_state(st["net"], st["server_opt_state"], st["rng"])
                     start_round = int(st["round"]) + 1
-                    if getattr(api, "accountant", None) is not None:
-                        # the epsilon claim is CUMULATIVE over the whole
-                        # training run: re-charge the pre-resume rounds
-                        # (q and z are static per run) so the logged budget
-                        # doesn't silently understate the true spend
-                        api.accountant.step(api._dp_q, api._dp_z,
-                                            rounds=start_round)
                     log.info("resumed from round %d", start_round - 1)
             trace_ctx = None
             if args.trace_dir and args.trace_rounds > 0:
@@ -568,6 +588,14 @@ def main(argv=None):
                     logger.log(rec, step=r)
                     log.info("round %d: %s", r, rec)
                 if args.ckpt_dir and (r % 10 == 0 or r == args.comm_round - 1):
+                    extra = None
+                    if getattr(api, "accountant", None) is not None:
+                        import numpy as np
+
+                        # cumulative RDP totals ride the checkpoint so a
+                        # resume under different q/z still reports the true
+                        # epsilon for the earlier rounds
+                        extra = {"dp_rdp": np.asarray(api.accountant._rdp)}
                     if args.async_ckpt:
                         # lazily created; disk write overlaps later rounds
                         if ckptr is None:
@@ -575,12 +603,14 @@ def main(argv=None):
 
                             ckptr = stack.enter_context(
                                 AsyncCheckpointer(args.ckpt_dir))
-                        ckptr.save(r, api.net, api.server_opt_state, api.rng)
+                        ckptr.save(r, api.net, api.server_opt_state, api.rng,
+                                   extra_state=extra)
                     else:
                         from fedml_tpu.core.checkpoint import save_round
 
                         save_round(args.ckpt_dir, r, api.net,
-                                   api.server_opt_state, api.rng)
+                                   api.server_opt_state, api.rng,
+                                   extra_state=extra)
     finally:
         # stop the XLA trace even when training crashes — the trace
         # is most wanted precisely when a run misbehaves

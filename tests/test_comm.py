@@ -142,6 +142,32 @@ def test_distributed_loopback_with_compression_still_learns(lr_setup):
     assert agg.history and agg.history[-1]["round"] == cfg.comm_round - 1
 
 
+def test_codec_roundtrip_matches_wire_bitwise():
+    """codec_roundtrip must reproduce EXACTLY what a float32 array looks
+    like after a to_bytes/from_bytes trip — it is what the server stashes
+    to densify sparse deltas (clients compute deltas against the DECODED
+    broadcast, so any divergence here becomes an untracked per-round
+    offset).  Covers the edge cases the wire codec special-cases: range
+    saturation (f16), non-finite guard + all-zero scale (q8), non-f32
+    passthrough."""
+    from fedml_tpu.comm.message import Message, codec_roundtrip
+
+    rs = np.random.RandomState(1)
+    leaves = [rs.randn(33, 7).astype(np.float32) * 10,
+              np.array([1e6, -np.inf, np.nan, 3.0], np.float32),
+              np.zeros((4,), np.float32),
+              np.arange(6, dtype=np.int32)]
+    for codec in ("none", "zlib", "f16", "q8", "f16+zlib", "q8+zlib"):
+        m = Message("sync", 1, 0)
+        m.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, leaves)
+        wire = Message.from_bytes(m.to_bytes(codec)) \
+            .get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        rt = codec_roundtrip(leaves, codec)
+        for a, b in zip(wire, rt):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"codec={codec}")
+
+
 def test_topk_sparse_encode_decode_conservation():
     """comm/sparse.py: shipped + residual == full delta (error feedback
     conserves mass); decode(global, encode(delta)) == global + shipped;

@@ -45,6 +45,36 @@ def test_checkpoint_roundtrip(tmp_path):
     assert float(d) < 1e-7
 
 
+def test_npz_restore_rejects_structure_mismatch(tmp_path, monkeypatch):
+    """The npz fallback maps leaves to the template BY INDEX: restoring a
+    checkpoint whose leaf set differs from the template (e.g. a dp run's
+    dp_rdp extra leaf, resumed without dp) must fail loudly, not shift
+    every leaf by one and install RDP totals as model weights."""
+    import numpy as np
+    import orbax.checkpoint as ocp
+    import pytest
+
+    # force the npz fallback (orbax otherwise handles structure itself)
+    monkeypatch.setattr(ocp, "StandardCheckpointer",
+                        lambda *a, **k: (_ for _ in ()).throw(RuntimeError))
+    data = synthetic_lr(num_clients=4, dim=10, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=4,
+                       client_num_per_round=4, epochs=1, batch_size=16,
+                       lr=0.05, seed=0)
+    api = FedAvgAPI(data, task, cfg)
+    ck = str(tmp_path / "ck")
+    save_round(ck, 0, api.net, api.server_opt_state, api.rng,
+               extra_state={"dp_rdp": np.zeros(3)})
+    base = {"net": api.net, "server_opt_state": api.server_opt_state,
+            "rng": api.rng, "round": 0}
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_round(ck, 0, base)  # template lacks the dp_rdp leaf
+    # the matching template restores fine
+    st = restore_round(ck, 0, dict(base, dp_rdp=np.zeros(3)))
+    assert int(st["round"]) == 0
+
+
 def test_async_checkpointer_equals_sync(tmp_path):
     """AsyncCheckpointer: background writes produce byte-equivalent
     restorable state (snapshot happens on the caller's thread, so donated

@@ -118,6 +118,81 @@ def test_dp_forces_uniform_average():
     assert float(tree_global_norm(tree_sub(c.net.params, d.net.params))) < 1e-6
 
 
+def test_dp_rejects_non_uniform_sampling():
+    """The RDP accountant charges the subsampled-Gaussian bound at q=m/N,
+    which assumes uniform client sampling: under size_weighted sampling a
+    data-rich client's inclusion probability exceeds q and its reported
+    epsilon would be understated — the SPMD engine must refuse the combo
+    the way the cross-process aggregator already does."""
+    import dataclasses
+
+    import pytest
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    task = classification_task(LogisticRegression(num_classes=3))
+    data = synthetic_images(num_clients=4, image_shape=(6,), num_classes=3,
+                            samples_per_client=8, test_samples=8, seed=0)
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=4,
+                       client_num_per_round=2, epochs=1, batch_size=4,
+                       lr=0.2, seed=0, frequency_of_the_test=100)
+    weighted = dataclasses.replace(cfg, sampling="size_weighted")
+    with pytest.raises(ValueError, match="uniform"):
+        FedAvgRobustAPI(data, task, weighted, defense_type="dp",
+                        norm_bound=10.0, noise_multiplier=1.0)
+    # other defenses keep accepting size_weighted (no accountant involved)
+    FedAvgRobustAPI(data, task, weighted, defense_type="norm_diff_clipping",
+                    norm_bound=10.0)
+
+
+def test_cli_dp_resume_restores_accountant_totals(tmp_path):
+    """The CLI resume path must restore the checkpoint's persisted RDP
+    totals rather than re-charging pre-resume rounds with the CURRENT
+    run's q/z: resuming with a different --noise_multiplier must still
+    report the true epsilon for the rounds already run (mirrors the
+    server_manager's dp_rdp persistence, tested above)."""
+    import argparse
+
+    import numpy as np
+
+    from fedml_tpu.core.privacy import DPAccountant
+    from fedml_tpu.experiments.cli import add_args, build_api, main
+
+    base = ["--algo", "fedavg_robust", "--defense_type", "dp",
+            "--dataset", "mnist", "--model", "lr",
+            "--client_num_in_total", "4", "--client_num_per_round", "2",
+            "--batch_size", "8", "--max_batches", "2", "--ci", "1",
+            "--frequency_of_the_test", "1", "--norm_bound", "5.0",
+            "--ckpt_dir", str(tmp_path / "ckpt"),
+            "--run_dir", str(tmp_path)]
+    # phase 1: 1 round at z=2.0 (checkpoint saved at r=0 with its RDP)
+    main(base + ["--comm_round", "1", "--noise_multiplier", "2.0"])
+    # phase 2: resume for 1 more round at z=1.0
+    main(base + ["--comm_round", "2", "--noise_multiplier", "1.0",
+                 "--resume"])
+    # read back the final checkpoint's persisted totals
+    from fedml_tpu.core.checkpoint import latest_round, restore_round
+
+    args = add_args(argparse.ArgumentParser()).parse_args(
+        base + ["--comm_round", "2", "--noise_multiplier", "1.0"])
+    api, _ = build_api(args)
+    r = latest_round(str(tmp_path / "ckpt"))
+    tmpl = {"net": api.net, "server_opt_state": api.server_opt_state,
+            "rng": api.rng, "round": 0,
+            "dp_rdp": np.asarray(api.accountant._rdp)}
+    st = restore_round(str(tmp_path / "ckpt"), r, tmpl)
+    # truth: one round at (q=0.5, z=2.0) + one at (q=0.5, z=1.0)
+    want = DPAccountant().step(0.5, 2.0).step(0.5, 1.0)._rdp
+    np.testing.assert_allclose(np.asarray(st["dp_rdp"]), want, rtol=1e-9)
+    # a z=1-only recompute of round 0 would differ — the bug being guarded
+    wrong = DPAccountant().step(0.5, 1.0, rounds=2)._rdp
+    assert not np.allclose(np.asarray(st["dp_rdp"]), wrong)
+
+
 def test_distributed_dp_aggregator_accounts_and_learns():
     """Cross-process DP-FedAvg: the robust aggregator clips, averages
     UNIFORMLY, adds z*C/m noise calibrated to the clients that actually
