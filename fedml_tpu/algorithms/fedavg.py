@@ -497,21 +497,40 @@ class FedAvgAPI:
         dispatch + transfer entirely — for small models (the flagship
         FedAvg-CNN) dispatch dominates, so this is the main throughput lever.
         Client keys are the same fold_in(fold_in(seed, round), client) chain
-        as run_round, so a hook-free block is bit-identical to the sequential
-        path (tested). With a mesh, the scan runs INSIDE shard_map: every
+        as run_round; the per-round hook keys (kh, kp) are PRE-DERIVED with
+        the exact split chain sequential run_round calls would draw
+        (self.rng -> rk per round, rk -> (_, kh, kp)) and scanned with the
+        rounds — so a block is bit-identical to the sequential path even for
+        hooked engines (clipping client_result_hook, DP post_aggregate_hook;
+        tested). With a mesh, the scan runs INSIDE shard_map: every
         device scans its client shard for R rounds and aggregation is a
         weighted psum per step — the whole block is one SPMD program and the
-        host is out of the loop entirely (the v4-32 north-star path)."""
+        host is out of the loop entirely (the v4-32 north-star path). The
+        post-aggregate hook runs right after the server update inside the
+        shard; its key is replicated, so the hook's draw (e.g. DP noise) is
+        identical on every device and the net stays replicated — the same
+        values the per-round path computes outside shard_map."""
         client_keys = _make_client_keys(self.cfg.seed)
+
+        def derive_hook_keys(rng, n_rounds):
+            """The sequential key stream, precomputed: run_round does
+            ``self.rng, rk = split(self.rng)`` then ``_, kh, kp =
+            split(rk, 3)`` — reproduce exactly that chain for each round in
+            the block so hooked engines keep bit-exact key parity."""
+            def kstep(r, _):
+                r, rk = jax.random.split(r)
+                _, kh, kp = jax.random.split(rk, 3)
+                return r, (kh, kp)
+
+            return jax.lax.scan(kstep, rng, None, length=n_rounds)
 
         if self.mesh is None:
 
             def make_step(dev_x, dev_y):
                 def step(carry, inp):
-                    rng, net, opt = carry
-                    idx_r, mask_r, nsamp_r, ids_r, r = inp
+                    net, opt = carry
+                    idx_r, mask_r, nsamp_r, ids_r, r, kh, kp = inp
                     keys = client_keys(r, ids_r)
-                    rng, kh, kp = jax.random.split(rng, 3)
                     x, y = _gather_rows(dev_x, dev_y, idx_r, mask_r)
                     nets, metrics, _ = self._round_body(
                         keys, net, opt, x, y, mask_r, nsamp_r, kh
@@ -519,16 +538,17 @@ class FedAvgAPI:
                     net, opt, m = self._aggregate_and_update(
                         net, opt, nets, metrics, nsamp_r, kp
                     )
-                    return (rng, net, opt), m
+                    return (net, opt), m
 
                 return step
 
             @partial(jax.jit, donate_argnums=(0, 1, 2))
             def block_fn(rng, net, opt, dev_x, dev_y, idx, mask, nsamp, ids,
                          round_idxs):
-                (rng, net, opt), ms = jax.lax.scan(
-                    make_step(dev_x, dev_y), (rng, net, opt),
-                    (idx, mask, nsamp, ids, round_idxs)
+                rng, (khs, kps) = derive_hook_keys(rng, idx.shape[0])
+                (net, opt), ms = jax.lax.scan(
+                    make_step(dev_x, dev_y), (net, opt),
+                    (idx, mask, nsamp, ids, round_idxs, khs, kps)
                 )
                 return rng, net, opt, ms
 
@@ -539,12 +559,13 @@ class FedAvgAPI:
         server_update = self.server_update
         local_update = self.local_update
 
-        def shard_block(net, opt, dev_x, dev_y, idx, mask, nsamp, ids, rounds):
+        def shard_block(net, opt, dev_x, dev_y, idx, mask, nsamp, ids, rounds,
+                        khs, kps):
             # idx/mask/nsamp/ids carry this device's client slice on axis 1:
-            # [R, K/n, ...]; net/opt/rounds are replicated
+            # [R, K/n, ...]; net/opt/rounds/khs/kps are replicated
             def step(carry, inp):
                 net, opt = carry
-                idx_r, mask_r, nsamp_r, ids_r, r = inp
+                idx_r, mask_r, nsamp_r, ids_r, r, kh, kp = inp
                 keys = client_keys(r, ids_r)
                 x, y = _gather_rows(dev_x, dev_y, idx_r, mask_r)
                 net_v = jax.tree.map(
@@ -552,19 +573,28 @@ class FedAvgAPI:
                 nets, metrics = jax.vmap(
                     local_update, in_axes=(0, None, 0, 0, 0))(
                         keys, net_v, x, y, mask_r)
+                if self.client_result_hook is not None:
+                    # same per-device split count as the per-round mesh
+                    # path's shard_body: block ≡ run_round on this mesh
+                    hkeys = jax.random.split(kh, keys.shape[0])
+                    nets = jax.vmap(
+                        lambda n, k: self.client_result_hook(n, net_v, k))(
+                            nets, hkeys)
                 avg, msum = _shard_aggregate(
                     nets, metrics, self._agg_weights(nsamp_r), axis)
                 net, opt = server_update(net, avg, opt)
+                if self.post_aggregate_hook is not None:
+                    net = self.post_aggregate_hook(net, kp)
                 return (net, opt), msum
 
             (net, opt), ms = jax.lax.scan(
-                step, (net, opt), (idx, mask, nsamp, ids, rounds))
+                step, (net, opt), (idx, mask, nsamp, ids, rounds, khs, kps))
             return net, opt, ms
 
         smapped_block = jax.shard_map(
             shard_block,
             in_specs=(P(), P(), P(), P(), P(None, axis), P(None, axis),
-                      P(None, axis), P(None, axis), P()),
+                      P(None, axis), P(None, axis), P(), P(), P()),
             out_specs=(P(), P(), P()),
             **self._smap_kw,
         )
@@ -572,8 +602,10 @@ class FedAvgAPI:
         @partial(jax.jit, donate_argnums=(1, 2))
         def block_fn(rng, net, opt, dev_x, dev_y, idx, mask, nsamp, ids,
                      round_idxs):
+            rng, (khs, kps) = derive_hook_keys(rng, idx.shape[0])
             net, opt, ms = smapped_block(net, opt, dev_x, dev_y,
-                                         idx, mask, nsamp, ids, round_idxs)
+                                         idx, mask, nsamp, ids, round_idxs,
+                                         khs, kps)
             return rng, net, opt, ms
 
         return block_fn
@@ -584,12 +616,6 @@ class FedAvgAPI:
         Returns per-round metrics stacked along axis 0."""
         if not self.device_data:
             raise ValueError("run_rounds needs device_data=True")
-        if self.client_result_hook is not None or self.post_aggregate_hook is not None:
-            # the block threads ONE rng through the scan; hooked engines
-            # would draw different hook keys than sequential run_round calls
-            raise ValueError("run_rounds does not support engines with "
-                             "client_result_hook/post_aggregate_hook; use "
-                             "run_round (key streams would diverge)")
         if not hasattr(self, "_block_fn"):
             self._block_fn = self._build_block_fn()
 

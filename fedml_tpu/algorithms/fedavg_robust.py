@@ -101,6 +101,16 @@ class FedAvgRobustAPI(FedAvgAPI):
             self.accountant.step(self._dp_q, self._dp_z)
         return m
 
+    def run_rounds(self, start_round: int, num_rounds: int):
+        # the scan block applies clip/noise hooks with the pre-derived
+        # sequential key stream (fedavg.py _build_block_fn), so DP rides
+        # the flagship throughput path; the accountant just charges all
+        # the block's rounds at once (q and z are static per engine)
+        ms = super().run_rounds(start_round, num_rounds)
+        if self.accountant is not None:
+            self.accountant.step(self._dp_q, self._dp_z, rounds=num_rounds)
+        return ms
+
     def epsilon(self, delta: float = 1e-5) -> float:
         """Cumulative (ε, δ)-DP spent by the rounds run so far."""
         if self.accountant is None:

@@ -103,10 +103,19 @@ def _load_edge_file(path: str):
 
         try:
             obj = torch.load(path, map_location="cpu", weights_only=True)
-        except Exception:
+        except Exception as e:
             # legacy archives (the reference's ARDIS saves predate
             # weights_only) need full unpickling, which EXECUTES code from
-            # the file — only load archives from a trusted source
+            # the file — an automatic fallback would run exactly the
+            # payloads the safe loader refused, so it requires an explicit
+            # opt-in for archives the operator has vetted
+            if os.environ.get("FEDML_ALLOW_LEGACY_TORCH_LOAD") != "1":
+                raise ValueError(
+                    f"{path}: torch.load(weights_only=True) refused this "
+                    "archive ({!r}). If it is a LEGACY save from a source "
+                    "you trust, set FEDML_ALLOW_LEGACY_TORCH_LOAD=1 to "
+                    "allow full unpickling (which executes code from the "
+                    "file).".format(e)) from e
             import warnings
 
             warnings.warn(

@@ -343,6 +343,47 @@ def test_run_rounds_block_mesh_equals_single_device(lr_data, lr_task, mesh8):
                                    rtol=2e-5, atol=1e-6)
 
 
+def test_run_rounds_block_equals_sequential_with_dp_hooks(lr_data, lr_task,
+                                                          mesh8):
+    """Hooked engines ride the scan block with BIT-EXACT key parity: the
+    block pre-derives each round's hook keys with the same split chain
+    sequential run_round calls draw, so DP-FedAvg (clip client_result_hook
+    + Gaussian post_aggregate_hook — noise is part of the model update!)
+    produces the identical net either way, and the accountant charges the
+    same epsilon. Single-device and over the client mesh."""
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.comm.message import pack_pytree
+
+    cfg = FedAvgConfig(comm_round=4, client_num_in_total=8,
+                       client_num_per_round=4, epochs=1, batch_size=8,
+                       lr=0.1, frequency_of_the_test=100, seed=0)
+    kw = dict(defense_type="dp", norm_bound=5.0, noise_multiplier=0.3,
+              device_data=True)
+    seq = FedAvgRobustAPI(lr_data, lr_task, cfg, **kw)
+    for r in range(4):
+        seq.run_round(r)
+    blk = FedAvgRobustAPI(lr_data, lr_task, cfg, **kw)
+    ms = blk.run_rounds(0, 4)
+    assert ms["count"].shape == (4,)
+    for a, b in zip(pack_pytree(seq.net), pack_pytree(blk.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(seq.epsilon(1e-5), blk.epsilon(1e-5),
+                               rtol=1e-12)
+
+    # mesh block ≡ mesh sequential (the mesh per-round path is itself the
+    # hook oracle here: same per-device key splits, psum aggregation)
+    cfg_m = dataclasses.replace(cfg, client_num_per_round=8)
+    seq_m = FedAvgRobustAPI(lr_data, lr_task, cfg_m, mesh=mesh8, **kw)
+    for r in range(3):
+        seq_m.run_round(r)
+    blk_m = FedAvgRobustAPI(lr_data, lr_task, cfg_m, mesh=mesh8, **kw)
+    blk_m.run_rounds(0, 3)
+    for a, b in zip(pack_pytree(seq_m.net), pack_pytree(blk_m.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
 def test_run_rounds_working_set_equals_full_park(lr_data, lr_task, mesh8):
     """block_working_set uploads only the block's unique rows (remapped
     indices, bucket-padded) — the trained model must be bit-identical to the
