@@ -158,6 +158,13 @@ class FedAvgConfig:
     # aggregate (the FedAvg paper's alternative scheme — both are
     # unbiased; size-weighting concentrates rounds on data-rich clients)
     sampling: str = "uniform"
+    # per-client eval inside train() (reference _local_test_on_all_clients,
+    # fedavg_api.py:117-180: every eval round the CURRENT global model is
+    # scored on EVERY client's own train and test split, aggregated by
+    # sample count). 'auto': on exactly when the dataset has per-client
+    # test splits (natural partitions — where the weighting differs from a
+    # shared global test set); 'on'/'off' force it.
+    local_test_on_all_clients: str = "auto"
 
 
 def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
@@ -708,6 +715,50 @@ class FedAvgAPI:
             )
         return metrics
 
+    def _eval_on_all_clients(self) -> bool:
+        mode = getattr(self.cfg, "local_test_on_all_clients", "auto")
+        if mode == "auto":
+            # natural per-client test splits AND no validation-subset cap:
+            # when eval_max_samples is configured (the reference's 10k
+            # stackoverflow validation set, FedAVGAggregator.py:99-107) the
+            # capped global eval wins — iterating every client's full split
+            # is exactly what that cap exists to avoid at 342k-client scale
+            return (self.data.test_idx_map is not None
+                    and self.cfg.eval_max_samples is None)
+        if mode in ("on", "off"):
+            return mode == "on"
+        raise ValueError(f"local_test_on_all_clients={mode!r} "
+                         "(expected 'auto', 'on' or 'off')")
+
+    def eval_record(self, round_idx: int, metrics) -> dict:
+        """Assemble one eval-round history record for the current model:
+        in-round training metrics plus either the per-client aggregate
+        (reference _local_test_on_all_clients, fedavg_api.py:117-180 —
+        the global model scored on every client's OWN train and test split,
+        sum(num_correct)/sum(num_samples) weighting) or the global test-set
+        eval. Shared by train() and the CLI round loop so the metrics
+        schema cannot drift between them."""
+        n = float(max(float(metrics.get("count", 1.0)), 1.0))
+        rec = {
+            "round": round_idx,
+            "train_loss": float(metrics.get("loss_sum", 0.0)) / n,
+            "train_acc": float(metrics.get("correct", 0.0)) / n,
+        }
+        with self.tracer.span("eval"):
+            if self._eval_on_all_clients():
+                _, tr = self.evaluate_per_client("train")
+                _, te = self.evaluate_per_client("test")
+                rec.update(
+                    train_all_loss=float(tr["loss"]),
+                    train_all_acc=float(tr["acc"]),
+                    test_loss=float(te["loss"]), test_acc=float(te["acc"]),
+                )
+            else:
+                ev = self.evaluate()
+                rec.update(test_loss=float(ev["loss"]),
+                           test_acc=float(ev["acc"]))
+        return rec
+
     def train(self, num_rounds: int | None = None):
         cfg = self.cfg
         rounds = num_rounds or cfg.comm_round
@@ -715,17 +766,8 @@ class FedAvgAPI:
             t0 = time.perf_counter()
             metrics = self.run_round(r)
             if (r % cfg.frequency_of_the_test == 0) or (r == rounds - 1):
-                with self.tracer.span("eval"):
-                    ev = self.evaluate()
-                n = float(max(metrics["count"], 1.0))
-                rec = {
-                    "round": r,
-                    "train_loss": float(metrics["loss_sum"]) / n,
-                    "train_acc": float(metrics["correct"]) / n,
-                    "test_loss": float(ev["loss"]),
-                    "test_acc": float(ev["acc"]),
-                    "round_time": time.perf_counter() - t0,
-                }
+                rec = self.eval_record(r, metrics)
+                rec["round_time"] = time.perf_counter() - t0
                 self.history.append(rec)
                 log.info("round %d: %s", r, rec)
             self.tracer.next_round()

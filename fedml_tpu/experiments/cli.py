@@ -35,7 +35,10 @@ def add_args(parser: argparse.ArgumentParser):
                                  "fedseg", "split_nn", "fedgkt", "vfl"])
     parser.add_argument("--model", type=str, default="lr")
     parser.add_argument("--dataset", type=str, default="mnist")
-    parser.add_argument("--data_dir", type=str, default=None)
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="real-dataset directory (fetch + layout: "
+                             "scripts/download_<dataset>.sh); absent files "
+                             "fall back to shape-identical synthetic data")
     parser.add_argument("--image_size", type=int, default=None,
                         help="square decode resolution for the folder/csv "
                              "image readers (imagenet/gld): 224 = reference "
@@ -570,16 +573,21 @@ def main(argv=None):
                     trace_ctx = None
                 metrics = api.run_round(r)
                 if r % args.frequency_of_the_test == 0 or r == args.comm_round - 1:
-                    ev = api.evaluate() if hasattr(api, "evaluate") else {}
-                    if isinstance(ev, (int, float)):  # FedGKT returns a bare acc
-                        ev = {"acc": float(ev), "loss": 0.0}
-                    n = float(max(float(metrics.get("count", 1)), 1))
-                    rec = {"round": r,
-                           "train_loss": float(metrics.get("loss_sum", 0)) / n,
-                           "train_acc": float(metrics.get("correct", 0)) / n}
-                    if ev:
-                        rec["test_acc"] = float(ev["acc"])
-                        rec["test_loss"] = float(ev["loss"])
+                    if hasattr(api, "eval_record"):
+                        # FedAvg-family engines: the shared record assembler
+                        # (per-client aggregate on natural partitions)
+                        rec = api.eval_record(r, metrics)
+                    else:
+                        ev = api.evaluate() if hasattr(api, "evaluate") else {}
+                        if isinstance(ev, (int, float)):  # FedGKT: bare acc
+                            ev = {"acc": float(ev), "loss": 0.0}
+                        n = float(max(float(metrics.get("count", 1)), 1))
+                        rec = {"round": r,
+                               "train_loss": float(metrics.get("loss_sum", 0)) / n,
+                               "train_acc": float(metrics.get("correct", 0)) / n}
+                        if ev:
+                            rec["test_acc"] = float(ev["acc"])
+                            rec["test_loss"] = float(ev["loss"])
                     if getattr(api, "_poisoned", None) is not None:
                         rec["backdoor_acc"] = float(
                             api.evaluate_backdoor()["acc"])

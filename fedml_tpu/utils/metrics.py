@@ -95,10 +95,45 @@ class RunLogger:
         if self._wandb is not None:
             self._wandb.log(metrics, step=step)
 
+    # reference metric names (FedAVGAggregator.py:136-162 wandb.log keys).
+    # Train/Acc is the all-clients aggregate of the CURRENT model on train
+    # splits (_local_test_on_all_clients) — train_all_* when the run produced
+    # it, else the in-round sampled-client training metric as the closest
+    # available analogue (listed later so the per-client aggregate wins).
+    _WANDB_KEYS = (
+        ("train_acc", "Train/Acc"), ("train_loss", "Train/Loss"),
+        ("train_all_acc", "Train/Acc"), ("train_all_loss", "Train/Loss"),
+        ("test_acc", "Test/Acc"), ("test_loss", "Test/Loss"),
+        ("round", "round"),
+    )
+
+    def _wandb_summary(self) -> dict:
+        out = dict(self.summary)
+        for src, dst in self._WANDB_KEYS:
+            if src in self.summary:
+                out[dst] = self.summary[src]
+        return out
+
     def finish(self):
-        """Write the summary file (wandb-summary.json analogue)."""
+        """Write the summary files: ``summary.json`` (raw keys) and a
+        wandb-interop ``wandb-summary.json`` with the reference's metric
+        names, also linked at ``<run_dir>/latest-run/files/wandb-summary.json``
+        — the exact path shape the reference CI consumes
+        (``wandb/latest-run/files/wandb-summary.json``,
+        CI-script-fedavg.sh:42-46), so tooling written against the reference
+        can point its ``wandb`` dir at ``run_dir`` unchanged."""
         with open(os.path.join(self.dir, "summary.json"), "w") as f:
             json.dump(self.summary, f, indent=2, default=float)
+        wandb_summary = self._wandb_summary()
+        with open(os.path.join(self.dir, "wandb-summary.json"), "w") as f:
+            json.dump(wandb_summary, f, indent=2, default=float)
+        latest = os.path.join(os.path.dirname(self.dir), "latest-run", "files")
+        try:
+            os.makedirs(latest, exist_ok=True)
+            with open(os.path.join(latest, "wandb-summary.json"), "w") as f:
+                json.dump(wandb_summary, f, indent=2, default=float)
+        except OSError:
+            pass  # read-only run_dir parent: the per-run copy above suffices
         self._f.close()
         if self._wandb is not None:
             self._wandb.finish()

@@ -1,0 +1,4 @@
+#!/usr/bin/env bash
+# Reference analogue of data/*/download_*.sh (CI-install.sh:43-85); see
+# download_data.sh for the layout the fedml_tpu readers expect.
+exec "$(dirname "$0")/download_data.sh" cinic10 "$@"

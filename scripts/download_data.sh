@@ -1,0 +1,107 @@
+#!/usr/bin/env bash
+# Dataset fetcher — the analogue of the reference's per-dataset
+# data/<ds>/download_*.sh scripts wired into CI-install.sh:43-85.
+#
+#   scripts/download_data.sh <dataset> [target_dir]
+#
+# Downloads into <target_dir> (default ./data/<dataset>) and arranges the
+# on-disk layout the fedml_tpu readers expect (fedml_tpu/data/files.py).
+# Point the CLI at it with:  --dataset <ds> --data_dir <target_dir>
+# When files are absent the loaders fall back to shape-identical synthetic
+# data, so nothing below is required to RUN the framework — only for
+# real-data fidelity. This box has zero egress; run these where the network
+# exists, then ship the directory.
+#
+# Layouts consumed by the readers (fedml_tpu/data/files.py):
+#   mnist            train/*.json + test/*.json        (LEAF power-law json)
+#   femnist          fed_emnist_train.h5 + _test.h5    (TFF: examples/<cid>/pixels|label)
+#   shakespeare      train/*.json + test/*.json        (LEAF)
+#   fed_shakespeare  shakespeare_train.h5 + _test.h5   (TFF: snippets)
+#   fed_cifar100     fed_cifar100_train.h5 + _test.h5  (TFF: image|coarse_label|label)
+#   stackoverflow_*  stackoverflow_train.h5 (+ vocab side files)
+#   cifar10/cifar100 data_batch_* / train + test       (python pickles)
+#   cinic10          {train,valid,test}/<class>/*.png  (imagefolder)
+#   svhn             train_32x32.mat + test_32x32.mat
+#   imagenet         {train,val}/<wnid>/*.JPEG         (ILSVRC folders)
+#   gld23k/gld160k   *train*.csv + *test*.csv + images/<image_id>.jpg
+#   edge_case        southwest/ardis/greencar pickles  (data/poisoning.py)
+set -euo pipefail
+DS="${1:?usage: download_data.sh <dataset> [target_dir]}"
+DIR="${2:-./data/$DS}"
+mkdir -p "$DIR"; cd "$DIR"
+fetch() { # fetch <url> [out]
+  local url="$1" out="${2:-$(basename "$1")}"
+  echo ">> $url -> $DIR/$out"
+  curl -fL --retry 3 -o "$out" "$url"
+}
+gdrive() { # gdrive <file_id> <out> — Google Drive big-file confirm dance
+  local id="$1" out="$2"
+  echo ">> gdrive:$id -> $DIR/$out"
+  curl -fL --retry 3 -c /tmp/gd_cookies -o /tmp/gd_probe \
+    "https://docs.google.com/uc?export=download&id=$id"
+  local confirm
+  confirm=$(sed -rn 's/.*confirm=([0-9A-Za-z_]+).*/\1/p' /tmp/gd_probe | head -1)
+  curl -fL --retry 3 -b /tmp/gd_cookies -o "$out" \
+    "https://docs.google.com/uc?export=download&confirm=${confirm}&id=$id"
+  rm -f /tmp/gd_cookies /tmp/gd_probe
+}
+
+case "$DS" in
+  mnist)  # LEAF MNIST, power-law partition over 1000 writers
+    gdrive 1cU_LcBAUZvfZWveOMhG4G5Fg9uFXhVdf MNIST.zip
+    unzip -o MNIST.zip && mv -f mnist/train train && mv -f mnist/test test
+    rm -rf mnist MNIST.zip ;;
+  femnist)  # TFF Federated-EMNIST h5 (3400 writers)
+    fetch https://fedml.s3-us-west-1.amazonaws.com/fed_emnist.tar.bz2
+    tar -xjf fed_emnist.tar.bz2 && rm -f fed_emnist.tar.bz2 ;;
+  shakespeare)  # LEAF shakespeare json
+    mkdir -p train test
+    gdrive 1mD6_4ju7n2WFAahMKDtozaGxUASaHAPH train/all_data_niid_2_keep_0_train_8.json
+    gdrive 1GERQ9qEJjXk_0FXnw1JbjuGCI-zmmfsk test/all_data_niid_2_keep_0_test_8.json ;;
+  fed_shakespeare)  # TFF shakespeare h5 (715 speakers)
+    fetch https://fedml.s3-us-west-1.amazonaws.com/shakespeare.tar.bz2
+    tar -xjf shakespeare.tar.bz2 && rm -f shakespeare.tar.bz2 ;;
+  fed_cifar100)  # TFF CIFAR-100 h5 (500 clients, Pachinko partition)
+    fetch https://fedml.s3-us-west-1.amazonaws.com/fed_cifar100.tar.bz2
+    tar -xjf fed_cifar100.tar.bz2 && rm -f fed_cifar100.tar.bz2 ;;
+  stackoverflow)  # TFF stackoverflow h5 + LR/NWP vocab side files (342k users)
+    fetch https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tar.bz2
+    fetch https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.tag_count.tar.bz2
+    fetch https://fedml.s3-us-west-1.amazonaws.com/stackoverflow.word_count.tar.bz2
+    for f in stackoverflow.tar.bz2 stackoverflow.tag_count.tar.bz2 \
+             stackoverflow.word_count.tar.bz2; do tar -xjf "$f" && rm -f "$f"; done ;;
+  cifar10)
+    fetch https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz
+    tar -xzf cifar-10-python.tar.gz --strip-components=1 && rm -f cifar-10-python.tar.gz ;;
+  cifar100)
+    fetch https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz
+    tar -xzf cifar-100-python.tar.gz --strip-components=1 && rm -f cifar-100-python.tar.gz ;;
+  cinic10)
+    fetch https://datashare.is.ed.ac.uk/bitstream/handle/10283/3192/CINIC-10.tar.gz CINIC-10.tar.gz
+    tar -xzf CINIC-10.tar.gz && rm -f CINIC-10.tar.gz ;;
+  svhn)
+    fetch http://ufldl.stanford.edu/housenumbers/train_32x32.mat
+    fetch http://ufldl.stanford.edu/housenumbers/test_32x32.mat ;;
+  gld23k|gld160k)  # Google Landmarks federated split
+    fetch https://fedcv.s3-us-west-1.amazonaws.com/landmark/data_user_dict.zip
+    fetch https://fedcv.s3-us-west-1.amazonaws.com/landmark/images.zip
+    unzip -o data_user_dict.zip && unzip -o images.zip
+    rm -f data_user_dict.zip images.zip ;;
+  edge_case)  # poisoned/backdoor archives (southwest, ARDIS, green-car)
+    fetch http://pages.cs.wisc.edu/~hongyiwang/edge_case_attack/edge_case_examples.zip
+    unzip -o edge_case_examples.zip && rm -f edge_case_examples.zip
+    echo "NOTE: these pickles execute code when loaded — fedml_tpu loads"
+    echo "them with weights_only first and warns on fallback (data/poisoning.py)." ;;
+  imagenet)
+    echo "ImageNet ILSVRC2012 requires registration: https://image-net.org/download"
+    echo "Arrange as $DIR/{train,val}/<wnid>/*.JPEG and pass --data_dir $DIR"; exit 2 ;;
+  nuswide|lending_club|uci)
+    echo "Vertical-FL tabular sources are manual-license downloads:"
+    echo "  NUS-WIDE: https://lms.comp.nus.edu.sg/wp-content/uploads/2019/research/nuswide/NUS-WIDE.html"
+    echo "  lending_club: https://www.kaggle.com/datasets/wordsforthewise/lending-club"
+    echo "  UCI susy/higgs: https://archive.ics.uci.edu/ml/datasets/SUSY"
+    echo "Drop the csv files under $DIR (fedml_tpu/data/tabular.py documents columns)."; exit 2 ;;
+  *)
+    echo "unknown dataset '$DS'"; exit 1 ;;
+esac
+echo "OK: $DS ready under $DIR"

@@ -207,6 +207,44 @@ def test_imagenet_folder_reader_no_val_and_caps(tmp_path):
     assert len(fd.test_x) == 2  # every 5th of 10 held out
 
 
+def test_landmarks_csv_reader(tmp_path):
+    """Google Landmarks (gld23k/gld160k) on-disk format: a train csv with
+    user_id/image_id/class columns (data_loader.py:133) mapping into
+    images/<image_id>.jpg; users become clients in csv order, the test csv
+    feeds the test split, and missing image files are skipped."""
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.RandomState(2)
+    img_dir = tmp_path / "images"
+    img_dir.mkdir()
+    rows = [("u_alice", "img_a0", 0), ("u_alice", "img_a1", 1),
+            ("u_bob", "img_b0", 2), ("u_bob", "img_b1", 0),
+            ("u_bob", "img_missing", 1)]  # no file on disk -> skipped
+    for _u, iid, _c in rows[:4]:
+        Image.fromarray(rng.randint(0, 255, (50, 70, 3), np.uint8)).save(
+            img_dir / f"{iid}.jpg")
+    with open(tmp_path / "federated_train.csv", "w") as f:
+        f.write("user_id,image_id,class\n")
+        f.writelines(f"{u},{i},{c}\n" for u, i, c in rows)
+    Image.fromarray(rng.randint(0, 255, (30, 30, 3), np.uint8)).save(
+        img_dir / "img_t0.jpg")
+    with open(tmp_path / "test.csv", "w") as f:
+        f.write("user_id,image_id,class\nu_eve,img_t0,2\n")
+
+    from fedml_tpu.data.registry import load_dataset
+
+    fd = load_dataset("gld23k", data_dir=str(tmp_path), client_num=5,
+                      image_size=32)
+    assert fd.train_x.shape == (4, 32, 32, 3) and fd.train_x.max() <= 1.0
+    assert len(fd.train_idx_map) == 2  # two users with surviving images
+    # csv order preserved: client 0 = u_alice (2 imgs), client 1 = u_bob
+    # (2 imgs; the missing one skipped)
+    assert [len(fd.train_idx_map[k]) for k in (0, 1)] == [2, 2]
+    np.testing.assert_array_equal(fd.train_y, [0, 1, 2, 0])
+    assert fd.test_x.shape == (1, 32, 32, 3) and fd.test_y.tolist() == [2]
+
+
 def test_cinic10_folder_reader(tmp_path):
     """CINIC-10 imagefolder layout ({train,valid,test}/<class>/*.png):
     valid merges into train (the reference's enlarged split), test read

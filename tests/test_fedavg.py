@@ -264,6 +264,51 @@ def test_evaluate_per_client_matches_global():
     assert len(pc_train) == 3 and agg_train["count"] > 0
 
 
+def test_train_uses_per_client_eval_on_natural_partitions(lr_task):
+    """train()'s eval-round history must carry the per-client aggregate on
+    naturally-partitioned datasets — the reference scores the global model on
+    EVERY client's own split each eval round and aggregates by sample count
+    (_local_test_on_all_clients, fedavg_api.py:117-180) — and fall back to
+    global eval when forced 'off'."""
+    data = synthetic_lr(num_clients=6, dim=12, num_classes=4, seed=3)
+    assert data.test_idx_map is not None  # natural per-client test splits
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=6,
+                       client_num_per_round=6, epochs=1, batch_size=32,
+                       lr=0.1, seed=0, frequency_of_the_test=100)
+    api = FedAvgAPI(data, lr_task, cfg)
+    api.train()
+
+    rec = api.history[-1]
+    # per-client keys present, pinned to the evaluate_per_client aggregate
+    # computed on the final model
+    _, te = api.evaluate_per_client("test")
+    _, tr = api.evaluate_per_client("train")
+    np.testing.assert_allclose(rec["test_acc"], te["acc"], atol=1e-6)
+    np.testing.assert_allclose(rec["test_loss"], te["loss"], rtol=1e-5)
+    np.testing.assert_allclose(rec["train_all_acc"], tr["acc"], atol=1e-6)
+    np.testing.assert_allclose(rec["train_all_loss"], tr["loss"], rtol=1e-5)
+
+    # a validation-subset cap disables the auto path (the reference's 10k
+    # stackoverflow validation set replaces the all-clients loop,
+    # FedAVGAggregator.py:99-107) — 'on' still forces it
+    api_cap = FedAvgAPI(data, lr_task,
+                        dataclasses.replace(cfg, eval_max_samples=16))
+    assert not api_cap._eval_on_all_clients()
+    api_forced = FedAvgAPI(data, lr_task,
+                           dataclasses.replace(cfg, eval_max_samples=16,
+                                               local_test_on_all_clients="on"))
+    assert api_forced._eval_on_all_clients()
+
+    # forced off: history reverts to the global-test-set eval
+    api_off = FedAvgAPI(data, lr_task,
+                        dataclasses.replace(cfg, local_test_on_all_clients="off"))
+    api_off.train()
+    ev = api_off.evaluate()
+    rec_off = api_off.history[-1]
+    assert "train_all_acc" not in rec_off
+    np.testing.assert_allclose(rec_off["test_acc"], float(ev["acc"]), atol=1e-6)
+
+
 def test_eval_max_samples_subset():
     """eval_max_samples caps global eval to a seeded subset — the reference's
     10k stackoverflow validation set (FedAVGAggregator.py:99-107)."""

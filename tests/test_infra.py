@@ -148,6 +148,24 @@ def test_run_logger(tmp_path):
     assert json.load(open(os.path.join(d, "config.json")))["lr"] == 0.1
 
 
+def test_run_logger_wandb_summary(tmp_path):
+    """finish() emits the reference CI's summary-file interface: the
+    reference reads Train/Acc from wandb/latest-run/files/wandb-summary.json
+    (CI-script-fedavg.sh:42-46); the per-client aggregate (train_all_*) must
+    win over the in-round sampled metric when both were logged."""
+    rl = RunLogger(str(tmp_path), "t2")
+    rl.log({"train_acc": 0.4, "train_all_acc": 0.55, "test_acc": 0.6,
+            "round": 3}, step=3)
+    rl.finish()
+    for p in (os.path.join(str(tmp_path), "t2", "wandb-summary.json"),
+              os.path.join(str(tmp_path), "latest-run", "files",
+                           "wandb-summary.json")):
+        ws = json.load(open(p))
+        assert ws["Train/Acc"] == 0.55  # per-client aggregate, not in-round
+        assert ws["Test/Acc"] == 0.6 and ws["round"] == 3
+        assert ws["train_acc"] == 0.4  # raw keys preserved alongside
+
+
 def test_centralized_trainer_learns():
     data = synthetic_lr(num_clients=4, dim=12, num_classes=3, seed=0)
     task = classification_task(LogisticRegression(num_classes=3))
