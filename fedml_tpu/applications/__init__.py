@@ -1,0 +1,8 @@
+"""Application layers on top of the core framework.
+
+The reference ships applications as separate repos pointed at by stub
+READMEs (applications/FedNLP/README.md is a 1-line URL). Here the worked
+equivalents live in-tree: fednlp (federated text classification /
+language modeling over HuggingFace Flax transformers and the native
+TransformerLM).
+"""

@@ -1,0 +1,170 @@
+"""FedNLP: federated NLP fine-tuning on the fedml_tpu engine.
+
+The reference's applications/FedNLP is a pointer README to the external
+FedNLP repo (applications/FedNLP/README.md), whose core workload is
+federated fine-tuning of transformer text classifiers over naturally
+non-IID text. This module is the in-tree equivalent, TPU-first:
+
+- ``hf_text_classification_task``: wraps any HuggingFace **Flax**
+  sequence-classification model (e.g. FlaxBertForSequenceClassification)
+  into the framework's pure ``Task`` bundle, so the whole FedAvg engine —
+  vmapped local fits, scanned round blocks, client-parallel meshes,
+  DP/robust hooks — applies to transformer fine-tuning unchanged. The
+  model's forward runs under jit like every other task; HBM-heavy configs
+  compose with ``FedAvgConfig(remat=True)``.
+- ``synthetic_text_classification``: class-conditional token-sequence
+  generator (Dirichlet label skew across clients — the FedNLP paper's
+  non-IID axis) used as the zero-egress stand-in; the real-data path is
+  the same Task with HF-tokenized 20news/agnews arrays.
+
+Offline by construction: models are built from a config (random init).
+Where a network exists, ``from_pretrained`` weights drop into the same
+``NetState.params`` slot — nothing else changes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from fedml_tpu.core.client_data import FederatedData
+from fedml_tpu.core.local import NetState, Task
+
+
+def hf_text_classification_task(model, pad_id: int = 0) -> Task:
+    """Task over a HuggingFace Flax *ForSequenceClassification model.
+
+    x: [bs, seq] int token ids (pad_id-padded), y: [bs] int labels,
+    mask: [bs] sample validity. The attention mask derives from pad_id on
+    device. ``model`` is the HF wrapper (has .module and .params); its
+    dropout rng collection is threaded from the engine's per-client keys.
+    """
+    import inspect
+
+    import jax.numpy as jnp
+    import optax
+
+    module = model.module
+    # HF Flax module signatures differ per family (BERT takes
+    # token_type_ids/position_ids/head_mask, DistilBERT does not, RoBERTa
+    # offsets positions past the pad id) — bind by NAME against the
+    # module's own __call__ so any *ForSequenceClassification family works
+    _accepts = set(inspect.signature(type(module).__call__).parameters)
+    _roberta_style = "roberta" in type(module).__name__.lower()
+
+    def _logits(params, x, rng, train):
+        attn = (x != pad_id).astype(jnp.int32)
+        kwargs = {"attention_mask": attn, "deterministic": not train}
+        if "token_type_ids" in _accepts:
+            kwargs["token_type_ids"] = jnp.zeros_like(x)
+        if "position_ids" in _accepts:
+            if _roberta_style:
+                # RoBERTa numbering: pad positions stay at padding_idx,
+                # real tokens count up from padding_idx + 1
+                kwargs["position_ids"] = jnp.cumsum(attn, -1) * attn + pad_id
+            else:
+                kwargs["position_ids"] = jnp.broadcast_to(
+                    jnp.arange(x.shape[-1]), x.shape)
+        if "head_mask" in _accepts:
+            kwargs["head_mask"] = None
+        kwargs = {k: v for k, v in kwargs.items() if k in _accepts}
+        rngs = {"dropout": rng} if train else {}
+        out = module.apply({"params": params}, x, rngs=rngs, **kwargs)
+        return out.logits if hasattr(out, "logits") else out[0]
+
+    def init(rng, x_sample):
+        del rng, x_sample  # HF materializes params at construction (seed=)
+        return NetState(model.params, {})
+
+    def loss(params, extra, x, y, mask, rng, train):
+        logits = _logits(params, x, rng, train)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        l = jnp.sum(per_ex * mask) / n
+        metrics = {
+            "loss_sum": jnp.sum(per_ex * mask),
+            "correct": jnp.sum((jnp.argmax(logits, -1) == y) * mask),
+            "count": jnp.sum(mask),
+        }
+        return l, extra, metrics
+
+    def predict(params, extra, x):
+        del extra
+        return _logits(params, x, rng=None, train=False)
+
+    def eval_batch(params, extra, x, y, mask):
+        logits = _logits(params, x, rng=None, train=False)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return {
+            "loss_sum": jnp.sum(per_ex * mask),
+            "correct": jnp.sum((jnp.argmax(logits, -1) == y) * mask),
+            "count": jnp.sum(mask),
+        }
+
+    return Task(init, loss, predict, eval_batch)
+
+
+def synthetic_text_classification(
+    num_clients: int,
+    num_classes: int = 4,
+    vocab_size: int = 200,
+    seq_len: int = 32,
+    samples_per_client: int = 24,
+    test_samples: int = 128,
+    partition_alpha: float = 0.5,
+    pad_id: int = 0,
+    seed: int = 0,
+) -> FederatedData:
+    """Class-conditional token sequences with Dirichlet label skew.
+
+    Each class owns a band of the vocabulary; a document is tokens drawn
+    mostly from its class band plus uniform noise and a random pad tail —
+    learnable by any sequence classifier, deterministic per seed, and
+    non-IID across clients the way FedNLP partitions real corpora
+    (label-Dirichlet over clients).
+    """
+    rng = np.random.RandomState(seed)
+    band = (vocab_size - 1) // num_classes
+
+    def draw(label: int, n: int) -> np.ndarray:
+        lo = 1 + label * band
+        toks = rng.randint(lo, lo + band, (n, seq_len))
+        noise = rng.randint(1, vocab_size, (n, seq_len))
+        keep = rng.rand(n, seq_len) < 0.7
+        toks = np.where(keep, toks, noise)
+        lengths = rng.randint(seq_len // 2, seq_len + 1, n)
+        toks[np.arange(seq_len)[None, :] >= lengths[:, None]] = pad_id
+        return toks.astype(np.int32)
+
+    xs, ys, idx_map, off = [], [], {}, 0
+    for k in range(num_clients):
+        mix = rng.dirichlet(np.repeat(partition_alpha, num_classes))
+        labels = rng.choice(num_classes, samples_per_client, p=mix)
+        for c in labels:
+            xs.append(draw(int(c), 1))
+        ys.append(labels)
+        idx_map[k] = np.arange(off, off + samples_per_client)
+        off += samples_per_client
+    ty = rng.choice(num_classes, test_samples)
+    tx = np.concatenate([draw(int(c), 1) for c in ty])
+    return FederatedData(
+        train_x=np.concatenate(xs), train_y=np.concatenate(ys).astype(np.int64),
+        test_x=tx, test_y=ty.astype(np.int64),
+        train_idx_map=idx_map, test_idx_map=None, class_num=num_classes,
+    )
+
+
+def tiny_bert_classifier(num_classes: int, vocab_size: int = 200,
+                         seq_len: int = 32, seed: int = 0):
+    """A BERT-tiny-shaped FlaxBertForSequenceClassification built offline
+    from a config (random init — no hub download). Swap in
+    ``FlaxBertForSequenceClassification.from_pretrained(...)`` where a
+    network exists; the Task is identical."""
+    from transformers import BertConfig, FlaxBertForSequenceClassification
+
+    cfg = BertConfig(
+        vocab_size=vocab_size, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=128,
+        max_position_embeddings=seq_len, num_labels=num_classes,
+        pad_token_id=0,
+    )
+    return FlaxBertForSequenceClassification(cfg, seed=seed)

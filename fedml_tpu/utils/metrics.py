@@ -30,6 +30,14 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         cache_dir = cache_dir or os.environ.get(
             "FEDML_COMPILE_CACHE",
             os.path.expanduser("~/.cache/fedml_tpu_xla"))
+        # per-platform subdirectory: entries written through a REMOTE
+        # compile service (e.g. a TPU relay) can carry host-feature flags
+        # the local CPU rejects — sharing one dir makes every CPU child
+        # iterate and discard them (slow startup + AOT-loader error spam).
+        # JAX_PLATFORMS is readable without initializing any backend.
+        platform = (os.environ.get("JAX_PLATFORMS") or "default").split(",")[0]
+        cache_dir = os.path.join(
+            cache_dir, "".join(c if c.isalnum() else "_" for c in platform))
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception as e:  # noqa: BLE001
