@@ -449,7 +449,7 @@ def main() -> None:
         # sensible budget; the per-round number is the honest result
         if cheap is None:
             raise RuntimeError("bench: all measurement paths failed")
-        print(json.dumps(cheap))
+        _emit(cheap)
         return
     if rc == 124 and on_accel:
         # whatever the last per-round child salvaged, a SIGKILLed-on-timeout
@@ -475,7 +475,43 @@ def main() -> None:
         best = _last_json_line(out)
     if best is None:
         raise RuntimeError("bench: all measurement paths failed")
+    _emit(best)
+
+
+def _emit(best: dict) -> None:
+    """Print the ONE authoritative JSON line. A degraded (CPU) liveness
+    number must not read as "no TPU evidence exists": it carries a pointer
+    to the newest committed real-TPU measurement when one is on disk."""
+    if best.get("platform") != "tpu":
+        ref = _last_recorded_tpu_result()
+        if ref is not None:
+            best["last_recorded_tpu"] = ref
     print(json.dumps(best))
+
+
+def _last_recorded_tpu_result(base: str | None = None) -> dict | None:
+    """Newest committed real-TPU bench line under runs/bench_tpu_*/.
+
+    "Newest" by descending path (round dirs then attempt names — git does
+    not preserve mtimes, so a fresh clone would make mtime order
+    arbitrary; `attempt_clean` deliberately sorts after `attempt1`).
+    ``FEDML_BENCH_TPU_EVIDENCE_DIR`` overrides the search root (tests)."""
+    import glob
+
+    base = (base or os.environ.get("FEDML_BENCH_TPU_EVIDENCE_DIR")
+            or os.path.dirname(os.path.abspath(__file__)))
+    logs = sorted(glob.glob(os.path.join(base, "runs", "bench_tpu_*",
+                                         "*.stdout.log")), reverse=True)
+    for p in logs:
+        try:
+            with open(p, errors="replace") as f:
+                rec = _last_json_line(f.read())
+        except OSError:
+            continue
+        if rec and rec.get("platform") == "tpu":
+            rec["source"] = os.path.relpath(p, base)
+            return rec
+    return None
 
 
 if __name__ == "__main__":

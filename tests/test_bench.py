@@ -138,6 +138,32 @@ def test_main_falls_back_to_stashed_per_round(monkeypatch, capsys):
     assert rec["mode"] == "per_round"
 
 
+def test_cpu_result_carries_last_recorded_tpu(monkeypatch, capsys, tmp_path):
+    """When the pool refuses and the final result is a CPU fallback, the
+    JSON must point at the newest committed real-TPU measurement (a
+    degraded liveness number must not read as 'no TPU evidence');
+     'newest' = descending path order (git does not preserve mtimes)."""
+    bench = _import_bench()
+    for d, name, val in (("bench_tpu_r3", "attempt1", 7.0),
+                         ("bench_tpu_r4", "attempt1", 11.0),
+                         ("bench_tpu_r4", "attempt_clean", 12.0)):
+        p = tmp_path / "runs" / d
+        p.mkdir(parents=True, exist_ok=True)
+        (p / f"{name}.stdout.log").write_text(json.dumps(
+            {"value": val, "platform": "tpu"}) + "\n")
+    ref = bench._last_recorded_tpu_result(base=str(tmp_path))
+    assert ref["value"] == 12.0  # r4 beats r3; attempt_clean beats attempt1
+    assert ref["source"].endswith("attempt_clean.stdout.log")
+
+    monkeypatch.setenv("FEDML_BENCH_TPU_EVIDENCE_DIR", str(tmp_path))
+    # classic path AND the low-core early-emit path both annotate
+    for cores in (8, 1):
+        rec = _run_main(monkeypatch, capsys, block_rc=0, cores=cores)
+        assert rec["last_recorded_tpu"]["value"] == 12.0, cores
+    # and a genuine TPU result carries no such pointer
+    assert "last_recorded_tpu" not in bench._result(5.0, "block", 1.0, 1, "tpu")
+
+
 def test_main_raises_when_everything_fails(monkeypatch, capsys):
     with pytest.raises(RuntimeError):
         _run_main(monkeypatch, capsys, block_rc=1, cheap_rc=1)
