@@ -66,6 +66,13 @@ def add_args(parser: argparse.ArgumentParser):
                              "'fresh' resamples per eval (reference "
                              "FedAVGAggregator semantics), 'fixed' reuses one "
                              "seeded subset")
+    parser.add_argument("--local_test_on_all_clients", type=str,
+                        default="auto", choices=["auto", "on", "off"],
+                        help="per-client eval each eval round (the "
+                             "reference's _local_test_on_all_clients, "
+                             "fedavg_api.py:117-180); 'auto' = on exactly "
+                             "when the dataset has per-client test splits "
+                             "and no validation-subset cap")
     # TPU execution surface (replaces --backend/--gpu_mapping/--is_mobile)
     parser.add_argument("--mesh", type=int, default=0,
                         help="devices on the 'clients' mesh axis; 0 = "
@@ -289,6 +296,7 @@ def build_api(args):
                           else None),
         eval_subset_mode=args.eval_subset_mode,
         sampling=args.sampling,
+        local_test_on_all_clients=args.local_test_on_all_clients,
     )
     if args.algo == "fedavg_seq":
         from fedml_tpu.algorithms.fedavg_seq import FedAvgSeqAPI
