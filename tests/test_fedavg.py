@@ -309,6 +309,23 @@ def test_train_uses_per_client_eval_on_natural_partitions(lr_task):
     np.testing.assert_allclose(rec_off["test_acc"], float(ev["acc"]), atol=1e-6)
 
 
+def test_train_per_client_eval_under_mesh(lr_task, mesh8):
+    """The per-client eval path also runs against a mesh engine (params
+    replicated over the 'clients' axis) and matches the single-device
+    aggregate on the same trajectory."""
+    data = synthetic_lr(num_clients=8, dim=12, num_classes=4, seed=4)
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                       client_num_per_round=8, epochs=1, batch_size=64,
+                       lr=0.1, seed=0, frequency_of_the_test=100)
+    a = FedAvgAPI(data, lr_task, cfg)
+    b = FedAvgAPI(data, lr_task, cfg, mesh=mesh8)
+    a.train()
+    b.train()
+    ra, rb = a.history[-1], b.history[-1]
+    for k in ("test_acc", "test_loss", "train_all_acc", "train_all_loss"):
+        np.testing.assert_allclose(ra[k], rb[k], rtol=1e-4, atol=1e-5)
+
+
 def test_eval_max_samples_subset():
     """eval_max_samples caps global eval to a seeded subset — the reference's
     10k stackoverflow validation set (FedAVGAggregator.py:99-107)."""
