@@ -219,9 +219,14 @@ def _measure(mode: str) -> None:
     # full train set (~330 MB) up front; FEDML_BENCH_FULL_PARK=1 restores
     # the whole-set park (the right call on a fast local link)
     working_set = os.environ.get("FEDML_BENCH_FULL_PARK") != "1"
+    # FEDML_BENCH_BUCKET_B=1: bucketed dynamic batch depth — bit-exact,
+    # skips padded no-op batch compute; a mid-timing bucket change costs a
+    # recompile, so it is a measured VARIANT, not the headline default
+    bucket = os.environ.get("FEDML_BENCH_BUCKET_B") == "1"
     api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),
                     donate=True, mesh=mesh,
-                    block_working_set=(mode == "block" and working_set))
+                    block_working_set=(mode == "block" and working_set),
+                    bucket_batches=bucket)
     _mark(t0, f"api built (device_data={mode == 'block'}, "
               f"working_set={mode == 'block' and working_set})")
 

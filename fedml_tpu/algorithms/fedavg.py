@@ -43,6 +43,8 @@ from fedml_tpu.core.client_data import (
     batch_global,
     pack_client_indices,
     pack_clients,
+    pad_batches,
+    pad_index_batches,
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.sampling import prepare_sampling, sample_for
@@ -203,6 +205,7 @@ class FedAvgAPI:
         donate: bool = False,
         block_working_set: bool = False,
         uniform_avg: bool = False,
+        bucket_batches: bool = False,
     ):
         self.data = dataset
         self.task = task
@@ -255,6 +258,18 @@ class FedAvgAPI:
         counts = [len(v) for v in dataset.train_idx_map.values()]
         b_needed = int(np.ceil(max(counts) / config.batch_size))
         self.num_batches = min(config.max_batches or b_needed, b_needed)
+        # bucket_batches: shrink each round's (or block's) common batch
+        # depth to the max the SAMPLED clients actually need, rounded up a
+        # small static ladder. Trailing all-masked batch slots are exact
+        # state no-ops (local.py's has_data select; rng chains are
+        # position-based) — so this is bit-exact while skipping their full
+        # compute cost, at the price of one extra jit variant per bucket
+        # (<=4). On size-skewed natural partitions (FEMNIST lognormal)
+        # most rounds sample no near-maximal client, so the common depth
+        # drops well below num_batches.
+        self.bucket_batches = bucket_batches
+        ladder = sorted({-(-self.num_batches // d) for d in (8, 4, 2, 1)})
+        self._b_ladder = [b for b in ladder if b > 0]
 
         self.local_spec = local_spec or LocalSpec(
             optimizer=make_client_optimizer(config), epochs=config.epochs,
@@ -441,24 +456,30 @@ class FedAvgAPI:
         finally:
             self.device_data = was
 
-    def _pack_round_indices_host(self, round_idx: int) -> IndexBatch:
+    def _bucketed_B(self, b_needed: int) -> int:
+        """Smallest ladder bucket covering ``b_needed`` (ladder tops out at
+        num_batches, so the result never exceeds the static budget)."""
+        for b in self._b_ladder:
+            if b >= b_needed:
+                return b
+        return self.num_batches
+
+    def _pack_round_indices_host(self, round_idx: int,
+                                 pad_to: int | None = None) -> IndexBatch:
         """Host-side padded IndexBatch (no device placement) — shared by the
-        per-round path and the R-round block packer."""
+        per-round path and the R-round block packer. ``pad_to`` is the
+        common batch depth: default the static num_batches; the bucketed
+        paths pass their (smaller) bucket; 0 = natural depth (no pad)."""
         cfg = self.cfg
         ids = self._sampled_ids(round_idx)
         ib = pack_client_indices(
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,
         )
-        if ib.idx.shape[1] < self.num_batches:
-            pad = self.num_batches - ib.idx.shape[1]
-            K, _, bs = ib.idx.shape
-            ib = IndexBatch(
-                idx=np.concatenate([ib.idx, np.zeros((K, pad, bs), ib.idx.dtype)], 1),
-                mask=np.concatenate([ib.mask, np.zeros((K, pad, bs), ib.mask.dtype)], 1),
-                num_samples=ib.num_samples,
-            )
-        return ib
+        if pad_to is None:
+            pad_to = (self._bucketed_B(ib.idx.shape[1])
+                      if self.bucket_batches else self.num_batches)
+        return pad_index_batches(ib, pad_to)
 
     def _pack_round(self, round_idx: int):
         cfg = self.cfg
@@ -476,15 +497,10 @@ class FedAvgAPI:
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,
         )
-        # fixed B across rounds -> single compilation
-        if cb.num_batches < self.num_batches:
-            pad = self.num_batches - cb.num_batches
-            cb = ClientBatch(
-                x=np.concatenate([cb.x, np.zeros((cb.x.shape[0], pad) + cb.x.shape[2:], cb.x.dtype)], 1),
-                y=np.concatenate([cb.y, np.zeros((cb.y.shape[0], pad) + cb.y.shape[2:], cb.y.dtype)], 1),
-                mask=np.concatenate([cb.mask, np.zeros((cb.mask.shape[0], pad, cb.mask.shape[2]), cb.mask.dtype)], 1),
-                num_samples=cb.num_samples,
-            )
+        # fixed B across rounds -> single compilation (or, with
+        # bucket_batches, the round's ladder bucket -> <=4 compilations)
+        cb = pad_batches(cb, self._bucketed_B(cb.num_batches)
+                         if self.bucket_batches else self.num_batches)
         if self.mesh is not None:
             sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
             cb = ClientBatch(
@@ -628,15 +644,25 @@ class FedAvgAPI:
 
         ids_l, idx_l, mask_l, ns_l = [], [], [], []
         with self.tracer.span("pack"):
+            # bucketed: pack at natural depth first, then pad every round
+            # to the BLOCK's common bucket (the scan needs one B; jit
+            # caches per bucket, <=4 variants)
+            pad_to = 0 if self.bucket_batches else self.num_batches
             for r in range(start_round, start_round + num_rounds):
                 # host-side pack: the stacked block is device_put ONCE below
                 # (per-round device_puts would round-trip, and on multi-host
                 # meshes a sharded array cannot come back through np.asarray)
-                ib = self._pack_round_indices_host(r)
+                ib = self._pack_round_indices_host(r, pad_to=pad_to)
                 ids_l.append(np.asarray(self._sampled_ids(r), np.int32))
                 idx_l.append(ib.idx)
                 mask_l.append(ib.mask)
                 ns_l.append(ib.num_samples)
+            if self.bucket_batches:
+                B = self._bucketed_B(max(a.shape[1] for a in idx_l))
+                for i, (ix, mk, ns) in enumerate(zip(idx_l, mask_l, ns_l)):
+                    ib = pad_index_batches(
+                        IndexBatch(idx=ix, mask=mk, num_samples=ns), B)
+                    idx_l[i], mask_l[i] = ib.idx, ib.mask
         rounds = np.arange(start_round, start_round + num_rounds, dtype=np.int32)
         idx_stack = np.stack(idx_l)
         if self.block_working_set:

@@ -262,6 +262,20 @@ def pad_batches(cb: "ClientBatch", num_batches: int) -> "ClientBatch":
                        num_samples=cb.num_samples)
 
 
+def pad_index_batches(ib: "IndexBatch", num_batches: int) -> "IndexBatch":
+    """Index-plane analogue of pad_batches: zero-pad idx/mask along the
+    batch axis up to ``num_batches`` (padded slots carry mask 0 = provable
+    no-ops). Every engine pads through HERE so the per-round and block
+    data planes cannot desynchronize."""
+    pad = num_batches - ib.idx.shape[1]
+    if pad <= 0:
+        return ib
+    z = lambda a: np.concatenate(
+        [a, np.zeros((a.shape[0], pad) + a.shape[2:], a.dtype)], 1)
+    return IndexBatch(idx=z(ib.idx), mask=z(ib.mask),
+                      num_samples=ib.num_samples)
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class IndexBatch:

@@ -106,6 +106,11 @@ def add_args(parser: argparse.ArgumentParser):
                              "instead of parking the whole train set")
     parser.add_argument("--uint8_pixels", type=int, default=0,
                         help="1 = ship image pixels as uint8, normalize on device")
+    parser.add_argument("--bucket_batches", type=int, default=0,
+                        help="1 = shrink each round/block's common batch "
+                             "depth to the sampled clients' ladder bucket "
+                             "(bit-exact; skips padded no-op batch compute "
+                             "at the cost of <=4 jit variants)")
     # algorithm-specific
     parser.add_argument("--server_optimizer", type=str, default="sgd")
     parser.add_argument("--server_lr", type=float, default=1.0)
@@ -341,7 +346,8 @@ def build_api(args):
             data, task, cfg, mesh=mesh,
             device_data=bool(getattr(args, "device_data", 0)),
             block_working_set=bool(getattr(args, "device_data", 0))
-            and bool(getattr(args, "working_set", 0))), data
+            and bool(getattr(args, "working_set", 0)),
+            bucket_batches=bool(getattr(args, "bucket_batches", 0))), data
     if algo == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptAPI
 
