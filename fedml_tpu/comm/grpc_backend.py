@@ -49,11 +49,16 @@ class GrpcCommManager(BaseCommManager):
         ip_table: dict[int, str] | str | None = None,
         base_port: int = 50000,
         host: str = "0.0.0.0",
+        send_timeout_s: float = 600.0,
     ):
         super().__init__()
         import grpc
 
         self.rank, self.size, self.base_port = rank, size, base_port
+        # per-send delivery deadline: generous by default (peers boot jax
+        # in arbitrary order); elastic servers shrink it to the round
+        # deadline so one dead peer cannot wedge the round loop
+        self.send_timeout_s = float(send_timeout_s)
         if isinstance(ip_table, str):
             ip_table = read_ip_config(ip_table)
         self.ip_table = ip_table or {r: "127.0.0.1" for r in range(size)}
@@ -168,7 +173,7 @@ class GrpcCommManager(BaseCommManager):
         frame = (self.rank.to_bytes(8, "little")
                  + self._epoch.to_bytes(8, "little")
                  + seq.to_bytes(8, "little") + msg.to_bytes())
-        deadline = time.monotonic() + 600
+        deadline = time.monotonic() + self.send_timeout_s
         attempt = 0
         while True:
             try:

@@ -39,7 +39,9 @@ def make_comm_manager(backend: str, rank: int, size: int, **kw) -> BaseCommManag
         from fedml_tpu.comm.grpc_backend import GrpcCommManager
 
         return GrpcCommManager(
-            rank, size, ip_table=kw.get("ip_table"), base_port=kw.get("base_port", 50000)
+            rank, size, ip_table=kw.get("ip_table"),
+            base_port=kw.get("base_port", 50000),
+            send_timeout_s=kw.get("send_timeout_s", 600.0),
         )
     if backend == "MQTT":
         from fedml_tpu.comm.mqtt_backend import MqttCommManager

@@ -56,7 +56,60 @@ class FedAvgServerManager(ServerManager):
                 f"{aggregator.cfg.client_num_per_round}"
             )
         ts = kw.pop("timeout_s", None)
+        if round_timeout_s is not None and round_timeout_s <= 0:
+            # 0 would arm the elastic error-swallowing but DISARM the
+            # watchdog ('or' treats 0.0 as unset) — a silent permanent hang
+            raise ValueError(f"round_timeout_s={round_timeout_s} must be > 0")
+        if round_timeout_s is not None:
+            # elastic mode: a send to a dead/unreachable client must not
+            # absorb more than one round deadline (the gRPC default is a
+            # 600 s boot-tolerance window) — and its failure is handled
+            # (the client becomes a straggler), not fatal
+            kw.setdefault("send_timeout_s", round_timeout_s)
         super().__init__(rank, size, backend, timeout_s=round_timeout_s or ts, **kw)
+
+    # a rank whose delivery failed is probed again only every k-th round:
+    # one dead peer must not cost every round a full send deadline, but a
+    # REBOOTED peer must still be able to rejoin
+    _DEAD_RANK_REPROBE_ROUNDS = 4
+
+    @staticmethod
+    def _is_transport_error(e: BaseException) -> bool:
+        """Only delivery failures are elastic-tolerable; config/programming
+        errors (KeyError on a bad ip table, serialization bugs) stay
+        fatal. grpc.RpcError is detected by name so the server module
+        needs no grpc import for the loopback/mqtt backends."""
+        if isinstance(e, (ConnectionError, TimeoutError, OSError)):
+            return True
+        return any(c.__name__ == "RpcError" for c in type(e).__mro__)
+
+    def send_message(self, msg) -> None:
+        """Elastic mode tolerates an unreachable downlink: the failed rank
+        simply has nothing to report this round and the watchdog drops it
+        (the reference aborts the whole job instead — raise_MPI_error ->
+        MPI.COMM_WORLD.Abort(), fedml_api/utils/context.py:9-18).
+        Without a round deadline, delivery failures stay fatal."""
+        rank = int(msg.get_receiver_id())
+        failed_at = getattr(self, "_undeliverable", {}).get(rank)
+        if (failed_at is not None and
+                (self.round_idx - failed_at) % self._DEAD_RANK_REPROBE_ROUNDS):
+            log.debug("elastic: skipping send to dead rank %d "
+                      "(failed at round %d; reprobed every %d rounds)",
+                      rank, failed_at, self._DEAD_RANK_REPROBE_ROUNDS)
+            return
+        try:
+            super().send_message(msg)
+            if failed_at is not None:
+                log.info("elastic: rank %d reachable again", rank)
+                self._undeliverable.pop(rank, None)
+        except Exception as e:
+            if self.round_timeout_s is None or not self._is_transport_error(e):
+                raise
+            if not hasattr(self, "_undeliverable"):
+                self._undeliverable = {}
+            self._undeliverable[rank] = self.round_idx
+            log.warning("elastic: dropping undeliverable send to rank %d",
+                        rank, exc_info=True)
 
     def _ckpt_state_template(self):
         import jax

@@ -69,6 +69,11 @@ def add_args(p: argparse.ArgumentParser):
                         "a job must pass the same value")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
+    p.add_argument("--round_timeout_s", type=float, default=None,
+                   help="elastic round deadline: a round idle past this "
+                        "aggregates over the clients that DID report and "
+                        "moves on (dead/straggler clients are dropped; "
+                        "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="server round checkpoints; restart resumes the job")
     # experiment surface (subset of cli.py, same names)
@@ -134,6 +139,7 @@ def init_role(args, data, task, cfg, backend_kw):
             agg = FedAvgAggregator(data, task, cfg, worker_num=args.world_size - 1)
         return FedAvgServerManager(agg, rank=0, size=args.world_size,
                                    backend=backend, ckpt_dir=args.ckpt_dir,
+                                   round_timeout_s=args.round_timeout_s,
                                    **backend_kw)
 
     # sparse uplinks apply where the upload is plain weights; a

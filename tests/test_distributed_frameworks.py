@@ -55,31 +55,30 @@ def test_decentralized_gossip_with_local_steps():
         assert abs(o[0] - center) < 1.0
 
 
-@pytest.mark.skipif(os.environ.get("FEDML_SKIP_SUBPROCESS") == "1",
-                    reason="subprocess smoke disabled")
-def test_distributed_launch_multiprocess_grpc(tmp_path):
-    """Real OS processes + gRPC on localhost — the closest analogue of the
-    reference's mpirun smoke runs."""
+def _run_grpc_fleet(tmp_path, client_ranks, extra_args=(), port_salt=7):
+    """Shared multiprocess-launch scaffolding: start the given client ranks
+    (files for stdout — an undrained PIPE deadlocks a client once its
+    gRPC-retry-heavy logs exceed the 64 KB pipe buffer), run the rank-0
+    server to completion, reap, and return the server CompletedProcess.
+    Surfaces client logs on timeout; always kills stragglers."""
     import time
 
     env = dict(os.environ)
     env.update(PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=1")
-    port = 52000 + (os.getpid() * 7 + int(time.time())) % 6000  # fresh ports per run
+    port = 52000 + (os.getpid() * port_salt + int(time.time())) % 6000
     base = ["--world_size", "3", "--backend", "grpc", "--base_port", str(port),
             "--dataset", "mnist", "--model", "lr", "--comm_round", "2",
             "--client_num_in_total", "6", "--frequency_of_the_test", "1",
-            "--ci", "1"]
-    # client stdout goes to files, not PIPE: an undrained PIPE deadlocks the
-    # client once its (gRPC-retry-heavy) logs exceed the 64 KB pipe buffer
-    logs = {r: open(tmp_path / f"client{r}.log", "wb") for r in (1, 2)}
+            "--ci", "1", *extra_args]
+    logs = {r: open(tmp_path / f"client{r}.log", "wb") for r in client_ranks}
     clients = [
         subprocess.Popen(
             [sys.executable, "-m", "fedml_tpu.experiments.distributed_launch",
              "--rank", str(r)] + base,
             env=env, stdout=logs[r], stderr=subprocess.STDOUT,
         )
-        for r in (1, 2)
+        for r in client_ranks
     ]
     try:
         server = subprocess.run(
@@ -104,7 +103,7 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
                 c.kill()
         outs = [
             (tmp_path / f"client{r}.log").read_bytes().decode(errors="replace")[-2000:]
-            for r in (1, 2)
+            for r in client_ranks
         ]
         raise AssertionError(f"launch timeout: {e}\nclient logs:\n" + "\n---\n".join(outs))
     finally:
@@ -113,7 +112,34 @@ def test_distributed_launch_multiprocess_grpc(tmp_path):
                 c.kill()
         for f in logs.values():
             f.close()
+    return server
+
+
+@pytest.mark.skipif(os.environ.get("FEDML_SKIP_SUBPROCESS") == "1",
+                    reason="subprocess smoke disabled")
+def test_distributed_launch_multiprocess_grpc(tmp_path):
+    """Real OS processes + gRPC on localhost — the closest analogue of the
+    reference's mpirun smoke runs."""
+    server = _run_grpc_fleet(tmp_path, client_ranks=(1, 2))
     assert '"round": 1' in server.stdout.replace("'", '"') or "round" in server.stdout
+
+
+@pytest.mark.skipif(os.environ.get("FEDML_SKIP_SUBPROCESS") == "1",
+                    reason="subprocess smoke disabled")
+def test_distributed_launch_survives_dead_client(tmp_path):
+    """Failure detection / elastic recovery end-to-end over real processes
+    + gRPC: rank 2 NEVER comes up; with --round_timeout_s the server's
+    watchdog drops the dead client each round, aggregates over the clients
+    that did report, and the job still finishes all rounds (the reference
+    aborts the whole mpirun job on any rank failure,
+    fedml_api/utils/context.py raise_MPI_error -> MPI.Abort)."""
+    server = _run_grpc_fleet(tmp_path, client_ranks=(1,),
+                             extra_args=("--round_timeout_s", "25"),
+                             port_salt=11)
+    # the elastic path fired (stragglers dropped), and eval history for
+    # every round still appears on stdout
+    assert "elastic partial aggregation" in (server.stderr + server.stdout)
+    assert '"round": 1' in server.stdout.replace("'", '"')
 
 
 def test_distributed_fedopt_matches_standalone():
