@@ -1,0 +1,86 @@
+"""Property-based invariants (hypothesis) for the wire layer and packer.
+
+The example-based suites pin behavior on fixed fixtures; these sweep the
+input space for the invariants the system's correctness leans on:
+exactly-roundtripping frames, bounded lossy-codec error, sparse-uplink
+identity at ratio 1.0, and the packer's grouping invariance (the property
+that makes the cross-process runtime bit-identical to the SPMD sim).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from fedml_tpu.comm.message import Message, codec_roundtrip
+
+_leaf = st.lists(
+    st.floats(-1e4, 1e4, allow_nan=False, width=32), min_size=1, max_size=40
+).map(lambda v: np.asarray(v, np.float32))
+_leaves = st.lists(_leaf, min_size=1, max_size=5)
+
+
+@settings(max_examples=40, deadline=None)
+@given(_leaves, st.sampled_from([None, "zlib"]))
+def test_frame_roundtrip_lossless(leaves, codec):
+    """Message frames survive to_bytes/from_bytes bit-exactly for the
+    lossless codecs, arbitrary shapes and values."""
+    msg = Message("t", 0, 1)
+    msg.add_params("model_params", leaves)
+    out = Message.from_bytes(msg.to_bytes(codec=codec))
+    got = out.get_params()["model_params"]
+    assert len(got) == len(leaves)
+    for a, b in zip(leaves, got):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+@settings(max_examples=40, deadline=None)
+@given(_leaves)
+def test_f16_codec_error_bounded(leaves):
+    """The lossy f16 tier's error is bounded by half-precision spacing
+    (relative ~1e-3 within range, saturating at the f16 max)."""
+    rt = codec_roundtrip(leaves, codec="f16")
+    for a, b in zip(leaves, rt):
+        a_clip = np.clip(a, -65504.0, 65504.0)
+        np.testing.assert_allclose(np.asarray(b), a_clip, rtol=2e-3, atol=1e-4)
+
+
+@settings(max_examples=30, deadline=None)
+@given(_leaves)
+def test_sparse_ratio_one_is_identity(leaves):
+    """ratio=1.0 top-k sparsification reproduces the dense delta exactly
+    (the documented dense-equivalence contract)."""
+    from fedml_tpu.comm.sparse import topk_decode, topk_encode
+
+    base = [np.zeros_like(a) for a in leaves]
+    idx, val = topk_encode(leaves, 1.0)
+    dec = topk_decode(base, idx, val)
+    for a, b in zip(leaves, dec):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(0, 2**31 - 1), st.integers(2, 10), st.integers(1, 6))
+def test_packer_grouping_invariance(seed, n_clients, bs):
+    """A client's packed batches depend only on (seed, round, client id) —
+    NOT on which other clients share the pack call. This is the property
+    that makes the cross-process runtime (one client per rank) bit-equal
+    to the SPMD simulation (all clients in one block)."""
+    from fedml_tpu.core.client_data import pack_clients
+    from fedml_tpu.data.synthetic import synthetic_images
+
+    data = synthetic_images(num_clients=n_clients, image_shape=(4, 4, 1),
+                            num_classes=3, samples_per_client=9,
+                            test_samples=4, seed=seed % 1000,
+                            size_lognormal=True)
+    ids = np.arange(n_clients)
+    together = pack_clients(data, ids, bs, seed=seed % 97, round_idx=seed % 7)
+    for k in (0, n_clients - 1):
+        alone = pack_clients(data, np.asarray([k]), bs, seed=seed % 97,
+                             round_idx=seed % 7)
+        B = alone.x.shape[1]
+        np.testing.assert_array_equal(together.x[k, :B], alone.x[0])
+        np.testing.assert_array_equal(together.mask[k, :B], alone.mask[0])
+        assert float(together.num_samples[k]) == float(alone.num_samples[0])
+        # slots beyond the lone pack's depth are pure padding
+        assert not together.mask[k, B:].any()
