@@ -45,6 +45,17 @@ def test_f16_codec_error_bounded(leaves):
         np.testing.assert_allclose(np.asarray(b), a_clip, rtol=2e-3, atol=1e-4)
 
 
+@settings(max_examples=40, deadline=None)
+@given(_leaves)
+def test_q8_codec_error_bounded(leaves):
+    """The int8 tier's per-entry error is bounded by half a quantization
+    step: scale = max|x|/127 per array (message.py q8 contract)."""
+    rt = codec_roundtrip(leaves, codec="q8")
+    for a, b in zip(leaves, rt):
+        step = float(np.max(np.abs(a))) / 127.0
+        np.testing.assert_allclose(np.asarray(b), a, atol=step / 2 + 1e-12)
+
+
 @settings(max_examples=30, deadline=None)
 @given(_leaves)
 def test_sparse_ratio_one_is_identity(leaves):
