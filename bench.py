@@ -61,11 +61,14 @@ _CNN_FWD_FLOPS = 2 * (28 * 28 * 5 * 5 * 1 * 32        # conv1 @ 28x28
                       + 14 * 14 * 5 * 5 * 32 * 64     # conv2 @ 14x14
                       + 3136 * 512 + 512 * 62)        # dense head
 # Peak dense-matmul throughput per chip, bf16, FLOPs/s (public figures:
-# v5e 197 TF, v4 275 TF, v5p 459 TF). MFU is quoted against bf16 peak
-# even for f32 runs (XLA runs f32 contractions through the MXU in
-# multi-pass bf16), so the f32 number is conservative.
-_PEAK_BF16 = {"v5e": 1.97e14, "v5 lite": 1.97e14, "v4": 2.75e14,
-              "v5p": 4.59e14}
+# v2 45 TF, v3 123 TF, v4 275 TF, v5e 197 TF, v5p 459 TF, v6e 918 TF).
+# MFU is quoted against bf16 peak even for f32 runs (XLA runs f32
+# contractions through the MXU in multi-pass bf16), so the f32 number is
+# conservative. More-specific keys first: next() takes the first substring
+# hit, and "v5"/"v6" alone would shadow the lite/p variants.
+_PEAK_BF16 = {"v5 lite": 1.97e14, "v5e": 1.97e14, "v5p": 4.59e14,
+              "v6 lite": 9.18e14, "v6e": 9.18e14,
+              "v4": 2.75e14, "v3": 1.23e14, "v2": 4.5e13}
 
 
 def _mfu(samples_per_sec_per_chip: float, platform: str) -> float | None:
@@ -80,7 +83,9 @@ def _mfu(samples_per_sec_per_chip: float, platform: str) -> float | None:
             kind = sys.modules["jax"].devices()[0].device_kind.lower()
         except Exception:  # noqa: BLE001 — MFU is garnish, never fail
             pass
-    peak = next((v for k, v in _PEAK_BF16.items() if k in kind), 1.97e14)
+    peak = next((v for k, v in _PEAK_BF16.items() if k in kind), None)
+    if peak is None:
+        return None  # unknown generation: a guessed peak would misreport
     return samples_per_sec_per_chip * 3 * _CNN_FWD_FLOPS / peak
 
 
@@ -465,6 +470,14 @@ def main() -> None:
         time.sleep(lease_sleep)
     rc, out = _run_child([here, "--measure", "block"], env, block_timeout)
     best = _last_json_line(out) or cheap
+    if (best is not None and best.get("mode") == "block" and cheap is not None
+            and cheap.get("platform") == best.get("platform")):
+        # one line, BOTH modes: the block number assumes the workload rides
+        # the scanned round-block; per_round is what run_round-only engines
+        # (FedDF/FedCon host-driven stages) actually get
+        best["per_round"] = {k: cheap[k] for k in
+                             ("value", "samples_per_sec_per_chip",
+                              "mfu_vs_bf16_peak") if k in cheap}
     if best is None and on_accel:
         # last resort: a degraded-but-real CPU number beats a stack trace
         # (the forced-CPU child never touches the accelerator, so no
@@ -494,19 +507,32 @@ def _emit(best: dict) -> None:
     print(json.dumps(best))
 
 
+def _natural_key(path: str) -> list:
+    """Descending-sort key that orders embedded integers numerically:
+    bench_tpu_r10 must beat bench_tpu_r4 and attempt10 beat attempt2 (plain
+    reverse string sort gets both wrong once a counter hits two digits).
+    Text chunks rank above number chunks so `attempt_clean` still sorts
+    after (wins over, in reverse) `attempt1`."""
+    import re
+
+    return [(0, int(c)) if c.isdigit() else (1, c)
+            for c in re.split(r"(\d+)", path)]
+
+
 def _last_recorded_tpu_result(base: str | None = None) -> dict | None:
     """Newest committed real-TPU bench line under runs/bench_tpu_*/.
 
-    "Newest" by descending path (round dirs then attempt names — git does
-    not preserve mtimes, so a fresh clone would make mtime order
-    arbitrary; `attempt_clean` deliberately sorts after `attempt1`).
+    "Newest" by descending natural-sorted path (round dirs then attempt
+    names — git does not preserve mtimes, so a fresh clone would make mtime
+    order arbitrary; `attempt_clean` deliberately sorts after `attempt1`).
     ``FEDML_BENCH_TPU_EVIDENCE_DIR`` overrides the search root (tests)."""
     import glob
 
     base = (base or os.environ.get("FEDML_BENCH_TPU_EVIDENCE_DIR")
             or os.path.dirname(os.path.abspath(__file__)))
     logs = sorted(glob.glob(os.path.join(base, "runs", "bench_tpu_*",
-                                         "*.stdout.log")), reverse=True)
+                                         "*.stdout.log")),
+                  key=_natural_key, reverse=True)
     for p in logs:
         try:
             with open(p, errors="replace") as f:

@@ -91,8 +91,13 @@ class FedAvgServerManager(ServerManager):
         Without a round deadline, delivery failures stay fatal."""
         rank = int(msg.get_receiver_id())
         failed_at = getattr(self, "_undeliverable", {}).get(rank)
+        # reprobe only on a POSITIVE multiple of the interval: at
+        # round_idx == failed_at the failure was just recorded, and a
+        # second send in the same round (e.g. the FINISH broadcast after a
+        # failed final sync) must not re-block a full send deadline
         if (failed_at is not None and
-                (self.round_idx - failed_at) % self._DEAD_RANK_REPROBE_ROUNDS):
+                (self.round_idx == failed_at or
+                 (self.round_idx - failed_at) % self._DEAD_RANK_REPROBE_ROUNDS)):
             log.debug("elastic: skipping send to dead rank %d "
                       "(failed at round %d; reprobed every %d rounds)",
                       rank, failed_at, self._DEAD_RANK_REPROBE_ROUNDS)

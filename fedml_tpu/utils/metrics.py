@@ -34,8 +34,20 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         # compile service (e.g. a TPU relay) can carry host-feature flags
         # the local CPU rejects — sharing one dir makes every CPU child
         # iterate and discard them (slow startup + AOT-loader error spam).
-        # JAX_PLATFORMS is readable without initializing any backend.
-        platform = (os.environ.get("JAX_PLATFORMS") or "default").split(",")[0]
+        # JAX_PLATFORMS is readable without initializing any backend; when
+        # it is unset, fall back to the backend jax has ALREADY initialized
+        # (never initialize one here — that can dial a dead relay) so TPU
+        # and CPU processes on the same host still get isolated subdirs.
+        platform = (os.environ.get("JAX_PLATFORMS") or "").split(",")[0]
+        if not platform:
+            try:
+                from jax._src import xla_bridge
+
+                if xla_bridge._backends:
+                    platform = jax.default_backend()
+            except Exception:  # noqa: BLE001 — isolation is best-effort
+                pass
+        platform = platform or "default"
         cache_dir = os.path.join(
             cache_dir, "".join(c if c.isalnum() else "_" for c in platform))
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -418,6 +418,44 @@ def test_grpc_distributed_fedavg_smoke(lr_setup):
     assert agg.history and agg.history[-1]["round"] == 1
 
 
+def test_dead_rank_same_round_resend_skipped(monkeypatch):
+    """ADVICE r4: a second send to a just-failed rank in the SAME round
+    (e.g. the FINISH broadcast after a failed final sync) must be skipped,
+    not re-block a full send deadline; reprobes happen only on positive
+    multiples of the reprobe interval."""
+    from fedml_tpu.comm.managers import ServerManager
+    from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+
+    attempts = []
+
+    def boom(self, msg):
+        attempts.append(self.round_idx)
+        raise ConnectionError("rank down")
+
+    monkeypatch.setattr(ServerManager, "send_message", boom)
+    mgr = object.__new__(FedAvgServerManager)
+    mgr.round_timeout_s = 5.0
+    mgr.round_idx = 7
+
+    class Msg:
+        @staticmethod
+        def get_receiver_id():
+            return 3
+
+    mgr.send_message(Msg)  # delivery fails -> rank recorded dead
+    mgr.send_message(Msg)  # same round: skipped (was: re-blocked)
+    assert attempts == [7]
+    for mgr.round_idx in (8, 9, 10):  # within the reprobe interval: skipped
+        mgr.send_message(Msg)
+    assert attempts == [7]
+    mgr.round_idx = 11  # failed_at + interval: reprobed (and fails again)
+    mgr.send_message(Msg)
+    assert attempts == [7, 11]
+    mgr.round_idx = 11
+    mgr.send_message(Msg)  # re-failure same round: skipped again
+    assert attempts == [7, 11]
+
+
 def test_elastic_partial_aggregation_survives_dead_client(lr_setup):
     """A client that never reports must not hang the job: with
     round_timeout_s set, the server aggregates over the live subset and
