@@ -41,8 +41,9 @@ import optax
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 from fedml_tpu.core.client_data import ClientBatch, FederatedData, pack_clients
 from fedml_tpu.core.local import NetState
-from fedml_tpu.core.tasks import classification_task
-from fedml_tpu.models.darts import DARTSNetwork, extract_genotype
+from fedml_tpu.core.tasks import aux_classification_task, classification_task
+from fedml_tpu.models.darts import (DARTSNetwork, NetworkCIFAR, as_genotype,
+                                    extract_genotype)
 
 
 def _split_arch(params):
@@ -89,10 +90,12 @@ class FedNASAPI(FedAvgAPI):
                  lambda_train: float = 1.0, lambda_valid: float = 1.0,
                  unrolled: bool = False, val_fraction: float = 0.5,
                  layers: int = 4, init_filters: int = 16, steps: int = 4,
-                 multiplier: int = 4, **kwargs):
+                 multiplier: int = 4, nas_method: str = "darts",
+                 tau: float = 10.0, **kwargs):
         module = DARTSNetwork(num_classes=dataset.class_num, layers=layers,
                               steps=steps, multiplier=multiplier,
-                              init_filters=init_filters)
+                              init_filters=init_filters,
+                              nas_method=nas_method, tau=tau)
         task = classification_task(module)
         self.arch_lr, self.arch_wd = arch_lr, arch_wd
         self.steps, self.multiplier = steps, multiplier
@@ -242,3 +245,30 @@ class FedNASAPI(FedAvgAPI):
     def genotype(self):
         return extract_genotype(self.net.params, steps=self.steps,
                                 multiplier=self.multiplier)
+
+
+class FedNASTrainAPI(FedAvgAPI):
+    """Train stage (``--stage train``): federated training of the DERIVED
+    fixed-genotype network — the half of the reference's NAS story the
+    search stage hands off to (main_fednas.py:44-45, 188-193: --stage
+    train builds NetworkCIFAR from a genotype and runs the same federated
+    loop with plain local SGD; FedNASTrainer.train/local_train
+    FedNASTrainer.py:129-183 adds the auxiliary-head loss term).
+
+    ``genotype`` accepts a registry name ("FedNAS_V1", the reference's
+    train-stage default at main_fednas.py:191), a search result
+    (FedNASAPI.genotype() dict), or a json file path — so
+    search -> extract -> train composes in one run (the
+    CI-script-fednas.sh:16-23 two-stage flow)."""
+
+    def __init__(self, dataset, config: FedAvgConfig, mesh=None,
+                 genotype="FedNAS_V1", layers: int = 8,
+                 init_filters: int = 16, auxiliary: bool = False,
+                 auxiliary_weight: float = 0.4,
+                 drop_path_prob: float = 0.5, **kwargs):
+        module = NetworkCIFAR(genotype=as_genotype(genotype),
+                              num_classes=dataset.class_num, layers=layers,
+                              init_filters=init_filters, auxiliary=auxiliary,
+                              drop_path_prob=drop_path_prob)
+        task = aux_classification_task(module, aux_weight=auxiliary_weight)
+        super().__init__(dataset, task, config, mesh=mesh, **kwargs)

@@ -43,13 +43,19 @@ _ZMAGIC = b"FMZ1"  # zlib-wrapped frame: FMZ1 | u32 raw_len | deflate bytes
 #            payloads and sparse updates; modest on dense f32)
 #   '+zlib' composes with either lossy tier. f16 and q8 are mutually
 #   exclusive (both re-encode the same f32 payloads).
-_CODECS = ("none", "f16", "q8", "zlib", "f16+zlib", "q8+zlib")
+#   'json' — the REFERENCE's wire format: one UTF-8 JSON object of
+#            msg_params with arrays as nested python lists (Message.to_json,
+#            message.py:62-66 + transform_tensor_to_list,
+#            fedavg/utils.py:13-16, the is_mobile=1 path) — so a stock
+#            reference mobile/IoT client can join a fedml_tpu round.
+#            Interop tier only: ~7x the bytes of the binary frame.
+_CODECS = ("none", "f16", "q8", "zlib", "f16+zlib", "q8+zlib", "json")
 
 
 def set_wire_codec(codec: str) -> None:
     """Process-wide default codec for Message.to_bytes (one of _CODECS:
-    'none', 'f16', 'q8', 'zlib', 'f16+zlib', 'q8+zlib'). Exposed on the
-    CLI as --compression."""
+    'none', 'f16', 'q8', 'zlib', 'f16+zlib', 'q8+zlib', 'json'). Exposed
+    on the CLI as --compression."""
     global _CODEC
     if codec not in _CODECS:
         raise ValueError(f"unknown wire codec {codec!r} (one of {_CODECS})")
@@ -142,6 +148,8 @@ class Message:
         codec = _CODEC if codec is None else codec
         if codec not in _CODECS:
             raise ValueError(f"unknown wire codec {codec!r} (one of {_CODECS})")
+        if codec == "json":
+            return self._to_reference_json()
         f16, q8 = "f16" in codec, "q8" in codec
         scalars: dict[str, Any] = {}
         manifest: list[dict] = []
@@ -182,8 +190,61 @@ class Message:
                      + zlib.compress(frame, 1))  # level 1: wire CPU is cheap
         return frame
 
+    def _to_reference_json(self) -> bytes:
+        """The reference's wire form: json.dumps(msg_params) with every
+        array payload as nested lists (message.py:62-66 to_json; weights
+        listified per transform_tensor_to_list, fedavg/utils.py:13-16)."""
+
+        def listify(v):
+            arr = self._as_array(v)
+            if arr is not None:
+                return arr.tolist()
+            if isinstance(v, (list, tuple)):
+                return [listify(e) for e in v]
+            if isinstance(v, dict):
+                return {k: listify(e) for k, e in v.items()}
+            return v
+
+        return json.dumps({k: listify(v) for k, v in
+                           self.msg_params.items()}).encode()
+
+    # reference integer msg types (fedavg/message_define.py:6-11) -> the
+    # string vocabulary fedml_tpu managers register handlers under
+    # (distributed/fedavg/message_define.py) — without this translation a
+    # stock reference client's upload would parse but never dispatch
+    _REFERENCE_MSG_TYPES = {1: "s2c_init", 2: "s2c_sync",
+                            3: "c2s_send_model", 4: "c2s_send_stats"}
+
+    @classmethod
+    def _from_reference_json(cls, data: bytes) -> "Message":
+        msg = cls.__new__(cls)
+        msg.msg_params = json.loads(data)
+        t = msg.msg_params.get(Message.MSG_ARG_KEY_TYPE)
+        if isinstance(t, int):
+            msg.msg_params[Message.MSG_ARG_KEY_TYPE] = \
+                cls._REFERENCE_MSG_TYPES.get(t, str(t))
+
+        def arrify(v):  # transform_list_to_tensor (fedavg/utils.py:7-10)
+            if isinstance(v, dict):
+                # reference state_dict shape: key -> ONE tensor as nested
+                # lists, however deep
+                return {k: np.asarray(e, np.float32) for k, e in v.items()}
+            if isinstance(v, list) and v and isinstance(v[0], list):
+                # fedml_tpu pack_pytree shape: a LIST of tensors
+                return [np.asarray(e, np.float32) for e in v]
+            if isinstance(v, list):
+                return np.asarray(v, np.float32)
+            return v
+
+        k = Message.MSG_ARG_KEY_MODEL_PARAMS
+        if k in msg.msg_params:
+            msg.msg_params[k] = arrify(msg.msg_params[k])
+        return msg
+
     @classmethod
     def from_bytes(cls, data: bytes) -> "Message":
+        if data[:1] == b"{":  # auto-detect: reference-format JSON peer
+            return cls._from_reference_json(data)
         if data[:4] == _ZMAGIC:  # auto-detect: sender chose zlib
             # raw_len (bytes 4:8) is advisory; zlib integrity-checks itself
             data = zlib.decompress(data[8:])

@@ -250,3 +250,35 @@ def tag_prediction_task(module, threshold: float = 0.5) -> Task:
         return {"loss_sum": jnp.sum(per_ex * mask), "correct": correct, "count": jnp.sum(mask)}
 
     return Task(init, loss, predict, eval_batch)
+
+
+def aux_classification_task(module, aux_weight: float = 0.4) -> Task:
+    """Cross-entropy with an auxiliary-head term for modules that return
+    ``(logits, logits_aux)`` during training (DARTS derived nets,
+    models/darts.NetworkCIFAR): train loss adds ``aux_weight *
+    CE(logits_aux)`` when the head is present (reference
+    FedNASTrainer.local_train, FedNASTrainer.py:179-183; standard DARTS
+    auxiliary weight 0.4). Eval is plain classification on the main head —
+    init/predict/eval_batch delegate to classification_task; only the
+    train loss differs."""
+
+    base = classification_task(module)
+
+    def loss(params, extra, x, y, mask, rng, train):
+        if not train:
+            return base.loss(params, extra, x, y, mask, rng, train)
+        x = _as_float_image(x)
+        out, new_extra = _apply_train(module, params, extra, x, rng)
+        logits, logits_aux = out if isinstance(out, tuple) else (out, None)
+        per_ex = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        # metrics track the MAIN head (the reference logs prec1 of logits)
+        n = jnp.maximum(jnp.sum(mask), 1.0)
+        metrics = {"loss_sum": jnp.sum(per_ex * mask),
+                   "correct": jnp.sum((jnp.argmax(logits, -1) == y) * mask),
+                   "count": jnp.sum(mask)}
+        if logits_aux is not None:
+            per_ex = per_ex + aux_weight * \
+                optax.softmax_cross_entropy_with_integer_labels(logits_aux, y)
+        return jnp.sum(per_ex * mask) / n, new_extra, metrics
+
+    return Task(base.init, loss, base.predict, base.eval_batch)

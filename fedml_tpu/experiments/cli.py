@@ -182,6 +182,31 @@ def add_args(parser: argparse.ArgumentParser):
                              "unloadably large); 0 = whole run")
     parser.add_argument("--run_dir", type=str, default="./runs")
     parser.add_argument("--run_name", type=str, default=None)
+    # FedNAS (reference main_fednas.py:44-45,78-98): search discovers a
+    # genotype; train federatedly trains the derived NetworkCIFAR
+    parser.add_argument("--stage", type=str, default="search",
+                        choices=["search", "train"],
+                        help="fednas: 'search' runs bilevel DARTS search; "
+                             "'train' trains the derived fixed-genotype net")
+    parser.add_argument("--arch", type=str, default="FedNAS_V1",
+                        help="fednas --stage train: genotype name "
+                             "(FedNAS_V1/DARTS_V2) or a json file from a "
+                             "search run")
+    parser.add_argument("--nas_layers", type=int, default=None,
+                        help="fednas cell count (default: 4 search / "
+                             "8 train, the reference --layers default)")
+    parser.add_argument("--init_channels", type=int, default=16)
+    parser.add_argument("--auxiliary", type=int, default=0,
+                        help="fednas train stage: add the auxiliary head")
+    parser.add_argument("--auxiliary_weight", type=float, default=0.4)
+    parser.add_argument("--drop_path_prob", type=float, default=0.5)
+    parser.add_argument("--nas_method", type=str, default="darts",
+                        choices=["darts", "gdas"],
+                        help="fednas search: softmax-mixture DARTS or "
+                             "Gumbel hard-selection GDAS")
+    parser.add_argument("--tau", type=float, default=10.0,
+                        help="GDAS gumbel-softmax temperature (static per "
+                             "run; the reference anneals it per epoch)")
     return parser
 
 
@@ -454,9 +479,21 @@ def build_api(args):
 
         return TurboAggregateAPI(data, task, cfg), data
     if algo == "fednas":
+        if args.stage == "train":
+            from fedml_tpu.algorithms.fednas import FedNASTrainAPI
+
+            return FedNASTrainAPI(
+                data, cfg, mesh=mesh, genotype=args.arch,
+                layers=args.nas_layers or 8,
+                init_filters=args.init_channels,
+                auxiliary=bool(args.auxiliary),
+                auxiliary_weight=args.auxiliary_weight,
+                drop_path_prob=args.drop_path_prob), data
         from fedml_tpu.algorithms.fednas import FedNASAPI
 
-        return FedNASAPI(data, cfg, mesh=mesh), data
+        return FedNASAPI(data, cfg, mesh=mesh, layers=args.nas_layers or 4,
+                         init_filters=args.init_channels,
+                         nas_method=args.nas_method, tau=args.tau), data
     if algo == "centralized":
         from fedml_tpu.centralized import CentralizedConfig, CentralizedTrainer
 

@@ -99,11 +99,13 @@ def add_args(p: argparse.ArgumentParser):
                         "equivalence, unset = dense protocol")
     p.add_argument("--compression", type=str, default="none",
                    choices=["none", "f16", "q8", "zlib", "f16+zlib",
-                            "q8+zlib"],
+                            "q8+zlib", "json"],
                    help="wire codec for outgoing frames (comm/message.py): "
                         "f16 halves float32 payloads (lossy ~1e-3 rel), q8 "
                         "quarters them (int8, the aggressive tier), zlib "
-                        "deflates losslessly; receivers auto-detect, so "
+                        "deflates losslessly; json emits the REFERENCE's "
+                        "nested-list format (is_mobile interop, "
+                        "fedavg/utils.py:7-16); receivers auto-detect, so "
                         "ranks may mix settings")
     return p
 

@@ -62,15 +62,17 @@ def _norm(c: int, affine: bool = False):
 
 
 class _ReLUConvNorm(nn.Module):
-    """ReLUConvBN analogue (operations.py) — 1x1 projection preprocessing."""
+    """ReLUConvBN analogue (operations.py) — 1x1 projection preprocessing.
+    ``affine=True`` in derived (fixed-genotype) networks, False in search."""
 
     filters: int
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = nn.relu(x)
         x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-        return _norm(self.filters)(x)
+        return _norm(self.filters, self.affine)(x)
 
 
 class FactorizedReduce(nn.Module):
@@ -79,6 +81,7 @@ class FactorizedReduce(nn.Module):
     constraint as the reference's pad-0 convs)."""
 
     filters: int
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -87,7 +90,8 @@ class FactorizedReduce(nn.Module):
                      padding="VALID", use_bias=False)(x)
         h2 = nn.Conv(self.filters - self.filters // 2, (1, 1), strides=(2, 2),
                      padding="VALID", use_bias=False)(x[:, 1:, 1:, :])
-        return _norm(self.filters)(jnp.concatenate([h1, h2], axis=-1))
+        return _norm(self.filters, self.affine)(
+            jnp.concatenate([h1, h2], axis=-1))
 
 
 class _SepConv(nn.Module):
@@ -97,6 +101,7 @@ class _SepConv(nn.Module):
     filters: int
     kernel: int
     stride: int = 1
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -106,7 +111,7 @@ class _SepConv(nn.Module):
             x = nn.Conv(c, (self.kernel, self.kernel), strides=(s, s),
                         padding="SAME", feature_group_count=c, use_bias=False)(x)
             x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-            x = _norm(self.filters)(x)
+            x = _norm(self.filters, self.affine)(x)
         return x
 
 
@@ -117,6 +122,7 @@ class _DilConv(nn.Module):
     filters: int
     kernel: int
     stride: int = 1
+    affine: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -126,7 +132,7 @@ class _DilConv(nn.Module):
                     kernel_dilation=(2, 2), padding="SAME",
                     feature_group_count=c, use_bias=False)(x)
         x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-        return _norm(self.filters)(x)
+        return _norm(self.filters, self.affine)(x)
 
 
 def _pool(x, kind: str, stride: int):
@@ -214,7 +220,15 @@ class DARTSNetwork(nn.Module):
     """Supernet (model_search.py Network): stem -> ``layers`` cells with
     reduction cells at layers//3 and 2*layers//3 (channels double there) ->
     global pool -> classifier. Two alpha tensors — ``alphas_normal`` and
-    ``alphas_reduce`` — each shared across all cells of that type."""
+    ``alphas_reduce`` — each shared across all cells of that type.
+
+    ``nas_method="gdas"`` switches the edge mixture from softmax(alphas) to
+    Gumbel straight-through hard selection (model_search_gdas.py:1-188
+    get_gumbel_prob: sample gumbel noise onto the alphas, softmax at
+    temperature tau, forward the one-hot argmax, backprop through the soft
+    probs). Deviation: the reference anneals tau per epoch from the host
+    (set_tau); here tau is a static module field — annealing means
+    rebuilding the jitted program, so federated rounds hold it fixed."""
 
     num_classes: int = 10
     layers: int = 8
@@ -222,13 +236,31 @@ class DARTSNetwork(nn.Module):
     multiplier: int = 4
     init_filters: int = 16
     stem_multiplier: int = 3
+    nas_method: str = "darts"
+    tau: float = 10.0
+
+    def _edge_weights(self, alphas, train: bool):
+        if self.nas_method != "gdas":
+            return jax.nn.softmax(alphas, -1)
+        logits = alphas
+        if train:  # eval selects deterministically (no gumbel noise)
+            u = jax.random.uniform(self.make_rng("dropout"), alphas.shape,
+                                   minval=1e-10, maxval=1.0)
+            logits = alphas - jnp.log(-jnp.log(u))
+        probs = jax.nn.softmax(logits / self.tau, -1)
+        hard = jax.nn.one_hot(jnp.argmax(probs, -1), alphas.shape[-1],
+                              dtype=probs.dtype)
+        # straight-through: forward the hard one-hot, grad via the probs
+        return hard + probs - jax.lax.stop_gradient(probs)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         E = num_edges(self.steps)
         a_init = lambda k: 1e-3 * jax.random.normal(k, (E, len(PRIMITIVES)))
-        aw_normal = jax.nn.softmax(self.param("alphas_normal", a_init), -1)
-        aw_reduce = jax.nn.softmax(self.param("alphas_reduce", a_init), -1)
+        aw_normal = self._edge_weights(self.param("alphas_normal", a_init),
+                                       train)
+        aw_reduce = self._edge_weights(self.param("alphas_reduce", a_init),
+                                       train)
 
         C_curr = self.stem_multiplier * self.init_filters
         s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
@@ -290,3 +322,204 @@ def extract_genotype(params, steps: int = 4, multiplier: int = 4) -> dict:
         "reduce": _parse_alphas(softmax_np(np.asarray(params["alphas_reduce"])), steps),
         "reduce_concat": concat,
     }
+
+
+# ---------------------------------------------------------------- derived net
+# The reference's "train" stage (main_fednas.py:44-45 --stage train) builds a
+# FIXED-genotype network (model.py:111 NetworkCIFAR) and federatedly trains
+# it: drop-path regularization on non-identity edges, optional auxiliary
+# head at 2/3 depth (aux loss weight args.auxiliary_weight).
+
+# Published genotypes (reference genotypes.py:74-91) + the FedNAS result.
+GENOTYPES: dict[str, dict] = {
+    "FedNAS_V1": {
+        "normal": [("sep_conv_3x3", 1), ("sep_conv_3x3", 0),
+                   ("sep_conv_3x3", 2), ("sep_conv_5x5", 0),
+                   ("sep_conv_3x3", 1), ("sep_conv_5x5", 3),
+                   ("dil_conv_5x5", 3), ("sep_conv_3x3", 4)],
+        "normal_concat": [2, 3, 4, 5],
+        "reduce": [("max_pool_3x3", 0), ("skip_connect", 1),
+                   ("max_pool_3x3", 0), ("max_pool_3x3", 2),
+                   ("max_pool_3x3", 0), ("dil_conv_5x5", 1),
+                   ("max_pool_3x3", 0), ("dil_conv_5x5", 2)],
+        "reduce_concat": [2, 3, 4, 5],
+    },
+    "DARTS_V2": {
+        "normal": [("sep_conv_3x3", 0), ("sep_conv_3x3", 1),
+                   ("sep_conv_3x3", 0), ("sep_conv_3x3", 1),
+                   ("sep_conv_3x3", 1), ("skip_connect", 0),
+                   ("skip_connect", 0), ("dil_conv_3x3", 2)],
+        "normal_concat": [2, 3, 4, 5],
+        "reduce": [("max_pool_3x3", 0), ("max_pool_3x3", 1),
+                   ("skip_connect", 2), ("max_pool_3x3", 1),
+                   ("max_pool_3x3", 0), ("skip_connect", 2),
+                   ("skip_connect", 2), ("max_pool_3x3", 1)],
+        "reduce_concat": [2, 3, 4, 5],
+    },
+}
+
+
+def as_genotype(g) -> dict:
+    """Normalize a genotype source: a registry name ("FedNAS_V1"), a dict
+    (extract_genotype output / parsed json), or a json file path."""
+    if isinstance(g, str):
+        if g in GENOTYPES:
+            return GENOTYPES[g]
+        import json
+        import os
+
+        if os.path.exists(g):
+            with open(g) as f:
+                return json.load(f)
+        raise ValueError(f"unknown genotype {g!r} (registry: "
+                         f"{sorted(GENOTYPES)} or a json file path)")
+    g = dict(g)
+    for k in ("normal", "reduce"):
+        g[k] = [(str(op), int(j)) for op, j in g[k]]
+        g[f"{k}_concat"] = [int(i) for i in g[f"{k}_concat"]]
+    return g
+
+
+def _drop_path(x, drop_prob: float, rng):
+    """Per-sample stochastic branch drop (darts/utils.py:82-88): zero the
+    whole branch for a bernoulli(drop_prob) subset of the batch, rescale
+    survivors by 1/keep."""
+    keep = 1.0 - drop_prob
+    mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, 1, 1))
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class DerivedCell(nn.Module):
+    """Fixed-genotype cell (model.py Cell): two ops per node, chosen
+    predecessors, drop-path on non-identity branches during training.
+    All norms affine (operations.py OPS called with affine=True at
+    model.py:37)."""
+
+    gene: tuple  # ((op_name, predecessor_idx), ...), 2 per node
+    concat: tuple  # state indices concatenated as the cell output
+    filters: int
+    reduction: bool = False
+    reduction_prev: bool = False
+    drop_path_prob: float = 0.0
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False):
+        C = self.filters
+        s0 = (FactorizedReduce(C, affine=True)(s0, train)
+              if self.reduction_prev
+              else _ReLUConvNorm(C, affine=True)(s0, train))
+        s1 = _ReLUConvNorm(C, affine=True)(s1, train)
+        states = [s0, s1]
+        for i in range(len(self.gene) // 2):
+            hs = []
+            for name, j in self.gene[2 * i: 2 * i + 2]:
+                stride = 2 if self.reduction and j < 2 else 1
+                h = states[j]
+                identity = False
+                if name == "skip_connect":
+                    if stride == 2:
+                        h = FactorizedReduce(C, affine=True)(h, train)
+                    else:
+                        identity = True  # Identity: no drop-path (model.py:55)
+                elif name == "max_pool_3x3":
+                    h = _pool(h, "max", stride)  # derived pools carry no norm
+                elif name == "avg_pool_3x3":
+                    h = _pool(h, "avg", stride)
+                elif name == "sep_conv_3x3":
+                    h = _SepConv(C, 3, stride, affine=True)(h, train)
+                elif name == "sep_conv_5x5":
+                    h = _SepConv(C, 5, stride, affine=True)(h, train)
+                elif name == "dil_conv_3x3":
+                    h = _DilConv(C, 3, stride, affine=True)(h, train)
+                elif name == "dil_conv_5x5":
+                    h = _DilConv(C, 5, stride, affine=True)(h, train)
+                elif name != "none":
+                    raise ValueError(f"unknown op {name!r} in genotype")
+                if train and self.drop_path_prob > 0.0 and not identity:
+                    h = _drop_path(h, self.drop_path_prob,
+                                   self.make_rng("dropout"))
+                hs.append(h)
+            states.append(hs[0] + hs[1])
+        return jnp.concatenate([states[i] for i in self.concat], axis=-1)
+
+
+class AuxiliaryHeadCIFAR(nn.Module):
+    """Aux classifier at 2/3 depth, 8x8 input (model.py:64-84): ReLU,
+    avg-pool 5x5/s3 (-> 2x2), 1x1 conv 128, norm, ReLU, 2x2 conv 768,
+    norm, ReLU, linear."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.shape[1] < 8 or x.shape[2] < 8:
+            raise ValueError(
+                f"auxiliary head needs >=8x8 features, got {x.shape[1:3]} — "
+                "input too small for this depth (model.py:66 assumes 8x8 at "
+                "2/3 of the layers; use a 32x32 input or auxiliary=False)")
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = nn.Conv(128, (1, 1), use_bias=False)(x)
+        x = nn.relu(_norm(128, affine=True)(x))
+        x = nn.Conv(768, (2, 2), padding="VALID", use_bias=False)(x)
+        x = nn.relu(_norm(768, affine=True)(x))
+        return nn.Dense(self.num_classes)(x.reshape(x.shape[0], -1))
+
+
+class NetworkCIFAR(nn.Module):
+    """Derived (fixed-genotype) CIFAR network — the reference's train-stage
+    model (model.py:111-159 NetworkCIFAR): stem, ``layers`` DerivedCells
+    with reductions at layers//3 and 2*layers//3 (channels double there),
+    optional auxiliary head after cell 2*layers//3 (training only),
+    global pool, classifier. Returns logits at eval; (logits, logits_aux)
+    during training when ``auxiliary`` (logits_aux=None without the head).
+
+    Param parity with the torch construction: C=16, layers=8, 10 classes,
+    FedNAS_V1 -> 337,626 params (773,092 with the auxiliary head) —
+    pinned in tests/test_param_parity.py. Norms are affine GroupNorm for
+    the same reason as the supernet (vmapped-over-clients training)."""
+
+    genotype: object = "FedNAS_V1"
+    num_classes: int = 10
+    layers: int = 8
+    init_filters: int = 16
+    stem_multiplier: int = 3
+    auxiliary: bool = False
+    drop_path_prob: float = 0.5  # reference fixed value (model.py:118)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        g = as_genotype(self.genotype)
+        C_curr = self.stem_multiplier * self.init_filters
+        s = nn.Conv(C_curr, (3, 3), padding="SAME", use_bias=False)(x)
+        s0 = s1 = _norm(C_curr, affine=True)(s)
+
+        C_curr = self.init_filters
+        reduce_at = {self.layers // 3, 2 * self.layers // 3} - {0}
+        reduction_prev = False
+        aux_in = None
+        for i in range(self.layers):
+            reduction = i in reduce_at
+            if reduction:
+                C_curr *= 2
+            gene, concat = ((g["reduce"], g["reduce_concat"]) if reduction
+                            else (g["normal"], g["normal_concat"]))
+            cell = DerivedCell(gene=tuple(tuple(e) for e in gene),
+                               concat=tuple(concat), filters=C_curr,
+                               reduction=reduction,
+                               reduction_prev=reduction_prev,
+                               drop_path_prob=self.drop_path_prob)
+            s0, s1 = s1, cell(s0, s1, train)
+            reduction_prev = reduction
+            if i == 2 * self.layers // 3:
+                aux_in = s1
+        logits_aux = None
+        if self.auxiliary and aux_in is not None:
+            # built unconditionally so init(train=False) creates the head's
+            # params; only RETURNED during training (model.py:153-155)
+            logits_aux = AuxiliaryHeadCIFAR(self.num_classes)(aux_in, train)
+        y = jnp.mean(s1, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(y)
+        if train:
+            return logits, (logits_aux if self.auxiliary else None)
+        return logits

@@ -89,6 +89,22 @@ def test_darts_supernet_param_count():
     assert arch == 224
 
 
+def test_network_cifar_derived_param_count():
+    from fedml_tpu.models.darts import NetworkCIFAR
+
+    # EXACTLY the reference train-stage network (model.py:111 NetworkCIFAR
+    # with C=16, layers=8, 10 classes, genotype=FedNAS_V1 — the
+    # main_fednas.py:191-193 construction), counted against the torch
+    # module's p.numel() sum: 337,626 bare, 773,092 with the auxiliary
+    # head (AuxiliaryHeadCIFAR = 435,466)
+    m = NetworkCIFAR(genotype="FedNAS_V1", num_classes=10, layers=8,
+                     init_filters=16, auxiliary=False)
+    assert _count(m, (1, 32, 32, 3), train=False) == 337_626
+    m_aux = NetworkCIFAR(genotype="FedNAS_V1", num_classes=10, layers=8,
+                         init_filters=16, auxiliary=True)
+    assert _count(m_aux, (1, 32, 32, 3), train=False) == 773_092
+
+
 def test_mobilenet_v3_modes_near_canonical():
     from fedml_tpu.models.mobilenet import MobileNetV3
 
