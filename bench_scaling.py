@@ -28,6 +28,9 @@ import sys
 import time
 
 
+_MEMO: dict = {}
+
+
 def _one_point(args, data, task, k):
     import jax
 
@@ -81,6 +84,23 @@ def _one_point(args, data, task, k):
         "dtype": "bf16" if args.bf16 else "f32",
         "remat": bool(args.remat),
     }
+    # MFU vs bf16 peak (TPU only): XLA's own FLOP count of the compiled
+    # forward on one batch, 3x-forward train accounting (utils/flops.py).
+    # Memoized: the forward is identical across every sweep point.
+    import jax.numpy as jnp
+
+    from fedml_tpu.utils.flops import compiled_flops, train_mfu
+
+    if "fwd_flops" not in _MEMO:
+        xb = jnp.asarray(data.train_x[: args.batch_size])
+        _MEMO["fwd_flops"] = compiled_flops(api.task.predict, api.net.params,
+                                            api.net.extra, xb)
+    fwd = _MEMO["fwd_flops"]
+    if fwd:
+        mfu = train_mfu(count * rps, fwd / args.batch_size)
+        if mfu is not None:
+            rec["mfu_vs_bf16_peak"] = round(mfu, 5)
+            rec["fwd_flops_per_sample"] = round(fwd / args.batch_size)
     if args.spans:
         # where TIMED-window wall-clock goes. Tracer spans give the host
         # side (index/data packing); everything else is the device program

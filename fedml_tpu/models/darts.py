@@ -466,6 +466,120 @@ class AuxiliaryHeadCIFAR(nn.Module):
         return nn.Dense(self.num_classes)(x.reshape(x.shape[0], -1))
 
 
+def genotype_to_dot(genotype, cell: str = "normal") -> str:
+    """Graphviz DOT source for one cell of a genotype — the reference's
+    visualize.py (fedml_api/model/cv/darts/visualize.py) renders the same
+    DAG via the graphviz binary; emitting portable DOT text keeps the
+    utility dependency-free (pipe into `dot -Tpng` to render)."""
+    g = as_genotype(genotype)
+    gene, concat = g[cell], g[f"{cell}_concat"]
+    steps = len(gene) // 2
+    lines = [f'digraph {cell} {{', '  rankdir=LR;',
+             '  node [shape=box, style=rounded];',
+             '  "c_{k-2}"; "c_{k-1}";']
+
+    def state_name(j: int) -> str:
+        return ('"c_{k-2}"' if j == 0 else '"c_{k-1}"' if j == 1
+                else f'"{j - 2}"')
+
+    for i in range(steps):
+        lines.append(f'  "{i}" [shape=circle];')
+        for op, j in gene[2 * i: 2 * i + 2]:
+            lines.append(f'  {state_name(j)} -> "{i}" [label="{op}"];')
+    lines.append('  "c_{k}" [shape=box];')
+    for c in concat:
+        lines.append(f'  {state_name(c)} -> "c_{{k}}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+class AuxiliaryHeadImageNet(nn.Module):
+    """ImageNet aux classifier, 14x14 input (model.py:87-108): ReLU,
+    avg-pool 5x5/s2 (-> 5x5... 2x2 at 14x14? the reference assumes 14x14 ->
+    2x2 via the torch pool arithmetic), 1x1 conv 128, norm, ReLU, 2x2 conv
+    768, ReLU, linear. NOTE: the reference deliberately OMITS the second
+    norm ('omitted in my earlier implementation due to a typo... for
+    consistency with the paper', model.py:100-102) — reproduced here, and
+    required for exact param parity."""
+
+    num_classes: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (5, 5), strides=(2, 2), padding="VALID")
+        x = nn.Conv(128, (1, 1), use_bias=False)(x)
+        x = nn.relu(_norm(128, affine=True)(x))
+        x = nn.Conv(768, (2, 2), padding="VALID", use_bias=False)(x)
+        x = nn.relu(x)  # no norm here (reference model.py:100-102)
+        # deviation: the reference flattens (model.py:106) into a
+        # Linear(768,·) — which cannot run at its own stated 14x14 input
+        # (4x4x768 features remain); global-pool the residual extent so the
+        # head matches the 768-feature classifier AND executes
+        return nn.Dense(self.num_classes)(jnp.mean(x, axis=(1, 2)))
+
+
+class NetworkImageNet(nn.Module):
+    """Derived ImageNet network (model.py:161-216 NetworkImageNet): 3-conv
+    double stem (each stride 2; cells start from 1/4 and 1/8 resolution
+    with reduction_prev=True), ``layers`` DerivedCells, optional
+    AuxiliaryHeadImageNet after cell 2*layers//3, global pool, classifier.
+
+    Param parity with the torch construction: C=48, layers=14, 1000
+    classes, DARTS_V2 -> 4,718,752 (5,979,528 with the auxiliary head) —
+    pinned in tests/test_param_parity.py."""
+
+    genotype: object = "DARTS_V2"
+    num_classes: int = 1000
+    layers: int = 14
+    init_filters: int = 48
+    auxiliary: bool = False
+    drop_path_prob: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        g = as_genotype(self.genotype)
+        C = self.init_filters
+        # stem0: 3 -> C//2 (s2) -> C (s2); stem1: C -> C (s2)
+        h = nn.Conv(C // 2, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(x)
+        h = nn.relu(_norm(C // 2, affine=True)(h))
+        h = nn.Conv(C, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(h)
+        s0 = _norm(C, affine=True)(h)
+        h = nn.Conv(C, (3, 3), strides=(2, 2), padding="SAME",
+                    use_bias=False)(nn.relu(s0))
+        s1 = _norm(C, affine=True)(h)
+
+        C_curr = C
+        reduce_at = {self.layers // 3, 2 * self.layers // 3} - {0}
+        reduction_prev = True  # stem1 already reduced (model.py:187)
+        aux_in = None
+        for i in range(self.layers):
+            reduction = i in reduce_at
+            if reduction:
+                C_curr *= 2
+            gene, concat = ((g["reduce"], g["reduce_concat"]) if reduction
+                            else (g["normal"], g["normal_concat"]))
+            cell = DerivedCell(gene=tuple(tuple(e) for e in gene),
+                               concat=tuple(concat), filters=C_curr,
+                               reduction=reduction,
+                               reduction_prev=reduction_prev,
+                               drop_path_prob=self.drop_path_prob)
+            s0, s1 = s1, cell(s0, s1, train)
+            reduction_prev = reduction
+            if i == 2 * self.layers // 3:
+                aux_in = s1
+        logits_aux = None
+        if self.auxiliary and aux_in is not None:
+            logits_aux = AuxiliaryHeadImageNet(self.num_classes)(aux_in, train)
+        y = jnp.mean(s1, axis=(1, 2))  # AvgPool2d(7) == global mean at 224
+        logits = nn.Dense(self.num_classes)(y)
+        if train:
+            return logits, (logits_aux if self.auxiliary else None)
+        return logits
+
+
 class NetworkCIFAR(nn.Module):
     """Derived (fixed-genotype) CIFAR network — the reference's train-stage
     model (model.py:111-159 NetworkCIFAR): stem, ``layers`` DerivedCells

@@ -27,6 +27,9 @@ import sys
 import time
 
 
+_FWD_FLOPS_MEMO: dict[int, float | None] = {}
+
+
 def _one_point(args, T: int, use_flash: bool) -> None:
     import jax
     import jax.numpy as jnp
@@ -63,7 +66,7 @@ def _one_point(args, T: int, use_flash: bool) -> None:
         params, opt_state, loss = step(params, net.extra, opt_state, x)
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
-    print(json.dumps({
+    rec = {
         "seq_len": T,
         "impl": "flash" if use_flash else "dense",
         "tokens_per_sec": round(args.batch * T * args.steps / dt, 1),
@@ -71,7 +74,26 @@ def _one_point(args, T: int, use_flash: bool) -> None:
         "loss": round(float(loss), 4),
         "batch": args.batch, "dim": args.dim, "depth": args.depth,
         "device": jax.devices()[0].platform,
-    }), flush=True)
+    }
+    # MFU (TPU only): XLA's FLOP count of the compiled forward per token,
+    # 3x-forward train accounting (utils/flops.py). The flash kernel hides
+    # its inner FLOPs from cost analysis, so quote the DENSE forward's
+    # count for both impls — same math, comparable MFU.
+    from fedml_tpu.utils.flops import compiled_flops, train_mfu
+
+    if T not in _FWD_FLOPS_MEMO:  # one cost-analysis compile per seq_len
+        dense = sequence_task(TransformerLM(
+            vocab_size=args.vocab, dim=args.dim, depth=args.depth,
+            num_heads=args.heads, max_len=T, use_flash=False))
+        _FWD_FLOPS_MEMO[T] = compiled_flops(dense.predict, params,
+                                            net.extra, x)
+    fwd = _FWD_FLOPS_MEMO[T]
+    if fwd:
+        # step is a plain single-device jit: tokens_per_sec IS per-chip
+        mfu = train_mfu(rec["tokens_per_sec"], fwd / (args.batch * T))
+        if mfu is not None:
+            rec["mfu_vs_bf16_peak"] = round(mfu, 5)
+    print(json.dumps(rec), flush=True)
 
 
 def main():

@@ -186,6 +186,36 @@ def test_search_derive_train_end_to_end(tmp_path):
     assert all(bool(jnp.isfinite(p_).all()) for p_ in flat)
 
 
+def test_network_imagenet_forward():
+    """NetworkImageNet (model.py:161): double stride-2 stem, cells start
+    reduction_prev=True; train returns (logits, aux) like the CIFAR net."""
+    from fedml_tpu.models.darts import NetworkImageNet
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    net = NetworkImageNet(genotype="DARTS_V2", num_classes=7, layers=3,
+                          init_filters=8, auxiliary=False,
+                          drop_path_prob=0.0)
+    v = net.init(jax.random.PRNGKey(0), x, train=False)
+    assert net.apply(v, x, train=False).shape == (2, 7)
+    tr, aux = net.apply(v, x, train=True,
+                        rngs={"dropout": jax.random.PRNGKey(1)})
+    assert tr.shape == (2, 7) and aux is None
+
+
+def test_genotype_to_dot():
+    """visualize.py analogue: DOT text with one labelled edge per gene
+    entry and the concat fan-in."""
+    from fedml_tpu.models.darts import GENOTYPES, genotype_to_dot
+
+    dot = genotype_to_dot("FedNAS_V1", "normal")
+    assert dot.startswith("digraph normal {") and dot.endswith("}")
+    for op, _ in GENOTYPES["FedNAS_V1"]["normal"]:
+        assert f'label="{op}"' in dot
+    # 8 op edges + 4 concat edges
+    assert dot.count(" -> ") == 12
+    assert "digraph reduce" in genotype_to_dot("DARTS_V2", "reduce")
+
+
 def test_aux_loss_term_active():
     """aux_classification_task: with the auxiliary head on, the training
     loss includes the weighted aux term (loss(aux_w=2) > loss(aux_w=0) on

@@ -105,6 +105,21 @@ def test_network_cifar_derived_param_count():
     assert _count(m_aux, (1, 32, 32, 3), train=False) == 773_092
 
 
+def test_network_imagenet_derived_param_count():
+    from fedml_tpu.models.darts import NetworkImageNet
+
+    # EXACTLY the reference NetworkImageNet (model.py:161 with C=48,
+    # layers=14, 1000 classes, DARTS_V2 — the published DARTS ImageNet
+    # eval config) vs the torch p.numel() sum; includes the reference's
+    # deliberately-omitted second aux norm (model.py:100-102)
+    m = NetworkImageNet(genotype="DARTS_V2", num_classes=1000, layers=14,
+                        init_filters=48, auxiliary=False)
+    assert _count(m, (1, 224, 224, 3), train=False) == 4_718_752
+    m_aux = NetworkImageNet(genotype="DARTS_V2", num_classes=1000,
+                            layers=14, init_filters=48, auxiliary=True)
+    assert _count(m_aux, (1, 224, 224, 3), train=False) == 5_979_528
+
+
 def test_mobilenet_v3_modes_near_canonical():
     from fedml_tpu.models.mobilenet import MobileNetV3
 
