@@ -575,8 +575,8 @@ class NetworkImageNet(nn.Module):
             logits_aux = AuxiliaryHeadImageNet(self.num_classes)(aux_in, train)
         y = jnp.mean(s1, axis=(1, 2))  # AvgPool2d(7) == global mean at 224
         logits = nn.Dense(self.num_classes)(y)
-        if train:
-            return logits, (logits_aux if self.auxiliary else None)
+        if train and self.auxiliary:
+            return logits, logits_aux  # tuple only when the head exists
         return logits
 
 
@@ -585,8 +585,9 @@ class NetworkCIFAR(nn.Module):
     model (model.py:111-159 NetworkCIFAR): stem, ``layers`` DerivedCells
     with reductions at layers//3 and 2*layers//3 (channels double there),
     optional auxiliary head after cell 2*layers//3 (training only),
-    global pool, classifier. Returns logits at eval; (logits, logits_aux)
-    during training when ``auxiliary`` (logits_aux=None without the head).
+    global pool, classifier. Returns bare logits at eval AND in train mode
+    without the head (so plain classification_task / create_model work);
+    the (logits, logits_aux) tuple only when ``auxiliary`` during training.
 
     Param parity with the torch construction: C=16, layers=8, 10 classes,
     FedNAS_V1 -> 337,626 params (773,092 with the auxiliary head) —
@@ -634,6 +635,8 @@ class NetworkCIFAR(nn.Module):
             logits_aux = AuxiliaryHeadCIFAR(self.num_classes)(aux_in, train)
         y = jnp.mean(s1, axis=(1, 2))
         logits = nn.Dense(self.num_classes)(y)
-        if train:
-            return logits, (logits_aux if self.auxiliary else None)
+        if train and self.auxiliary:
+            # tuple ONLY when the head exists: without it the net is a
+            # plain classifier usable by classification_task / create_model
+            return logits, logits_aux
         return logits

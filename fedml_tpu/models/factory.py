@@ -62,4 +62,16 @@ def create_model(model_name: str, output_dim: int = 10, **kwargs):
         from fedml_tpu.models.vgg import VGG
 
         return VGG(depth=16, num_classes=output_dim)
+    if name in ("darts", "darts_cifar", "darts_imagenet"):
+        # the DERIVED fixed-genotype nets (the reference train stage,
+        # model.py:111/:161); genotype= accepts a registry name, a search
+        # result dict, or a json path. The search SUPERNET stays behind
+        # FedNASAPI (it needs the bilevel engine, not plain FedAvg).
+        from fedml_tpu.models.darts import NetworkCIFAR, NetworkImageNet
+
+        if name == "darts_imagenet":
+            kwargs.setdefault("genotype", "DARTS_V2")
+            return NetworkImageNet(num_classes=output_dim, **kwargs)
+        kwargs.setdefault("genotype", "FedNAS_V1")
+        return NetworkCIFAR(num_classes=output_dim, **kwargs)
     raise ValueError(f"unknown model: {model_name}")

@@ -143,11 +143,13 @@ def test_derived_network_forward_and_drop_path():
     v = net.init(jax.random.PRNGKey(0), x, train=False)
     out = net.apply(v, x, train=False)
     assert out.shape == (4, 5)
-    tr1, aux = net.apply(v, x, train=True,
-                         rngs={"dropout": jax.random.PRNGKey(2)})
-    assert aux is None and tr1.shape == (4, 5)
-    tr2, _ = net.apply(v, x, train=True,
-                       rngs={"dropout": jax.random.PRNGKey(3)})
+    # without the aux head the net returns BARE logits even in train mode
+    # (usable by classification_task / create_model)
+    tr1 = net.apply(v, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(2)})
+    assert tr1.shape == (4, 5)
+    tr2 = net.apply(v, x, train=True,
+                    rngs={"dropout": jax.random.PRNGKey(3)})
     assert not np.allclose(tr1, tr2)  # drop-path active during training
     # eval path has no stochasticity
     np.testing.assert_array_equal(out, net.apply(v, x, train=False))
@@ -197,9 +199,34 @@ def test_network_imagenet_forward():
                           drop_path_prob=0.0)
     v = net.init(jax.random.PRNGKey(0), x, train=False)
     assert net.apply(v, x, train=False).shape == (2, 7)
-    tr, aux = net.apply(v, x, train=True,
-                        rngs={"dropout": jax.random.PRNGKey(1)})
-    assert tr.shape == (2, 7) and aux is None
+    tr = net.apply(v, x, train=True,
+                   rngs={"dropout": jax.random.PRNGKey(1)})
+    assert tr.shape == (2, 7)  # bare logits without the aux head
+
+
+def test_create_model_darts_derived_generic_task():
+    """create_model('darts_cifar'/'darts_imagenet') returns a plain
+    classifier (no aux tuple) usable by the generic classification_task —
+    the derived nets ride every generic surface (CLI models, cross-process
+    launch) like any other model."""
+    from fedml_tpu.models import create_model
+
+    net = create_model("darts_cifar", output_dim=3, layers=2,
+                       init_filters=8, drop_path_prob=0.1)
+    task = classification_task(net)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    y = jnp.array([0, 1])
+    st = task.init(jax.random.PRNGKey(1), x)
+    l, _, m = task.loss(st.params, st.extra, x, y, jnp.ones(2),
+                        jax.random.PRNGKey(2), True)
+    assert np.isfinite(float(l)) and float(m["count"]) == 2
+    # imagenet variant resolves and evaluates too
+    net_i = create_model("darts_imagenet", output_dim=4, layers=2,
+                         init_filters=8, drop_path_prob=0.0)
+    ti = classification_task(net_i)
+    xi = jax.random.normal(jax.random.PRNGKey(3), (1, 32, 32, 3))
+    sti = ti.init(jax.random.PRNGKey(4), xi)
+    assert ti.predict(sti.params, sti.extra, xi).shape == (1, 4)
 
 
 def test_genotype_to_dot():
