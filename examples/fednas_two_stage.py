@@ -1,0 +1,81 @@
+"""FedNAS two-stage flow: federated architecture search, then federated
+training of the discovered network.
+
+The reference runs this as two mpirun jobs (CI-script-fednas.sh:16-23:
+main_fednas.py --stage search, then --stage train with the recorded
+genotype, main_fednas.py:44-45,188-193). Here both stages are SPMD engines
+and the genotype crosses between them as a json file — the same handoff
+the CLI exposes (`--stage search` / `--stage train --arch genotype.json`).
+
+Run on the 8-device virtual CPU mesh:
+    env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        PYTHONPATH=. python examples/fednas_two_stage.py
+Tiny shapes by default (1-core-box friendly); scale with the flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--search_rounds", type=int, default=2)
+    ap.add_argument("--train_rounds", type=int, default=2)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--per_round", type=int, default=2)
+    ap.add_argument("--layers_search", type=int, default=2)
+    ap.add_argument("--layers_train", type=int, default=3)
+    ap.add_argument("--init_filters", type=int, default=8)
+    ap.add_argument("--nas_method", type=str, default="darts",
+                    choices=["darts", "gdas"])
+    ap.add_argument("--genotype_out", type=str, default="/tmp/fednas_genotype.json")
+    args = ap.parse_args()
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.fednas import FedNASAPI, FedNASTrainAPI
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.darts import genotype_to_dot
+
+    data = synthetic_images(num_clients=args.clients, image_shape=(32, 32, 3),
+                            num_classes=10, samples_per_client=32,
+                            test_samples=64, seed=0, size_lognormal=False)
+
+    # ---- stage 1: bilevel search on the supernet --------------------------
+    cfg = FedAvgConfig(comm_round=args.search_rounds,
+                       client_num_in_total=args.clients,
+                       client_num_per_round=args.per_round, epochs=1,
+                       batch_size=8, lr=0.025, frequency_of_the_test=1000)
+    search = FedNASAPI(data, cfg, layers=args.layers_search,
+                       init_filters=args.init_filters,
+                       nas_method=args.nas_method)
+    for r in range(args.search_rounds):
+        m = search.run_round(r)
+        print(f"search round {r}: {float(m['count']):.0f} samples")
+    geno = search.genotype()
+    with open(args.genotype_out, "w") as f:
+        json.dump(geno, f, indent=1)
+    print(f"genotype -> {args.genotype_out}")
+    print(genotype_to_dot(geno, "normal"))
+
+    # ---- stage 2: federated training of the derived network --------------
+    tcfg = FedAvgConfig(comm_round=args.train_rounds,
+                        client_num_in_total=args.clients,
+                        client_num_per_round=args.per_round, epochs=1,
+                        batch_size=8, lr=0.05, frequency_of_the_test=1)
+    train = FedNASTrainAPI(data, tcfg, genotype=args.genotype_out,
+                           layers=args.layers_train,
+                           init_filters=args.init_filters,
+                           auxiliary=True, drop_path_prob=0.2)
+    train.train()
+    print("train history:",
+          [(h["round"], round(h["test_acc"], 3)) for h in train.history])
+
+
+if __name__ == "__main__":
+    main()

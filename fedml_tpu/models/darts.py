@@ -416,7 +416,12 @@ class DerivedCell(nn.Module):
                 stride = 2 if self.reduction and j < 2 else 1
                 h = states[j]
                 identity = False
-                if name == "skip_connect":
+                if name == "none":
+                    # true Zero op (operations.py Zero): contributes nothing,
+                    # at the op's output spatial extent. Discretized
+                    # genotypes never pick it, but user-supplied json may.
+                    h = jnp.zeros_like(h[:, ::stride, ::stride, :])
+                elif name == "skip_connect":
                     if stride == 2:
                         h = FactorizedReduce(C, affine=True)(h, train)
                     else:
@@ -433,7 +438,7 @@ class DerivedCell(nn.Module):
                     h = _DilConv(C, 3, stride, affine=True)(h, train)
                 elif name == "dil_conv_5x5":
                     h = _DilConv(C, 5, stride, affine=True)(h, train)
-                elif name != "none":
+                else:
                     raise ValueError(f"unknown op {name!r} in genotype")
                 if train and self.drop_path_prob > 0.0 and not identity:
                     h = _drop_path(h, self.drop_path_prob,

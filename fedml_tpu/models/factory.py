@@ -67,11 +67,14 @@ def create_model(model_name: str, output_dim: int = 10, **kwargs):
         # model.py:111/:161); genotype= accepts a registry name, a search
         # result dict, or a json path. The search SUPERNET stays behind
         # FedNASAPI (it needs the bilevel engine, not plain FedAvg).
-        from fedml_tpu.models.darts import NetworkCIFAR, NetworkImageNet
+        from fedml_tpu.models.darts import (NetworkCIFAR, NetworkImageNet,
+                                            as_genotype)
 
         if name == "darts_imagenet":
             kwargs.setdefault("genotype", "DARTS_V2")
+            kwargs["genotype"] = as_genotype(kwargs["genotype"])  # fail fast
             return NetworkImageNet(num_classes=output_dim, **kwargs)
         kwargs.setdefault("genotype", "FedNAS_V1")
+        kwargs["genotype"] = as_genotype(kwargs["genotype"])
         return NetworkCIFAR(num_classes=output_dim, **kwargs)
     raise ValueError(f"unknown model: {model_name}")
