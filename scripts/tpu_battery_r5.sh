@@ -17,6 +17,36 @@ OUT="runs/bench_tpu_r5"
 SCALE="runs/bench_scaling_r5"
 mkdir -p "$OUT" "$SCALE"
 
+# one CPU core: a concurrently-running pytest would starve the bench
+# children into rc=124 wedges (never run pytest + a TPU bench child
+# together). Wait up to 45 min for any pytest to drain first. The match
+# targets the interpreter's own argv ('-m pytest' / a pytest script), NOT
+# a bare substring — the driver's cmdline embeds prompt text that can
+# contain the word 'pytest' and must not stall the battery forever.
+# NB: pgrep -f substring matching is NOT safe here — the build driver's
+# cmdline embeds prompt text containing 'python -m pytest ...' as one big
+# argument. Match on real argv BOUNDARIES via /proc cmdline (NUL-separated):
+# a genuine `python -m pytest` has "-m" and "pytest" as separate args.
+is_pytest_running() {
+  pgrep -x pytest >/dev/null 2>&1 && return 0
+  local f
+  for f in /proc/[0-9]*/cmdline; do
+    tr '\0' '\n' < "$f" 2>/dev/null | grep -A1 -x -- '-m' \
+      | grep -qx 'pytest' && return 0
+  done
+  return 1
+}
+for i in $(seq 1 90); do
+  is_pytest_running || break
+  [ "$i" -eq 1 ] && echo "battery: pytest running; waiting for it to drain"
+  sleep 30
+done
+if is_pytest_running; then
+  echo "battery: WARNING pytest still running after 45 min — proceeding" \
+       "anyway; bench children may starve on this 1-core host (rc=124s" \
+       "below are likely that, not the pool)"
+fi
+
 LEASE_SLEEP="${TPU_SMOKE_LEASE_SLEEP:-180}"
 post_step() {  # $1 = rc of the step that just finished
   if [ "$1" -eq 124 ]; then
