@@ -228,7 +228,11 @@ class DARTSNetwork(nn.Module):
     temperature tau, forward the one-hot argmax, backprop through the soft
     probs). Deviation: the reference anneals tau per epoch from the host
     (set_tau); here tau is a static module field — annealing means
-    rebuilding the jitted program, so federated rounds hold it fixed."""
+    rebuilding the jitted program, so federated rounds hold it fixed
+    WITHIN a stage. Annealing recipe: params (incl. alphas) are
+    tau-independent, so run staged search — build a fresh FedNASAPI at
+    each lower tau and carry ``net`` over (one recompile per stage, the
+    honest cost model under jit; tested in test_nas_affinity_condense)."""
 
     num_classes: int = 10
     layers: int = 8
