@@ -161,10 +161,19 @@ def test_wire_codecs_roundtrip_and_shrink():
     np.testing.assert_allclose(got, [65504.0, -65504.0, 3.0], rtol=1e-3)
 
 
-def test_distributed_loopback_with_compression_still_learns(lr_setup):
-    """End-to-end: the loopback runtime with f16+zlib uplinks/downlinks
-    (every frame through the codec) still reproduces the standalone run to
-    f16 quantization tolerance."""
+@pytest.mark.parametrize("codec,rtol,atol", [
+    # f16+zlib: lossy tier — f16 quantization tolerance
+    ("f16+zlib", 5e-3, 2e-3),
+    # json: the REFERENCE wire format ('--compression json', is_mobile
+    # interop). f32 -> json -> f32 is exact, so only the dense oracle's
+    # float-summation-order divergence remains (2e-5, like the
+    # binary-frame distributed ≡ standalone oracle)
+    ("json", 2e-5, 1e-6),
+])
+def test_distributed_loopback_codec_matches_standalone(lr_setup, codec,
+                                                       rtol, atol):
+    """End-to-end: the loopback runtime with EVERY frame through the given
+    wire codec reproduces the standalone run to that codec's tolerance."""
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
     from fedml_tpu.comm.message import set_wire_codec
     from fedml_tpu.distributed.fedavg import run_simulated
@@ -175,15 +184,15 @@ def test_distributed_loopback_with_compression_still_learns(lr_setup):
                        lr=0.1, frequency_of_the_test=1, seed=0)
     standalone = FedAvgAPI(data, task, cfg)
     standalone.train()
-    set_wire_codec("f16+zlib")
+    set_wire_codec(codec)
     try:
         agg = run_simulated(data, task, cfg, backend="LOOPBACK",
-                            job_id="t-codec")
+                            job_id=f"t-codec-{codec}")
     finally:
         set_wire_codec("none")
     for a, b in zip(pack_pytree(standalone.net), pack_pytree(agg.net)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=5e-3, atol=2e-3)
+                                   rtol=rtol, atol=atol)
     assert agg.history and agg.history[-1]["round"] == cfg.comm_round - 1
 
 
