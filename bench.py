@@ -228,10 +228,31 @@ def _measure(mode: str) -> None:
     # skips padded no-op batch compute; a mid-timing bucket change costs a
     # recompile, so it is a measured VARIANT, not the headline default
     bucket = os.environ.get("FEDML_BENCH_BUCKET_B") == "1"
+    # FEDML_BENCH_TELEMETRY_DIR=<dir>: write the obs event log (per-round
+    # records + Prometheus dump; scripts/report.py renders it). A measured
+    # VARIANT, never the headline default: floating the round metrics for
+    # the event log syncs per round, which the overlap-dependent paths pay
+    # for. Off (the default) adds zero work — FedAvgAPI(telemetry=None)
+    # builds the identical round program.
+    telemetry = None
+    tdir = os.environ.get("FEDML_BENCH_TELEMETRY_DIR")
+    if tdir:
+        import atexit
+
+        from fedml_tpu.obs import Telemetry
+
+        # per-mode subdirectory: the parent runs per_round and block as
+        # SEPARATE children — sharing one events.jsonl would interleave two
+        # runs' round records (duplicate round numbers, mixed span bases)
+        # and the second child's close() would clobber the first's
+        # metrics.prom
+        telemetry = Telemetry(log_dir=os.path.join(tdir, mode),
+                              run_id=f"bench_{mode}")
+        atexit.register(telemetry.close)
     api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),
                     donate=True, mesh=mesh,
                     block_working_set=(mode == "block" and working_set),
-                    bucket_batches=bucket)
+                    bucket_batches=bucket, telemetry=telemetry)
     _mark(t0, f"api built (device_data={mode == 'block'}, "
               f"working_set={mode == 'block' and working_set})")
 

@@ -37,3 +37,14 @@ utils       pytree ops, metrics, tracing, condensation
 """
 
 __version__ = "0.1.0"
+
+# graft missing new-jax names (jax.typeof / jax.lax.pcast / jax.shard_map)
+# onto older jax runtimes — a no-op on current jax (see utils/jax_compat).
+# Imports jax, which is acceptable at package-import time: every fedml_tpu
+# subpackage needs jax within a few lines anyway, and importing jax does
+# NOT initialize a backend (so this cannot hang on a dead accelerator
+# relay — the thing the light-import entry points guard against).
+from fedml_tpu.utils.jax_compat import install as _jax_compat_install
+
+_jax_compat_install()
+del _jax_compat_install

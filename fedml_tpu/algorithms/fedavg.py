@@ -69,6 +69,48 @@ def _gather_rows(dev_x, dev_y, idx, mask):
     return jnp.where(mx, x, jnp.zeros_like(x)), jnp.where(my, y, jnp.zeros_like(y))
 
 
+def _sq_norm(tree):
+    """Global squared L2 norm of a pytree (a scalar, inside jit)."""
+    leaves = jax.tree.leaves(tree)
+    return sum((jnp.vdot(v, v) for v in leaves), jnp.zeros(()))
+
+
+def _update_norm(new_params, old_params):
+    """||new - old|| over params — the single definition every telemetry
+    path (standalone stats, mesh round fn, mesh block step) emits under
+    the ``update_norm`` record key."""
+    return jnp.sqrt(_sq_norm(jax.tree.map(jnp.subtract, new_params,
+                                          old_params)))
+
+
+def round_stats(old_net, new_net, nets, avg, nsamp) -> dict:
+    """Telemetry round stats, computed IN-GRAPH so enabling them adds no
+    device sync — they ride out with the metrics dict the round program
+    already returns. (With telemetry off the round program is bit-identical
+    to the pre-telemetry build: none of this is traced.)
+
+    - ``update_norm``: ||new - old|| over params — the aggregate step size
+      the server actually applied (post server_update / post hooks);
+    - ``client_drift_mean``/``client_drift_max``: per-client ||net_k - avg||
+      over the round's REAL clients (zero-sample padding excluded) — the
+      non-IID dispersion statistic FedProx/FedNova papers reason about.
+    """
+    out = {"update_norm": _update_norm(new_net.params, old_net.params)}
+    # [K] per-client squared distances to the aggregate
+    drift_sq = sum(
+        (jnp.sum((s - a) ** 2, axis=tuple(range(1, s.ndim)))
+         for s, a in zip(jax.tree.leaves(nets.params),
+                         jax.tree.leaves(avg.params))),
+        jnp.zeros(nsamp.shape),
+    )
+    drift = jnp.sqrt(drift_sq)
+    real = (nsamp > 0).astype(drift.dtype)
+    n_real = jnp.maximum(jnp.sum(real), 1.0)
+    out["client_drift_mean"] = jnp.sum(drift * real) / n_real
+    out["client_drift_max"] = jnp.max(drift * real)
+    return out
+
+
 def agg_weights(nsamp, uniform: bool):
     """Aggregation weights: sample counts (FedAvg default) or, with
     ``uniform``, 1 per participating client / 0 for zero-sample padding —
@@ -206,11 +248,18 @@ class FedAvgAPI:
         block_working_set: bool = False,
         uniform_avg: bool = False,
         bucket_batches: bool = False,
+        telemetry=None,
     ):
         self.data = dataset
         self.task = task
         self.cfg = config
         self.mesh = mesh
+        # telemetry: an obs.Telemetry bundle. None (default) keeps the round
+        # program bit-identical to the untelemetered build — the stats below
+        # are extra jit OUTPUTS, so the off path has zero overhead and the
+        # on path adds no device sync beyond the metrics it already returns.
+        self.telemetry = telemetry
+        self._emit_stats = telemetry is not None and telemetry.round_stats
         # uniform_avg: aggregate with weight 1 per REAL client (0 for
         # zero-sample padding) instead of sample counts. DP-FedAvg needs
         # this: with sample-weighted averaging a clipped update's influence
@@ -337,6 +386,8 @@ class FedAvgAPI:
         if self.post_aggregate_hook is not None:
             new_net = self.post_aggregate_hook(new_net, post_key)
         agg_metrics = {k: jnp.sum(v) for k, v in metrics.items()}
+        if self._emit_stats:
+            agg_metrics.update(round_stats(net, new_net, nets, avg, nsamp))
         return new_net, new_opt, agg_metrics
 
     def _materialize(self, batch):
@@ -440,6 +491,12 @@ class FedAvgAPI:
             new_net, new_opt = self.server_update(net, avg, server_opt_state)
             if self.post_aggregate_hook is not None:
                 new_net = self.post_aggregate_hook(new_net, kp)
+            if self._emit_stats:
+                # drift needs the per-client nets, which live inside
+                # shard_map — the mesh path reports the update norm only
+                metrics = dict(metrics)
+                metrics["update_norm"] = _update_norm(new_net.params,
+                                                      net.params)
             return new_net, new_opt, metrics
 
         return round_fn
@@ -605,9 +662,15 @@ class FedAvgAPI:
                             nets, hkeys)
                 avg, msum = _shard_aggregate(
                     nets, metrics, self._agg_weights(nsamp_r), axis)
+                old_net = net
                 net, opt = server_update(net, avg, opt)
                 if self.post_aggregate_hook is not None:
                     net = self.post_aggregate_hook(net, kp)
+                if self._emit_stats:
+                    # mesh parity with the per-round path: update norm only
+                    msum = dict(msum)
+                    msum["update_norm"] = _update_norm(net.params,
+                                                       old_net.params)
                 return (net, opt), msum
 
             (net, opt), ms = jax.lax.scan(
@@ -641,6 +704,8 @@ class FedAvgAPI:
             raise ValueError("run_rounds needs device_data=True")
         if not hasattr(self, "_block_fn"):
             self._block_fn = self._build_block_fn()
+        if self.telemetry is not None:
+            spans_before = dict(self.tracer.rounds[-1])
 
         ids_l, idx_l, mask_l, ns_l = [], [], [], []
         with self.tracer.span("pack"):
@@ -680,6 +745,20 @@ class FedAvgAPI:
                 self.rng, self.net, self.server_opt_state, dev_x, dev_y,
                 *[jnp.asarray(b) for b in blocks], jnp.asarray(rounds),
             )
+        if self.telemetry is not None:
+            # per-round records from the scanned block's stacked metrics
+            # (one sync for the whole block); the block's host spans
+            # (pack + one dispatch) ride on a separate 'block' event since
+            # they are amortized over the R rounds, not per-round
+            ms_host = {k: np.asarray(v) for k, v in ms.items()}
+            self.telemetry.events.emit(
+                "block", start=int(start_round), rounds=int(num_rounds),
+                spans=self._span_delta(spans_before))
+            for i in range(num_rounds):
+                self.telemetry.emit_round(
+                    start_round + i, clients=ids_l[i].tolist(),
+                    metrics={k: float(v[i]) for k, v in ms_host.items()},
+                    block=True)
         return ms
 
     _WORKING_SET_BUCKET = 8192  # rows; pad-to-bucket keeps ONE compiled block
@@ -728,8 +807,20 @@ class FedAvgAPI:
         self._ws_dev_x, self._ws_dev_y = put(cx), put(cy)
         return remapped, self._ws_dev_x, self._ws_dev_y
 
+    def _span_delta(self, before: dict) -> dict:
+        """This call's span seconds: current tracer round minus a snapshot
+        taken at entry. run_round/run_rounds may be driven directly (bench,
+        CLI loops) without train()'s next_round() between calls, so the
+        tracer's round dict ACCUMULATES — the emitted record must carry the
+        delta, not the running total."""
+        cur = self.tracer.rounds[-1]
+        return {k: v - before.get(k, 0.0) for k, v in cur.items()
+                if v - before.get(k, 0.0) > 0.0}
+
     # ------------------------------------------------------------------ train
     def run_round(self, round_idx: int):
+        if self.telemetry is not None:
+            spans_before = dict(self.tracer.rounds[-1])
         with self.tracer.span("pack"):
             ids = self._sampled_ids(round_idx)
             cb = self._pack_round(round_idx)
@@ -739,6 +830,14 @@ class FedAvgAPI:
                 rk, self.net, self.server_opt_state, cb,
                 jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
             )
+        if self.telemetry is not None:
+            # floating the metrics syncs on the round's outputs — a cost the
+            # caller opted into by passing telemetry; the off path returns
+            # the device arrays untouched (no sync, dispatch still overlaps)
+            self.telemetry.emit_round(
+                round_idx, clients=np.asarray(ids).tolist(),
+                spans=self._span_delta(spans_before),
+                metrics={k: float(v) for k, v in metrics.items()})
         return metrics
 
     def _eval_on_all_clients(self) -> bool:
@@ -788,6 +887,9 @@ class FedAvgAPI:
     def train(self, num_rounds: int | None = None):
         cfg = self.cfg
         rounds = num_rounds or cfg.comm_round
+        if self.telemetry is not None:
+            self.telemetry.run_header(dataclasses.asdict(cfg),
+                                      engine="standalone")
         for r in range(rounds):
             t0 = time.perf_counter()
             metrics = self.run_round(r)
@@ -796,6 +898,8 @@ class FedAvgAPI:
                 rec["round_time"] = time.perf_counter() - t0
                 self.history.append(rec)
                 log.info("round %d: %s", r, rec)
+                if self.telemetry is not None:
+                    self.telemetry.emit_eval(r, rec)
             self.tracer.next_round()
         return self.net
 

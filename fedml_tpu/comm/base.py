@@ -12,7 +12,10 @@ from __future__ import annotations
 import abc
 import queue
 import threading
+import time
 from typing import TYPE_CHECKING
+
+from fedml_tpu.obs import comm_instrument as _obs
 
 if TYPE_CHECKING:
     from fedml_tpu.comm.message import Message
@@ -20,9 +23,14 @@ if TYPE_CHECKING:
 
 
 class BaseCommManager(abc.ABC):
+    # wire-accounting label (obs/comm_instrument); backends override
+    backend_name = "base"
+
     def __init__(self):
         self._observers: list["Observer"] = []
-        self._q: "queue.Queue[Message]" = queue.Queue()
+        # (message, enqueue-time) pairs: the dispatch loop reports how long
+        # each decoded message waited before its handler ran
+        self._q: "queue.Queue[tuple[Message, float]]" = queue.Queue()
         self._running = threading.Event()
 
     # ------------------------------------------------------------- interface
@@ -44,17 +52,40 @@ class BaseCommManager(abc.ABC):
         self._running.set()
         while self._running.is_set():
             try:
-                msg = self._q.get(timeout=0.1)
+                msg, t_in = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
+            _obs.record_dispatch_latency(self.backend_name,
+                                         time.perf_counter() - t_in)
             self._notify(msg)
 
     def stop_receive_message(self) -> None:
         self._running.clear()
 
     # -------------------------------------------------------------- plumbing
+    def _encode(self, msg: "Message", codec: str | None = None) -> bytes:
+        """Serialize an outgoing message through the wire codec, recording
+        messages/bytes-per-codec into the process metrics registry. Every
+        backend's send path routes through here so loopback, gRPC, and MQTT
+        report identically."""
+        from fedml_tpu.comm import message as _message
+
+        frame = msg.to_bytes(codec)
+        _obs.record_send(self.backend_name,
+                         codec if codec is not None else _message._CODEC,
+                         len(frame), str(msg.get_type()))
+        return frame
+
+    def _receive_frame(self, data: bytes) -> None:
+        """Decode an inbound frame, record its size, and enqueue it for the
+        dispatch loop — the shared receive half of ``_encode``."""
+        from fedml_tpu.comm.message import Message
+
+        _obs.record_receive(self.backend_name, len(data))
+        self._enqueue(Message.from_bytes(data))
+
     def _enqueue(self, msg: "Message") -> None:
-        self._q.put(msg)
+        self._q.put((msg, time.perf_counter()))
 
     def _notify(self, msg: "Message") -> None:
         for obs in list(self._observers):

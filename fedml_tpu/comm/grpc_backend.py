@@ -42,6 +42,8 @@ def read_ip_config(path: str) -> dict[int, str]:
 
 
 class GrpcCommManager(BaseCommManager):
+    backend_name = "grpc"
+
     def __init__(
         self,
         rank: int,
@@ -96,9 +98,12 @@ class GrpcCommManager(BaseCommManager):
             epoch = int.from_bytes(hdr[8:16], "little")
             seq = int.from_bytes(hdr[16:], "little")
             if not self._accept_frame(src, epoch, seq):
+                from fedml_tpu.obs import comm_instrument as _obs
+
+                _obs.record_duplicate(self.backend_name)
                 log.warning("drop duplicate frame %d from rank %d", seq, src)
                 return b"dup"
-            self._enqueue(Message.from_bytes(frame))
+            self._receive_frame(frame)
             return b"ok"
 
         handler = grpc.method_handlers_generic_handler(
@@ -172,7 +177,7 @@ class GrpcCommManager(BaseCommManager):
             seq = self._send_seq
         frame = (self.rank.to_bytes(8, "little")
                  + self._epoch.to_bytes(8, "little")
-                 + seq.to_bytes(8, "little") + msg.to_bytes())
+                 + seq.to_bytes(8, "little") + self._encode(msg))
         deadline = time.monotonic() + self.send_timeout_s
         attempt = 0
         while True:
@@ -188,6 +193,11 @@ class GrpcCommManager(BaseCommManager):
                 if not retriable or time.monotonic() >= deadline:
                     raise
                 attempt += 1
+                # wire accounting: _encode counted this frame once (logical
+                # send); each retry moves the bytes again
+                from fedml_tpu.obs import comm_instrument as _obs
+
+                _obs.record_retransmit(self.backend_name, len(frame))
                 log.warning("send to rank %d unavailable (attempt %d), retrying", dest, attempt)
                 # Drop (don't close) the cached channel: a dead peer's channel
                 # can linger in TRANSIENT_FAILURE with long reconnect backoff,

@@ -21,6 +21,8 @@ _registry_lock = threading.Lock()
 
 
 class LoopbackCommManager(BaseCommManager):
+    backend_name = "loopback"
+
     def __init__(self, job_id: str, rank: int, size: int):
         super().__init__()
         self.job_id, self.rank, self.size = job_id, rank, size
@@ -28,13 +30,13 @@ class LoopbackCommManager(BaseCommManager):
             _registry[job_id][rank] = self
 
     def send_message(self, msg: Message) -> None:
-        frame = msg.to_bytes()  # force the real wire path
+        frame = self._encode(msg)  # force the real wire path (and count it)
         dest = int(msg.get_receiver_id())
         with _registry_lock:
             peer = _registry[self.job_id].get(dest)
         if peer is None:
             raise RuntimeError(f"loopback: rank {dest} not registered in job {self.job_id}")
-        peer._enqueue(Message.from_bytes(frame))
+        peer._receive_frame(frame)
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()

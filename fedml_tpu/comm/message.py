@@ -193,7 +193,19 @@ class Message:
     def _to_reference_json(self) -> bytes:
         """The reference's wire form: json.dumps(msg_params) with every
         array payload as nested lists (message.py:62-66 to_json; weights
-        listified per transform_tensor_to_list, fedavg/utils.py:13-16)."""
+        listified per transform_tensor_to_list, fedavg/utils.py:13-16).
+
+        Decode-symmetry extension (ADVICE r5 item 1): the frame also carries
+        an ``__arrays__`` manifest naming every top-level key that was
+        listified, with its dtype(s) — so ``_from_reference_json`` can
+        restore ndarrays for EVERY protocol's array params (split_nn
+        acts/grads, fedgkt feats/logits, sparse idx/val...), not just
+        ``model_params``, and with the sender's dtype instead of a blanket
+        float32. A stock reference peer ignores the extra key (its decode
+        is a plain json.loads into the params dict), so interop holds; a
+        stock reference SENDER omits it and we fall back to the
+        ``model_params``-only heuristic arrify below."""
+        manifest: dict[str, Any] = {}
 
         def listify(v):
             arr = self._as_array(v)
@@ -205,8 +217,29 @@ class Message:
                 return {k: listify(e) for k, e in v.items()}
             return v
 
-        return json.dumps({k: listify(v) for k, v in
-                           self.msg_params.items()}).encode()
+        doc: dict[str, Any] = {}
+        for k, v in self.msg_params.items():
+            arr = self._as_array(v)
+            if arr is not None:
+                doc[k] = arr.tolist()
+                manifest[k] = arr.dtype.str
+            elif isinstance(v, (list, tuple)) and v and all(
+                self._as_array(e) is not None for e in v
+            ):
+                arrs = [self._as_array(e) for e in v]
+                doc[k] = [a.tolist() for a in arrs]
+                manifest[k] = [a.dtype.str for a in arrs]
+            elif isinstance(v, dict) and v and all(
+                self._as_array(e) is not None for e in v.values()
+            ):  # state_dict shape: key -> one tensor
+                arrs2 = {k2: self._as_array(e) for k2, e in v.items()}
+                doc[k] = {k2: a.tolist() for k2, a in arrs2.items()}
+                manifest[k] = {k2: a.dtype.str for k2, a in arrs2.items()}
+            else:
+                doc[k] = listify(v)
+        if manifest:
+            doc["__arrays__"] = manifest
+        return json.dumps(doc).encode()
 
     # reference integer msg types (fedavg/message_define.py:6-11) -> the
     # string vocabulary fedml_tpu managers register handlers under
@@ -224,6 +257,25 @@ class Message:
             msg.msg_params[Message.MSG_ARG_KEY_TYPE] = \
                 cls._REFERENCE_MSG_TYPES.get(t, str(t))
 
+        manifest = msg.msg_params.pop("__arrays__", None)
+        if manifest is not None:
+            # fedml_tpu sender: restore ndarrays (with the sender's dtype)
+            # for exactly the keys it listified — symmetric for every
+            # protocol's array params, not just model_params
+            for k, spec in manifest.items():
+                v = msg.msg_params.get(k)
+                if v is None:
+                    continue
+                if isinstance(spec, list):  # list-of-arrays payload
+                    msg.msg_params[k] = [np.asarray(e, np.dtype(d))
+                                         for e, d in zip(v, spec)]
+                elif isinstance(spec, dict):  # state_dict-shaped payload
+                    msg.msg_params[k] = {k2: np.asarray(v[k2], np.dtype(d))
+                                         for k2, d in spec.items()}
+                else:
+                    msg.msg_params[k] = np.asarray(v, np.dtype(spec))
+            return msg
+
         def arrify(v):  # transform_list_to_tensor (fedavg/utils.py:7-10)
             if isinstance(v, dict):
                 # reference state_dict shape: key -> ONE tensor as nested
@@ -236,6 +288,8 @@ class Message:
                 return np.asarray(v, np.float32)
             return v
 
+        # stock-reference sender (no manifest): the model_params-only
+        # heuristic — the only array key the reference's own protocol ships
         k = Message.MSG_ARG_KEY_MODEL_PARAMS
         if k in msg.msg_params:
             msg.msg_params[k] = arrify(msg.msg_params[k])

@@ -42,6 +42,8 @@ log = logging.getLogger("fedml_tpu.comm.mqtt")
 
 
 class MqttCommManager(BaseCommManager):
+    backend_name = "mqtt"
+
     def __init__(self, broker_host: str, broker_port: int, client_id: int,
                  client_num: int, job_id: str | None = None):
         super().__init__()
@@ -100,7 +102,7 @@ class MqttCommManager(BaseCommManager):
     def _on_payload(self, payload: bytes) -> None:
         if not payload:  # retained-clear tombstone (§3.3.1.3), not a frame
             return
-        self._enqueue(Message.from_bytes(payload))
+        self._receive_frame(payload)
 
     def _on_message(self, client, userdata, m):
         self._on_payload(m.payload)
@@ -118,7 +120,7 @@ class MqttCommManager(BaseCommManager):
         retain = self.client_id == 0
         if retain:
             self._retained_topics.add(topic)
-        self._publish(topic, msg.to_bytes(), retain)
+        self._publish(topic, self._encode(msg), retain)
 
     def _publish(self, topic: str, payload: bytes, retain: bool):
         if self._mini is not None:

@@ -62,13 +62,14 @@ def run_simulated(
     broker_host: str = "127.0.0.1",
     broker_port: int = 1883,
     sparsify_ratio: float | None = None,
+    telemetry=None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
     server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
-                                 ckpt_dir=ckpt_dir, **kw)
+                                 ckpt_dir=ckpt_dir, telemetry=telemetry, **kw)
     clients = [
         init_client(dataset, task, cfg, rank, size, backend,
                     sparsify_ratio=sparsify_ratio, **kw)

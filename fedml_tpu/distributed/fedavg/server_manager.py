@@ -37,13 +37,27 @@ log = logging.getLogger("fedml_tpu.distributed.fedavg")
 class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0,
                  backend="LOOPBACK", round_timeout_s: float | None = None,
-                 ckpt_dir: str | None = None, **kw):
+                 ckpt_dir: str | None = None, telemetry=None, **kw):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
         self._bcast_leaves = None  # this round's packed broadcast (sparse)
         self.round_timeout_s = round_timeout_s
         self.ckpt_dir = ckpt_dir
+        # obs.Telemetry: per-round event records (sampled ids, aggregate/eval
+        # span timings, update norm, comm byte/message deltas). None = the
+        # seed behavior, zero extra work.
+        self.telemetry = telemetry
+        self._round_ids: list[int] = []
+        if telemetry is not None:
+            import dataclasses
+
+            from fedml_tpu.utils.tracing import RoundTracer
+
+            self._tracer = RoundTracer()
+            telemetry.run_header(dataclasses.asdict(aggregator.cfg),
+                                 engine="distributed", backend=backend,
+                                 world_size=size)
         if ckpt_dir is not None:
             self._maybe_resume()
         self._round_lock = threading.Lock()
@@ -192,6 +206,7 @@ class FedAvgServerManager(ServerManager):
 
     def send_init_msg(self):
         client_indexes = self.aggregator.client_sampling(self.round_idx)
+        self._round_ids = [int(c) for c in client_indexes]
         global_params = self.aggregator.get_global_model_params()
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
         # codec their deltas are relative to the decoded broadcast
@@ -242,8 +257,32 @@ class FedAvgServerManager(ServerManager):
     def _advance_round(self):
         """Aggregate what's collected, eval, and start the next round (or
         finish). Caller holds _round_lock."""
-        global_params = self.aggregator.aggregate()
-        self.aggregator.test_on_server_for_all_clients(self.round_idx)
+        tel = self.telemetry
+        if tel is not None:
+            import numpy as np
+
+            n_samples = float(sum(self.aggregator.sample_num_dict.values()))
+            old_leaves = [np.asarray(v)
+                          for v in self.aggregator.get_global_model_params()]
+            with self._tracer.span("aggregate"):
+                global_params = self.aggregator.aggregate()
+            with self._tracer.span("eval"):
+                self.aggregator.test_on_server_for_all_clients(self.round_idx)
+            upd_sq = sum(
+                float(np.sum((np.asarray(n) - o) ** 2))
+                for n, o in zip(global_params, old_leaves))
+            hist = self.aggregator.history
+            tel.emit_round(
+                self.round_idx, clients=self._round_ids,
+                spans=dict(self._tracer.rounds[-1]),
+                metrics={"update_norm": float(np.sqrt(upd_sq)),
+                         "num_samples": n_samples},
+                evals=(hist[-1] if hist
+                       and hist[-1].get("round") == self.round_idx else None))
+            self._tracer.next_round()
+        else:
+            global_params = self.aggregator.aggregate()
+            self.aggregator.test_on_server_for_all_clients(self.round_idx)
         self._maybe_save()
 
         self.round_idx += 1
@@ -251,6 +290,7 @@ class FedAvgServerManager(ServerManager):
             self._broadcast_finish()
             return
         client_indexes = self.aggregator.client_sampling(self.round_idx)
+        self._round_ids = [int(c) for c in client_indexes]
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
         # codec their deltas are relative to the decoded broadcast
         self._bcast_leaves = codec_roundtrip(global_params)

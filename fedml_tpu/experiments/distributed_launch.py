@@ -76,6 +76,14 @@ def add_args(p: argparse.ArgumentParser):
                         "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="server round checkpoints; restart resumes the job")
+    p.add_argument("--telemetry-dir", "--telemetry_dir", dest="telemetry_dir",
+                   type=str, default=None,
+                   help="rank 0: write the structured run telemetry here — "
+                        "events.jsonl (run header + per-round records: "
+                        "sampled ids, span timings, update norm, comm "
+                        "byte/message counters; docs/OBSERVABILITY.md) and "
+                        "a Prometheus text dump at exit; render with "
+                        "scripts/report.py")
     # experiment surface (subset of cli.py, same names)
     p.add_argument("--model", type=str, default="lr")
     p.add_argument("--dataset", type=str, default="mnist")
@@ -110,7 +118,7 @@ def add_args(p: argparse.ArgumentParser):
     return p
 
 
-def init_role(args, data, task, cfg, backend_kw):
+def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     """Construct this rank's manager for --algo (does not run it)."""
     from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
     from fedml_tpu.distributed.fedavg.api import init_client
@@ -142,7 +150,7 @@ def init_role(args, data, task, cfg, backend_kw):
         return FedAvgServerManager(agg, rank=0, size=args.world_size,
                                    backend=backend, ckpt_dir=args.ckpt_dir,
                                    round_timeout_s=args.round_timeout_s,
-                                   **backend_kw)
+                                   telemetry=telemetry, **backend_kw)
 
     # sparse uplinks apply where the upload is plain weights; a
     # turboaggregate share is a masked tensor whose top-k entries are
@@ -235,8 +243,17 @@ def main(argv=None):
     else:
         backend_kw.update(job_id="launch")
 
-    mgr = init_role(args, data, task, cfg, backend_kw)
-    mgr.run()
+    telemetry = None
+    if args.telemetry_dir and args.rank == 0:
+        from fedml_tpu.obs import Telemetry
+
+        telemetry = Telemetry(log_dir=args.telemetry_dir)
+    mgr = init_role(args, data, task, cfg, backend_kw, telemetry=telemetry)
+    try:
+        mgr.run()
+    finally:
+        if telemetry is not None:
+            telemetry.close()
     if args.rank == 0:
         print(json.dumps(mgr.aggregator.history, default=float))
 

@@ -232,7 +232,22 @@ class DARTSNetwork(nn.Module):
     WITHIN a stage. Annealing recipe: params (incl. alphas) are
     tau-independent, so run staged search — build a fresh FedNASAPI at
     each lower tau and carry ``net`` over (one recompile per stage, the
-    honest cost model under jit; tested in test_nas_affinity_condense)."""
+    honest cost model under jit; tested in test_nas_affinity_condense).
+
+    Second GDAS deviation (ADVICE r5 item 2, documented deliberately): the
+    gumbel noise is drawn ONCE per alphas tensor per forward and the
+    resulting hard selection is shared across all cells of that type; the
+    reference re-samples inside the per-cell forward loop
+    (model_search_gdas.py:127-129), giving each cell an independent draw
+    (more exploration per step). Here the edge weights are computed once
+    before the cell loop precisely so the mixture is a single fused op
+    under vmap-over-clients — per-cell draws would rebuild the mixture
+    inside every cell at K-clients width. The shared draw is still an
+    unbiased sample of the same categorical; it only correlates the cells'
+    exploration within one step, and successive steps (fresh dropout rng
+    per batch) decorrelate across time. Callers who want reference-exact
+    exploration can raise ``layers``-many supernets — nothing in the
+    search API assumes the shared draw."""
 
     num_classes: int = 10
     layers: int = 8
@@ -374,7 +389,11 @@ def as_genotype(g) -> dict:
 
         if os.path.exists(g):
             with open(g) as f:
-                return json.load(f)
+                # recurse so file input gets the same (op, int)
+                # normalization and fail-fast validation as dict input —
+                # a file with float/string node indices must error HERE,
+                # not later inside DerivedCell (ADVICE r5 item 4)
+                return as_genotype(json.load(f))
         raise ValueError(f"unknown genotype {g!r} (registry: "
                          f"{sorted(GENOTYPES)} or a json file path)")
     g = dict(g)
@@ -561,6 +580,7 @@ class NetworkImageNet(nn.Module):
         s1 = _norm(C, affine=True)(h)
 
         C_curr = C
+        # -{0}: tiny-layer deviation from model.py (see NetworkCIFAR note)
         reduce_at = {self.layers // 3, 2 * self.layers // 3} - {0}
         reduction_prev = True  # stem1 already reduced (model.py:187)
         aux_in = None
@@ -619,6 +639,11 @@ class NetworkCIFAR(nn.Module):
         s0 = s1 = _norm(C_curr, affine=True)(s)
 
         C_curr = self.init_filters
+        # reference model.py:130 places a reduction at cell 0 when
+        # layers < 3; the -{0} exclusion is a deliberate deviation (ADVICE
+        # r5 item 3) shared with the supernet: a reduction at layer 0 would
+        # leave a <3-layer net with no normal cell. Real configs
+        # (layers >= 6) are unaffected — layers//3 >= 2.
         reduce_at = {self.layers // 3, 2 * self.layers // 3} - {0}
         reduction_prev = False
         aux_in = None

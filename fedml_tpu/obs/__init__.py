@@ -1,0 +1,39 @@
+"""Unified telemetry subsystem (obs = observability).
+
+The reference FedML's only observability is ad-hoc wall-clock prints
+(FedAVGAggregator.py:59,85-86); the seed carried only a host-side
+``RoundTracer``. This package is the backend-spanning layer everything else
+reports through:
+
+- ``metrics``        — MetricsRegistry: counters / gauges / streaming
+                       histograms (p50/p95/p99), labeled families, the
+                       process-wide default ``REGISTRY``;
+- ``events``         — structured JSONL EventLog (run header, per-round
+                       records) with rotating-file and in-memory sinks;
+- ``comm_instrument``— wire accounting hooks BaseCommManager calls, so
+                       loopback/gRPC/MQTT report identically;
+- ``telemetry``      — the ``Telemetry`` bundle engines accept
+                       (``FedAvgAPI(..., telemetry=...)``,
+                       ``--telemetry-dir`` on the distributed launcher);
+- ``export``         — CSV / Prometheus-text / BENCH-blob exporters and the
+                       jax.profiler bridge.
+
+scripts/report.py renders a run's events.jsonl; docs/OBSERVABILITY.md has
+the schema and metric-name reference.
+"""
+
+from fedml_tpu.obs.comm_instrument import comm_counters
+from fedml_tpu.obs.events import EventLog, JsonlSink, MemorySink, read_jsonl
+from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from fedml_tpu.obs.telemetry import Telemetry
+
+__all__ = [
+    "REGISTRY",
+    "EventLog",
+    "JsonlSink",
+    "MemorySink",
+    "MetricsRegistry",
+    "Telemetry",
+    "comm_counters",
+    "read_jsonl",
+]

@@ -1,0 +1,122 @@
+"""Comm-layer instrumentation — wire accounting every backend reports alike.
+
+``BaseCommManager`` calls these hooks at the three points all transports
+share (obs must not import comm, so the dependency points this way):
+
+- ``record_send``    — at encode time (``_encode``): messages/bytes out,
+  labeled by backend, codec tier, and msg_type;
+- ``record_receive`` — at decode time (``_receive_frame``): messages/bytes in;
+- ``record_dispatch_latency`` — in the receive loop: seconds a decoded
+  message waited in the inbound queue before its handler ran (the reference's
+  MPI poll loop put a 0.3 s floor here, mpi/com_manager.py:71-78 — this
+  histogram is the proof ours doesn't).
+
+Counters land in the process-wide ``metrics.REGISTRY`` so loopback (many
+managers, one process), gRPC, and MQTT runs all read through the same names:
+
+    comm_messages_sent_total{backend,type}
+    comm_bytes_sent_total{backend,codec}
+    comm_messages_received_total{backend}
+    comm_bytes_received_total{backend}
+    comm_dispatch_latency_seconds{backend}   (histogram)
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+# Child metrics are memoized so the per-message hot path is just an inc()
+# under that metric's own lock — no registry-lock + family-dict + sorted
+# label-tuple work per frame. Key cardinality is bounded: a handful of
+# backends, codecs, and protocol msg_types. Safe because REGISTRY is
+# process-immortal (never reset).
+
+
+@lru_cache(maxsize=512)
+def _sent_msgs(backend: str, msg_type: str):
+    return REGISTRY.counter("comm_messages_sent_total", backend=backend,
+                            type=msg_type)
+
+
+@lru_cache(maxsize=64)
+def _sent_bytes(backend: str, codec: str):
+    return REGISTRY.counter("comm_bytes_sent_total", backend=backend,
+                            codec=codec)
+
+
+@lru_cache(maxsize=16)
+def _recv(backend: str):
+    return (REGISTRY.counter("comm_messages_received_total", backend=backend),
+            REGISTRY.counter("comm_bytes_received_total", backend=backend))
+
+
+@lru_cache(maxsize=16)
+def _dispatch_hist(backend: str):
+    return REGISTRY.histogram("comm_dispatch_latency_seconds",
+                              backend=backend)
+
+
+def record_send(backend: str, codec: str, nbytes: int, msg_type: str) -> None:
+    _sent_msgs(backend, msg_type).inc()
+    _sent_bytes(backend, codec).inc(nbytes)
+
+
+def record_receive(backend: str, nbytes: int) -> None:
+    msgs, byts = _recv(backend)
+    msgs.inc()
+    byts.inc(nbytes)
+
+
+def record_dispatch_latency(backend: str, seconds: float) -> None:
+    _dispatch_hist(backend).observe(seconds)
+
+
+@lru_cache(maxsize=16)
+def _retransmits(backend: str):
+    return (REGISTRY.counter("comm_retransmits_total", backend=backend),
+            REGISTRY.counter("comm_retransmit_bytes_total", backend=backend))
+
+
+def record_retransmit(backend: str, nbytes: int) -> None:
+    """A frame transmitted AGAIN after a delivery failure. ``*_sent_total``
+    counts logical frames (one per message, at encode time); this counter
+    exposes the extra wire traffic retries add — the number that diagnoses
+    a flaky link."""
+    msgs, byts = _retransmits(backend)
+    msgs.inc()
+    byts.inc(nbytes)
+
+
+@lru_cache(maxsize=16)
+def _duplicates(backend: str):
+    return REGISTRY.counter("comm_duplicates_dropped_total", backend=backend)
+
+
+def record_duplicate(backend: str) -> None:
+    """An inbound frame dropped by exactly-once dedup before decode —
+    received wire traffic that ``*_received_total`` (decoded frames)
+    deliberately excludes."""
+    _duplicates(backend).inc()
+
+
+def comm_counters(registry: MetricsRegistry | None = None) -> dict:
+    """Flat cumulative totals (all labels summed) — the snapshot Telemetry
+    diffs between rounds to put per-round byte/message counts in the event
+    log. Includes dispatch-latency quantiles when any message was timed."""
+    reg = registry or REGISTRY
+    out = {
+        "messages_sent": reg.total("comm_messages_sent_total"),
+        "bytes_sent": reg.total("comm_bytes_sent_total"),
+        "messages_received": reg.total("comm_messages_received_total"),
+        "bytes_received": reg.total("comm_bytes_received_total"),
+    }
+    snap = reg.snapshot().get("comm_dispatch_latency_seconds", {})
+    n = sum(s.get("count", 0) for s in snap.values())
+    if n:
+        out["dispatch_count"] = n
+        # single-backend runs (the norm) have one child; multi-backend runs
+        # get the max — a conservative "slowest transport" view
+        out["dispatch_p95_s"] = max(s.get("p95", 0.0) for s in snap.values())
+    return out

@@ -1,0 +1,128 @@
+"""Structured event log — JSONL run records with pluggable sinks.
+
+One run = one stream of JSON objects, one per line:
+
+    {"ts": ..., "kind": "run",   "run": "...", "config": {...}}
+    {"ts": ..., "kind": "round", "run": "...", "round": 3,
+     "clients": [7, 12, ...], "spans": {"pack": ..., "round": ...},
+     "metrics": {"loss_sum": ..., "update_norm": ...},
+     "comm": {"messages_sent": ..., "bytes_sent": ...}}
+    {"ts": ..., "kind": "eval",  "run": "...", "round": 3,
+     "eval": {"test_acc": ..., "test_loss": ...}}
+
+The schema is documented in docs/OBSERVABILITY.md and consumed by
+scripts/report.py. Sinks: ``JsonlSink`` (size-rotated file — a long run
+cannot fill the disk) and ``MemorySink`` (tests read ``.records``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class MemorySink:
+    """In-memory sink — tests and short-lived tools read ``records``."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+
+    def write(self, rec: dict) -> None:
+        self.records.append(rec)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """Append-only JSONL file with size-based rotation: when the active file
+    would exceed ``max_bytes`` the stack shifts (events.jsonl ->
+    events.jsonl.1 -> ... -> .{backups}, oldest dropped) and a fresh file
+    opens. Rotation is per-record, so a single record is never split."""
+
+    def __init__(self, path: str, max_bytes: int = 64 << 20, backups: int = 3):
+        self.path = path
+        self.max_bytes = max_bytes
+        self.backups = backups
+        self._lock = threading.Lock()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._size = self._f.tell()
+
+    def _rotate(self) -> None:
+        self._f.close()
+        for i in range(self.backups - 1, 0, -1):
+            src, dst = f"{self.path}.{i}", f"{self.path}.{i + 1}"
+            if os.path.exists(src):
+                os.replace(src, dst)
+        if self.backups > 0:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)
+        self._f = open(self.path, "a")
+        self._size = 0
+
+    def write(self, rec: dict) -> None:
+        line = json.dumps(rec, default=float) + "\n"
+        with self._lock:
+            if self._size and self._size + len(line) > self.max_bytes:
+                self._rotate()
+            self._f.write(line)
+            self._f.flush()
+            self._size += len(line)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+
+class EventLog:
+    """Emit structured records into a sink. Every record carries ``ts``
+    (wall clock), ``kind``, and the run id."""
+
+    def __init__(self, sink, run_id: str | None = None, clock=time.time):
+        self.sink = sink
+        self.run_id = run_id or time.strftime("run_%Y%m%d_%H%M%S")
+        self._clock = clock
+
+    def emit(self, kind: str, **fields) -> dict:
+        rec = {"ts": self._clock(), "kind": kind, "run": self.run_id}
+        rec.update(fields)
+        self.sink.write(rec)
+        return rec
+
+    def close(self) -> None:
+        self.sink.close()
+
+
+def read_jsonl(path: str, kinds: tuple[str, ...] | None = None) -> list[dict]:
+    """Load a JSONL event file (rotated predecessors first, so records come
+    back in emission order). Unparseable lines are skipped — a run killed
+    mid-write must not make its whole log unreadable."""
+    paths = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        paths.append(f"{path}.{i}")
+        i += 1
+    paths.reverse()  # .N is oldest
+    if os.path.exists(path):
+        paths.append(path)
+    out = []
+    for p in paths:
+        with open(p, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if kinds is None or rec.get("kind") in kinds:
+                    out.append(rec)
+    return out

@@ -1,0 +1,125 @@
+"""Telemetry — the bundle engines and launchers actually pass around.
+
+One object ties together the three obs primitives:
+
+- a ``MetricsRegistry`` (defaults to the process-wide one, so comm-layer
+  counters recorded by the backends show up in this run's round records);
+- an ``EventLog`` over a rotating JSONL file (``log_dir/events.jsonl``) or
+  an in-memory sink (tests);
+- the ``jax.profiler`` bridge (``profile(logdir)`` — the opt-in XLA trace,
+  reusing utils.tracing.trace).
+
+Contract with the engines: a ``telemetry=None`` engine is bit-identical to
+the pre-telemetry engine — no extra outputs in the jitted round program, no
+extra device syncs, no host work. All cost is opt-in.
+"""
+
+from __future__ import annotations
+
+import os
+
+from fedml_tpu.obs.comm_instrument import comm_counters
+from fedml_tpu.obs.events import EventLog, JsonlSink, MemorySink
+from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+
+class Telemetry:
+    def __init__(self, log_dir: str | None = None,
+                 registry: MetricsRegistry | None = None,
+                 sink=None, run_id: str | None = None,
+                 round_stats: bool = True,
+                 rotate_bytes: int = 64 << 20, backups: int = 3):
+        self.log_dir = log_dir
+        # ``registry`` is where THIS bundle's own metrics live and what
+        # close() dumps. Comm deltas always read the process-wide REGISTRY
+        # regardless — the comm backends hard-wire their counters there
+        # (they have no construction-time hook to receive another), so
+        # honoring a custom registry for comm would silently report zero
+        # traffic on a run that moved gigabytes.
+        self.registry = registry or REGISTRY
+        if sink is None:
+            sink = (JsonlSink(os.path.join(log_dir, "events.jsonl"),
+                              max_bytes=rotate_bytes, backups=backups)
+                    if log_dir else MemorySink())
+        self.events = EventLog(sink, run_id=run_id)
+        # round_stats=False: keep the event stream but skip the in-graph
+        # update-norm/drift outputs (an engine knob; comm counters stay on)
+        self.round_stats = round_stats
+        self._header_emitted = False
+        self._last_comm = comm_counters(REGISTRY)
+
+    # ------------------------------------------------------------- records
+    def run_header(self, config: dict | None = None, **fields) -> None:
+        """Emit the run-header record once (idempotent — standalone train()
+        and a wrapping launcher may both call it)."""
+        if self._header_emitted:
+            return
+        self._header_emitted = True
+        self.events.emit("run", config=config or {}, **fields)
+
+    def comm_delta(self) -> dict:
+        """Comm counter movement since the previous call — the per-round
+        byte/message accounting, read from the process-wide registry the
+        comm backends record into (see __init__). Cumulative totals ride
+        along under ``total_`` so a record is interpretable on its own."""
+        now = comm_counters(REGISTRY)
+        delta = {k: now[k] - self._last_comm.get(k, 0.0)
+                 for k in ("messages_sent", "bytes_sent",
+                           "messages_received", "bytes_received")}
+        delta["total_bytes_sent"] = now["bytes_sent"]
+        delta["total_messages_sent"] = now["messages_sent"]
+        # dispatch stats come from a run-cumulative histogram (no per-round
+        # reset), so they carry the total_ prefix like the other cumulatives
+        if "dispatch_p95_s" in now:
+            delta["total_dispatch_p95_s"] = now["dispatch_p95_s"]
+            delta["total_dispatch_count"] = now["dispatch_count"]
+        self._last_comm = now
+        return delta
+
+    def emit_round(self, round_idx: int, clients=None, spans=None,
+                   metrics=None, evals=None, **extra) -> dict:
+        """The standard per-round record: sampled client ids, host span
+        timings (RoundTracer's dict for the round), scalar metrics (already
+        floated by the caller), optional eval block, and the comm delta
+        since the last round record."""
+        rec: dict = {"round": int(round_idx)}
+        if clients is not None:
+            rec["clients"] = [int(c) for c in clients]
+        if spans:
+            rec["spans"] = {k: float(v) for k, v in spans.items()}
+        if metrics:
+            rec["metrics"] = {k: float(v) for k, v in metrics.items()}
+        if evals:
+            rec["eval"] = {k: (float(v) if isinstance(v, (int, float)) else v)
+                           for k, v in evals.items()}
+        rec["comm"] = self.comm_delta()
+        rec.update(extra)
+        return self.events.emit("round", **rec)
+
+    def emit_eval(self, round_idx: int, evals: dict) -> dict:
+        return self.events.emit(
+            "eval", round=int(round_idx),
+            eval={k: (float(v) if isinstance(v, (int, float)) else v)
+                  for k, v in evals.items()})
+
+    # ------------------------------------------------------------ profiler
+    def profile(self, logdir: str):
+        """Opt-in jax.profiler bridge: context manager writing an XLA/TPU
+        trace (TensorBoard 'profile' plugin / Perfetto) to ``logdir`` —
+        utils.tracing.trace under the obs roof."""
+        from fedml_tpu.utils.tracing import trace
+
+        return trace(logdir)
+
+    # ------------------------------------------------------------- teardown
+    def close(self) -> None:
+        """Flush and close the event log; when file-backed, also drop a
+        Prometheus text dump of the registry next to it."""
+        if self.log_dir:
+            try:
+                with open(os.path.join(self.log_dir, "metrics.prom"),
+                          "w") as f:
+                    f.write(self.registry.to_prometheus())
+            except OSError:
+                pass  # read-only dir: the event log (already flushed) stands
+        self.events.close()

@@ -1,0 +1,92 @@
+"""Compatibility graft for older jax runtimes.
+
+The engine is written against current jax surface: ``jax.typeof`` (aval
+inspection, incl. shard_map varying-manual-axes), ``jax.lax.pcast``
+(replicated -> varying casts under shard_map), and top-level
+``jax.shard_map`` with ``axis_names`` partial-manual mode. Containers that
+bake an older jax (e.g. 0.4.x) lack those names while providing equivalent
+machinery under ``jax.experimental.shard_map`` — and on them every engine
+module would otherwise die at its first round with AttributeError.
+
+``install()`` grafts the missing names onto the jax namespace, each gated
+behind ``hasattr`` so it is a strict no-op on a current jax:
+
+- ``jax.typeof``      -> ``jax.core.get_aval`` (old avals carry no ``vma``
+  attribute; every caller already defends with ``getattr(..., "vma",
+  frozenset())``, which is exactly right — old shard_map has no
+  varying-manual-axes tracking to reconcile);
+- ``jax.enable_x64``  -> ``jax.experimental.enable_x64`` (same context
+  manager, pre-promotion name);
+- ``jax.lax.axis_size`` -> ``jax.core.axis_frame`` (which on old jax IS the
+  bound axis's static size — callers use it to build python-level ring
+  permutations, so it must stay a python int);
+- ``jax.lax.pcast``   -> identity (the cast only exists to satisfy the new
+  vma type system; without vma tracking there is nothing to cast);
+- ``jax.shard_map``   -> ``jax.experimental.shard_map.shard_map`` with
+  ``check_rep=False`` (the old replication checker predates the vma model
+  the callers are written for) and ``axis_names`` translated to the old
+  ``auto`` complement. ``check_vma`` is accepted and ignored — the strict
+  vma check does not exist on old jax, so strict-mode tests degrade to
+  plain shard_map tests there.
+
+Called from ``fedml_tpu/__init__``, so every entry point (tests, CLIs,
+bench, launchers) runs on either jax generation without code changes.
+"""
+
+from __future__ import annotations
+
+
+def install() -> None:
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is a hard dep everywhere else
+        return
+
+    if not hasattr(jax, "typeof"):
+        import jax.core
+
+        jax.typeof = jax.core.get_aval
+
+    if not hasattr(jax, "enable_x64"):
+        from jax.experimental import enable_x64
+
+        jax.enable_x64 = enable_x64
+
+    if not hasattr(jax.lax, "axis_size"):
+        import jax.core as _core
+
+        def axis_size(axis_name):
+            # old jax: core.axis_frame(name) IS the static size (an int)
+            if isinstance(axis_name, (tuple, list)):
+                out = 1
+                for a in axis_name:
+                    out *= _core.axis_frame(a)
+                return out
+            return _core.axis_frame(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax.lax, "pcast"):
+
+        def pcast(x, axis_name=None, *, to=None):
+            return x
+
+        jax.lax.pcast = pcast
+
+    if not hasattr(jax, "set_mesh"):
+        # old Mesh is itself a context manager; `with jax.set_mesh(m):`
+        # degrades to `with m:` (the pre-sharding-in-types idiom)
+        jax.set_mesh = lambda mesh: mesh
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                      axis_names=None, check_vma=None, **kw):
+            auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+                    if axis_names else frozenset())
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False,
+                              auto=auto)
+
+        jax.shard_map = shard_map

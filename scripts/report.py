@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Run reporter — render a telemetry event log as a round-by-round table
+and (optionally) a BENCH-compatible JSON summary.
+
+    python scripts/report.py runs/mnist/events.jsonl
+    python scripts/report.py runs/mnist/events.jsonl --bench-json -   # stdout
+    python scripts/report.py runs/mnist/events.jsonl \
+        --bench-json summary.json --csv rounds.csv
+
+Input: the events.jsonl a Telemetry run writes (FedAvgAPI(telemetry=...),
+distributed_launch --telemetry-dir, or FEDML_BENCH_TELEMETRY_DIR on
+bench.py); rotated segments (events.jsonl.N) are folded back in
+automatically. Schema: docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fmt(v, width: int) -> str:
+    if v is None or v == "":
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.4g}"
+    else:
+        s = str(v)
+    return s.rjust(width)
+
+
+def render_table(records: list[dict]) -> str:
+    """Round-by-round text table; eval rows are folded into their round."""
+    evals: dict[int, dict] = {}
+    for r in records:
+        if r.get("kind") == "eval" and r.get("eval"):
+            evals[int(r["round"])] = r["eval"]
+    rows = []
+    for r in records:
+        if r.get("kind") != "round":
+            continue
+        m = r.get("metrics", {})
+        sp = r.get("spans", {})
+        ev = r.get("eval") or evals.get(int(r["round"])) or {}
+        n = max(float(m.get("count", 0.0)), 1.0)
+        rows.append({
+            "round": r["round"],
+            "clients": len(r.get("clients", [])) or None,
+            "round_s": sp.get("round"),
+            "pack_s": sp.get("pack"),
+            "agg_s": sp.get("aggregate"),
+            "loss": (m["loss_sum"] / n) if "loss_sum" in m else None,
+            "upd_norm": m.get("update_norm"),
+            "drift": m.get("client_drift_mean"),
+            "test_acc": ev.get("test_acc"),
+            "tx_msgs": r.get("comm", {}).get("messages_sent"),
+            "tx_bytes": r.get("comm", {}).get("bytes_sent"),
+        })
+    if not rows:
+        return "(no round records)"
+    cols = [c for c in rows[0] if any(row[c] is not None for row in rows)]
+    widths = {c: max(len(c), *(len(_fmt(row[c], 0).strip()) for row in rows))
+              for c in cols}
+    lines = ["  ".join(c.rjust(widths[c]) for c in cols)]
+    lines.append("  ".join("-" * widths[c] for c in cols))
+    for row in rows:
+        lines.append("  ".join(_fmt(row[c], widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fedml_tpu run reporter")
+    p.add_argument("events", help="path to a run's events.jsonl")
+    p.add_argument("--bench-json", default=None, metavar="PATH",
+                   help="also write the BENCH-compatible summary blob "
+                        "('-' = stdout as the last line)")
+    p.add_argument("--csv", default=None, metavar="PATH",
+                   help="also write the round records as CSV")
+    args = p.parse_args(argv)
+
+    from fedml_tpu.obs.events import read_jsonl
+    from fedml_tpu.obs.export import bench_blob, write_csv
+
+    records = read_jsonl(args.events)
+    if not records:
+        print(f"report: no records in {args.events}", file=sys.stderr)
+        return 1
+
+    headers = [r for r in records if r.get("kind") == "run"]
+    if headers:
+        h = headers[0]
+        print(f"run: {h.get('run')}  engine: {h.get('engine', '?')}")
+    print(render_table(records))
+
+    if args.csv:
+        cols = write_csv(records, args.csv)
+        print(f"report: wrote {args.csv} ({len(cols)} columns)",
+              file=sys.stderr)
+    if args.bench_json:
+        blob = bench_blob(records)
+        if args.bench_json == "-":
+            print(json.dumps(blob))
+        else:
+            with open(args.bench_json, "w") as f:
+                json.dump(blob, f, indent=2)
+            print(f"report: wrote {args.bench_json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,10 @@
 """Static gate (reference CI runs pyflakes first, CI-script-fedavg.sh:6):
-every module must parse and import cleanly."""
+every module must parse and import cleanly, and library code must not
+print to stdout."""
 
+import ast
 import importlib
+import pathlib
 import pkgutil
 
 
@@ -17,3 +20,32 @@ def test_every_module_imports():
         except Exception as e:  # pragma: no cover - failure path
             bad.append((m.name, repr(e)))
     assert not bad, bad
+
+
+# CLI entry points whose stdout IS their interface — the only places a bare
+# print() is legitimate inside the package. Everything else must route
+# through logging or the obs EventLog (telemetry must be structured and
+# capturable, not interleaved with stdout).
+_PRINT_ALLOWED = {
+    # prints the final eval history JSON for the launching script to parse
+    "experiments/distributed_launch.py",
+}
+
+
+def test_no_bare_print_in_package():
+    import fedml_tpu
+
+    root = pathlib.Path(fedml_tpu.__path__[0])
+    bad = []
+    for p in sorted(root.rglob("*.py")):
+        rel = p.relative_to(root).as_posix()
+        tree = ast.parse(p.read_text(), filename=str(p))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                    and rel not in _PRINT_ALLOWED):
+                bad.append(f"fedml_tpu/{rel}:{node.lineno}")
+    assert not bad, (
+        "bare print() in library code (route telemetry through "
+        f"fedml_tpu.obs.EventLog or logging, or allowlist a CLI): {bad}")

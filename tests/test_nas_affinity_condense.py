@@ -47,6 +47,35 @@ def test_genotype_extraction():
                 assert 0 <= pred < 2 + i
 
 
+def test_as_genotype_json_file_normalizes_like_dict(tmp_path):
+    """ADVICE r5 item 4: the json-FILE branch must apply the same (op, int)
+    normalization/validation as dict input — a file with float node indices
+    (json has no int/float distinction for some producers) must come back
+    int-indexed, and garbage must fail fast, not deep inside DerivedCell."""
+    import json
+
+    import pytest
+
+    from fedml_tpu.models.darts import GENOTYPES, as_genotype
+
+    g = {k: (list(v) if isinstance(v, tuple) else v)
+         for k, v in GENOTYPES["FedNAS_V1"].items()}
+    g["normal"] = [[op, float(j)] for op, j in g["normal"]]  # float indices
+    g["normal_concat"] = [float(i) for i in g["normal_concat"]]
+    p = tmp_path / "geno.json"
+    p.write_text(json.dumps(g))
+    out = as_genotype(str(p))
+    assert out["normal"] == as_genotype(GENOTYPES["FedNAS_V1"])["normal"]
+    assert all(isinstance(i, int) for i in out["normal_concat"])
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"normal": [["sep_conv_3x3", "x"]],
+                               "normal_concat": [2],
+                               "reduce": [], "reduce_concat": []}))
+    with pytest.raises((ValueError, TypeError)):
+        as_genotype(str(bad))
+
+
 def _nas_setup(seed=0, **api_kw):
     data = synthetic_images(num_clients=2, image_shape=(12, 12, 3), num_classes=3,
                             samples_per_client=16, test_samples=24, seed=seed,
