@@ -78,11 +78,30 @@ class BaseCommManager(abc.ABC):
 
     def _receive_frame(self, data: bytes) -> None:
         """Decode an inbound frame, record its size, and enqueue it for the
-        dispatch loop — the shared receive half of ``_encode``."""
+        dispatch loop — the shared receive half of ``_encode``.
+
+        A frame that fails to decode — CRC32 mismatch (message.py FMT2),
+        bad magic, damaged deflate stream, or any downstream parse error a
+        flipped bit can cause (CorruptFrame and the json/frombuffer errors
+        are ValueError; a truncated header manifest raises KeyError) — is
+        dropped and counted (``comm_corrupt_frames_total``), never raised:
+        wire damage must degrade one frame, not kill the transport's
+        receive thread and wedge the job. Only those two exception types
+        are absorbed — a genuine programming error in the decode path
+        still fails fast (the same rationale as ``_notify``'s re-raise)."""
         from fedml_tpu.comm.message import Message
 
         _obs.record_receive(self.backend_name, len(data))
-        self._enqueue(Message.from_bytes(data))
+        try:
+            msg = Message.from_bytes(data)
+        except (ValueError, KeyError):
+            _obs.record_corrupt_frame(self.backend_name)
+            import logging
+
+            logging.getLogger("fedml_tpu.comm").warning(
+                "dropping corrupt %d-byte frame", len(data), exc_info=True)
+            return
+        self._enqueue(msg)
 
     def _enqueue(self, msg: "Message") -> None:
         self._q.put((msg, time.perf_counter()))

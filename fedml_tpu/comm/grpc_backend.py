@@ -82,6 +82,11 @@ class GrpcCommManager(BaseCommManager):
         self._seen: dict[tuple[int, int], tuple[set[int], int]] = {}
         self._seen_lock = threading.Lock()
         self._send_lock = threading.Lock()
+        # guards the channel cache: sender threads create channels in
+        # _stub while the retry path pops them — without the lock a
+        # reconnect could hand a half-registered channel to a concurrent
+        # send to the same peer (or leak one that close() then misses)
+        self._channels_lock = threading.Lock()
 
         from concurrent import futures
 
@@ -153,14 +158,17 @@ class GrpcCommManager(BaseCommManager):
         return True
 
     def _stub(self, dest: int):
-        if dest not in self._channels:
-            addr = f"{self.ip_table[dest]}:{self.base_port + dest}"
-            opts = [
-                ("grpc.max_send_message_length", _MAX_MSG),
-                ("grpc.max_receive_message_length", _MAX_MSG),
-            ]
-            self._channels[dest] = self._grpc.insecure_channel(addr, options=opts)
-        return self._channels[dest].unary_unary(f"/{_SERVICE}/{_METHOD}")
+        with self._channels_lock:
+            ch = self._channels.get(dest)
+            if ch is None:
+                addr = f"{self.ip_table[dest]}:{self.base_port + dest}"
+                opts = [
+                    ("grpc.max_send_message_length", _MAX_MSG),
+                    ("grpc.max_receive_message_length", _MAX_MSG),
+                ]
+                ch = self._grpc.insecure_channel(addr, options=opts)
+                self._channels[dest] = ch
+        return ch.unary_unary(f"/{_SERVICE}/{_METHOD}")
 
     def send_message(self, msg: Message) -> None:
         """Deliver one frame. ``wait_for_ready`` queues the RPC until the
@@ -204,7 +212,10 @@ class GrpcCommManager(BaseCommManager):
                 # but close() would cancel another thread's in-flight RPC on
                 # the same channel (CANCELLED is not retriable). The dropped
                 # channel is finalized by GC once all calls on it finish.
-                self._channels.pop(dest, None)
+                # Under _channels_lock so a concurrent _stub can't observe
+                # (and cache a call on) the entry mid-replacement.
+                with self._channels_lock:
+                    self._channels.pop(dest, None)
                 # wait_for_ready throttles only connection establishment; if
                 # the peer accepts connections but fails RPCs (restart loop,
                 # GOAWAY during shutdown) each attempt returns immediately —
@@ -213,7 +224,8 @@ class GrpcCommManager(BaseCommManager):
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()
-        for ch in self._channels.values():
+        with self._channels_lock:
+            channels, self._channels = list(self._channels.values()), {}
+        for ch in channels:
             ch.close()
-        self._channels.clear()
         self._server.stop(grace=0.5)

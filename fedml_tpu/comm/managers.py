@@ -29,28 +29,39 @@ log = logging.getLogger("fedml_tpu.comm.managers")
 
 
 def make_comm_manager(backend: str, rank: int, size: int, **kw) -> BaseCommManager:
-    """Backend switch (parity with client_manager.py:20-32)."""
+    """Backend switch (parity with client_manager.py:20-32).
+
+    When a chaos FaultPlan is installed (fedml_tpu/chaos — seeded
+    deterministic fault injection for robustness tests and soak runs), the
+    manager comes back wrapped in a ChaosCommManager executing that plan;
+    with no plan installed the manager is returned as-is and the hot path
+    is untouched."""
     backend = backend.upper()
     if backend == "LOOPBACK":
         from fedml_tpu.comm.loopback import LoopbackCommManager
 
-        return LoopbackCommManager(kw.get("job_id", "default"), rank, size)
-    if backend == "GRPC":
+        mgr: BaseCommManager = LoopbackCommManager(
+            kw.get("job_id", "default"), rank, size)
+    elif backend == "GRPC":
         from fedml_tpu.comm.grpc_backend import GrpcCommManager
 
-        return GrpcCommManager(
+        mgr = GrpcCommManager(
             rank, size, ip_table=kw.get("ip_table"),
             base_port=kw.get("base_port", 50000),
             send_timeout_s=kw.get("send_timeout_s", 600.0),
         )
-    if backend == "MQTT":
+    elif backend == "MQTT":
         from fedml_tpu.comm.mqtt_backend import MqttCommManager
 
-        return MqttCommManager(
+        mgr = MqttCommManager(
             kw.get("broker_host", "127.0.0.1"), kw.get("broker_port", 1883),
             rank, size - 1, job_id=kw.get("job_id"),
         )
-    raise ValueError(f"unknown backend {backend!r} (LOOPBACK|GRPC|MQTT)")
+    else:
+        raise ValueError(f"unknown backend {backend!r} (LOOPBACK|GRPC|MQTT)")
+    from fedml_tpu import chaos
+
+    return chaos.maybe_wrap(mgr, rank)
 
 
 class DistributedManager(Observer):

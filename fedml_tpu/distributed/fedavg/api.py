@@ -63,17 +63,35 @@ def run_simulated(
     broker_port: int = 1883,
     sparsify_ratio: float | None = None,
     telemetry=None,
+    chaos_plan=None,
+    round_timeout_s: float | None = None,
 ) -> FedAvgAggregator:
-    """All ranks as threads on one host — the mpirun-on-localhost analogue."""
+    """All ranks as threads on one host — the mpirun-on-localhost analogue.
+
+    ``chaos_plan``: a ``fedml_tpu.chaos.FaultPlan`` installed for the
+    duration of the run — every rank's comm manager is wrapped in the
+    deterministic fault injector (drops/dups/corruption/partitions per the
+    plan's seeded schedule). Pair with ``round_timeout_s`` so dropped
+    uplinks degrade to elastic partial aggregation instead of a hang."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
-    aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
-    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend,
-                                 ckpt_dir=ckpt_dir, telemetry=telemetry, **kw)
-    clients = [
-        init_client(dataset, task, cfg, rank, size, backend,
-                    sparsify_ratio=sparsify_ratio, **kw)
-        for rank in range(1, size)
-    ]
-    launch_simulated(server, clients)
+    from fedml_tpu import chaos as _chaos
+
+    if chaos_plan is not None:  # None must not clobber an installed plan
+        _chaos.install_plan(chaos_plan)
+    try:
+        aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
+        server = FedAvgServerManager(aggregator, rank=0, size=size,
+                                     backend=backend, ckpt_dir=ckpt_dir,
+                                     round_timeout_s=round_timeout_s,
+                                     telemetry=telemetry, **kw)
+        clients = [
+            init_client(dataset, task, cfg, rank, size, backend,
+                        sparsify_ratio=sparsify_ratio, **kw)
+            for rank in range(1, size)
+        ]
+        launch_simulated(server, clients)
+    finally:
+        if chaos_plan is not None:
+            _chaos.install_plan(None)
     return aggregator

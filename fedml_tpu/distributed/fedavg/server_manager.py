@@ -44,6 +44,11 @@ class FedAvgServerManager(ServerManager):
         self._bcast_leaves = None  # this round's packed broadcast (sparse)
         self.round_timeout_s = round_timeout_s
         self.ckpt_dir = ckpt_dir
+        # rank -> round its delivery last failed. Initialized HERE, not
+        # lazily at first failure: two sender paths (round loop + watchdog
+        # thread) can fail concurrently, and a hasattr-then-create race
+        # would lose one rank's failure record.
+        self._undeliverable: dict[int, int] = {}
         # obs.Telemetry: per-round event records (sampled ids, aggregate/eval
         # span timings, update norm, comm byte/message deltas). None = the
         # seed behavior, zero extra work.
@@ -104,7 +109,7 @@ class FedAvgServerManager(ServerManager):
         MPI.COMM_WORLD.Abort(), fedml_api/utils/context.py:9-18).
         Without a round deadline, delivery failures stay fatal."""
         rank = int(msg.get_receiver_id())
-        failed_at = getattr(self, "_undeliverable", {}).get(rank)
+        failed_at = self._undeliverable.get(rank)
         # reprobe only on a POSITIVE multiple of the interval: at
         # round_idx == failed_at the failure was just recorded, and a
         # second send in the same round (e.g. the FINISH broadcast after a
@@ -124,8 +129,6 @@ class FedAvgServerManager(ServerManager):
         except Exception as e:
             if self.round_timeout_s is None or not self._is_transport_error(e):
                 raise
-            if not hasattr(self, "_undeliverable"):
-                self._undeliverable = {}
             self._undeliverable[rank] = self.round_idx
             log.warning("elastic: dropping undeliverable send to rank %d",
                         rank, exc_info=True)

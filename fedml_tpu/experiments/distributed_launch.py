@@ -76,6 +76,16 @@ def add_args(p: argparse.ArgumentParser):
                         "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="server round checkpoints; restart resumes the job")
+    p.add_argument("--chaos-plan", "--chaos_plan", dest="chaos_plan",
+                   type=str, default=None,
+                   help="seeded fault-injection plan (fedml_tpu/chaos): a "
+                        "JSON file path or inline JSON with {seed, rules} — "
+                        "frame drop/delay/duplicate/reorder/corrupt/"
+                        "partition + rank crash/straggle schedules, "
+                        "deterministic per seed so a soak run replays "
+                        "bit-for-bit (docs/ROBUSTNESS.md). Pass the SAME "
+                        "plan to every rank; pair with --round_timeout_s "
+                        "so injected losses degrade elastically")
     p.add_argument("--telemetry-dir", "--telemetry_dir", dest="telemetry_dir",
                    type=str, default=None,
                    help="rank 0: write the structured run telemetry here — "
@@ -192,6 +202,19 @@ def main(argv=None):
 
     set_wire_codec(args.compression)
 
+    if args.chaos_plan:
+        import os
+
+        from fedml_tpu import chaos
+
+        plan = (chaos.FaultPlan.from_file(args.chaos_plan)
+                if os.path.exists(args.chaos_plan)
+                else chaos.FaultPlan.from_json(args.chaos_plan))
+        chaos.install_plan(plan)
+        logging.getLogger("fedml_tpu.launch").warning(
+            "CHAOS plan installed (seed=%d, %d rules) — faults will be "
+            "injected on purpose", plan.seed, len(plan.rules))
+
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.core.tasks import classification_task, sequence_task, tag_prediction_task
     from fedml_tpu.data.registry import DATASETS, load_dataset
@@ -254,6 +277,14 @@ def main(argv=None):
     finally:
         if telemetry is not None:
             telemetry.close()
+    if args.chaos_plan:
+        from fedml_tpu import chaos
+
+        plan = chaos.active_plan()
+        if plan is not None:
+            logging.getLogger("fedml_tpu.launch").info(
+                "chaos: %d faults injected %s", len(plan.ledger),
+                plan.ledger.counts())
     if args.rank == 0:
         print(json.dumps(mgr.aggregator.history, default=float))
 

@@ -101,6 +101,32 @@ def record_duplicate(backend: str) -> None:
     _duplicates(backend).inc()
 
 
+@lru_cache(maxsize=16)
+def _corrupt(backend: str):
+    return REGISTRY.counter("comm_corrupt_frames_total", backend=backend)
+
+
+def record_corrupt_frame(backend: str) -> None:
+    """An inbound frame that failed integrity/decode (CRC32 mismatch, bad
+    magic, damaged deflate) and was dropped by ``_receive_frame`` instead
+    of crashing the dispatch loop. Counted IN ``*_received_total`` (the
+    bytes did arrive) but never dispatched."""
+    _corrupt(backend).inc()
+
+
+@lru_cache(maxsize=256)
+def _faults(backend: str, fault: str, direction: str):
+    return REGISTRY.counter("comm_faults_injected_total", backend=backend,
+                            fault=fault, direction=direction)
+
+
+def record_fault(backend: str, fault: str, direction: str) -> None:
+    """A fault the chaos layer (fedml_tpu/chaos) injected on purpose —
+    labeled by fault kind and direction so a soak run's summary can assert
+    the planned chaos actually happened."""
+    _faults(backend, fault, direction).inc()
+
+
 def comm_counters(registry: MetricsRegistry | None = None) -> dict:
     """Flat cumulative totals (all labels summed) — the snapshot Telemetry
     diffs between rounds to put per-round byte/message counts in the event

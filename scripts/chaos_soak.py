@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Chaos soak: N seeded FaultPlans over the loopback FedAvg stack.
+
+Each trial builds a random-but-seeded FaultPlan (drops, duplicates,
+corruption, delays, a crash window — all derived from the trial seed, so
+any failing trial replays bit-for-bit from its seed alone), runs a full
+federated job under it, and asserts the robustness invariants:
+
+- every round completed (elastic degradation, no hang);
+- the fault ledger is non-empty (chaos actually happened) and canonical;
+- a replay of the same seed produces the identical ledger and final model
+  (spot-checked on ``--replay-every`` trials).
+
+Emits a pass/fail summary JSON (BENCH-blob style, reusing the obs
+exporter's conventions) to stdout or ``--out``::
+
+    python scripts/chaos_soak.py --trials 10 --rounds 4 --out soak.json
+
+The pytest soak tier (tests/test_chaos.py::test_chaos_soak_many_seeds,
+marked ``chaos`` + ``slow``) drives the same helpers, so tier-1 stays fast
+while ``pytest -m chaos`` or this script runs the long campaign.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as `python scripts/chaos_soak.py` from the repo root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def random_plan(seed: int, world_size: int, elastic: bool = True):
+    """A seeded plan over client ranks 1..world_size-1: every field comes
+    from sha256 draws on the seed, so the plan IS the seed."""
+    import hashlib
+
+    from fedml_tpu.chaos import FaultPlan
+
+    def draw(tag: str, n: int) -> int:
+        h = hashlib.sha256(f"plan|{seed}|{tag}".encode()).digest()
+        return int.from_bytes(h[:8], "little") % n
+
+    clients = list(range(1, world_size))
+    rules = [
+        # a lossy uplink (elastic partial aggregation territory)
+        {"fault": "drop", "direction": "send",
+         "src": [clients[draw("drop", len(clients))]], "dst": [0],
+         "prob": 0.3 + 0.1 * draw("dropp", 4)},
+        # at-least-once redelivery on another uplink
+        {"fault": "duplicate", "direction": "send",
+         "src": [clients[draw("dup", len(clients))]], "dst": [0],
+         "prob": 0.5},
+        # bit rot into the server (CRC32 drop-and-count path)
+        {"fault": "corrupt", "direction": "recv", "dst": [0],
+         "prob": 0.2 + 0.05 * draw("corp", 4)},
+        # a latency spike well inside the round deadline
+        {"fault": "delay", "direction": "send",
+         "src": [clients[draw("delay", len(clients))]], "dst": [0],
+         "delay_s": 0.05, "prob": 0.5},
+    ]
+    if draw("crash?", 2) and len(clients) > 1:
+        lo = 1 + draw("crashlo", 2)
+        rules.append({"fault": "crash",
+                      "ranks": [clients[draw("crashr", len(clients))]],
+                      "rounds": [lo, lo + 1]})
+    return FaultPlan.from_json({"seed": seed, "rules": rules})
+
+
+def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
+             round_timeout_s: float = 1.0) -> dict:
+    """One soak trial: run the loopback job under ``plan``; return the
+    trial record (ok flag, per-fault counts, history tail, timing)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    per_round = (world_size - 1) if world_size else 3
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=data.num_clients,
+                       client_num_per_round=per_round, epochs=1, batch_size=8,
+                       lr=0.1, frequency_of_the_test=1, seed=0)
+    t0 = time.perf_counter()
+    err = None
+    agg = None
+    try:
+        agg = run_simulated(data, task, cfg, backend="LOOPBACK",
+                            job_id=f"soak-{plan.seed}-{time.time_ns()}",
+                            chaos_plan=plan, round_timeout_s=round_timeout_s)
+    except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
+        err = repr(e)
+    completed = bool(agg and agg.history
+                     and agg.history[-1]["round"] == rounds - 1)
+    return {
+        "seed": plan.seed,
+        "ok": err is None and completed,
+        "error": err,
+        "completed_rounds": (agg.history[-1]["round"] + 1
+                             if agg and agg.history else 0),
+        "faults": plan.ledger.counts(),
+        "n_faults": len(plan.ledger),
+        "final_eval": (agg.history[-1] if agg and agg.history else None),
+        "seconds": round(time.perf_counter() - t0, 2),
+        "plan": json.loads(plan.to_json()),
+        "net": agg.net if agg else None,       # stripped before JSON dump
+        "ledger": plan.ledger.canonical(),     # stripped before JSON dump
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("chaos_soak")
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--rounds", type=int, default=3)
+    ap.add_argument("--world_size", type=int, default=4,
+                    help="server + world_size-1 clients per trial")
+    ap.add_argument("--seed0", type=int, default=0, help="first trial seed")
+    ap.add_argument("--replay-every", type=int, default=5,
+                    help="every k-th trial is re-run with the same seed and "
+                         "must reproduce the ledger and final model exactly")
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args(argv)
+
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(8, 8, 1),
+                            num_classes=4, samples_per_client=24,
+                            test_samples=96, seed=3)
+    task = classification_task(LogisticRegression(num_classes=4))
+
+    trials = []
+    for i in range(args.trials):
+        seed = args.seed0 + i
+        plan = random_plan(seed, args.world_size)
+        rec = run_plan(data, task, plan, rounds=args.rounds,
+                       world_size=args.world_size)
+        if rec["ok"] and args.replay_every and i % args.replay_every == 0:
+            import numpy as np
+
+            from fedml_tpu.comm.message import pack_pytree
+
+            rec2 = run_plan(data, task, random_plan(seed, args.world_size),
+                            rounds=args.rounds, world_size=args.world_size)
+            replay_ok = rec2["ledger"] == rec["ledger"] and all(
+                np.array_equal(np.asarray(a), np.asarray(b))
+                for a, b in zip(pack_pytree(rec["net"]),
+                                pack_pytree(rec2["net"])))
+            rec["replay_deterministic"] = replay_ok
+            if not replay_ok:
+                rec["ok"] = False
+                rec["error"] = "replay diverged (ledger or final model)"
+        rec.pop("net", None)
+        rec.pop("ledger", None)
+        trials.append(rec)
+        print(f"trial {seed}: {'ok' if rec['ok'] else 'FAIL'} "
+              f"({rec['n_faults']} faults, {rec['seconds']}s)",
+              file=sys.stderr)
+
+    n_ok = sum(t["ok"] for t in trials)
+    # BENCH-blob-shaped summary (obs/export conventions): one metric line a
+    # dashboard can ingest, with the trial records riding along
+    summary = {
+        "metric": "chaos_soak_pass_rate",
+        "value": round(n_ok / max(1, len(trials)), 3),
+        "unit": "fraction",
+        "mode": "chaos_soak",
+        "trials": len(trials),
+        "passed": n_ok,
+        "rounds_per_trial": args.rounds,
+        "faults_injected_total": sum(t["n_faults"] for t in trials),
+        "records": trials,
+    }
+    out = json.dumps(summary, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+    else:
+        print(out)
+    return 0 if n_ok == len(trials) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,4 +99,9 @@ agg = run_simulated(data, classification_task(LogisticRegression(num_classes=3))
 assert agg.history, "no eval records"
 print("cross-process smoke ok:", agg.history[-1])
 PY
+
+echo "== chaos soak (seeded fault-injection campaign, docs/ROBUSTNESS.md) =="
+# every trial's plan derives from its seed; the script replays every 5th
+# trial and fails unless ledger + final model reproduce exactly
+python scripts/chaos_soak.py --trials 5 --rounds 3 --out ./tmp/chaos_soak.json
 echo "CI GREEN"

@@ -32,6 +32,14 @@ def pytest_configure(config):
         "smoke: fast tier — every engine's oracle at minimal shapes, "
         "<5 min total on a 1-core box (scripts/ci.sh default; run the "
         "full suite with scripts/ci.sh full or plain pytest)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection soak tier — many seeded FaultPlans over "
+        "full federated runs (scripts/chaos_soak.py). Marked slow too, so "
+        "tier-1 ('-m not slow') excludes it; run with -m chaos")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 budget ('-m not slow')")
 
 
 # The smoke tier, kept as ONE auditable list instead of decorators

@@ -1,0 +1,426 @@
+"""Chaos layer (fedml_tpu/chaos): seeded deterministic fault injection
+drives every elastic/retry/dedup/resume path from CPU-only tier-1 —
+
+- a seeded FaultPlan replays exactly (identical injected-fault ledgers AND
+  identical final global models across two runs);
+- duplicated uplinks never double-count in aggregation;
+- a corrupt binary frame is dropped + counted (CRC32, message.py FMT2),
+  never raised into the dispatch loop;
+- dropped uplinks degrade to elastic partial aggregation that stays
+  sample-weight exact over the clients that DID report;
+- a crashed rank is marked undeliverable, reprobed, and rejoins when its
+  crash window ends (dead-rank reprobe);
+- a server restart mid-chaos resumes equal to an uninterrupted chaos run.
+
+The soak tier (many seeded plans, scripts/chaos_soak.py) is marked
+``chaos`` + ``slow`` and excluded from tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu import chaos
+from fedml_tpu.chaos import ChaosCommManager, FaultPlan, FaultRule
+from fedml_tpu.comm.loopback import LoopbackCommManager
+from fedml_tpu.comm.message import Message, pack_pytree
+from fedml_tpu.obs.metrics import REGISTRY
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(scope="module")
+def lr_setup():
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(8, 8, 1), num_classes=4,
+                            samples_per_client=24, test_samples=96, seed=3)
+    task = classification_task(LogisticRegression(num_classes=4))
+    return data, task
+
+
+def _cfg(rounds=3, per_round=3, seed=0):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=per_round, epochs=1, batch_size=8,
+                        lr=0.1, frequency_of_the_test=1, seed=seed)
+
+
+def _counter(name):
+    return REGISTRY.total(name)  # family sum (0.0 if never touched)
+
+
+# ---------------------------------------------------------------- plan unit
+def test_fault_plan_schema_and_validation():
+    plan = FaultPlan.from_json(
+        '{"seed": 9, "rules": ['
+        '{"fault": "drop", "src": [1], "dst": [0], "rounds": [0, 2],'
+        ' "prob": 0.5},'
+        '{"fault": "partition", "groups": [[0], [2]]},'
+        '{"fault": "crash", "ranks": [3], "rounds": [1, 2]}]}')
+    assert plan.seed == 9 and len(plan.rules) == 3
+    # round-trips through its own JSON form (the replay artifact)
+    again = FaultPlan.from_json(plan.to_json())
+    assert again.to_json() == plan.to_json()
+    assert plan.rules[0].in_window(1) and not plan.rules[0].in_window(2)
+    assert plan.rules[1].partition_cut(0, 2)
+    assert not plan.rules[1].partition_cut(0, 1)  # rank 1 in no group? 0's
+    with pytest.raises(ValueError, match="unknown fault"):
+        FaultRule(fault="meteor")
+    with pytest.raises(ValueError, match="prob"):
+        FaultRule(fault="drop", prob=1.5)
+    with pytest.raises(ValueError, match="groups"):
+        FaultRule(fault="partition")
+    with pytest.raises(ValueError, match="ranks"):
+        FaultRule(fault="crash")
+
+
+def test_decisions_are_pure_functions_of_seed_and_link():
+    """The determinism substrate: a draw depends only on (seed, rule, link,
+    seq) — same inputs same answer, different seed different stream."""
+    p1 = FaultPlan.from_json({"seed": 5, "rules": [
+        {"fault": "drop", "prob": 0.5}]})
+    p2 = FaultPlan.from_json({"seed": 5, "rules": [
+        {"fault": "drop", "prob": 0.5}]})
+    seq1 = [p1.fires(0, "send", 1, 0, s) for s in range(200)]
+    assert seq1 == [p2.fires(0, "send", 1, 0, s) for s in range(200)]
+    assert 20 < sum(seq1) < 180  # actually probabilistic, not const
+    p3 = FaultPlan.from_json({"seed": 6, "rules": [
+        {"fault": "drop", "prob": 0.5}]})
+    assert seq1 != [p3.fires(0, "send", 1, 0, s) for s in range(200)]
+
+
+def test_no_plan_means_no_wrapper():
+    """Acceptance: with no FaultPlan installed the comm hot path is the
+    plain backend — make_comm_manager returns the manager unwrapped."""
+    from fedml_tpu.comm.managers import make_comm_manager
+
+    assert chaos.active_plan() is None
+    mgr = make_comm_manager("LOOPBACK", 0, 1, job_id="t-nochaos")
+    try:
+        assert type(mgr) is LoopbackCommManager
+    finally:
+        mgr.stop_receive_message()
+
+
+# ------------------------------------------------------- frame-level faults
+def test_corrupt_frame_dropped_and_counted_not_raised():
+    """A corrupted binary frame fails its CRC32 and is dropped + counted
+    (comm_corrupt_frames_total); the dispatch loop stays alive and the next
+    clean frame is delivered."""
+    plan = FaultPlan.from_json({"seed": 1, "rules": [
+        {"fault": "corrupt", "direction": "send", "src": [1], "dst": [0],
+         "max_per_link": 1}]})
+    rx = LoopbackCommManager("t-corrupt", 0, 2)
+    tx = ChaosCommManager(LoopbackCommManager("t-corrupt", 1, 2), plan, 1)
+    got = []
+
+    class Sink:
+        def receive_message(self, t, p):
+            got.append(p["v"])
+
+    rx.add_observer(Sink())
+    t = threading.Thread(target=rx.handle_receive_message, daemon=True)
+    t.start()
+    before = _counter("comm_corrupt_frames_total")
+    try:
+        m1 = Message("m", 1, 0)
+        m1.add_params("v", 1)
+        tx.send_message(m1)  # corrupted in flight (max_per_link caps at 1)
+        m2 = Message("m", 1, 0)
+        m2.add_params("v", 2)
+        tx.send_message(m2)  # clean: proves the receive loop survived
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == [2], got  # frame 1 vanished, frame 2 dispatched
+        assert _counter("comm_corrupt_frames_total") == before + 1
+        assert plan.ledger.counts() == {"corrupt": 1}
+    finally:
+        rx.stop_receive_message()
+        tx.stop_receive_message()
+        t.join(timeout=5)
+
+
+def test_corrupt_detection_is_wire_level():
+    """CRC32 integrity is independent of the chaos layer: a flipped byte
+    anywhere in an FMT2 body (or a zlib-wrapped frame's deflate stream)
+    raises at decode — CorruptFrame and the json/frombuffer errors a
+    damaged header can cause are all ValueError, which _receive_frame
+    turns into a counted drop. Positions start at 12 because the zlib
+    wrapper's bytes 4:8 are an advisory length (ignored by design)."""
+    m = Message("sync", 1, 0)
+    m.add_params("model_params", [np.arange(40, dtype=np.float32)])
+    m.add_params("num_samples", 11)
+    for codec in ("none", "f16", "q8", "zlib", "q8+zlib"):
+        frame = m.to_bytes(codec)
+        for pos in (12, len(frame) // 2, len(frame) - 1):
+            bad = frame[:pos] + bytes([frame[pos] ^ 0x41]) + frame[pos + 1:]
+            with pytest.raises(ValueError):
+                Message.from_bytes(bad)
+    # a clean frame still round-trips (the CRC is not over-eager)
+    back = Message.from_bytes(m.to_bytes("none"))
+    assert back.get("num_samples") == 11
+
+
+# ------------------------------------------------- end-to-end (loopback FL)
+def test_seeded_plan_replays_identically(lr_setup):
+    """Acceptance: two runs with the same seed produce identical
+    injected-fault sequences (canonical ledgers) and identical final
+    global models."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    spec = {"seed": 7, "rules": [
+        {"fault": "drop", "direction": "send", "src": [2], "dst": [0],
+         "rounds": [1, 2]},
+        {"fault": "corrupt", "direction": "recv", "src": [1], "dst": [0],
+         "prob": 0.5},
+        {"fault": "duplicate", "direction": "send", "src": [3], "dst": [0]},
+    ]}
+    runs = []
+    for i in range(2):
+        plan = FaultPlan.from_json(spec)
+        agg = run_simulated(data, task, _cfg(rounds=3), backend="LOOPBACK",
+                            job_id=f"t-chaos-det-{i}", chaos_plan=plan,
+                            round_timeout_s=1.0)
+        assert agg.history[-1]["round"] == 2  # survived to the last round
+        runs.append((plan.ledger.canonical(), pack_pytree(agg.net)))
+    assert runs[0][0] == runs[1][0]          # identical fault sequences
+    assert len(runs[0][0]) > 0               # ...and chaos actually happened
+    for a, b in zip(runs[0][1], runs[1][1]):  # identical final models
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_duplicated_uplinks_never_double_count(lr_setup):
+    """Every client upload delivered twice == the clean run exactly: a
+    same-round duplicate overwrites its own slot (keyed by rank) and a
+    post-aggregation duplicate is dropped by round tag — either way the
+    sample-weighted average counts each client once."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    clean = run_simulated(data, task, _cfg(), backend="LOOPBACK",
+                          job_id="t-dup-clean")
+    plan = FaultPlan.from_json({"seed": 2, "rules": [
+        {"fault": "duplicate", "direction": "send",
+         "src": [1, 2, 3], "dst": [0]}]})
+    dup = run_simulated(data, task, _cfg(), backend="LOOPBACK",
+                        job_id="t-dup-chaos", chaos_plan=plan)
+    assert plan.ledger.counts()["duplicate"] == 3 * 3  # every uplink, 3 rounds
+    for a, b in zip(pack_pytree(clean.net), pack_pytree(dup.net)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_dropped_uplink_partial_aggregation_sample_weight_exact(lr_setup):
+    """Elastic partial aggregation under chaos-dropped uplinks: the round
+    aggregates over the clients that DID report, and the average is the
+    exact sample-weighted mean of exactly those uploads (asserted against
+    a numpy recomputation captured at aggregation time)."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+
+    data, task = lr_setup
+    seen = []
+    orig = FedAvgAggregator.aggregate
+
+    def spying_aggregate(self):
+        uploads = {r: [np.asarray(x) for x in leaves]
+                   for r, leaves in self.model_dict.items()}
+        weights = dict(self.sample_num_dict)
+        out = orig(self)
+        seen.append((uploads, weights, [np.asarray(x) for x in out]))
+        return out
+
+    plan = FaultPlan.from_json({"seed": 4, "rules": [
+        {"fault": "drop", "direction": "send", "src": [1], "dst": [0]}]})
+    FedAvgAggregator.aggregate = spying_aggregate
+    try:
+        agg = run_simulated(data, task, _cfg(rounds=2), backend="LOOPBACK",
+                            job_id="t-drop-exact", chaos_plan=plan,
+                            round_timeout_s=1.0)
+    finally:
+        FedAvgAggregator.aggregate = orig
+    assert agg.history[-1]["round"] == 1  # every round completed (elastic)
+    assert len(seen) == 2
+    for uploads, weights, got in seen:
+        assert sorted(uploads) == [1, 2]  # rank 1 (index 0) never arrived
+        wsum = sum(weights.values())
+        for i, g in enumerate(got):
+            exact = sum(np.float32(weights[r]) * uploads[r][i]
+                        for r in sorted(uploads)) / np.float32(wsum)
+            np.testing.assert_allclose(g, exact, rtol=1e-6, atol=1e-7)
+
+
+def test_crashed_rank_reprobed_and_rejoins(lr_setup):
+    """crash window [1, 5) on rank 2: the server's sync fails like a dead
+    TCP peer (ConnectionError), the rank is marked undeliverable and
+    skipped, the reprobe at failed_at+4 lands after the window — the rank
+    REJOINS and the job finishes with it participating again."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    plan = FaultPlan.from_json({"seed": 3, "rules": [
+        {"fault": "crash", "ranks": [2], "rounds": [1, 5]}]})
+    agg = run_simulated(data, task, _cfg(rounds=7), backend="LOOPBACK",
+                        job_id="t-crash-rejoin", chaos_plan=plan,
+                        round_timeout_s=1.0)
+    assert agg.history[-1]["round"] == 6
+    counts = plan.ledger.counts()
+    assert counts.get("crash", 0) >= 1  # the downlink really failed
+    # rank 2 participated after the window: its round-5+ uploads aggregated
+    # (if it never rejoined, every post-window round would be partial and
+    # the crash ledger would keep growing past the window's rounds)
+    post_window = [e for e in plan.ledger.canonical() if (e[5] or 0) >= 5]
+    assert post_window == []
+
+
+def test_delayed_uplinks_converge_exactly(lr_setup):
+    """delay (async re-delivery) and straggle (synchronous slowdown) well
+    inside the round deadline change nothing: every upload still arrives
+    and the final model equals the clean run bit-for-bit."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    clean = run_simulated(data, task, _cfg(rounds=2), backend="LOOPBACK",
+                          job_id="t-delay-clean")
+    plan = FaultPlan.from_json({"seed": 8, "rules": [
+        {"fault": "delay", "direction": "send", "src": [1], "dst": [0],
+         "delay_s": 0.15},
+        {"fault": "straggle", "direction": "send", "src": [2], "dst": [0],
+         "delay_s": 0.1}]})
+    slow = run_simulated(data, task, _cfg(rounds=2), backend="LOOPBACK",
+                         job_id="t-delay-chaos", chaos_plan=plan,
+                         round_timeout_s=8.0)
+    assert plan.ledger.counts() == {"delay": 2, "straggle": 2}
+    for a, b in zip(pack_pytree(clean.net), pack_pytree(slow.net)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_server_restart_mid_chaos_equals_uninterrupted(lr_setup, tmp_path):
+    """Checkpoint-resume under chaos: run 2 rounds with a windowed plan,
+    'crash' the server (process boundary = new manager from the same
+    ckpt_dir), resume for rounds 2-3 under the same plan — final model
+    equals one uninterrupted 4-round chaos run. Rules are windowed and
+    prob=1 so the fault schedule is restart-invariant."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    base = dict(client_num_in_total=8, client_num_per_round=3, epochs=1,
+                batch_size=8, lr=0.1, frequency_of_the_test=10, seed=0)
+    spec = {"seed": 11, "rules": [
+        {"fault": "drop", "direction": "send", "src": [1], "dst": [0],
+         "rounds": [1, 2]},
+        {"fault": "duplicate", "direction": "send", "src": [3], "dst": [0],
+         "rounds": [0, 4]},
+        {"fault": "corrupt", "direction": "recv", "src": [2], "dst": [0],
+         "rounds": [3, 4]}]}
+
+    ckpt = str(tmp_path / "chaos-ckpt")
+    run_simulated(data, task, FedAvgConfig(comm_round=2, **base),
+                  job_id="t-cr-1", chaos_plan=FaultPlan.from_json(spec),
+                  round_timeout_s=1.0, ckpt_dir=ckpt)
+    resumed = run_simulated(data, task, FedAvgConfig(comm_round=4, **base),
+                            job_id="t-cr-2",
+                            chaos_plan=FaultPlan.from_json(spec),
+                            round_timeout_s=1.0, ckpt_dir=ckpt)
+
+    oracle = run_simulated(data, task, FedAvgConfig(comm_round=4, **base),
+                           job_id="t-cr-oracle",
+                           chaos_plan=FaultPlan.from_json(spec),
+                           round_timeout_s=1.0)
+    assert resumed.history[-1]["round"] == 3
+    for a, b in zip(pack_pytree(resumed.net), pack_pytree(oracle.net)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_reorder_and_partition_liveness(lr_setup):
+    """reorder (held frames released by successor or backstop) and a
+    windowed partition (server cut off from rank 3 in round 1) must
+    degrade — partial rounds, late releases — but never wedge the job."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    plan = FaultPlan.from_json({"seed": 12, "rules": [
+        {"fault": "reorder", "direction": "send", "src": [2], "dst": [0],
+         "rounds": [0, 1]},
+        {"fault": "partition", "groups": [[0], [3]], "rounds": [1, 2]}]})
+    agg = run_simulated(data, task, _cfg(rounds=3), backend="LOOPBACK",
+                        job_id="t-reorder", chaos_plan=plan,
+                        round_timeout_s=1.5)
+    assert agg.history[-1]["round"] == 2
+    counts = plan.ledger.counts()
+    assert counts.get("reorder", 0) >= 1
+    assert counts.get("partition", 0) >= 1
+    faults = _counter("comm_faults_injected_total")
+    assert faults >= len(plan.ledger)  # metric family saw them too
+
+
+def test_grpc_wire_duplicate_dropped_by_exactly_once_dedup():
+    """On gRPC, a chaos 'duplicate' re-sends the SAME stamped (rank,
+    epoch, seq) frame — a true at-least-once redelivery — and the
+    receiver's exactly-once ``_accept_frame`` gate drops the copy
+    (comm_duplicates_dropped_total), so exactly one message dispatches."""
+    grpc = pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    plan = FaultPlan.from_json({"seed": 6, "rules": [
+        {"fault": "duplicate", "direction": "send", "src": [0], "dst": [1]}]})
+    base = 58200 + (int(time.time()) % 400)
+    tx = ChaosCommManager(GrpcCommManager(rank=0, size=2, base_port=base),
+                          plan, 0)
+    rx = GrpcCommManager(rank=1, size=2, base_port=base)
+    got = []
+
+    class Sink:
+        def receive_message(self, t, p):
+            got.append(p["v"])
+
+    rx.add_observer(Sink())
+    t = threading.Thread(target=rx.handle_receive_message, daemon=True)
+    t.start()
+    dups_before = _counter("comm_duplicates_dropped_total")
+    try:
+        m = Message("m", 0, 1)
+        m.add_params("v", 41)
+        tx.send_message(m)  # wire-duplicated: same seq sent twice
+        deadline = time.time() + 10
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.2)  # let the duplicate arrive (and be dropped)
+        assert got == [41], got  # exactly once, not twice
+        assert _counter("comm_duplicates_dropped_total") == dups_before + 1
+        assert plan.ledger.counts() == {"duplicate": 1}
+    finally:
+        rx.stop_receive_message()
+        tx.stop_receive_message()
+        t.join(timeout=5)
+
+
+# ------------------------------------------------------------------- soak
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_soak_many_seeds(lr_setup):
+    """The soak tier (excluded from tier-1): several seeded random plans,
+    each must complete every round and replay deterministically. Run via
+    ``pytest -m chaos`` or scripts/chaos_soak.py."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak", os.path.join(os.path.dirname(__file__), "..",
+                                   "scripts", "chaos_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+
+    data, task = lr_setup
+    for seed in range(4):
+        plan = soak.random_plan(seed, world_size=4)
+        res = soak.run_plan(data, task, plan, rounds=3)
+        assert res["ok"], res

@@ -490,6 +490,7 @@ def test_dead_rank_same_round_resend_skipped(monkeypatch):
     mgr = object.__new__(FedAvgServerManager)
     mgr.round_timeout_s = 5.0
     mgr.round_idx = 7
+    mgr._undeliverable = {}  # normally set by __init__ (eagerly, not lazily)
 
     class Msg:
         @staticmethod
