@@ -236,7 +236,14 @@ def _measure(mode: str) -> None:
     # builds the identical round program.
     telemetry = None
     tdir = os.environ.get("FEDML_BENCH_TELEMETRY_DIR")
-    if tdir:
+    # FEDML_BENCH_TRACE_DIR=<dir>: also ship the stitched per-round
+    # timeline (obs/tracing.py) — trace.json per mode, Perfetto-loadable —
+    # so the next TPU battery can decompose its rounds/sec figure into
+    # pack/compute/eval wall-clock instead of quoting one opaque number.
+    # Implies telemetry (the spans ride the same bundle); a measured
+    # VARIANT like the event log, never the headline default.
+    trdir = os.environ.get("FEDML_BENCH_TRACE_DIR")
+    if tdir or trdir:
         import atexit
 
         from fedml_tpu.obs import Telemetry
@@ -246,7 +253,9 @@ def _measure(mode: str) -> None:
         # runs' round records (duplicate round numbers, mixed span bases)
         # and the second child's close() would clobber the first's
         # metrics.prom
-        telemetry = Telemetry(log_dir=os.path.join(tdir, mode),
+        telemetry = Telemetry(log_dir=os.path.join(tdir or trdir, mode),
+                              trace_dir=(os.path.join(trdir, mode)
+                                         if trdir else None),
                               run_id=f"bench_{mode}")
         atexit.register(telemetry.close)
     api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),

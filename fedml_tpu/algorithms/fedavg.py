@@ -48,7 +48,7 @@ from fedml_tpu.core.client_data import (
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.sampling import prepare_sampling, sample_for
-from fedml_tpu.utils.tracing import RoundTracer
+from fedml_tpu.obs.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
 
 log = logging.getLogger("fedml_tpu.fedavg")
@@ -356,7 +356,12 @@ class FedAvgAPI:
         self.round_fn = self._build_round_fn()
         self._test_cache = None
         self.history: list[dict] = []
-        self.tracer = RoundTracer()  # pack/compute/eval spans (SURVEY.md §5)
+        # pack/compute/eval spans (SURVEY.md §5); with a tracing-enabled
+        # Telemetry bundle, the same spans also feed the distributed
+        # tracer's single-rank timeline (all host-side — nothing traced
+        # here touches the jitted round program)
+        self.tracer = RoundTracer(
+            sink=telemetry.tracer if telemetry is not None else None)
 
     # ------------------------------------------------------------------ round
     def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp, hook_key):
@@ -706,6 +711,10 @@ class FedAvgAPI:
             self._block_fn = self._build_block_fn()
         if self.telemetry is not None:
             spans_before = dict(self.tracer.rounds[-1])
+            if self.telemetry.tracer is not None:
+                # one trace per scanned block (its spans are amortized
+                # over the R rounds, like the 'block' event record)
+                self.telemetry.tracer.begin_round(start_round)
 
         ids_l, idx_l, mask_l, ns_l = [], [], [], []
         with self.tracer.span("pack"):
@@ -759,6 +768,8 @@ class FedAvgAPI:
                     start_round + i, clients=ids_l[i].tolist(),
                     metrics={k: float(v[i]) for k, v in ms_host.items()},
                     block=True)
+            if self.telemetry.tracer is not None:
+                self.telemetry.tracer.finish_round()  # see run_round
         return ms
 
     _WORKING_SET_BUCKET = 8192  # rows; pad-to-bucket keeps ONE compiled block
@@ -821,6 +832,8 @@ class FedAvgAPI:
     def run_round(self, round_idx: int):
         if self.telemetry is not None:
             spans_before = dict(self.tracer.rounds[-1])
+            if self.telemetry.tracer is not None:
+                self.telemetry.tracer.begin_round(round_idx)
         with self.tracer.span("pack"):
             ids = self._sampled_ids(round_idx)
             cb = self._pack_round(round_idx)
@@ -838,6 +851,13 @@ class FedAvgAPI:
                 round_idx, clients=np.asarray(ids).tolist(),
                 spans=self._span_delta(spans_before),
                 metrics={k: float(v) for k, v in metrics.items()})
+            if self.telemetry.tracer is not None:
+                # close the trace envelope HERE: left open it would absorb
+                # inter-round idle (timing loops, the post-run gap to
+                # close()) and misreport per-round wall-clock. train()'s
+                # eval spans still reach the histograms/event record; only
+                # the single-rank trace view scopes to the round program.
+                self.telemetry.tracer.finish_round()
         return metrics
 
     def _eval_on_all_clients(self) -> bool:

@@ -145,6 +145,17 @@ class FaultLedger:
                 ((e["fault"], e["direction"], e["src"], e["dst"], e["seq"],
                   e["round"]) for e in self._entries), key=key)
 
+    def for_round(self, round_idx, faults: tuple[str, ...] | None = None
+                  ) -> list[dict]:
+        """Entries of one round (optionally one fault subset) — an O(n)
+        filtered scan, no sort/full copy: per-round consumers (the trace
+        stitcher cross-references straggle/delay per upload) must not
+        re-canonicalize a soak run's whole ledger every frame."""
+        with self._lock:
+            return [dict(e) for e in self._entries
+                    if e["round"] == round_idx
+                    and (faults is None or e["fault"] in faults)]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._entries)

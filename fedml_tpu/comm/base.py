@@ -101,6 +101,9 @@ class BaseCommManager(abc.ABC):
             logging.getLogger("fedml_tpu.comm").warning(
                 "dropping corrupt %d-byte frame", len(data), exc_info=True)
             return
+        # liveness: a decoded frame proves its sender alive — feeds the
+        # fed_last_heartbeat_age_seconds{rank} gauges on every transport
+        _obs.record_rank_seen(msg.get_params().get("sender"))
         self._enqueue(msg)
 
     def _enqueue(self, msg: "Message") -> None:

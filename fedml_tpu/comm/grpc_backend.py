@@ -102,9 +102,12 @@ class GrpcCommManager(BaseCommManager):
             src = int.from_bytes(hdr[:8], "little")
             epoch = int.from_bytes(hdr[8:16], "little")
             seq = int.from_bytes(hdr[16:], "little")
-            if not self._accept_frame(src, epoch, seq):
-                from fedml_tpu.obs import comm_instrument as _obs
+            from fedml_tpu.obs import comm_instrument as _obs
 
+            # wire-level heartbeat: even a frame the dedup gate is about
+            # to drop proves the peer process is alive
+            _obs.record_rank_seen(src)
+            if not self._accept_frame(src, epoch, seq):
                 _obs.record_duplicate(self.backend_name)
                 log.warning("drop duplicate frame %d from rank %d", seq, src)
                 return b"dup"

@@ -24,6 +24,7 @@ from typing import Callable
 from fedml_tpu.comm.base import BaseCommManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.observer import Observer
+from fedml_tpu.obs import comm_instrument as _obs
 
 log = logging.getLogger("fedml_tpu.comm.managers")
 
@@ -115,6 +116,9 @@ class DistributedManager(Observer):
     def _watch(self) -> None:
         while not self._finished.is_set():
             time.sleep(min(self.timeout_s / 4, 1.0))
+            # periodic liveness refresh: heartbeat-age gauges keep growing
+            # while the link is silent — exactly when the watchdog watches
+            _obs.refresh_liveness()
             idle = time.monotonic() - self._last_rx
             if idle > self.timeout_s:
                 self._last_rx = time.monotonic()  # rate-limit the callback

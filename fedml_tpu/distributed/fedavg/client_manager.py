@@ -3,14 +3,24 @@
 Mirror of fedml_api/distributed/fedavg/FedAvgClientManager.py: on INIT/SYNC,
 update model + assigned client index, run __train (:72-75), send model to
 rank 0 (:66-70).
+
+Tracing: when an inbound broadcast carries ``__trace`` context (the server
+has tracing on), the handler times its unpack / local_fit / pack phases as
+spans parented to the server's broadcast span and piggybacks the finished
+buffer (plus the NTP clock stamps) on the upload frame — so clients trace
+exactly when the server does, with zero client-side configuration. With no
+context present this path is untouched and the upload is byte-identical.
 """
 
 from __future__ import annotations
+
+import contextlib
 
 from fedml_tpu.comm.managers import ClientManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+from fedml_tpu.obs.tracing import TRACE_KEY, ClientSpanBuffer
 
 
 class FedAvgClientManager(ClientManager):
@@ -28,6 +38,7 @@ class FedAvgClientManager(ClientManager):
                 f"sparsify_ratio must be in (0, 1], got {sparsify_ratio}")
         self.sparsify_ratio = sparsify_ratio
         self._residual = None
+        self._trace_buf: ClientSpanBuffer | None = None  # lazy: see module doc
         super().__init__(rank, size, backend, **kw)
 
     def register_message_receive_handlers(self):
@@ -53,22 +64,36 @@ class FedAvgClientManager(ClientManager):
         # trust the server's round counter (keeps stragglers aligned after an
         # elastic partial aggregation skipped them)
         self.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx))
+        buf = None
+        blob = msg_params.get(TRACE_KEY)
+        if isinstance(blob, dict) and blob.get("tid"):  # server is tracing
+            if self._trace_buf is None:
+                self._trace_buf = ClientSpanBuffer(self.rank)
+            buf = self._trace_buf
+            buf.on_broadcast(blob)
+        span = buf.span if buf is not None else \
+            (lambda _name: contextlib.nullcontext())
         global_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
-        self.trainer.update_model(global_leaves)
-        self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
-        wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
+        with span("unpack"):
+            self.trainer.update_model(global_leaves)
+            self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
+        with span("local_fit"):
+            wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        if self.sparsify_ratio:
-            from fedml_tpu.comm.sparse import (topk_delta, topk_encode,
-                                               topk_residual)
+        with span("pack"):
+            if self.sparsify_ratio:
+                from fedml_tpu.comm.sparse import (topk_delta, topk_encode,
+                                                   topk_residual)
 
-            delta = topk_delta(wire_leaves, global_leaves, self._residual)
-            idx, vals = topk_encode(delta, self.sparsify_ratio)
-            self._residual = topk_residual(delta, idx)
-            msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_IDX, idx)
-            msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_VAL, vals)
-        else:
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
-        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
-        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+                delta = topk_delta(wire_leaves, global_leaves, self._residual)
+                idx, vals = topk_encode(delta, self.sparsify_ratio)
+                self._residual = topk_residual(delta, idx)
+                msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_IDX, idx)
+                msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_VAL, vals)
+            else:
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
+            msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        if buf is not None:  # span buffer + clock stamps ride the uplink
+            msg.add_params(TRACE_KEY, buf.upload_blob())
         self.send_message(msg)

@@ -30,6 +30,8 @@ from fedml_tpu.comm.managers import ServerManager
 from fedml_tpu.comm.message import Message, codec_roundtrip
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
+from fedml_tpu.obs import comm_instrument as _obs
+from fedml_tpu.obs.tracing import TRACE_KEY
 
 log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
@@ -54,15 +56,20 @@ class FedAvgServerManager(ServerManager):
         # seed behavior, zero extra work.
         self.telemetry = telemetry
         self._round_ids: list[int] = []
+        # cross-rank tracer (obs/tracing.py): present only when the
+        # Telemetry bundle opted in (trace_dir / trace=True). None = no
+        # __trace params on any frame — the wire is byte-identical.
+        self._dtracer = telemetry.tracer if telemetry is not None else None
         if telemetry is not None:
             import dataclasses
 
-            from fedml_tpu.utils.tracing import RoundTracer
+            from fedml_tpu.obs.tracing import RoundTracer
 
-            self._tracer = RoundTracer()
+            self._tracer = RoundTracer(sink=self._dtracer)
             telemetry.run_header(dataclasses.asdict(aggregator.cfg),
                                  engine="distributed", backend=backend,
-                                 world_size=size)
+                                 world_size=size,
+                                 tracing=self._dtracer is not None)
         if ckpt_dir is not None:
             self._maybe_resume()
         self._round_lock = threading.Lock()
@@ -86,11 +93,20 @@ class FedAvgServerManager(ServerManager):
             # (the client becomes a straggler), not fatal
             kw.setdefault("send_timeout_s", round_timeout_s)
         super().__init__(rank, size, backend, timeout_s=round_timeout_s or ts, **kw)
+        _obs.set_ranks_alive(size - 1)  # all peers presumed reachable at boot
 
     # a rank whose delivery failed is probed again only every k-th round:
     # one dead peer must not cost every round a full send deadline, but a
     # REBOOTED peer must still be able to rejoin
     _DEAD_RANK_REPROBE_ROUNDS = 4
+
+    def _update_alive_gauge(self) -> None:
+        """fed_ranks_alive from the undeliverable/reprobe bookkeeping —
+        world size may be unknown on a partially-built instance (tests
+        drive the elastic send path without the comm stack)."""
+        size = getattr(self, "size", None)
+        if size is not None:
+            _obs.set_ranks_alive(size - 1 - len(self._undeliverable))
 
     @staticmethod
     def _is_transport_error(e: BaseException) -> bool:
@@ -126,10 +142,12 @@ class FedAvgServerManager(ServerManager):
             if failed_at is not None:
                 log.info("elastic: rank %d reachable again", rank)
                 self._undeliverable.pop(rank, None)
+                self._update_alive_gauge()
         except Exception as e:
             if self.round_timeout_s is None or not self._is_transport_error(e):
                 raise
             self._undeliverable[rank] = self.round_idx
+            self._update_alive_gauge()
             log.warning("elastic: dropping undeliverable send to rank %d",
                         rank, exc_info=True)
 
@@ -207,19 +225,33 @@ class FedAvgServerManager(ServerManager):
         self.send_init_msg()
         super().run()
 
-    def send_init_msg(self):
+    def _broadcast_model(self, msg_type: str, global_params) -> None:
+        """Sample this round's clients and broadcast ``global_params`` to
+        every rank under ``msg_type`` — the shared body of send_init_msg
+        and the round-advance sync (they must not diverge). Starts the
+        round's trace and rides its context on each frame when tracing."""
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         self._round_ids = [int(c) for c in client_indexes]
-        global_params = self.aggregator.get_global_model_params()
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
         # codec their deltas are relative to the decoded broadcast
         self._bcast_leaves = codec_roundtrip(global_params)
+        tr = self._dtracer
+        if tr is not None:
+            tr.begin_round(self.round_idx)
         for rank in range(1, self.size):
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, rank)
+            msg = Message(msg_type, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if tr is not None:  # trace context rides the header scalars
+                msg.add_params(TRACE_KEY, tr.broadcast_ctx(rank))
             self.send_message(msg)
+        if tr is not None:
+            tr.end_broadcast()
+
+    def send_init_msg(self):
+        self._broadcast_model(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+                              self.aggregator.get_global_model_params())
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -235,6 +267,12 @@ class FedAvgServerManager(ServerManager):
                 log.warning("drop stale upload from rank %d (round %s, now %d)",
                             sender, msg_round, self.round_idx)
                 return
+            if self._dtracer is not None:
+                # arrival time + clock sample + the piggybacked client
+                # span buffer (None from a stock/untraced peer is fine —
+                # the arrival alone keeps slack computable)
+                self._dtracer.on_upload(int(sender),
+                                        msg_params.get(TRACE_KEY))
             if MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params:
                 # sparse uplink: densify against the global this round
                 # broadcast — the ALREADY-PACKED leaves stashed at send
@@ -275,13 +313,19 @@ class FedAvgServerManager(ServerManager):
                 float(np.sum((np.asarray(n) - o) ** 2))
                 for n, o in zip(global_params, old_leaves))
             hist = self.aggregator.history
+            # stitch: close the round's trace and fold the critical-path
+            # attribution (straggler rank, phase breakdown, slack, chaos
+            # cross-reference) into the round record
+            cp = (self._dtracer.finish_round()
+                  if self._dtracer is not None else None)
             tel.emit_round(
                 self.round_idx, clients=self._round_ids,
                 spans=dict(self._tracer.rounds[-1]),
                 metrics={"update_norm": float(np.sqrt(upd_sq)),
                          "num_samples": n_samples},
                 evals=(hist[-1] if hist
-                       and hist[-1].get("round") == self.round_idx else None))
+                       and hist[-1].get("round") == self.round_idx else None),
+                **({"critical_path": cp} if cp else {}))
             self._tracer.next_round()
         else:
             global_params = self.aggregator.aggregate()
@@ -292,17 +336,8 @@ class FedAvgServerManager(ServerManager):
         if self.round_idx == self.round_num:
             self._broadcast_finish()
             return
-        client_indexes = self.aggregator.client_sampling(self.round_idx)
-        self._round_ids = [int(c) for c in client_indexes]
-        # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
-        # codec their deltas are relative to the decoded broadcast
-        self._bcast_leaves = codec_roundtrip(global_params)
-        for rank in range(1, self.size):
-            msg = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
-            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
-            self.send_message(msg)
+        self._broadcast_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              global_params)
 
     def on_timeout(self, idle_s: float):
         """Watchdog (own thread): no traffic for round_timeout_s."""

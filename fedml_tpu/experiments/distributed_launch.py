@@ -94,6 +94,17 @@ def add_args(p: argparse.ArgumentParser):
                         "byte/message counters; docs/OBSERVABILITY.md) and "
                         "a Prometheus text dump at exit; render with "
                         "scripts/report.py")
+    p.add_argument("--trace-dir", "--trace_dir", dest="trace_dir",
+                   type=str, default=None,
+                   help="rank 0: enable cross-rank distributed tracing "
+                        "(obs/tracing.py) and write the stitched per-round "
+                        "timeline here as Chrome trace-event JSON "
+                        "(trace.json — load in Perfetto or chrome://"
+                        "tracing); round records gain critical-path/"
+                        "straggler attribution (render with scripts/"
+                        "report.py --critical-path). Implies telemetry; "
+                        "clients need no flag — trace context propagates "
+                        "in the message headers")
     # experiment surface (subset of cli.py, same names)
     p.add_argument("--model", type=str, default="lr")
     p.add_argument("--dataset", type=str, default="mnist")
@@ -267,10 +278,13 @@ def main(argv=None):
         backend_kw.update(job_id="launch")
 
     telemetry = None
-    if args.telemetry_dir and args.rank == 0:
+    if (args.telemetry_dir or args.trace_dir) and args.rank == 0:
         from fedml_tpu.obs import Telemetry
 
-        telemetry = Telemetry(log_dir=args.telemetry_dir)
+        # --trace-dir alone implies telemetry: the event log (with the
+        # critical-path round records) lands next to trace.json
+        telemetry = Telemetry(log_dir=args.telemetry_dir or args.trace_dir,
+                              trace_dir=args.trace_dir)
     mgr = init_role(args, data, task, cfg, backend_kw, telemetry=telemetry)
     try:
         mgr.run()

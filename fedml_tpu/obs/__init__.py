@@ -16,7 +16,15 @@ reports through:
                        (``FedAvgAPI(..., telemetry=...)``,
                        ``--telemetry-dir`` on the distributed launcher);
 - ``export``         — CSV / Prometheus-text / BENCH-blob exporters and the
-                       jax.profiler bridge.
+                       jax.profiler bridge;
+- ``tracing``        — cross-rank distributed tracing: per-round trace ids,
+                       spans with (trace, span, parent, rank), context
+                       propagated in message header scalars, stitched
+                       per-round timelines + critical-path attribution;
+- ``clock``          — the NTP-style clock-offset estimator the stitcher
+                       rebases client spans with;
+- ``trace_export``   — Chrome trace-event JSON (Perfetto /
+                       chrome://tracing) + the critical-path renderer.
 
 scripts/report.py renders a run's events.jsonl; docs/OBSERVABILITY.md has
 the schema and metric-name reference.
@@ -26,13 +34,19 @@ from fedml_tpu.obs.comm_instrument import comm_counters
 from fedml_tpu.obs.events import EventLog, JsonlSink, MemorySink, read_jsonl
 from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from fedml_tpu.obs.telemetry import Telemetry
+from fedml_tpu.obs.tracing import (TRACE_KEY, ClientSpanBuffer,
+                                   DistributedTracer, RoundTracer)
 
 __all__ = [
     "REGISTRY",
+    "TRACE_KEY",
+    "ClientSpanBuffer",
+    "DistributedTracer",
     "EventLog",
     "JsonlSink",
     "MemorySink",
     "MetricsRegistry",
+    "RoundTracer",
     "Telemetry",
     "comm_counters",
     "read_jsonl",

@@ -23,6 +23,8 @@ managers, one process), gRPC, and MQTT runs all read through the same names:
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import lru_cache
 
 from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
@@ -69,8 +71,21 @@ def record_receive(backend: str, nbytes: int) -> None:
     byts.inc(nbytes)
 
 
+_tls = threading.local()
+
+
 def record_dispatch_latency(backend: str, seconds: float) -> None:
     _dispatch_hist(backend).observe(seconds)
+    # stash for the handler about to run on THIS thread (the dispatch loop
+    # notifies observers right after timing) — the tracing layer reads it
+    # to attribute inbound queue wait on the client_round span
+    _tls.last_dispatch_s = seconds
+
+
+def last_dispatch_latency() -> float | None:
+    """Queue wait of the message currently being dispatched on this thread
+    (None outside a dispatch-loop handler)."""
+    return getattr(_tls, "last_dispatch_s", None)
 
 
 @lru_cache(maxsize=16)
@@ -127,10 +142,61 @@ def record_fault(backend: str, fault: str, direction: str) -> None:
     _faults(backend, fault, direction).inc()
 
 
+# --------------------------------------------------------------- liveness
+# Heartbeat/liveness gauges, fed by the machinery that already exists:
+# every decoded inbound frame proves its sender alive (BaseCommManager.
+# _receive_frame), a gRPC dedup-dropped duplicate still proves liveness
+# (grpc_backend.recv), and the elastic server's undeliverable/reprobe
+# bookkeeping sets the alive count. Ages are recomputed on snapshot
+# (refresh_liveness) so the Prometheus dump and per-round comm deltas
+# carry fresh values.
+
+_hb_lock = threading.Lock()
+_hb_last_seen: dict[int, float] = {}
+
+
+@lru_cache(maxsize=256)
+def _hb_gauge(rank: int):
+    return REGISTRY.gauge("fed_last_heartbeat_age_seconds", rank=rank)
+
+
+def record_rank_seen(rank) -> None:
+    """A frame from ``rank`` arrived — reset its heartbeat age. Runs on
+    the per-frame receive path, so the gauge child is memoized like the
+    other hot-path hooks (no registry-lock traffic per frame)."""
+    try:
+        rank = int(rank)
+    except (TypeError, ValueError):
+        return  # interop peers may ship non-integer sender ids
+    with _hb_lock:
+        _hb_last_seen[rank] = time.time()
+    _hb_gauge(rank).set(0.0)
+
+
+def refresh_liveness() -> None:
+    """Recompute every rank's ``fed_last_heartbeat_age_seconds`` gauge
+    from its last-seen stamp (ages grow between frames; a gauge is a
+    snapshot, so exporters call this right before reading)."""
+    now = time.time()
+    with _hb_lock:
+        items = list(_hb_last_seen.items())
+    for rank, ts in items:
+        _hb_gauge(rank).set(max(0.0, now - ts))
+
+
+def set_ranks_alive(n: int) -> None:
+    """``fed_ranks_alive``: peer ranks currently considered reachable —
+    set by the elastic server from its undeliverable/reprobe bookkeeping
+    (world - 1 at start, decremented on delivery failure, restored when a
+    reprobe succeeds)."""
+    REGISTRY.gauge("fed_ranks_alive").set(n)
+
+
 def comm_counters(registry: MetricsRegistry | None = None) -> dict:
     """Flat cumulative totals (all labels summed) — the snapshot Telemetry
     diffs between rounds to put per-round byte/message counts in the event
     log. Includes dispatch-latency quantiles when any message was timed."""
+    refresh_liveness()  # age gauges must be fresh in any snapshot
     reg = registry or REGISTRY
     out = {
         "messages_sent": reg.total("comm_messages_sent_total"),

@@ -7,7 +7,10 @@ One object ties together the three obs primitives:
 - an ``EventLog`` over a rotating JSONL file (``log_dir/events.jsonl``) or
   an in-memory sink (tests);
 - the ``jax.profiler`` bridge (``profile(logdir)`` — the opt-in XLA trace,
-  reusing utils.tracing.trace).
+  reusing utils.tracing.trace);
+- optionally a ``DistributedTracer`` (``trace_dir=``/``trace=True``) — the
+  cross-rank per-round trace stitcher (obs/tracing.py); ``close()`` writes
+  its Chrome trace-event JSON next to the event log.
 
 Contract with the engines: a ``telemetry=None`` engine is bit-identical to
 the pre-telemetry engine — no extra outputs in the jitted round program, no
@@ -28,7 +31,9 @@ class Telemetry:
                  registry: MetricsRegistry | None = None,
                  sink=None, run_id: str | None = None,
                  round_stats: bool = True,
-                 rotate_bytes: int = 64 << 20, backups: int = 3):
+                 rotate_bytes: int = 64 << 20, backups: int = 3,
+                 trace_dir: str | None = None, trace: bool = False,
+                 trace_clock=None):
         self.log_dir = log_dir
         # ``registry`` is where THIS bundle's own metrics live and what
         # close() dumps. Comm deltas always read the process-wide REGISTRY
@@ -45,6 +50,20 @@ class Telemetry:
         # round_stats=False: keep the event stream but skip the in-graph
         # update-norm/drift outputs (an engine knob; comm counters stay on)
         self.round_stats = round_stats
+        # cross-rank distributed tracing (obs/tracing.py): opt-in via
+        # trace_dir (Chrome trace-event JSON written at close) or
+        # trace=True (spans kept in memory — tests read tracer.spans()).
+        # Off (the default): self.tracer is None, the engines add no trace
+        # context to any frame, and the wire is byte-identical.
+        self.trace_dir = trace_dir
+        self.tracer = None
+        if trace or trace_dir:
+            import time as _time
+
+            from fedml_tpu.obs.tracing import DistributedTracer
+
+            self.tracer = DistributedTracer(
+                self.events.run_id, clock=trace_clock or _time.time)
         self._header_emitted = False
         self._last_comm = comm_counters(REGISTRY)
 
@@ -114,7 +133,21 @@ class Telemetry:
     # ------------------------------------------------------------- teardown
     def close(self) -> None:
         """Flush and close the event log; when file-backed, also drop a
-        Prometheus text dump of the registry next to it."""
+        Prometheus text dump of the registry next to it. With tracing on
+        and a trace_dir, write the stitched Chrome trace (trace.json —
+        load it in Perfetto / chrome://tracing)."""
+        if self.tracer is not None:
+            self.tracer.finish()
+            if self.trace_dir:
+                from fedml_tpu.obs.trace_export import write_chrome_trace
+
+                try:
+                    os.makedirs(self.trace_dir, exist_ok=True)
+                    write_chrome_trace(
+                        self.tracer.spans(),
+                        os.path.join(self.trace_dir, "trace.json"))
+                except OSError:
+                    pass  # read-only dir: in-memory spans still stand
         if self.log_dir:
             try:
                 with open(os.path.join(self.log_dir, "metrics.prom"),

@@ -1,73 +1,23 @@
-"""Tracing / profiling hooks — a parity-plus subsystem.
+"""jax.profiler bridges + compatibility alias for the span tracer.
 
-The reference has no profiler integration; its only timing is ad-hoc
-wall-clock prints ("aggregate time cost", FedAVGAggregator.py:59,85-86) —
-SURVEY.md §5 flags jax.profiler hooks as the first-class improvement to add.
+The host-side span path now lives in ``fedml_tpu/obs/tracing.py`` (one
+span path for everything: ``RoundTracer`` feeds the process metrics
+registry and, via its ``sink``, the cross-rank distributed tracer).
+``RoundTracer`` is re-exported here so seed-era imports keep working.
 
-Two layers:
-- ``RoundTracer``: lightweight host-side span timing (pack/compute/eval per
-  round) with summary stats — always on, microsecond overhead.
+What genuinely lives here are the XLA-level profiler hooks:
+
 - ``trace(logdir)``: context manager around jax.profiler for full XLA/TPU
   traces viewable in TensorBoard/Perfetto — opt-in because trace files are
-  large.
-
-Usage:
-    tracer = RoundTracer()
-    with tracer.span("pack"):   cb = ...
-    with tracer.span("round"):  net = round_fn(...)
-    tracer.next_round()
-    print(tracer.summary())
+  large;
+- ``annotate(name)``: named region inside device traces.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 
-import numpy as np
-
-
-class RoundTracer:
-    """Per-round named span timing with aggregate statistics."""
-
-    def __init__(self):
-        self.rounds: list[dict[str, float]] = [{}]
-
-    @contextlib.contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            cur = self.rounds[-1]
-            cur[name] = cur.get(name, 0.0) + (time.perf_counter() - t0)
-
-    def next_round(self):
-        self.rounds.append({})
-
-    def summary(self) -> dict[str, dict[str, float]]:
-        """name -> {mean, p50, p95, max, total} over completed rounds."""
-        per_name = defaultdict(list)
-        for r in self.rounds:
-            for k, v in r.items():
-                per_name[k].append(v)
-        out = {}
-        for k, vs in per_name.items():
-            a = np.asarray(vs)
-            out[k] = {
-                "mean": float(a.mean()),
-                "p50": float(np.percentile(a, 50)),
-                "p95": float(np.percentile(a, 95)),
-                "max": float(a.max()),
-                "total": float(a.sum()),
-                "count": len(vs),
-            }
-        return out
-
-    def totals(self) -> dict[str, float]:
-        """name -> total seconds across all rounds (the bench span report)."""
-        return {k: v["total"] for k, v in self.summary().items()}
+from fedml_tpu.obs.tracing import RoundTracer  # noqa: F401 — compat alias
 
 
 @contextlib.contextmanager

@@ -27,6 +27,46 @@ unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 if [ "$TIER" = "smoke" ]; then
   echo "== smoke tier (every engine oracle, minimal shapes) =="
   python -m pytest tests/ -q -m smoke
+  echo "== tracing smoke (2-round loopback sim, span-schema + Chrome-trace validation) =="
+  # a stitched cross-rank trace must come out of a plain loopback sim and
+  # validate against the documented span schema (docs/OBSERVABILITY.md
+  # §Tracing); scripts/report.py must render its critical path
+  TRACE_DIR=./tmp/ci_trace; rm -rf "$TRACE_DIR"
+  python - "$TRACE_DIR" <<'PY'
+import json, os, sys
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+from fedml_tpu.obs.trace_export import validate_chrome_trace, validate_spans
+
+d = sys.argv[1]
+data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+tel = Telemetry(log_dir=d, trace_dir=d)
+run_simulated(data, classification_task(LogisticRegression(num_classes=3)),
+              FedAvgConfig(comm_round=2, client_num_in_total=4,
+                           client_num_per_round=2, batch_size=6,
+                           frequency_of_the_test=1),
+              job_id="ci-trace-smoke", telemetry=tel)
+errs = validate_spans(tel.tracer.spans())
+assert not errs, f"span schema violations: {errs}"
+tel.close()
+with open(os.path.join(d, "trace.json")) as f:
+    doc = json.load(f)
+errs = validate_chrome_trace(doc)
+assert not errs, f"chrome trace violations: {errs}"
+rounds = [json.loads(line) for line in open(os.path.join(d, "events.jsonl"))
+          if '"round"' in line]
+cps = [r.get("critical_path") for r in rounds if r.get("kind") == "round"]
+assert cps and all(cps), "round records missing critical_path"
+print(f"tracing smoke ok: {len(doc['traceEvents'])} events, "
+      f"straggler ranks {[c['straggler'] for c in cps]}")
+PY
+  python scripts/report.py "$TRACE_DIR/events.jsonl" --critical-path
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi

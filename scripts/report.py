@@ -80,10 +80,17 @@ def main(argv=None) -> int:
                         "('-' = stdout as the last line)")
     p.add_argument("--csv", default=None, metavar="PATH",
                    help="also write the round records as CSV")
+    p.add_argument("--critical-path", action="store_true",
+                   help="render the per-round critical-path/straggler "
+                        "attribution (straggler rank, phase breakdown, "
+                        "per-rank slack, chaos-injected delay) from a "
+                        "tracing-enabled run's round records; logs that "
+                        "predate tracing degrade to a notice")
     args = p.parse_args(argv)
 
     from fedml_tpu.obs.events import read_jsonl
     from fedml_tpu.obs.export import bench_blob, write_csv
+    from fedml_tpu.obs.trace_export import render_critical_path
 
     records = read_jsonl(args.events)
     if not records:
@@ -95,6 +102,9 @@ def main(argv=None) -> int:
         h = headers[0]
         print(f"run: {h.get('run')}  engine: {h.get('engine', '?')}")
     print(render_table(records))
+    if args.critical_path:
+        print()
+        print(render_critical_path(records))
 
     if args.csv:
         cols = write_csv(records, args.csv)
