@@ -47,6 +47,12 @@ from fedml_tpu.core.client_data import (
     pad_index_batches,
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
+from fedml_tpu.core.robust_agg import (
+    DEFAULT_NORM_MULT,
+    QuarantineLedger,
+    gated_aggregate,
+    make_robust_aggregator,
+)
 from fedml_tpu.core.sampling import prepare_sampling, sample_for
 from fedml_tpu.obs.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
@@ -249,11 +255,59 @@ class FedAvgAPI:
         uniform_avg: bool = False,
         bucket_batches: bool = False,
         telemetry=None,
+        aggregator: str | Callable | None = None,
+        aggregator_params: dict | None = None,
+        sanitize: bool | float | None = None,
+        adversary_plan=None,
     ):
         self.data = dataset
         self.task = task
         self.cfg = config
         self.mesh = mesh
+        # Byzantine-robust aggregation (core/robust_agg.py). ``aggregator``
+        # replaces the weighted mean with a robust estimator over the
+        # stacked client updates: 'mean' | 'median' | 'trimmed_mean' |
+        # 'krum' | 'multi_krum' | 'geometric_median', or a callable
+        # ``(stacked, weights) -> (tree, info)``. ``sanitize`` fronts it
+        # with the non-finite/norm-outlier gate (True = default norm_mult,
+        # a float = that multiple, False = off; None = on iff an
+        # aggregator is set). The default (None/None) keeps the round
+        # program bit-identical to the plain weighted-mean build.
+        if aggregator is None:
+            self._robust_agg = None
+        elif callable(aggregator):
+            self._robust_agg = aggregator
+        else:
+            self._robust_agg = make_robust_aggregator(
+                aggregator, n=config.client_num_per_round,
+                **(aggregator_params or {}))
+        if sanitize is None:
+            sanitize = self._robust_agg is not None
+        self._sanitize_mult = (
+            None if sanitize is False
+            else DEFAULT_NORM_MULT if sanitize is True else float(sanitize))
+        self._needs_stacked = (self._robust_agg is not None
+                               or self._sanitize_mult is not None)
+        # per-round gate/aggregator verdicts (suspected/rejected ranks);
+        # rank = stacked slot + 1, matching the loopback runtime's worker
+        # ranks so the two ledgers are comparable entry-for-entry
+        self.quarantine = QuarantineLedger()
+        # model-space adversary injection (chaos/adversary.py): perturb the
+        # stacked client nets INSIDE the jitted round program, per the
+        # plan's (round-window, rank) schedule — the standalone twin of a
+        # Byzantine client lying on the wire.
+        self._adversary = None
+        if adversary_plan is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "adversary_plan is a standalone-simulation feature "
+                    "(single device); on a mesh run the cross-process "
+                    "runtime with per-client adversaries instead")
+            from fedml_tpu.chaos.adversary import make_in_graph_injector
+
+            self._adversary = make_in_graph_injector(
+                adversary_plan, config.client_num_per_round)
+            self.adversary_plan = adversary_plan
         # telemetry: an obs.Telemetry bundle. None (default) keeps the round
         # program bit-identical to the untelemetered build — the stats below
         # are extra jit OUTPUTS, so the off path has zero overhead and the
@@ -364,7 +418,8 @@ class FedAvgAPI:
             sink=telemetry.tracer if telemetry is not None else None)
 
     # ------------------------------------------------------------------ round
-    def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp, hook_key):
+    def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp,
+                    hook_key, round_idx=None):
         """Per-shard body: vmap local fits, weighted-aggregate, server update.
 
         In distributed mode this runs inside shard_map: the leading client
@@ -375,6 +430,15 @@ class FedAvgAPI:
         nets, metrics = jax.vmap(self.local_update, in_axes=(0, None, 0, 0, 0))(
             keys, net, x, y, mask
         )
+        if self._adversary is not None and round_idx is not None:
+            # Byzantine slots lie BEFORE any server-side defense sees them
+            # (the clipping client_result_hook models the server's view).
+            # The FULL NetState is perturbed — params AND extra — because
+            # that is what a Byzantine client controls on the wire
+            # (perturb_leaves hits every packed leaf), and the two
+            # runtimes' gate verdicts must agree on models with
+            # batch_stats, not just param-only ones.
+            nets = self._adversary(nets, net, round_idx)
         if self.client_result_hook is not None:
             # x may be a pytree (FedNAS packs (train, val) streams) — take K
             # from the keys, which are always a flat [K, 2] array
@@ -386,13 +450,26 @@ class FedAvgAPI:
         return agg_weights(nsamp, self.uniform_avg)
 
     def _aggregate_and_update(self, net, server_opt_state, nets, metrics, nsamp, post_key):
-        avg = tree_weighted_mean(nets, self._agg_weights(nsamp))
+        if self._needs_stacked:
+            # gate -> estimator -> suspected merge -> all-rejected
+            # fallback, via the ONE composition both runtimes share
+            # (core/robust_agg.gated_aggregate)
+            avg, _, reasons = gated_aggregate(
+                nets, net, self._agg_weights(nsamp),
+                robust_fn=self._robust_agg, norm_mult=self._sanitize_mult)
+        else:
+            avg = tree_weighted_mean(nets, self._agg_weights(nsamp))
+            reasons = None
         new_net, new_opt = self.server_update(net, avg, server_opt_state)
         if self.post_aggregate_hook is not None:
             new_net = self.post_aggregate_hook(new_net, post_key)
         agg_metrics = {k: jnp.sum(v) for k, v in metrics.items()}
         if self._emit_stats:
             agg_metrics.update(round_stats(net, new_net, nets, avg, nsamp))
+        if reasons is not None:
+            # [K] reason codes ride out of the jit with the metrics and are
+            # popped host-side into the quarantine ledger (never floated)
+            agg_metrics["__quarantine"] = reasons
         return new_net, new_opt, agg_metrics
 
     def _materialize(self, batch):
@@ -420,6 +497,7 @@ class FedAvgAPI:
                 rng, kh, kp = jax.random.split(rng, 3)
                 nets, metrics, nsamp = self._round_body(
                     keys, net, server_opt_state, x, y, mask, nsamp_in, kh,
+                    round_idx=round_idx,
                 )
                 new_net, new_opt, m = self._aggregate_and_update(
                     net, server_opt_state, nets, metrics, nsamp, kp
@@ -445,8 +523,8 @@ class FedAvgAPI:
                 "zero-weight clients)"
             )
 
-        def shard_body(keys, net, x, y, mask, nsamp, hook_key):
-            # keys/x/y/mask/nsamp have this device's client slice. The global
+        def shard_fits(keys, net, x, y, mask, hook_key):
+            # keys/x/y/mask have this device's client slice. The global
             # net enters replicated but the scan carry becomes device-varying
             # after the first local step — mark it varying up front (vma rule).
             net = jax.tree.map(lambda v: jax.lax.pcast(v, axis, to="varying"), net)
@@ -456,6 +534,10 @@ class FedAvgAPI:
             if self.client_result_hook is not None:
                 hkeys = jax.random.split(hook_key, keys.shape[0])
                 nets = jax.vmap(lambda n, k: self.client_result_hook(n, net, k))(nets, hkeys)
+            return nets, metrics
+
+        def shard_body(keys, net, x, y, mask, nsamp, hook_key):
+            nets, metrics = shard_fits(keys, net, x, y, mask, hook_key)
             return _shard_aggregate(nets, metrics, self._agg_weights(nsamp),
                                     axis)
 
@@ -479,6 +561,51 @@ class FedAvgAPI:
             out_specs=(P(), P()),
             **self._smap_kw,
         )
+
+        if self._needs_stacked:
+            # Robust aggregation needs the FULL stacked client set (sorts,
+            # pairwise distances — not psum-able). Run only the local fits
+            # under shard_map (the same shard_fits the weighted-mean path
+            # aggregates in-shard; out_specs P(axis): each device returns
+            # its client shard) and aggregate in the enclosing jit, where
+            # GSPMD handles the gather the estimator implies.
+            smapped_fits = jax.shard_map(
+                shard_fits,
+                in_specs=(P(axis), P(), P(axis), P(axis), P(axis), P()),
+                out_specs=(P(axis), P(axis)),
+                **self._smap_kw,
+            )
+
+            def shard_fits_devdata(keys, net, dev_x, dev_y, idx, mask,
+                                   hook_key):
+                x, y = _gather_rows(dev_x, dev_y, idx, mask)
+                return shard_fits(keys, net, x, y, mask, hook_key)
+
+            smapped_fits_dd = jax.shard_map(
+                shard_fits_devdata,
+                in_specs=(P(axis), P(), P(), P(), P(axis), P(axis), P()),
+                out_specs=(P(axis), P(axis)),
+                **self._smap_kw,
+            )
+
+            @partial(jax.jit, donate_argnums=donate_args)
+            def robust_round_fn(rng, net, server_opt_state, batch, round_idx,
+                                ids):
+                keys = client_keys(round_idx, ids)
+                rng, kh, kp = jax.random.split(rng, 3)
+                if isinstance(batch, IndexBatch):
+                    nets, metrics = smapped_fits_dd(
+                        keys, net, self._dev_x, self._dev_y,
+                        batch.idx, batch.mask, kh)
+                    nsamp = batch.num_samples
+                else:
+                    nets, metrics = smapped_fits(
+                        keys, net, batch.x, batch.y, batch.mask, kh)
+                    nsamp = batch.num_samples
+                return self._aggregate_and_update(
+                    net, server_opt_state, nets, metrics, nsamp, kp)
+
+            return robust_round_fn
 
         @partial(jax.jit, donate_argnums=donate_args)
         def round_fn(rng, net, server_opt_state, batch, round_idx, ids):
@@ -618,7 +745,8 @@ class FedAvgAPI:
                     keys = client_keys(r, ids_r)
                     x, y = _gather_rows(dev_x, dev_y, idx_r, mask_r)
                     nets, metrics, _ = self._round_body(
-                        keys, net, opt, x, y, mask_r, nsamp_r, kh
+                        keys, net, opt, x, y, mask_r, nsamp_r, kh,
+                        round_idx=r,
                     )
                     net, opt, m = self._aggregate_and_update(
                         net, opt, nets, metrics, nsamp_r, kp
@@ -707,6 +835,15 @@ class FedAvgAPI:
         Returns per-round metrics stacked along axis 0."""
         if not self.device_data:
             raise ValueError("run_rounds needs device_data=True")
+        if self.mesh is not None and self._needs_stacked:
+            # the mesh block scans INSIDE shard_map, where a robust
+            # aggregator's full-stack sorts/distances cannot run — degrade
+            # to per-round dispatch (run_round's fits-only mesh path),
+            # returning the same stacked-metrics contract
+            rounds = [self.run_round(r)
+                      for r in range(start_round, start_round + num_rounds)]
+            return {k: jnp.stack([m[k] for m in rounds])
+                    for k in rounds[0]}
         if not hasattr(self, "_block_fn"):
             self._block_fn = self._build_block_fn()
         if self.telemetry is not None:
@@ -754,6 +891,7 @@ class FedAvgAPI:
                 self.rng, self.net, self.server_opt_state, dev_x, dev_y,
                 *[jnp.asarray(b) for b in blocks], jnp.asarray(rounds),
             )
+        ms = self._drain_quarantine_block(ms, start_round, ids_l)
         if self.telemetry is not None:
             # per-round records from the scanned block's stacked metrics
             # (one sync for the whole block); the block's host spans
@@ -767,7 +905,8 @@ class FedAvgAPI:
                 self.telemetry.emit_round(
                     start_round + i, clients=ids_l[i].tolist(),
                     metrics={k: float(v[i]) for k, v in ms_host.items()},
-                    block=True)
+                    block=True,
+                    **self._quarantine_extra(start_round + i))
             if self.telemetry.tracer is not None:
                 self.telemetry.tracer.finish_round()  # see run_round
         return ms
@@ -828,6 +967,36 @@ class FedAvgAPI:
         return {k: v - before.get(k, 0.0) for k, v in cur.items()
                 if v - before.get(k, 0.0) > 0.0}
 
+    # ------------------------------------------------------------- quarantine
+    def _drain_quarantine(self, metrics: dict, round_idx: int, ids):
+        """Pop the round's in-graph ``__quarantine`` reason codes (if the
+        gate/aggregator is armed) into the host-side ledger + metric
+        families. Returns the metrics dict without the codes — they are a
+        [K] int vector, not a floatable round scalar."""
+        if "__quarantine" not in metrics:
+            return metrics
+        metrics = dict(metrics)
+        codes = np.asarray(metrics.pop("__quarantine"))
+        self.quarantine.record_codes(round_idx, codes,
+                                     clients=np.asarray(ids).tolist())
+        return metrics
+
+    def _drain_quarantine_block(self, ms: dict, start_round: int, ids_l):
+        if "__quarantine" not in ms:
+            return ms
+        ms = dict(ms)
+        codes = np.asarray(ms.pop("__quarantine"))  # [R, K]
+        for i in range(codes.shape[0]):
+            self.quarantine.record_codes(start_round + i, codes[i],
+                                         clients=ids_l[i].tolist())
+        return ms
+
+    def _quarantine_extra(self, round_idx: int) -> dict:
+        """The per-round record field telemetry rides the verdicts on —
+        absent entirely on clean rounds to keep records stable."""
+        entries = self.quarantine.for_round(round_idx)
+        return {"quarantine": entries} if entries else {}
+
     # ------------------------------------------------------------------ train
     def run_round(self, round_idx: int):
         if self.telemetry is not None:
@@ -843,6 +1012,7 @@ class FedAvgAPI:
                 rk, self.net, self.server_opt_state, cb,
                 jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
             )
+        metrics = self._drain_quarantine(metrics, round_idx, ids)
         if self.telemetry is not None:
             # floating the metrics syncs on the round's outputs — a cost the
             # caller opted into by passing telemetry; the off path returns
@@ -850,7 +1020,8 @@ class FedAvgAPI:
             self.telemetry.emit_round(
                 round_idx, clients=np.asarray(ids).tolist(),
                 spans=self._span_delta(spans_before),
-                metrics={k: float(v) for k, v in metrics.items()})
+                metrics={k: float(v) for k, v in metrics.items()},
+                **self._quarantine_extra(round_idx))
             if self.telemetry.tracer is not None:
                 # close the trace envelope HERE: left open it would absorb
                 # inter-round idle (timing loops, the post-run gap to

@@ -9,6 +9,14 @@ and evaluates backdoor targeted-task accuracy (:14-80).
 TPU form: clipping is the engine's client_result_hook (runs vmapped on
 device, per client, before the psum); noise is the post_aggregate_hook.
 Backdoor evaluation = eval_fn on a poisoned test set with target labels.
+
+Byzantine-robust aggregation (core/robust_agg.py) composes through the
+inherited ``aggregator=``/``sanitize=``/``adversary_plan=`` kwargs:
+``FedAvgRobustAPI(..., defense_type='norm_diff_clipping',
+aggregator='krum')`` clips every update AND feeds the clipped stack to
+Krum behind the sanitation gate — defenses stack, they don't compete
+(clipping bounds magnitude, the robust estimator survives colluding
+direction; docs/ROBUSTNESS.md §Byzantine-robust aggregation).
 """
 
 from __future__ import annotations

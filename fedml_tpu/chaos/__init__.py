@@ -29,6 +29,13 @@ Usage::
 
 With no plan installed, ``maybe_wrap`` returns the manager unchanged — the
 no-chaos hot path costs nothing.
+
+Model-space adversaries (``chaos/adversary.py``) are the Byzantine-client
+sibling: an :class:`AdversaryPlan` schedules sign_flip/scale/gaussian/
+nan/shift uploads per (round-window, rank) with the same seeded
+determinism, consumed by ``FedAvgAPI(adversary_plan=...)`` (in-graph) and
+the cross-process client manager (on-the-wire) — see
+docs/ROBUSTNESS.md §Byzantine-robust aggregation.
 """
 
 from __future__ import annotations
@@ -38,6 +45,7 @@ import threading
 
 from fedml_tpu.chaos.plan import FaultLedger, FaultPlan, FaultRule
 from fedml_tpu.chaos.inject import ChaosCommManager
+from fedml_tpu.chaos.adversary import AdversaryPlan, AdversaryRule
 
 _active: FaultPlan | None = None
 _lock = threading.Lock()
@@ -77,5 +85,6 @@ def maybe_wrap(manager, rank: int):
 
 __all__ = [
     "FaultPlan", "FaultRule", "FaultLedger", "ChaosCommManager",
+    "AdversaryPlan", "AdversaryRule",
     "install_plan", "active_plan", "installed", "maybe_wrap",
 ]

@@ -197,6 +197,15 @@ class FaultPlan:
         with open(path) as f:
             return cls.from_json(json.load(f))
 
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """The CLI dual form — a JSON file path or inline JSON (the one
+        dispatch rule every --*-plan flag shares)."""
+        import os
+
+        return cls.from_file(spec) if os.path.exists(spec) \
+            else cls.from_json(spec)
+
     def to_json(self) -> str:
         def rule_doc(r: FaultRule) -> dict:
             doc = {"fault": r.fault, "direction": r.direction}

@@ -106,7 +106,18 @@ def _f16_wire(arr: np.ndarray) -> np.ndarray:
 def _q8_wire(arr: np.ndarray) -> tuple[np.ndarray, float]:
     """float32 -> (int8 wire form, scale). Non-finite guard: nan→0 and
     ±inf saturate to the largest FINITE magnitude so one diverged entry
-    can't blow the scale up / NaN the decode."""
+    can't blow the scale up / NaN the decode.
+
+    Policy note: this clamp exists because q8's SCALE computation would
+    otherwise be destroyed by a single non-finite entry — it is a codec
+    necessity, not a sanitization layer. The plain float paths ('none',
+    'f16' pre-clip aside, 'zlib', 'json') deliberately ship the sender's
+    bits verbatim: silently laundering a NaN to 0 at unpack time would
+    hide a diverging or hostile client from every defense. Non-finite
+    uploads are instead REJECTED, counted, and quarantined by the
+    aggregation-side sanitation gate (core/robust_agg.sanitize_updates,
+    unconditional in FedAvgAggregator.aggregate) — a NaN can reach the
+    server, but never ``tree_weighted_mean``, and never unannounced."""
     finite = np.isfinite(arr)
     if not finite.all():
         amax = float(np.max(np.abs(arr[finite]))) if finite.any() else 0.0

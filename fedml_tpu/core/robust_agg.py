@@ -1,0 +1,418 @@
+"""Byzantine-robust aggregation — pure jittable functions over STACKED updates.
+
+The reference's only poisoning defenses are norm-diff clipping and weak-DP
+noise (core/robust.py); neither survives a single Byzantine client that
+uploads NaNs or a scaled sign-flipped update — both aggregation paths
+(``tree_weighted_mean`` in the SPMD engine, ``FedAvgAggregator._wavg`` in
+the cross-process runtime) would average hostility straight into the global
+model. This module supplies the classical robust estimators as drop-in
+replacements for the weighted mean, all over the SAME data layout both
+runtimes already produce: a pytree whose leaves carry one leading client
+axis ``[K, ...]`` plus a ``[K]`` weight vector (sample counts; 0 =
+excluded slot — zero-sample padding and gate-rejected clients alike).
+
+Aggregators (each ``fn(stacked, weights) -> (tree, info)``, jit-safe):
+
+- ``mean``               the exact ``tree_weighted_mean`` baseline;
+- ``median``             coordinate-wise weighted (lower) median —
+                         breakdown point f < n/2;
+- ``trimmed_mean``       coordinate-wise weighted trimmed mean: the outer
+                         ``trim`` fraction of total weight is discarded at
+                         EACH end per coordinate (winsorized-interval
+                         weights, exact for uniform weights and integral
+                         trim counts) — breakdown f/n < trim;
+- ``krum`` / ``multi_krum``  Krum (Blanchard et al., NeurIPS'17): score
+                         each client by the sum of its n-f-2 smallest
+                         pairwise squared distances on the flattened
+                         update; pick the minimizer (krum) or average the
+                         ``m`` best by sample weight (multi_krum).
+                         Requires n >= 2f+3;
+- ``geometric_median``   fixed-iteration (jit-static) Weiszfeld loop on
+                         the flattened updates — the smoothed L1 point
+                         estimate, breakdown f < n/2.
+
+The **sanitation gate** (``sanitize_updates``) runs BEFORE any aggregator:
+it rejects non-finite updates and norm outliers (update norm beyond
+``norm_mult`` x the UNWEIGHTED median norm of the finite participants —
+one vote per client, because sample counts are client-reported and a
+weighted baseline would let an attacker claiming the weight majority
+become its own reference), replaces a rejected client's
+update with the global model (a neutral value — a zero WEIGHT alone would
+still poison sorts/distances with NaNs), and zeroes its weight. Because
+every aggregator normalizes by the SURVIVING weight mass (the same
+reweighting elastic partial aggregation relies on), the result stays the
+exact estimator over the survivors — no post-hoc correction needed
+(test-enforced against a numpy oracle).
+
+Attribution comes out as per-slot int32 reason codes (``REASONS``), which
+the engines turn into a :class:`QuarantineLedger` — the replayable
+artifact both runtimes must agree on (the chaos ledger's model-space
+sibling).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.utils.tree import tree_weighted_mean
+
+# per-slot quarantine reason codes (int32 in-graph; names in ledgers)
+REASONS = ("ok", "nonfinite", "norm_outlier", "suspected")
+REASON_OK, REASON_NONFINITE, REASON_NORM_OUTLIER, REASON_SUSPECTED = range(4)
+
+# sanitation default: reject ||update|| > 4x the weighted-median norm.
+# Benign client norms on non-IID data spread ~2-3x; the classic scaled
+# attacks (sign_flip/scale with factor >= 5) land well past 4x.
+DEFAULT_NORM_MULT = 4.0
+
+AGGREGATORS = ("mean", "median", "trimmed_mean", "krum", "multi_krum",
+               "geometric_median")
+
+
+def _wshape(w, leaf):
+    """[K] weights broadcast-shaped against a [K, ...] leaf."""
+    return w.reshape((w.shape[0],) + (1,) * (leaf.ndim - 1))
+
+
+def _sorted_with_weights(x, w):
+    """Per-coordinate ascending sort of a [K, ...] leaf with the [K]
+    weights carried along each coordinate's order."""
+    order = jnp.argsort(x, axis=0)
+    xs = jnp.take_along_axis(x, order, axis=0)
+    wb = jnp.broadcast_to(_wshape(w, x), x.shape)
+    ws = jnp.take_along_axis(wb, order, axis=0)
+    return xs, ws
+
+
+def weighted_median(stacked, weights):
+    """Coordinate-wise weighted (lower) median over the leading client
+    axis: the smallest value whose cumulative weight reaches half the
+    total. Zero-weight slots contribute nothing; with uniform weights and
+    an odd survivor count this is the exact coordinate-wise median."""
+    w = jnp.asarray(weights, jnp.float32)
+
+    def med(x):
+        xs, ws = _sorted_with_weights(x, w)
+        cum = jnp.cumsum(ws, axis=0)
+        half = jnp.maximum(cum[-1:], 1e-12) * 0.5
+        idx = jnp.argmax(cum >= half, axis=0)
+        return jnp.take_along_axis(xs, idx[None], axis=0)[0]
+
+    return jax.tree.map(med, stacked)
+
+
+def weighted_trimmed_mean(stacked, weights, trim: float = 0.2):
+    """Coordinate-wise weighted trimmed mean: each coordinate's sorted
+    weight intervals are clipped to the central ``[trim*W, (1-trim)*W]``
+    band of total weight ``W`` and averaged with the clipped widths. For
+    uniform weights and integral trim counts this IS the classical trimmed
+    mean; zero-weight slots have zero interval width and vanish."""
+    if not 0.0 <= trim < 0.5:
+        raise ValueError(f"trim must be in [0, 0.5), got {trim}")
+    w = jnp.asarray(weights, jnp.float32)
+
+    def tmean(x):
+        xs, ws = _sorted_with_weights(x, w)
+        cum = jnp.cumsum(ws, axis=0)
+        total = cum[-1:]
+        lo, hi = trim * total, (1.0 - trim) * total
+        eff = jnp.clip(jnp.minimum(cum, hi) - jnp.maximum(cum - ws, lo),
+                       0.0, None)
+        return (jnp.sum(xs * eff, axis=0)
+                / jnp.maximum(jnp.sum(eff, axis=0), 1e-12))
+
+    return jax.tree.map(tmean, stacked)
+
+
+def _flatten_clients(stacked):
+    """[K, D] matrix of per-client flattened updates (every leaf raveled
+    past the client axis and concatenated — float32 so distances in one
+    dtype regardless of mixed leaves)."""
+    leaves = jax.tree.leaves(stacked)
+    return jnp.concatenate(
+        [leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
+         for leaf in leaves], axis=1)
+
+
+def krum_scores(stacked, weights, f: int):
+    """Krum scores: for each valid client, the sum of its ``n - f - 2``
+    smallest squared distances to OTHER valid clients (n = number of
+    positive-weight slots, a traced scalar). Invalid slots (weight 0)
+    score +inf and are never anyone's neighbor."""
+    v = _flatten_clients(stacked)
+    k = v.shape[0]
+    valid = jnp.asarray(weights, jnp.float32) > 0
+    sq = jnp.sum(v * v, axis=1)
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (v @ v.T)
+    d2 = jnp.maximum(d2, 0.0)  # clamp fp cancellation below zero
+    inf = jnp.full_like(d2, jnp.inf)
+    d2 = jnp.where(jnp.eye(k, dtype=bool) | ~valid[None, :], inf, d2)
+    n = jnp.sum(valid.astype(jnp.int32))
+    n_neighbors = jnp.maximum(n - f - 2, 1)
+    ds = jnp.sort(d2, axis=1)
+    take = jnp.arange(k)[None, :] < n_neighbors
+    score = jnp.sum(jnp.where(take, ds, 0.0), axis=1)
+    return jnp.where(valid, score, jnp.inf)
+
+
+def krum(stacked, weights, f: int, m: int = 1):
+    """(Multi-)Krum: ``m=1`` returns the single client minimizing the Krum
+    score; ``m>1`` sample-weight-averages the ``m`` best-scoring clients.
+    ``info['suspected']`` flags the ``f`` WORST-scoring valid clients —
+    the aggregator-level attribution the quarantine ledger records.
+
+    ``f`` and ``m`` are static (they shape the program); the number of
+    valid clients is traced, so gate rejections need no recompile."""
+    score = krum_scores(stacked, weights, f)
+    k = score.shape[0]
+    valid = jnp.isfinite(score)
+    if m <= 1:
+        win = jnp.argmin(score)
+        agg = jax.tree.map(lambda x: jnp.take(x, win, axis=0), stacked)
+    else:
+        _, sel = jax.lax.top_k(-score, min(m, k))
+        w = jnp.asarray(weights, jnp.float32)
+        sel_w = jnp.where(jnp.isfinite(score[sel]), w[sel], 0.0)
+        sel_tree = jax.tree.map(lambda x: jnp.take(x, sel, axis=0), stacked)
+        agg = tree_weighted_mean(sel_tree, sel_w)
+    # suspected = the f highest finite scores (ties broken by slot order);
+    # with no f budget nothing is suspected. Invalid slots sort LAST in
+    # the from-worst order (+inf) so a gate-rejected slot is never
+    # re-reported as krum-suspected.
+    if f > 0:
+        rank_from_worst = jnp.argsort(jnp.argsort(
+            jnp.where(valid, -score, jnp.inf)))
+        suspected = valid & (rank_from_worst < jnp.minimum(
+            f, jnp.sum(valid.astype(jnp.int32))))
+    else:
+        suspected = jnp.zeros((k,), bool)
+    return agg, {"suspected": suspected}
+
+
+def geometric_median(stacked, weights, iters: int = 8, eps: float = 1e-8):
+    """Weighted geometric median by a fixed-iteration Weiszfeld loop
+    (jit-static ``iters``), initialized at the weighted mean. Zero-weight
+    slots drop out of every reweighting."""
+    v = _flatten_clients(stacked)
+    w = jnp.asarray(weights, jnp.float32)
+    z0 = (w @ v) / jnp.maximum(jnp.sum(w), 1e-12)
+
+    def step(_, z):
+        d = jnp.sqrt(jnp.sum((v - z[None, :]) ** 2, axis=1))
+        beta = w / jnp.maximum(d, eps)
+        return (beta @ v) / jnp.maximum(jnp.sum(beta), 1e-12)
+
+    z = jax.lax.fori_loop(0, iters, step, z0)
+    # unflatten back into the stacked tree's per-client leaf structure
+    leaves = jax.tree.leaves(stacked)
+    treedef = jax.tree.structure(stacked)
+    out, off = [], 0
+    for leaf in leaves:
+        n = int(leaf.size // leaf.shape[0])
+        out.append(z[off:off + n].reshape(leaf.shape[1:]).astype(leaf.dtype))
+        off += n
+    return jax.tree.unflatten(treedef, out)
+
+
+def make_robust_aggregator(name: str, n: int, f: int | None = None,
+                           trim: float | None = None, m: int | None = None,
+                           iters: int = 8):
+    """Build ``fn(stacked, weights) -> (tree, info)`` for aggregator
+    ``name`` over ``n`` client slots. ``f`` is the Byzantine budget
+    (default ``(n-3)//2``, Krum's maximum); ``trim`` the per-end trim
+    fraction (default ``max(f/n, 0.1)``); ``m`` multi-Krum's selection
+    count (default ``n - f - 2``)."""
+    if name not in AGGREGATORS:
+        raise ValueError(f"unknown aggregator {name!r} (one of {AGGREGATORS})")
+    if f is None:
+        f = max((n - 3) // 2, 0)
+    if not 0 <= f < n:
+        raise ValueError(f"f={f} must be in [0, {n})")
+    if name == "mean":
+        return lambda s, w: (tree_weighted_mean(s, w), {})
+    if name == "median":
+        return lambda s, w: (weighted_median(s, w), {})
+    if name == "trimmed_mean":
+        t = max(f / n, 0.1) if trim is None else trim
+        return lambda s, w: (weighted_trimmed_mean(s, w, trim=t), {})
+    if name in ("krum", "multi_krum"):
+        if n < 2 * f + 3:
+            raise ValueError(f"krum needs n >= 2f+3 (n={n}, f={f})")
+        mm = 1 if name == "krum" else (max(n - f - 2, 1) if m is None
+                                       else int(m))
+        return partial(krum, f=f, m=mm)
+    return lambda s, w: (geometric_median(s, w, iters=iters), {})
+
+
+# ------------------------------------------------------------------ gate
+def sanitize_updates(stacked, global_tree, weights,
+                     norm_mult: float = DEFAULT_NORM_MULT):
+    """The sanitation gate, in-graph: per slot decide ok / nonfinite /
+    norm-outlier, then neutralize rejects.
+
+    Returns ``(clean_stacked, new_weights, reasons)`` where ``reasons`` is
+    an int32 ``[K]`` of ``REASONS`` codes. A rejected slot's update is
+    REPLACED by the broadcast global model and its weight zeroed — both
+    matter: weights alone leave NaNs free to poison sorts, distances, and
+    ``0 * nan`` products; values alone leave the reject counted in the
+    weight mass. Survivor weights are untouched, so any downstream
+    aggregator's internal normalization IS the elastic partial-aggregation
+    reweighting — exact over the survivors.
+
+    Non-finite is checked over every leaf (the wire's float path performs
+    no clamping by design — comm/message.py ships f32 bits verbatim, so
+    this gate is where a NaN upload must die). The norm rule compares each
+    slot's update norm ``||u_k - g||`` (over the full tree) to the
+    UNWEIGHTED median norm of the finite participating slots: reject
+    beyond ``norm_mult * median``. Unweighted on purpose: sample counts
+    are client-REPORTED (a Byzantine client can claim any weight), so a
+    weighted baseline would let an attacker holding — or fabricating —
+    more than half the weight mass become its own reference norm. The
+    gate's breakdown is therefore by client COUNT (f < n/2), the standard
+    Byzantine model; the aggregators behind it stay sample-weighted.
+    ``norm_mult=inf`` disables the norm rule but keeps the non-finite one.
+    """
+    w = jnp.asarray(weights, jnp.float32)
+    k = w.shape[0]
+
+    finite = jnp.ones((k,), bool)
+    norm_sq = jnp.zeros((k,), jnp.float32)
+    for s, g in zip(jax.tree.leaves(stacked), jax.tree.leaves(global_tree)):
+        axes = tuple(range(1, s.ndim))
+        finite &= jnp.all(jnp.isfinite(s), axis=axes)
+        d = (s.astype(jnp.float32)
+             - g.astype(jnp.float32)[None])
+        # non-finite entries would NaN the norm; they are already
+        # rejected by the finite flag, so mask them out of the sum
+        norm_sq += jnp.sum(jnp.where(jnp.isfinite(d), d, 0.0) ** 2,
+                           axis=axes)
+    norm = jnp.sqrt(norm_sq)
+
+    # unweighted median of the finite, participating slots' norms (one
+    # vote per client — see the docstring's breakdown note)
+    med_w = (finite & (w > 0)).astype(jnp.float32)
+    med = weighted_median(norm, med_w)
+    outlier = finite & (w > 0) & (norm > norm_mult * jnp.maximum(med, 1e-12))
+
+    # value replacement covers EVERY non-finite/outlier slot (even
+    # zero-weight padding — a stray NaN there would still poison sorts and
+    # pairwise distances); the REPORTED reasons cover only participating
+    # (w > 0) slots, so padding never shows up in the ledger.
+    replace = ~finite | outlier
+    reasons = jnp.where(~finite, REASON_NONFINITE,
+                        jnp.where(outlier, REASON_NORM_OUTLIER, REASON_OK))
+    reasons = jnp.where(w > 0, reasons, REASON_OK).astype(jnp.int32)
+    new_w = jnp.where(replace, 0.0, w)
+    clean = jax.tree.map(
+        lambda s, g: jnp.where(_wshape(replace, s),
+                               jnp.broadcast_to(g[None], s.shape)
+                               .astype(s.dtype), s),
+        stacked, global_tree)
+    return clean, new_w, reasons
+
+
+def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
+                    norm_mult: float | None = None):
+    """The full verdict composition, jittable, defined ONCE for both
+    runtimes (their quarantine ledgers must agree entry-for-entry, so the
+    composition rule must not exist in two dialects):
+
+    gate (``norm_mult`` armed; None = off) -> estimator (``robust_fn`` or
+    the weighted mean) -> merge the estimator's ``suspected`` verdicts
+    into the gate's reason codes (gate reasons win) -> if EVERY slot was
+    rejected, fall back to the global model instead of averaging an empty
+    survivor set.
+
+    Returns ``(avg_tree, surviving_weights, reasons)``; ``reasons`` is
+    None only when the gate is off AND the estimator reported nothing.
+    """
+    w = jnp.asarray(weights, jnp.float32)
+    reasons = None
+    agg_in = stacked
+    if norm_mult is not None:
+        agg_in, w, reasons = sanitize_updates(stacked, global_tree, w,
+                                              norm_mult=norm_mult)
+    if robust_fn is not None:
+        avg, info = robust_fn(agg_in, w)
+        sus = info.get("suspected")
+        if sus is not None:
+            base = (reasons if reasons is not None
+                    else jnp.zeros(sus.shape, jnp.int32))
+            reasons = jnp.where((base == REASON_OK) & sus,
+                                REASON_SUSPECTED, base)
+    else:
+        avg = tree_weighted_mean(agg_in, w)
+    if reasons is not None:
+        alive = jnp.sum(w) > 0
+        avg = jax.tree.map(lambda a, g: jnp.where(alive, a, g), avg,
+                           global_tree)
+    return avg, w, reasons
+
+
+# ---------------------------------------------------------------- ledger
+class QuarantineLedger:
+    """Thread-safe record of per-round gate/aggregator verdicts — the
+    model-space sibling of the chaos FaultLedger, and the artifact the
+    standalone and cross-process runtimes must AGREE on for the same
+    adversary plan (test-enforced). ``rank`` is the 1-based worker rank,
+    which in the standalone engine is the stacked slot index + 1 (the same
+    client the loopback runtime's rank trains)."""
+
+    def __init__(self):
+        self._entries: list[dict] = []
+        self._lock = threading.Lock()
+
+    def record(self, round_idx: int, rank: int, reason: str,
+               client=None) -> None:
+        if reason not in REASONS or reason == "ok":
+            raise ValueError(f"unrecordable quarantine reason {reason!r}")
+        with self._lock:
+            self._entries.append({
+                "round": int(round_idx), "rank": int(rank),
+                "reason": reason,
+                "client": None if client is None else int(client),
+            })
+
+    def record_codes(self, round_idx: int, reasons, clients=None,
+                     ranks=None) -> None:
+        """Fold a round's in-graph ``[K]`` reason-code vector into ledger
+        entries; also feeds the metric families. Slot ``i`` maps to worker
+        rank ``i + 1`` unless ``ranks`` gives the explicit slot->rank map
+        (elastic partial rounds aggregate a rank subset)."""
+        from fedml_tpu.obs import comm_instrument as _obs
+
+        for slot, code in enumerate(reasons):
+            code = int(code)
+            if code == REASON_OK:
+                continue
+            reason = REASONS[code]
+            client = None if clients is None else clients[slot]
+            rank = (slot + 1) if ranks is None else int(ranks[slot])
+            self.record(round_idx, rank, reason, client=client)
+            _obs.record_update_rejected(reason)
+            _obs.record_suspected_rank(rank)
+
+    def canonical(self) -> list[tuple]:
+        with self._lock:
+            return sorted((e["round"], e["rank"], e["reason"], e["client"])
+                          for e in self._entries)
+
+    def for_round(self, round_idx: int) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._entries
+                    if e["round"] == round_idx]
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        with self._lock:
+            for e in self._entries:
+                out[e["reason"]] = out.get(e["reason"], 0) + 1
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

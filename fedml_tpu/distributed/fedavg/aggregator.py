@@ -7,12 +7,31 @@ seeded by round), test_on_server_for_all_clients (:109-163).
 
 The average itself is one jitted pytree op on stacked leaves rather than a
 python loop over state_dict keys.
+
+Hardening beyond the reference (docs/ROBUSTNESS.md §Byzantine-robust
+aggregation):
+
+- **upload slotting is stamped**: ``add_local_trained_result`` rejects
+  out-of-round and unknown-rank uploads (``comm_stale_uploads_total``)
+  instead of silently overwriting whatever index arrives;
+- **sanitation gate, always on for non-finite**: the binary wire ships
+  float32 bits verbatim (comm/message.py clamps only inside the lossy
+  f16/q8 re-encoders), so ``aggregate`` is the last stop before a NaN
+  upload hits ``tree_weighted_mean`` — any non-finite update is dropped,
+  counted, and quarantined unconditionally; the norm-outlier rule arms
+  with ``sanitize=``;
+- **pluggable robust aggregation**: ``aggregator=`` swaps the weighted
+  mean for a core/robust_agg estimator (median / trimmed_mean / krum /
+  multi_krum / geometric_median) over the same stacked-leaf layout,
+  sharing the exact jitted code the standalone engine runs so the two
+  runtimes' quarantine ledgers agree entry-for-entry.
 """
 
 from __future__ import annotations
 
 import logging
 import time
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -22,14 +41,23 @@ from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.comm.message import pack_pytree, unpack_pytree
 from fedml_tpu.core.client_data import FederatedData, batch_global
 from fedml_tpu.core.local import Task, make_eval_fn
+from fedml_tpu.core.robust_agg import (
+    DEFAULT_NORM_MULT,
+    QuarantineLedger,
+    gated_aggregate,
+    make_robust_aggregator,
+)
 from fedml_tpu.core.sampling import sample_clients
-from fedml_tpu.utils.tree import tree_weighted_mean
+from fedml_tpu.obs import comm_instrument as _obs
 
 log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
 
 class FedAvgAggregator:
-    def __init__(self, dataset: FederatedData, task: Task, cfg: FedAvgConfig, worker_num: int):
+    def __init__(self, dataset: FederatedData, task: Task, cfg: FedAvgConfig,
+                 worker_num: int, aggregator: str | None = None,
+                 aggregator_params: dict | None = None,
+                 sanitize: bool | float | None = None):
         if cfg.sampling != "uniform":
             # this runtime's client_sampling + weighted aggregate implement
             # the uniform scheme only — refuse rather than silently ignore
@@ -41,6 +69,10 @@ class FedAvgAggregator:
         self.model_dict: dict[int, list] = {}
         self.sample_num_dict: dict[int, int] = {}
         self.flag_client_model_uploaded = {i: False for i in range(worker_num)}
+        # the round uploads are currently being accepted FOR — stamped by
+        # the server manager at broadcast (begin_round); uploads tagged
+        # with any other round are rejected, never slotted
+        self.current_round = 0
 
         # same init-key derivation as FedAvgAPI/DistributedTrainer so every
         # party (and the standalone oracle) starts from identical weights
@@ -49,15 +81,66 @@ class FedAvgAggregator:
         self.eval_fn = make_eval_fn(task)
         self._test_cache = None
         self.history: list[dict] = []
-        # same formula (and code) as the SPMD engine's aggregation so the
-        # two runtimes cannot drift numerically
-        self._wavg = jax.jit(tree_weighted_mean)
+        # robust aggregation + sanitation gate: the SAME core/robust_agg
+        # functions (and default weighted-mean formula) the SPMD engine
+        # jits, applied to the stacked wire leaves (= jax.tree.leaves of
+        # the engine's stacked NetState, so sorts/distances see identical
+        # values in identical order and the runtimes cannot drift)
+        robust = None
+        if aggregator is not None:
+            robust = make_robust_aggregator(
+                aggregator, n=worker_num, **(aggregator_params or {}))
+        if sanitize is None:
+            sanitize = aggregator is not None
+        self._sanitize_mult = (
+            None if sanitize is False
+            else DEFAULT_NORM_MULT if sanitize is True else float(sanitize))
+        # gate -> estimator -> suspected merge -> all-rejected fallback:
+        # the ONE jittable composition both runtimes share
+        # (core/robust_agg.gated_aggregate). The gate runs every
+        # aggregate: norm_mult=inf disarms the outlier rule but the
+        # non-finite rejection is unconditional (see module docstring —
+        # the float wire path performs no clamping).
+        mult = (self._sanitize_mult if self._sanitize_mult is not None
+                else float("inf"))
+        self._gagg = jax.jit(partial(gated_aggregate, robust_fn=robust,
+                                     norm_mult=mult))
+        self.quarantine = QuarantineLedger()
 
     def get_global_model_params(self):
         return pack_pytree(self.net)
 
     # ------------------------------------------------------------- receive
-    def add_local_trained_result(self, index: int, wire_leaves, sample_num: int) -> None:
+    def begin_round(self, round_idx: int) -> None:
+        """Stamp the round uploads are now accepted for (called by the
+        server manager right before each broadcast)."""
+        self.current_round = int(round_idx)
+
+    def add_local_trained_result(self, index: int, wire_leaves,
+                                 sample_num: int,
+                                 round_idx: int | None = None) -> None:
+        """Slot one client upload. Rejects (counted in
+        ``comm_stale_uploads_total{reason}``, never slotted):
+
+        - ``unknown_rank`` — ``index`` outside the worker table (a stray
+          or forged sender id must not grow the dict unboundedly);
+        - ``stale`` — ``round_idx`` given and != the stamped current
+          round (a straggler's superseded upload must not overwrite a
+          fresh one after elastic partial aggregation moved on).
+
+        ``round_idx=None`` (legacy caller) skips the round check only.
+        """
+        if index not in self.flag_client_model_uploaded:
+            _obs.record_stale_upload("unknown_rank")
+            log.warning("reject upload for unknown worker index %s "
+                        "(workers 0..%d)", index, self.worker_num - 1)
+            return
+        if round_idx is not None and int(round_idx) != self.current_round:
+            _obs.record_stale_upload("stale")
+            log.warning("reject out-of-round upload from index %s "
+                        "(tagged round %s, current %d)",
+                        index, round_idx, self.current_round)
+            return
         self.model_dict[index] = wire_leaves
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded[index] = True
@@ -78,7 +161,26 @@ class FedAvgAggregator:
             for i in range(len(self.model_dict[ranks[0]]))
         ]
         weights = jnp.asarray([self.sample_num_dict[r] for r in ranks], jnp.float32)
-        avg_leaves = self._wavg(stacked, weights)
+
+        # the shared composition: gate (non-finite unconditionally; norm
+        # outliers when armed) -> estimator -> suspected merge -> keep the
+        # global model when every upload was quarantined
+        global_leaves = [jnp.asarray(v) for v in pack_pytree(self.net)]
+        avg_leaves, new_w, reasons = self._gagg(stacked, global_leaves,
+                                                weights)
+        reasons = np.asarray(reasons)
+        if reasons.any():
+            # slot i holds worker index ranks[i] -> 1-based rank + the
+            # client id that rank trained this round
+            ids = self.client_sampling(self.current_round)
+            self.quarantine.record_codes(
+                self.current_round, reasons,
+                clients=[int(ids[r]) for r in ranks],
+                ranks=[r + 1 for r in ranks])
+            if float(jnp.sum(new_w)) == 0.0:
+                log.warning("round %d: all %d uploads quarantined — "
+                            "keeping the current global model",
+                            self.current_round, len(ranks))
         self.net = unpack_pytree(self.net, avg_leaves)
         self.model_dict.clear()
         self.sample_num_dict.clear()

@@ -65,6 +65,10 @@ def run_simulated(
     telemetry=None,
     chaos_plan=None,
     round_timeout_s: float | None = None,
+    aggregator: str | None = None,
+    aggregator_params: dict | None = None,
+    sanitize: bool | float | None = None,
+    adversary_plan=None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -72,7 +76,13 @@ def run_simulated(
     duration of the run — every rank's comm manager is wrapped in the
     deterministic fault injector (drops/dups/corruption/partitions per the
     plan's seeded schedule). Pair with ``round_timeout_s`` so dropped
-    uplinks degrade to elastic partial aggregation instead of a hang."""
+    uplinks degrade to elastic partial aggregation instead of a hang.
+
+    ``adversary_plan``: a ``fedml_tpu.chaos.AdversaryPlan`` — the listed
+    worker ranks upload model-space attacks (sign_flip/scale/gaussian/
+    nan/shift) on their scheduled rounds; pair with ``aggregator=``
+    ('median', 'krum', ...) and the ``sanitize`` gate to run a replayable
+    attack-vs-defense experiment (docs/ROBUSTNESS.md)."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -80,18 +90,22 @@ def run_simulated(
     if chaos_plan is not None:  # None must not clobber an installed plan
         _chaos.install_plan(chaos_plan)
     try:
-        aggregator = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1)
-        server = FedAvgServerManager(aggregator, rank=0, size=size,
+        aggregator_ = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1,
+                                       aggregator=aggregator,
+                                       aggregator_params=aggregator_params,
+                                       sanitize=sanitize)
+        server = FedAvgServerManager(aggregator_, rank=0, size=size,
                                      backend=backend, ckpt_dir=ckpt_dir,
                                      round_timeout_s=round_timeout_s,
                                      telemetry=telemetry, **kw)
         clients = [
             init_client(dataset, task, cfg, rank, size, backend,
-                        sparsify_ratio=sparsify_ratio, **kw)
+                        sparsify_ratio=sparsify_ratio,
+                        adversary_plan=adversary_plan, **kw)
             for rank in range(1, size)
         ]
         launch_simulated(server, clients)
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)
-    return aggregator
+    return aggregator_

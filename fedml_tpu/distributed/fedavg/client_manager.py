@@ -26,9 +26,16 @@ from fedml_tpu.obs.tracing import TRACE_KEY, ClientSpanBuffer
 class FedAvgClientManager(ClientManager):
     def __init__(self, trainer: DistributedTrainer, rank, size,
                  backend="LOOPBACK", sparsify_ratio: float | None = None,
-                 **kw):
+                 adversary_plan=None, **kw):
         self.trainer = trainer
         self.round_idx = 0
+        # model-space adversary (chaos/adversary.py): when this rank is in
+        # the plan's schedule, its upload is perturbed AFTER the honest
+        # local fit and BEFORE packing/sparsification — the Byzantine
+        # client lies on the wire, so every server-side defense (clip,
+        # sanitation gate, robust aggregator) sees exactly what a real
+        # attacker would send
+        self.adversary_plan = adversary_plan
         # top-k sparsified uplinks with per-rank error feedback
         # (comm/sparse.py); None = dense protocol. Validate HERE so a bad
         # ratio fails at launch, not inside the receive-loop handler after
@@ -79,6 +86,12 @@ class FedAvgClientManager(ClientManager):
             self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         with span("local_fit"):
             wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
+        if self.adversary_plan is not None:
+            from fedml_tpu.chaos.adversary import perturb_leaves
+
+            wire_leaves = perturb_leaves(
+                self.adversary_plan, wire_leaves, global_leaves,
+                self.rank, self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         with span("pack"):
             if self.sparsify_ratio:

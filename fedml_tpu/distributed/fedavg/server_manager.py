@@ -232,6 +232,10 @@ class FedAvgServerManager(ServerManager):
         round's trace and rides its context on each frame when tracing."""
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         self._round_ids = [int(c) for c in client_indexes]
+        # stamp the aggregator's accepted round BEFORE any client can
+        # answer the broadcast — uploads tagged with any other round are
+        # rejected at the slotting layer (add_local_trained_result)
+        self.aggregator.begin_round(self.round_idx)
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
         # codec their deltas are relative to the decoded broadcast
         self._bcast_leaves = codec_roundtrip(global_params)
@@ -264,6 +268,7 @@ class FedAvgServerManager(ServerManager):
             sender = msg_params[Message.MSG_ARG_KEY_SENDER]
             msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             if int(msg_round) != self.round_idx:
+                _obs.record_stale_upload("stale")
                 log.warning("drop stale upload from rank %d (round %s, now %d)",
                             sender, msg_round, self.round_idx)
                 return
@@ -290,6 +295,7 @@ class FedAvgServerManager(ServerManager):
                 sender - 1,
                 wire_leaves,
                 msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
+                round_idx=int(msg_round),
             )
             if not self.aggregator.check_whether_all_receive():
                 return
@@ -318,6 +324,8 @@ class FedAvgServerManager(ServerManager):
             # cross-reference) into the round record
             cp = (self._dtracer.finish_round()
                   if self._dtracer is not None else None)
+            q = self.aggregator.quarantine.for_round(self.round_idx) \
+                if hasattr(self.aggregator, "quarantine") else []
             tel.emit_round(
                 self.round_idx, clients=self._round_ids,
                 spans=dict(self._tracer.rounds[-1]),
@@ -325,7 +333,8 @@ class FedAvgServerManager(ServerManager):
                          "num_samples": n_samples},
                 evals=(hist[-1] if hist
                        and hist[-1].get("round") == self.round_idx else None),
-                **({"critical_path": cp} if cp else {}))
+                **({"critical_path": cp} if cp else {}),
+                **({"quarantine": q} if q else {}))
             self._tracer.next_round()
         else:
             global_params = self.aggregator.aggregate()

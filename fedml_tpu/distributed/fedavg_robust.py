@@ -36,8 +36,11 @@ class FedAvgRobustAggregator(FedAvgAggregator):
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
                  defense_type: str = "norm_diff_clipping",  # |'weak_dp'|'dp'|'none'
                  norm_bound: float = 30.0, stddev: float = 0.025,
-                 noise_multiplier: float = 1.0):
-        super().__init__(dataset, task, cfg, worker_num)
+                 noise_multiplier: float = 1.0, **agg_kw):
+        # agg_kw: the base aggregator's robust-aggregation surface
+        # (aggregator= / sanitize=) — clipping runs first, then the gate +
+        # robust estimator see the clipped stack (defenses compose)
+        super().__init__(dataset, task, cfg, worker_num, **agg_kw)
         if defense_type not in ("norm_diff_clipping", "weak_dp", "dp", "none"):
             # an unknown value silently running defenseless would be worse
             # than refusing

@@ -27,8 +27,11 @@ from fedml_tpu.utils.tree import tree_sub
 class FedOptAggregator(FedAvgAggregator):
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
                  server_optimizer: str = "sgd", server_lr: float = 1.0,
-                 server_momentum: float = 0.9):
-        super().__init__(dataset, task, cfg, worker_num)
+                 server_momentum: float = 0.9, **agg_kw):
+        # agg_kw: the base aggregator's robust-aggregation surface
+        # (aggregator= / sanitize=) — the server step composes on top of
+        # whatever estimator produced the "average"
+        super().__init__(dataset, task, cfg, worker_num, **agg_kw)
         tx = make_server_optimizer(server_optimizer, server_lr, server_momentum)
         self._server_opt_state = tx.init(self.net.params)
 

@@ -76,6 +76,32 @@ def add_args(p: argparse.ArgumentParser):
                         "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="server round checkpoints; restart resumes the job")
+    p.add_argument("--aggregator", type=str, default=None,
+                   choices=["mean", "median", "trimmed_mean", "krum",
+                            "multi_krum", "geometric_median"],
+                   help="rank 0: Byzantine-robust aggregation strategy "
+                        "(core/robust_agg.py) replacing the weighted mean, "
+                        "fronted by the sanitation gate (non-finite + "
+                        "norm-outlier rejection with survivor reweighting; "
+                        "rejections land in the quarantine ledger / "
+                        "fed_updates_rejected_total). Applies to --algo "
+                        "fedavg, fedprox, and fedavg_robust "
+                        "(docs/ROBUSTNESS.md §Byzantine-robust aggregation)")
+    p.add_argument("--byzantine_f", type=int, default=None,
+                   help="Byzantine budget f for krum/multi_krum/"
+                        "trimmed_mean (default (n-3)//2; krum needs "
+                        "n >= 2f+3)")
+    p.add_argument("--adversary-plan", "--adversary_plan",
+                   dest="adversary_plan", type=str, default=None,
+                   help="model-space adversary schedule "
+                        "(fedml_tpu/chaos/adversary.py): a JSON file path "
+                        "or inline JSON {seed, rules:[{attack, ranks, "
+                        "rounds, ...}]} — the listed worker ranks upload "
+                        "sign_flip/scale/gaussian/nan/shift attacks on "
+                        "their scheduled rounds. Pass the SAME plan to "
+                        "every rank (each client applies only its own "
+                        "rules); pair with --aggregator on rank 0 for a "
+                        "replayable attack-vs-defense experiment")
     p.add_argument("--chaos-plan", "--chaos_plan", dest="chaos_plan",
                    type=str, default=None,
                    help="seeded fault-injection plan (fedml_tpu/chaos): a "
@@ -147,6 +173,14 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 
     backend = args.backend.upper()
+    # robust aggregation (--aggregator): kwargs shared by every aggregator
+    # that inherits the FedAvgAggregator gate (turboaggregate excluded —
+    # a Shamir share is a masked tensor, not an update to sort or gate)
+    agg_kw: dict = {}
+    if getattr(args, "aggregator", None):
+        agg_kw["aggregator"] = args.aggregator
+        if getattr(args, "byzantine_f", None) is not None:
+            agg_kw["aggregator_params"] = {"f": args.byzantine_f}
     if args.rank == 0:
         if args.algo == "fedopt":
             from fedml_tpu.distributed.fedopt import FedOptAggregator
@@ -154,20 +188,22 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
             agg = FedOptAggregator(
                 data, task, cfg, worker_num=args.world_size - 1,
                 server_optimizer=args.server_optimizer, server_lr=args.server_lr,
-                server_momentum=args.server_momentum)
+                server_momentum=args.server_momentum, **agg_kw)
         elif args.algo == "fedavg_robust":
             from fedml_tpu.distributed.fedavg_robust import FedAvgRobustAggregator
 
             agg = FedAvgRobustAggregator(
                 data, task, cfg, worker_num=args.world_size - 1,
                 defense_type=args.defense_type, norm_bound=args.norm_bound,
-                stddev=args.stddev, noise_multiplier=args.noise_multiplier)
+                stddev=args.stddev, noise_multiplier=args.noise_multiplier,
+                **agg_kw)
         elif args.algo == "turboaggregate":
             from fedml_tpu.distributed.turboaggregate import TAAggregator
 
             agg = TAAggregator(data, task, cfg, worker_num=args.world_size - 1)
         else:  # fedavg / fedprox share the plain weighted-average server
-            agg = FedAvgAggregator(data, task, cfg, worker_num=args.world_size - 1)
+            agg = FedAvgAggregator(data, task, cfg,
+                                   worker_num=args.world_size - 1, **agg_kw)
         return FedAvgServerManager(agg, rank=0, size=args.world_size,
                                    backend=backend, ckpt_dir=args.ckpt_dir,
                                    round_timeout_s=args.round_timeout_s,
@@ -177,12 +213,13 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     # turboaggregate share is a masked tensor whose top-k entries are
     # meaningless (the mask dominates), so it stays dense
     sp = getattr(args, "sparsify_ratio", None) or None
+    adv = _load_adversary_plan(getattr(args, "adversary_plan", None))
     if args.algo == "fedprox":
         from fedml_tpu.distributed.fedprox import prox_spec
 
         return init_client(data, task, cfg, args.rank, args.world_size, backend,
                            local_spec=prox_spec(cfg, args.fedprox_mu),
-                           sparsify_ratio=sp, **backend_kw)
+                           sparsify_ratio=sp, adversary_plan=adv, **backend_kw)
     if args.algo == "turboaggregate":
         from fedml_tpu.distributed.turboaggregate import SecureTrainer
 
@@ -190,7 +227,17 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         return FedAvgClientManager(trainer, rank=args.rank, size=args.world_size,
                                    backend=backend, **backend_kw)
     return init_client(data, task, cfg, args.rank, args.world_size, backend,
-                       sparsify_ratio=sp, **backend_kw)
+                       sparsify_ratio=sp, adversary_plan=adv, **backend_kw)
+
+
+def _load_adversary_plan(spec: str | None):
+    """--adversary-plan: a JSON file path or inline JSON (same dual form
+    as --chaos-plan)."""
+    if not spec:
+        return None
+    from fedml_tpu.chaos import AdversaryPlan
+
+    return AdversaryPlan.from_spec(spec)
 
 
 def main(argv=None):
@@ -214,13 +261,9 @@ def main(argv=None):
     set_wire_codec(args.compression)
 
     if args.chaos_plan:
-        import os
-
         from fedml_tpu import chaos
 
-        plan = (chaos.FaultPlan.from_file(args.chaos_plan)
-                if os.path.exists(args.chaos_plan)
-                else chaos.FaultPlan.from_json(args.chaos_plan))
+        plan = chaos.FaultPlan.from_spec(args.chaos_plan)
         chaos.install_plan(plan)
         logging.getLogger("fedml_tpu.launch").warning(
             "CHAOS plan installed (seed=%d, %d rules) — faults will be "

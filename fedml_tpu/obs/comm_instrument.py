@@ -142,6 +142,47 @@ def record_fault(backend: str, fault: str, direction: str) -> None:
     _faults(backend, fault, direction).inc()
 
 
+# ----------------------------------------------------- robust aggregation
+# Quarantine bookkeeping (core/robust_agg.py + distributed aggregator):
+# the sanitation gate / robust aggregators report every rejected or
+# suspected update here so a soak dashboard can watch a poisoning attempt
+# the same way it watches wire faults.
+
+
+@lru_cache(maxsize=16)
+def _rejected(reason: str):
+    return REGISTRY.counter("fed_updates_rejected_total", reason=reason)
+
+
+def record_update_rejected(reason: str) -> None:
+    """An uploaded update the sanitation gate rejected or a robust
+    aggregator suspected, labeled by quarantine reason
+    (nonfinite | norm_outlier | suspected)."""
+    _rejected(reason).inc()
+
+
+@lru_cache(maxsize=256)
+def _suspected(rank: int):
+    return REGISTRY.counter("fed_suspected_rank", rank=rank)
+
+
+def record_suspected_rank(rank: int) -> None:
+    """Per-rank quarantine tally — which worker keeps getting flagged."""
+    _suspected(int(rank)).inc()
+
+
+@lru_cache(maxsize=16)
+def _stale(reason: str):
+    return REGISTRY.counter("comm_stale_uploads_total", reason=reason)
+
+
+def record_stale_upload(reason: str) -> None:
+    """An upload the aggregator refused to slot: ``stale`` (round tag
+    behind/ahead of the current round) or ``unknown_rank`` (index outside
+    the worker table) — previously these silently overwrote state."""
+    _stale(reason).inc()
+
+
 # --------------------------------------------------------------- liveness
 # Heartbeat/liveness gauges, fed by the machinery that already exists:
 # every decoded inbound frame proves its sender alive (BaseCommManager.

@@ -71,9 +71,15 @@ def random_plan(seed: int, world_size: int, elastic: bool = True):
 
 
 def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
-             round_timeout_s: float = 1.0) -> dict:
+             round_timeout_s: float = 1.0, adversary_plan=None,
+             aggregator: str | None = None) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
-    trial record (ok flag, per-fault counts, history tail, timing)."""
+    trial record (ok flag, per-fault counts, history tail, timing).
+
+    ``adversary_plan`` layers model-space faults (chaos/adversary.py) on
+    top of the wire-level plan; pair with ``aggregator`` so the trial also
+    exercises the sanitation gate + robust estimator, whose verdicts land
+    in the record's ``quarantine`` counts."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.distributed.fedavg import run_simulated
 
@@ -84,10 +90,17 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     t0 = time.perf_counter()
     err = None
     agg = None
+    agg_params = None
+    if aggregator in ("krum", "multi_krum"):
+        # krum needs n >= 2f+3 — derive a legal budget for small worlds
+        agg_params = {"f": max((per_round - 3) // 2, 0)}
     try:
         agg = run_simulated(data, task, cfg, backend="LOOPBACK",
                             job_id=f"soak-{plan.seed}-{time.time_ns()}",
-                            chaos_plan=plan, round_timeout_s=round_timeout_s)
+                            chaos_plan=plan, round_timeout_s=round_timeout_s,
+                            adversary_plan=adversary_plan,
+                            aggregator=aggregator,
+                            aggregator_params=agg_params)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
     completed = bool(agg and agg.history
@@ -100,11 +113,57 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
                              if agg and agg.history else 0),
         "faults": plan.ledger.counts(),
         "n_faults": len(plan.ledger),
+        "quarantine": (agg.quarantine.counts()
+                       if agg is not None and adversary_plan is not None
+                       else None),
         "final_eval": (agg.history[-1] if agg and agg.history else None),
         "seconds": round(time.perf_counter() - t0, 2),
         "plan": json.loads(plan.to_json()),
         "net": agg.net if agg else None,       # stripped before JSON dump
         "ledger": plan.ledger.canonical(),     # stripped before JSON dump
+        "qledger": (agg.quarantine.canonical()
+                    if agg is not None else []),  # stripped before dump
+    }
+
+
+def backdoor_defense_trial(rounds: int = 4, aggregator: str | None = "krum",
+                           seed: int = 0) -> dict:
+    """Standalone attack-vs-defense spot check folded into the soak
+    summary: a BadNets pixel-trigger backdoor (data/poisoning.py) on two
+    attacker clients, defended by norm clipping + the requested robust
+    aggregator; ``FedAvgRobustAPI.evaluate_backdoor`` gives the targeted-
+    task accuracy the campaign reports (low = the backdoor failed)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.poisoning import make_backdoor_dataset
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(8, 8, 1),
+                            num_classes=4, samples_per_client=24,
+                            test_samples=96, seed=3)
+    task = classification_task(LogisticRegression(num_classes=4))
+    poisoned, eval_set = make_backdoor_dataset(
+        data, target_label=1, poison_client_ids=[1, 4], poison_frac=0.8,
+        seed=seed)
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                       client_num_per_round=8, epochs=1, batch_size=8,
+                       lr=0.1, frequency_of_the_test=rounds, seed=seed)
+    agg_params = {"f": 2} if aggregator in ("krum", "multi_krum") else None
+    api = FedAvgRobustAPI(poisoned, task, cfg, norm_bound=5.0,
+                          poisoned_test=eval_set, aggregator=aggregator,
+                          aggregator_params=agg_params)
+    for r in range(rounds):
+        api.run_round(r)
+    bd = api.evaluate_backdoor()
+    clean = api.evaluate()
+    return {
+        "aggregator": aggregator or "mean",
+        "rounds": rounds,
+        "backdoor_acc": float(bd["acc"]),  # targeted-task accuracy
+        "clean_acc": float(clean["acc"]),
+        "quarantine": api.quarantine.counts(),
     }
 
 
@@ -118,6 +177,18 @@ def main(argv=None) -> int:
     ap.add_argument("--replay-every", type=int, default=5,
                     help="every k-th trial is re-run with the same seed and "
                          "must reproduce the ledger and final model exactly")
+    ap.add_argument("--adversary-plan", "--adversary_plan",
+                    dest="adversary_plan", type=str, default=None,
+                    help="model-space adversary schedule (JSON file path or "
+                         "inline JSON, chaos/adversary.py) layered on every "
+                         "trial's wire-level faults; replays fold in the "
+                         "quarantine ledger, and the summary gains a "
+                         "standalone backdoor defense spot check "
+                         "(FedAvgRobustAPI.evaluate_backdoor)")
+    ap.add_argument("--aggregator", type=str, default="krum",
+                    help="robust aggregator defending adversary trials "
+                         "(core/robust_agg.py; only used with "
+                         "--adversary-plan)")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
 
@@ -130,29 +201,49 @@ def main(argv=None) -> int:
                             test_samples=96, seed=3)
     task = classification_task(LogisticRegression(num_classes=4))
 
+    adv_spec = None
+    if args.adversary_plan:
+        from fedml_tpu.chaos import AdversaryPlan
+
+        # normalized to JSON and rebuilt per trial: plans are cheap
+        adv_spec = AdversaryPlan.from_spec(args.adversary_plan).to_json()
+
+    def adv():
+        if adv_spec is None:
+            return None
+        from fedml_tpu.chaos import AdversaryPlan
+
+        return AdversaryPlan.from_json(adv_spec)
+
+    aggregator = args.aggregator if adv_spec is not None else None
     trials = []
     for i in range(args.trials):
         seed = args.seed0 + i
         plan = random_plan(seed, args.world_size)
         rec = run_plan(data, task, plan, rounds=args.rounds,
-                       world_size=args.world_size)
+                       world_size=args.world_size, adversary_plan=adv(),
+                       aggregator=aggregator)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
             from fedml_tpu.comm.message import pack_pytree
 
             rec2 = run_plan(data, task, random_plan(seed, args.world_size),
-                            rounds=args.rounds, world_size=args.world_size)
-            replay_ok = rec2["ledger"] == rec["ledger"] and all(
+                            rounds=args.rounds, world_size=args.world_size,
+                            adversary_plan=adv(), aggregator=aggregator)
+            replay_ok = (rec2["ledger"] == rec["ledger"]
+                         and rec2["qledger"] == rec["qledger"] and all(
                 np.array_equal(np.asarray(a), np.asarray(b))
                 for a, b in zip(pack_pytree(rec["net"]),
-                                pack_pytree(rec2["net"])))
+                                pack_pytree(rec2["net"]))))
             rec["replay_deterministic"] = replay_ok
             if not replay_ok:
                 rec["ok"] = False
-                rec["error"] = "replay diverged (ledger or final model)"
+                rec["error"] = "replay diverged (ledger, quarantine, or " \
+                               "final model)"
         rec.pop("net", None)
         rec.pop("ledger", None)
+        rec.pop("qledger", None)
         trials.append(rec)
         print(f"trial {seed}: {'ok' if rec['ok'] else 'FAIL'} "
               f"({rec['n_faults']} faults, {rec['seconds']}s)",
@@ -172,6 +263,17 @@ def main(argv=None) -> int:
         "faults_injected_total": sum(t["n_faults"] for t in trials),
         "records": trials,
     }
+    if adv_spec is not None:
+        summary["adversary_plan"] = json.loads(adv_spec)
+        summary["aggregator"] = aggregator
+        summary["quarantine_total"] = {
+            k: sum((t.get("quarantine") or {}).get(k, 0) for t in trials)
+            for k in ("nonfinite", "norm_outlier", "suspected")}
+        # standalone backdoor spot check: targeted-task accuracy under the
+        # clip + robust-aggregator defense (evaluate_backdoor; low = the
+        # backdoor failed to implant)
+        summary["backdoor_defense"] = backdoor_defense_trial(
+            rounds=args.rounds, aggregator=aggregator)
     out = json.dumps(summary, indent=1, default=str)
     if args.out:
         with open(args.out, "w") as f:

@@ -67,6 +67,39 @@ print(f"tracing smoke ok: {len(doc['traceEvents'])} events, "
       f"straggler ranks {[c['straggler'] for c in cps]}")
 PY
   python scripts/report.py "$TRACE_DIR/events.jsonl" --critical-path
+  echo "== byzantine smoke (2-round loopback: 1 sign-flip adversary vs krum) =="
+  # the robust-aggregation gate must quarantine the attacker (non-empty
+  # ledger) and the defended run must stay finite (docs/ROBUSTNESS.md
+  # §Byzantine-robust aggregation)
+  python - <<'PY'
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+
+data = synthetic_images(num_clients=8, image_shape=(8, 8, 1), num_classes=4,
+                        samples_per_client=24, test_samples=96, seed=3)
+plan = AdversaryPlan.from_json(
+    {"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [2],
+                           "factor": 10.0}]})
+agg = run_simulated(data, classification_task(LogisticRegression(num_classes=4)),
+                    FedAvgConfig(comm_round=2, client_num_in_total=8,
+                                 client_num_per_round=8, batch_size=8,
+                                 lr=0.1, frequency_of_the_test=1),
+                    job_id="ci-byz-smoke", adversary_plan=plan,
+                    aggregator="krum", aggregator_params={"f": 2})
+ledger = agg.quarantine.canonical()
+assert ledger, "quarantine ledger empty: the adversary went undetected"
+assert any(e[1] == 2 for e in ledger), f"rank 2 never quarantined: {ledger}"
+assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(agg.net))
+print(f"byzantine smoke ok: {len(ledger)} quarantine entries, "
+      f"counts {agg.quarantine.counts()}, final eval {agg.history[-1]}")
+PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi
@@ -144,4 +177,10 @@ echo "== chaos soak (seeded fault-injection campaign, docs/ROBUSTNESS.md) =="
 # every trial's plan derives from its seed; the script replays every 5th
 # trial and fails unless ledger + final model reproduce exactly
 python scripts/chaos_soak.py --trials 5 --rounds 3 --out ./tmp/chaos_soak.json
+# model-space tier: wire faults + a sign-flip Byzantine client defended by
+# krum; replays must also reproduce the quarantine ledger, and the summary
+# carries the backdoor defense spot check (evaluate_backdoor)
+python scripts/chaos_soak.py --trials 3 --rounds 3 \
+  --adversary-plan '{"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [1], "factor": 10.0}]}' \
+  --out ./tmp/chaos_soak_byz.json
 echo "CI GREEN"
