@@ -47,6 +47,11 @@ from fedml_tpu.core.client_data import (
     pad_index_batches,
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
+from fedml_tpu.core.pipeline import (
+    InflightRing,
+    Prefetcher,
+    compile_concurrently,
+)
 from fedml_tpu.core.robust_agg import (
     DEFAULT_NORM_MULT,
     QuarantineLedger,
@@ -54,6 +59,7 @@ from fedml_tpu.core.robust_agg import (
     make_robust_aggregator,
 )
 from fedml_tpu.core.sampling import prepare_sampling, sample_for
+from fedml_tpu.obs import perf_instrument as _perf
 from fedml_tpu.obs.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
 
@@ -259,11 +265,31 @@ class FedAvgAPI:
         aggregator_params: dict | None = None,
         sanitize: bool | float | None = None,
         adversary_plan=None,
+        prefetch: int = 0,
+        drain_lag: int = 2,
     ):
         self.data = dataset
         self.task = task
         self.cfg = config
         self.mesh = mesh
+        # Pipelined round execution (core/pipeline.py, docs/PERFORMANCE.md):
+        # ``prefetch`` > 0 arms the double-buffered host->device prefetch —
+        # a packer thread prepares round r+1's batch and issues its
+        # device_put while round r executes, with up to ``prefetch`` batches
+        # staged ahead (2 = classic double buffering). ``drain_lag`` is how
+        # many rounds behind dispatch the metrics/quarantine drain trails,
+        # so JAX async dispatch stays that deep. Bit-identical to the
+        # synchronous driver (packing is a pure function of (seed, round);
+        # test-enforced); prefetch=0 (default) changes nothing.
+        if prefetch < 0:
+            raise ValueError(f"prefetch must be >= 0, got {prefetch}")
+        if drain_lag < 0:
+            raise ValueError(f"drain_lag must be >= 0, got {drain_lag}")
+        self.prefetch = int(prefetch)
+        self.drain_lag = int(drain_lag)
+        # test/instrumentation hook: a callable observing the pipeline's
+        # ("produced"/"got"/"drained", key) events — the overlap oracle
+        self._pipe_on_event = None
         # Byzantine-robust aggregation (core/robust_agg.py). ``aggregator``
         # replaces the weighted mean with a robust estimator over the
         # stacked client updates: 'mean' | 'median' | 'trimmed_mean' |
@@ -637,13 +663,11 @@ class FedAvgAPI:
     def _pack_round_host(self, round_idx: int) -> ClientBatch:
         """Always the dense host-packed ClientBatch, regardless of
         device_data — for engines that consume .x/.y directly (FedDF's
-        distillation batches, TurboAggregate's share encoding, affinity)."""
-        was = self.device_data
-        try:
-            self.device_data = False
-            return self._pack_round(round_idx)
-        finally:
-            self.device_data = was
+        distillation batches, TurboAggregate's share encoding, affinity).
+        Delegates through the explicit ``device_data`` argument (never a
+        mutate-self-and-restore toggle: the prefetch thread packs
+        concurrently with the driver, and a shared flag flip would race)."""
+        return self._pack_round(round_idx, device_data=False)
 
     def _bucketed_B(self, b_needed: int) -> int:
         """Smallest ladder bucket covering ``b_needed`` (ladder tops out at
@@ -670,17 +694,26 @@ class FedAvgAPI:
                       if self.bucket_batches else self.num_batches)
         return pad_index_batches(ib, pad_to)
 
-    def _pack_round(self, round_idx: int):
+    def _shard_round_batch(self, batch):
+        """Mesh placement of one round's batch: every leaf client-sharded
+        over the first mesh axis (no-op off-mesh). One definition shared by
+        the round packer, the prefetch thread, and warmup lowering."""
+        if self.mesh is None:
+            return batch
+        sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+        return jax.tree.map(lambda v: jax.device_put(v, sh), batch)
+
+    def _pack_round(self, round_idx: int, device_data: bool | None = None):
+        """One round's batch on the engine's data plane. ``device_data``
+        overrides the engine default explicitly (None = self.device_data)
+        so callers needing the dense host pack — and the prefetch thread —
+        never toggle shared state."""
         cfg = self.cfg
-        if self.device_data and not self.block_working_set:
+        if device_data is None:
+            device_data = self.device_data
+        if device_data and not self.block_working_set:
             ib = self._pack_round_indices_host(round_idx)
-            if self.mesh is not None:
-                sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
-                ib = IndexBatch(
-                    idx=jax.device_put(ib.idx, sh), mask=jax.device_put(ib.mask, sh),
-                    num_samples=jax.device_put(ib.num_samples, sh),
-                )
-            return ib
+            return self._shard_round_batch(ib)
         ids = self._sampled_ids(round_idx)
         cb = pack_clients(
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
@@ -690,14 +723,7 @@ class FedAvgAPI:
         # bucket_batches, the round's ladder bucket -> <=4 compilations)
         cb = pad_batches(cb, self._bucketed_B(cb.num_batches)
                          if self.bucket_batches else self.num_batches)
-        if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
-            cb = ClientBatch(
-                x=jax.device_put(cb.x, sh), y=jax.device_put(cb.y, sh),
-                mask=jax.device_put(cb.mask, sh),
-                num_samples=jax.device_put(cb.num_samples, sh),
-            )
-        return cb
+        return self._shard_round_batch(cb)
 
     def _sampled_ids(self, round_idx: int):
         return sample_for(self.cfg, round_idx, self._client_sizes)
@@ -853,63 +879,241 @@ class FedAvgAPI:
                 # over the R rounds, like the 'block' event record)
                 self.telemetry.tracer.begin_round(start_round)
 
-        ids_l, idx_l, mask_l, ns_l = [], [], [], []
         with self.tracer.span("pack"):
-            # bucketed: pack at natural depth first, then pad every round
-            # to the BLOCK's common bucket (the scan needs one B; jit
-            # caches per bucket, <=4 variants)
-            pad_to = 0 if self.bucket_batches else self.num_batches
-            for r in range(start_round, start_round + num_rounds):
-                # host-side pack: the stacked block is device_put ONCE below
-                # (per-round device_puts would round-trip, and on multi-host
-                # meshes a sharded array cannot come back through np.asarray)
-                ib = self._pack_round_indices_host(r, pad_to=pad_to)
-                ids_l.append(np.asarray(self._sampled_ids(r), np.int32))
-                idx_l.append(ib.idx)
-                mask_l.append(ib.mask)
-                ns_l.append(ib.num_samples)
-            if self.bucket_batches:
-                B = self._bucketed_B(max(a.shape[1] for a in idx_l))
-                for i, (ix, mk, ns) in enumerate(zip(idx_l, mask_l, ns_l)):
-                    ib = pad_index_batches(
-                        IndexBatch(idx=ix, mask=mk, num_samples=ns), B)
-                    idx_l[i], mask_l[i] = ib.idx, ib.mask
-        rounds = np.arange(start_round, start_round + num_rounds, dtype=np.int32)
-        idx_stack = np.stack(idx_l)
-        if self.block_working_set:
-            with self.tracer.span("pack"):
-                idx_stack, dev_x, dev_y = self._compact_block_rows(idx_stack)
-        else:
-            dev_x, dev_y = self._dev_x, self._dev_y
-        blocks = [idx_stack, np.stack(mask_l), np.stack(ns_l),
-                  np.stack(ids_l)]
-        if self.mesh is not None:
-            sh = NamedSharding(self.mesh, P(None, self.mesh.axis_names[0]))
-            blocks = [jax.device_put(b, sh) for b in blocks]
+            packed = self._pack_block_host(start_round, num_rounds)
+            ids_l, placed = self._place_block(packed)
         with self.tracer.span("round"):
-            self.rng, self.net, self.server_opt_state, ms = self._block_fn(
-                self.rng, self.net, self.server_opt_state, dev_x, dev_y,
-                *[jnp.asarray(b) for b in blocks], jnp.asarray(rounds),
-            )
+            ms = self._dispatch_block(placed)
         ms = self._drain_quarantine_block(ms, start_round, ids_l)
         if self.telemetry is not None:
             # per-round records from the scanned block's stacked metrics
             # (one sync for the whole block); the block's host spans
             # (pack + one dispatch) ride on a separate 'block' event since
             # they are amortized over the R rounds, not per-round
-            ms_host = {k: np.asarray(v) for k, v in ms.items()}
-            self.telemetry.events.emit(
-                "block", start=int(start_round), rounds=int(num_rounds),
-                spans=self._span_delta(spans_before))
-            for i in range(num_rounds):
-                self.telemetry.emit_round(
-                    start_round + i, clients=ids_l[i].tolist(),
-                    metrics={k: float(v[i]) for k, v in ms_host.items()},
-                    block=True,
-                    **self._quarantine_extra(start_round + i))
+            self._emit_block_records(start_round, num_rounds, ids_l, ms,
+                                     spans=self._span_delta(spans_before))
             if self.telemetry.tracer is not None:
                 self.telemetry.tracer.finish_round()  # see run_round
         return ms
+
+    def _pack_block_host(self, start_round: int, num_rounds: int):
+        """Host-side pack of one R-round block — a pure function of
+        (seed, rounds), safe on the prefetch thread. Returns
+        (rounds, ids_l, idx_stack, mask_stack, ns_stack), all numpy."""
+        ids_l, idx_l, mask_l, ns_l = [], [], [], []
+        # bucketed: pack at natural depth first, then pad every round
+        # to the BLOCK's common bucket (the scan needs one B; jit
+        # caches per bucket, <=4 variants)
+        pad_to = 0 if self.bucket_batches else self.num_batches
+        for r in range(start_round, start_round + num_rounds):
+            # host-side pack: the stacked block is device_put ONCE in
+            # _place_block (per-round device_puts would round-trip, and on
+            # multi-host meshes a sharded array can't return via np.asarray)
+            ib = self._pack_round_indices_host(r, pad_to=pad_to)
+            ids_l.append(np.asarray(self._sampled_ids(r), np.int32))
+            idx_l.append(ib.idx)
+            mask_l.append(ib.mask)
+            ns_l.append(ib.num_samples)
+        if self.bucket_batches:
+            B = self._bucketed_B(max(a.shape[1] for a in idx_l))
+            for i, (ix, mk, ns) in enumerate(zip(idx_l, mask_l, ns_l)):
+                ib = pad_index_batches(
+                    IndexBatch(idx=ix, mask=mk, num_samples=ns), B)
+                idx_l[i], mask_l[i] = ib.idx, ib.mask
+        rounds = np.arange(start_round, start_round + num_rounds,
+                           dtype=np.int32)
+        return rounds, ids_l, np.stack(idx_l), np.stack(mask_l), np.stack(ns_l)
+
+    def _place_block(self, packed):
+        """Device placement for a packed block: working-set compaction (its
+        grow-only caches are touched by exactly one placer at a time — the
+        prefetch thread in pipelined mode, the driver otherwise) plus the
+        block's H2D transfers. Returns (ids_l, dispatch args)."""
+        rounds, ids_l, idx_stack, mask_stack, ns_stack = packed
+        if self.block_working_set:
+            idx_stack, dev_x, dev_y = self._compact_block_rows(idx_stack)
+        else:
+            dev_x, dev_y = self._dev_x, self._dev_y
+        blocks = [idx_stack, mask_stack, ns_stack, np.stack(ids_l)]
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, self.mesh.axis_names[0]))
+            blocks = [jax.device_put(b, sh) for b in blocks]
+        blocks = [jnp.asarray(b) for b in blocks]
+        return ids_l, (dev_x, dev_y, blocks, jnp.asarray(rounds))
+
+    def _dispatch_block(self, placed):
+        dev_x, dev_y, blocks, rounds = placed
+        self.rng, self.net, self.server_opt_state, ms = self._block_fn(
+            self.rng, self.net, self.server_opt_state, dev_x, dev_y,
+            *blocks, rounds,
+        )
+        return ms
+
+    def _emit_block_records(self, start_round: int, num_rounds: int, ids_l,
+                            ms, spans=None, pipeline=None):
+        ms_host = {k: np.asarray(v) for k, v in ms.items()}
+        self.telemetry.events.emit(
+            "block", start=int(start_round), rounds=int(num_rounds),
+            spans=spans or {},
+            **({"pipeline": pipeline} if pipeline else {}))
+        for i in range(num_rounds):
+            self.telemetry.emit_round(
+                start_round + i, clients=ids_l[i].tolist(),
+                metrics={k: float(v[i]) for k, v in ms_host.items()},
+                block=True,
+                **self._quarantine_extra(start_round + i))
+
+    def _drain_block_entry(self, start_round: int, entry):
+        """Block analogue of _drain_round_entry: the only sync, one block
+        behind dispatch; ledger + telemetry flushed in block order."""
+        num_rounds, ids_l, spans, pipeline, ms = entry
+        ms = self._drain_quarantine_block(ms, start_round, ids_l)
+        ms_host = {k: np.asarray(v) for k, v in ms.items()}
+        if self.telemetry is not None:
+            self._emit_block_records(start_round, num_rounds, ids_l, ms_host,
+                                     spans=spans, pipeline=pipeline)
+        return start_round, ms_host
+
+    def run_blocks_pipelined(self, start_round: int, num_blocks: int,
+                             block_rounds: int):
+        """``num_blocks`` scanned R-round blocks with block-level prefetch:
+        block b+1's host pack + H2D run on the packer thread while block
+        b's program executes; the metrics drain trails one block behind.
+        Bit-identical to the same sequence of run_rounds calls
+        (test-enforced). Returns drained [(start_round, host metrics)]."""
+        self._warn_tracer_unsupported()
+        if not self.device_data:
+            raise ValueError("run_blocks_pipelined needs device_data=True")
+        if self.mesh is not None and self._needs_stacked:
+            # the robust mesh block already degrades to per-round dispatch
+            # (see run_rounds) — pipeline per round instead of per block
+            out = []
+            for b in range(num_blocks):
+                out.extend(self.run_pipelined(
+                    start_round + b * block_rounds, block_rounds))
+            return out
+        if not hasattr(self, "_block_fn"):
+            self._block_fn = self._build_block_fn()
+
+        def produce(s):
+            t0 = time.perf_counter()
+            packed = self._pack_block_host(s, block_rounds)
+            t1 = time.perf_counter()
+            ids_l, placed = self._place_block(packed)
+            h2d = time.perf_counter() - t1
+            _perf.record_span("prefetch_pack", t1 - t0)
+            _perf.record_h2d(h2d)
+            return ids_l, placed, {"prefetch_pack": t1 - t0, "h2d": h2d}
+
+        starts = [start_round + b * block_rounds for b in range(num_blocks)]
+        pf = Prefetcher(produce, starts, depth=max(1, self.prefetch),
+                        on_event=self._pipe_on_event)
+        # block units are R rounds each, so the lag is capped at one block
+        # — but drain_lag=0 (the documented "correlate api.net with its
+        # metrics" escape hatch) must still mean drain-immediately here
+        ring = InflightRing(min(self.drain_lag, 1), self._drain_block_entry,
+                            on_event=self._pipe_on_event)
+        out = []
+        try:
+            for s in starts:
+                (ids_l, placed, spans), stall = pf.get(s)
+                with self.tracer.span("round"):
+                    ms = self._dispatch_block(placed)
+                spans = dict(spans, prefetch_stall=stall)
+                out.extend(ring.push(
+                    s, (block_rounds, ids_l, spans, {"depth": len(ring) + 1},
+                        ms)))
+            out.extend(ring.drain_all())
+        finally:
+            pf.close()
+        return out
+
+    # ----------------------------------------------------------------- warmup
+    def _warmup_batch(self, B: int):
+        """A zero-filled round batch with exactly the shapes/dtypes/sharding
+        the round program sees at bucket depth ``B`` — values are irrelevant
+        (lowering abstracts them); shapes select the jit variant."""
+        K, bs = self.cfg.client_num_per_round, self.cfg.batch_size
+        if self.device_data and not self.block_working_set:
+            ib = IndexBatch(
+                idx=np.zeros((K, B, bs), np.int32),
+                mask=np.zeros((K, B, bs), np.float32),
+                num_samples=np.zeros((K,), np.float32))
+            return self._shard_round_batch(ib)
+        x, y = self.data.train_x, self.data.train_y
+        cb = ClientBatch(
+            x=np.zeros((K, B, bs) + x.shape[1:], x.dtype),
+            y=np.zeros((K, B, bs) + y.shape[1:], y.dtype),
+            mask=np.zeros((K, B, bs), np.float32),
+            num_samples=np.zeros((K,), np.float32))
+        return self._shard_round_batch(cb)
+
+    def warmup(self, block_rounds: int | None = None,
+               per_round: bool = True,
+               max_workers: int | None = None) -> dict:
+        """AOT-compile every round-program variant this engine can dispatch
+        — the <=4 bucket depths of the per-round fn plus, with
+        ``block_rounds=R``, the scanned R-round block fn per bucket —
+        concurrently on a thread pool (``.lower()`` serially, ``.compile()``
+        overlapped; XLA releases the GIL).
+
+        Wired to the persistent compile cache: warmup enables it when no
+        cache dir is configured yet, every compile lands on disk, and the
+        jit dispatch that later runs the round deserializes instead of
+        recompiling — so a repeat run (or the N-1 sibling ranks of a
+        simulated cluster) performs zero fresh compiles, which the returned
+        report asserts rather than assumes (``fresh_compiles`` /
+        ``cache_hits`` deltas from obs/perf_instrument).
+
+        ``per_round=False`` drops the per-round variants (a block-only
+        driver should not pay compiles it will never dispatch). Skipped
+        variants that the first dispatch compiles instead: the block fn
+        under ``block_working_set`` (its parked-row count is
+        data-dependent) and on a robust mesh (that path degrades to
+        per-round dispatch)."""
+        if not getattr(jax.config, "jax_compilation_cache_dir", None):
+            from fedml_tpu.utils.metrics import enable_compile_cache
+
+            enable_compile_cache()
+        cfg = self.cfg
+        K = cfg.client_num_per_round
+        buckets = (list(self._b_ladder) if self.bucket_batches
+                   else [self.num_batches])
+        rng = jax.random.PRNGKey(0)
+        r0, ids = jnp.int32(0), jnp.zeros((K,), jnp.int32)
+        lowered = {}
+        if per_round:
+            for B in buckets:
+                lowered[f"round_b{B}"] = self.round_fn.lower(
+                    rng, self.net, self.server_opt_state,
+                    self._warmup_batch(B), r0, ids)
+        if block_rounds and self.device_data and not self.block_working_set \
+                and not (self.mesh is not None and self._needs_stacked):
+            if not hasattr(self, "_block_fn"):
+                self._block_fn = self._build_block_fn()
+            R = int(block_rounds)
+            for B in buckets:
+                bs = cfg.batch_size
+                blocks = [np.zeros((R, K, B, bs), np.int32),
+                          np.zeros((R, K, B, bs), np.float32),
+                          np.zeros((R, K), np.float32),
+                          np.zeros((R, K), np.int32)]
+                if self.mesh is not None:
+                    sh = NamedSharding(self.mesh,
+                                       P(None, self.mesh.axis_names[0]))
+                    blocks = [jax.device_put(b, sh) for b in blocks]
+                blocks = [jnp.asarray(b) for b in blocks]
+                lowered[f"block_r{R}_b{B}"] = self._block_fn.lower(
+                    rng, self.net, self.server_opt_state,
+                    self._dev_x, self._dev_y, *blocks,
+                    jnp.asarray(np.arange(R, dtype=np.int32)))
+        rep = compile_concurrently(lowered, max_workers=max_workers)
+        rep.pop("executables", None)
+        rep["bucket_depths"] = buckets
+        log.info("warmup: %d variant(s) in %.2fs (%d fresh compiles, "
+                 "%d persistent-cache hits)", len(rep["variants"]),
+                 rep["seconds"], rep["fresh_compiles"], rep["cache_hits"])
+        return rep
 
     _WORKING_SET_BUCKET = 8192  # rows; pad-to-bucket keeps ONE compiled block
 
@@ -998,6 +1202,19 @@ class FedAvgAPI:
         return {"quarantine": entries} if entries else {}
 
     # ------------------------------------------------------------------ train
+    def _dispatch_round(self, round_idx: int, ids, cb):
+        """Advance the rng chain and dispatch one round program — the ONE
+        jit call site both the synchronous driver (run_round) and the
+        pipelined drivers share, so their rng chains cannot diverge.
+        Returns the round's metrics as device arrays (no sync)."""
+        with self.tracer.span("round"):
+            self.rng, rk = jax.random.split(self.rng)
+            self.net, self.server_opt_state, metrics = self.round_fn(
+                rk, self.net, self.server_opt_state, cb,
+                jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
+            )
+        return metrics
+
     def run_round(self, round_idx: int):
         if self.telemetry is not None:
             spans_before = dict(self.tracer.rounds[-1])
@@ -1006,12 +1223,7 @@ class FedAvgAPI:
         with self.tracer.span("pack"):
             ids = self._sampled_ids(round_idx)
             cb = self._pack_round(round_idx)
-        with self.tracer.span("round"):
-            self.rng, rk = jax.random.split(self.rng)
-            self.net, self.server_opt_state, metrics = self.round_fn(
-                rk, self.net, self.server_opt_state, cb,
-                jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
-            )
+        metrics = self._dispatch_round(round_idx, ids, cb)
         metrics = self._drain_quarantine(metrics, round_idx, ids)
         if self.telemetry is not None:
             # floating the metrics syncs on the round's outputs — a cost the
@@ -1030,6 +1242,134 @@ class FedAvgAPI:
                 # the single-rank trace view scopes to the round program.
                 self.telemetry.tracer.finish_round()
         return metrics
+
+    # --------------------------------------------------------------- pipeline
+    def _place_round_batch(self, batch):
+        """Issue the host->device transfer for a packed round batch NOW (on
+        the prefetch thread) instead of implicitly at jit dispatch. Leaves
+        already on device (the mesh packer shards in _pack_round) pass
+        through. Transfers are exact, so a placed batch is bit-identical to
+        letting dispatch transfer it."""
+        leaves, treedef = jax.tree.flatten(batch)
+        return jax.tree.unflatten(
+            treedef,
+            [v if isinstance(v, jax.Array) else jax.device_put(v)
+             for v in leaves])
+
+    def _pack_round_placed(self, round_idx: int):
+        """Prefetch producer (runs on the packer thread): sample ids, pack
+        the round batch into FRESH host buffers (every pack path allocates
+        anew — donation-safe while earlier rounds are still in flight), and
+        issue its device_put. Returns (ids, device batch, span dict)."""
+        t0 = time.perf_counter()
+        ids = self._sampled_ids(round_idx)
+        cb = self._pack_round(round_idx)
+        t1 = time.perf_counter()
+        cb = self._place_round_batch(cb)
+        h2d = time.perf_counter() - t1
+        # the packer thread must not touch self.tracer (its per-round dict
+        # belongs to the driver thread) — spans go straight to the
+        # fed_span_seconds / fed_h2d_seconds histograms and ride the round
+        # record at drain time
+        _perf.record_span("prefetch_pack", t1 - t0)
+        _perf.record_h2d(h2d)
+        return ids, cb, {"prefetch_pack": t1 - t0, "h2d": h2d}
+
+    def _drain_round_entry(self, round_idx: int, entry):
+        """Materialize one in-flight round's outputs (this is the only
+        sync, and it happens drain_lag rounds behind dispatch): quarantine
+        codes into the ledger, metrics to host, telemetry record flushed —
+        all in dispatch order, so ledgers and event logs are bit-identical
+        to the synchronous driver's."""
+        ids, spans, pipeline, metrics = entry
+        metrics = self._drain_quarantine(metrics, round_idx, ids)
+        host = {k: np.asarray(v) for k, v in metrics.items()}
+        if self.telemetry is not None:
+            self.telemetry.emit_round(
+                round_idx, clients=np.asarray(ids).tolist(),
+                spans=spans, pipeline=pipeline,
+                metrics={k: float(v) for k, v in host.items()},
+                **self._quarantine_extra(round_idx))
+        return round_idx, host
+
+    def _warn_tracer_unsupported(self):
+        """Pipelined drivers overlap rounds, which the sequential per-round
+        distributed-trace model (obs/tracing.py begin_round..finish_round)
+        cannot represent — so they emit NO per-round traces. Say so loudly
+        once instead of silently exporting an empty trace.json."""
+        if (self.telemetry is not None and self.telemetry.tracer is not None
+                and not getattr(self, "_tracer_warned", False)):
+            self._tracer_warned = True
+            log.warning(
+                "pipelined drivers do not emit per-round distributed "
+                "traces (rounds overlap; the trace model is sequential) — "
+                "round records carry prefetch/h2d/stall spans instead; "
+                "use the synchronous driver (prefetch=0) for trace runs")
+
+    def run_pipelined(self, start_round: int, num_rounds: int):
+        """Per-round dispatch through the prefetch pipeline: round r+1's
+        pack + H2D overlap round r's execution, and the metrics drain
+        trails ``drain_lag`` rounds behind so async dispatch stays that
+        deep. Bit-identical to the run_round loop (same packs, same rng
+        chain, same ledger order — test-enforced). Returns the drained
+        [(round_idx, host metrics dict)] in round order."""
+        self._warn_tracer_unsupported()
+        depth = max(1, self.prefetch)
+        pf = Prefetcher(self._pack_round_placed,
+                        range(start_round, start_round + num_rounds),
+                        depth=depth, on_event=self._pipe_on_event)
+        ring = InflightRing(self.drain_lag, self._drain_round_entry,
+                            on_event=self._pipe_on_event)
+        out = []
+        try:
+            for r in range(start_round, start_round + num_rounds):
+                (ids, cb, spans), stall = pf.get(r)
+                metrics = self._dispatch_round(r, ids, cb)
+                spans = dict(spans, prefetch_stall=stall)
+                out.extend(ring.push(
+                    r, (ids, spans, {"depth": len(ring) + 1}, metrics)))
+            out.extend(ring.drain_all())
+        finally:
+            pf.close()
+        return out
+
+    def _train_pipelined(self, rounds: int):
+        """train() body with the pipeline armed: same eval cadence and
+        history records as the synchronous loop; an eval round drains the
+        ring (its own metrics must be host-side), which re-syncs — set
+        frequency_of_the_test high for pure-throughput runs."""
+        self._warn_tracer_unsupported()
+        cfg = self.cfg
+        depth = max(1, self.prefetch)
+        pf = Prefetcher(self._pack_round_placed, range(rounds), depth=depth,
+                        on_event=self._pipe_on_event)
+        ring = InflightRing(self.drain_lag, self._drain_round_entry,
+                            on_event=self._pipe_on_event)
+        pending: dict[int, dict] = {}
+        try:
+            for r in range(rounds):
+                t0 = time.perf_counter()
+                (ids, cb, spans), stall = pf.get(r)
+                metrics = self._dispatch_round(r, ids, cb)
+                spans = dict(spans, prefetch_stall=stall)
+                for k, m in ring.push(
+                        r, (ids, spans, {"depth": len(ring) + 1}, metrics)):
+                    pending[k] = m
+                if (r % cfg.frequency_of_the_test == 0) or (r == rounds - 1):
+                    for k, m in ring.drain_all():
+                        pending[k] = m
+                    rec = self.eval_record(r, pending[r])
+                    rec["round_time"] = time.perf_counter() - t0
+                    self.history.append(rec)
+                    log.info("round %d: %s", r, rec)
+                    if self.telemetry is not None:
+                        self.telemetry.emit_eval(r, rec)
+                pending = {k: v for k, v in pending.items() if k >= r}
+                self.tracer.next_round()
+            ring.drain_all()
+        finally:
+            pf.close()
+        return self.net
 
     def _eval_on_all_clients(self) -> bool:
         mode = getattr(self.cfg, "local_test_on_all_clients", "auto")
@@ -1081,6 +1421,8 @@ class FedAvgAPI:
         if self.telemetry is not None:
             self.telemetry.run_header(dataclasses.asdict(cfg),
                                       engine="standalone")
+        if self.prefetch and rounds > 0:
+            return self._train_pipelined(rounds)
         for r in range(rounds):
             t0 = time.perf_counter()
             metrics = self.run_round(r)

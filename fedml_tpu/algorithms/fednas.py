@@ -227,7 +227,9 @@ class FedNASAPI(FedAvgAPI):
                            mask=(cb_w.mask, cb_a.mask),
                            num_samples=cb_w.num_samples)
 
-    def _pack_round(self, round_idx: int):
+    def _pack_round(self, round_idx: int, device_data: bool | None = None):
+        # device_data accepted for base-signature parity (the NAS pack is
+        # always the host-packed pair — there is no index plane here)
         merged = self._pack_pair(self._sampled_ids(round_idx), round_idx)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P

@@ -281,6 +281,33 @@ class Message:
     _REFERENCE_MSG_TYPES = {1: "s2c_init", 2: "s2c_sync",
                             3: "c2s_send_model", 4: "c2s_send_stats"}
 
+    # Decode-symmetry fallback for manifest-LESS json frames (ADVICE r5
+    # item 1): ``to_bytes('json')`` listifies EVERY array param, so a
+    # receiver must restore ndarrays for every protocol's array keys, not
+    # just ``model_params`` — otherwise --compression json hands split_nn/
+    # fedgkt/vfl handlers nested python lists. fedml_tpu senders attach the
+    # ``__arrays__`` manifest (exact keys + dtypes, handled above); this
+    # table covers frames from stock peers that don't. Values are
+    # (wire dtype, kind): 'leaves' = a LIST of tensors (pack_pytree shape —
+    # nested-list depth is per-tensor), 'array' = ONE tensor however deep
+    # its nesting. Dtypes are the senders' conventional ones — best-effort
+    # by construction (the manifest path is the exact one).
+    _KNOWN_ARRAY_KEYS = {
+        "model_params": ("<f4", "leaves"),   # fedavg weights
+        "params": ("<f4", "leaves"),         # vfl final host params
+        "sparse_idx": ("<i4", "leaves"),     # comm/sparse top-k uplinks
+        "sparse_val": ("<f4", "leaves"),
+        "acts": ("<f4", "array"),            # split_nn activations
+        "grads": ("<f4", "array"),           # split_nn / vfl cotangents
+        "feats": ("<f4", "array"),           # fedgkt features
+        "s_logits": ("<f4", "array"),        # fedgkt server logits
+        "c_logits": ("<f4", "array"),        # fedgkt client logits
+        "logits": ("<f4", "array"),          # vfl host logit contribution
+        "labels": ("<i8", "array"),
+        "mask": ("<f4", "array"),
+        "sel": ("<i8", "array"),             # vfl batch index selection
+    }
+
     @classmethod
     def _from_reference_json(cls, data: bytes) -> "Message":
         msg = cls.__new__(cls)
@@ -309,23 +336,27 @@ class Message:
                     msg.msg_params[k] = np.asarray(v, np.dtype(spec))
             return msg
 
-        def arrify(v):  # transform_list_to_tensor (fedavg/utils.py:7-10)
+        def arrify(v, dtype, kind):  # transform_list_to_tensor analogue
             if isinstance(v, dict):
                 # reference state_dict shape: key -> ONE tensor as nested
                 # lists, however deep
-                return {k: np.asarray(e, np.float32) for k, e in v.items()}
-            if isinstance(v, list) and v and isinstance(v[0], list):
+                return {k: np.asarray(e, dtype) for k, e in v.items()}
+            if kind == "leaves" and isinstance(v, list) and v \
+                    and isinstance(v[0], list):
                 # fedml_tpu pack_pytree shape: a LIST of tensors
-                return [np.asarray(e, np.float32) for e in v]
+                return [np.asarray(e, dtype) for e in v]
             if isinstance(v, list):
-                return np.asarray(v, np.float32)
+                return np.asarray(v, dtype)
             return v
 
-        # stock-reference sender (no manifest): the model_params-only
-        # heuristic — the only array key the reference's own protocol ships
-        k = Message.MSG_ARG_KEY_MODEL_PARAMS
-        if k in msg.msg_params:
-            msg.msg_params[k] = arrify(msg.msg_params[k])
+        # stock sender (no manifest): restore every KNOWN array-valued key
+        # of the protocol vocabulary (fedavg weights, split_nn acts/grads,
+        # fedgkt feats/logits, vfl sel, sparse idx/val) instead of only
+        # model_params — the decode-asymmetry fix for interop frames
+        for k, (dtype, kind) in cls._KNOWN_ARRAY_KEYS.items():
+            if k in msg.msg_params:
+                msg.msg_params[k] = arrify(msg.msg_params[k],
+                                           np.dtype(dtype), kind)
         return msg
 
     @classmethod

@@ -111,6 +111,23 @@ class FedAvgAggregator:
         return pack_pytree(self.net)
 
     # ------------------------------------------------------------- receive
+    # Decode-on-arrival: float upload leaves move to device as each frame
+    # arrives (jax.device_put is async — the H2D overlaps the clients still
+    # training) instead of all K at the round barrier, where ``aggregate``
+    # used to serialize every transfer under the round lock. Values are
+    # bit-exact either way. Subclasses whose aggregate works on the HOST
+    # representation (TurboAggregate's int64 Shamir shares, the robust
+    # clip's unpack/re-pack loop) opt out via the class attribute.
+    _stage_uploads_on_arrival = True
+
+    def _stage_upload(self, wire_leaves):
+        if not self._stage_uploads_on_arrival:
+            return wire_leaves
+        return [jax.device_put(v)
+                if isinstance(v, np.ndarray) and v.dtype == np.float32
+                else v
+                for v in wire_leaves]
+
     def begin_round(self, round_idx: int) -> None:
         """Stamp the round uploads are now accepted for (called by the
         server manager right before each broadcast)."""
@@ -141,7 +158,7 @@ class FedAvgAggregator:
                         "(tagged round %s, current %d)",
                         index, round_idx, self.current_round)
             return
-        self.model_dict[index] = wire_leaves
+        self.model_dict[index] = self._stage_upload(wire_leaves)
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded[index] = True
 

@@ -69,6 +69,7 @@ def run_simulated(
     aggregator_params: dict | None = None,
     sanitize: bool | float | None = None,
     adversary_plan=None,
+    warmup: bool = False,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -82,7 +83,14 @@ def run_simulated(
     worker ranks upload model-space attacks (sign_flip/scale/gaussian/
     nan/shift) on their scheduled rounds; pair with ``aggregator=``
     ('median', 'krum', ...) and the ``sanitize`` gate to run a replayable
-    attack-vs-defense experiment (docs/ROBUSTNESS.md)."""
+    attack-vs-defense experiment (docs/ROBUSTNESS.md).
+
+    ``warmup``: AOT-compile the client local-fit program through the
+    persistent compile cache (enable_compile_cache) before launching the
+    ranks — one rank's warm-up seeds the disk cache the sibling ranks (and
+    repeat runs) then deserialize from (docs/PERFORMANCE.md §Warm-up). Off
+    by default: on tiny test workloads the extra AOT pass costs more than
+    the compiles it saves."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -104,6 +112,12 @@ def run_simulated(
                         adversary_plan=adversary_plan, **kw)
             for rank in range(1, size)
         ]
+        if warmup and clients:
+            from fedml_tpu.utils.metrics import enable_compile_cache
+
+            enable_compile_cache()
+            # one rank compiles, every sibling deserializes from disk
+            clients[0].warmup()
         launch_simulated(server, clients)
     finally:
         if chaos_plan is not None:

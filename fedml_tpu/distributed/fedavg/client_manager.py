@@ -26,9 +26,18 @@ from fedml_tpu.obs.tracing import TRACE_KEY, ClientSpanBuffer
 class FedAvgClientManager(ClientManager):
     def __init__(self, trainer: DistributedTrainer, rank, size,
                  backend="LOOPBACK", sparsify_ratio: float | None = None,
-                 adversary_plan=None, **kw):
+                 adversary_plan=None, async_uplink: bool = True, **kw):
         self.trainer = trainer
         self.round_idx = 0
+        # async_uplink: uplink frame encoding (tree flatten + buffer copies
+        # + CRC32 + optional deflate) and transmission run on a FIFO sender
+        # worker (core/pipeline.AsyncSender) instead of the dispatch-loop
+        # thread — the thread that must be free to receive the next
+        # broadcast the moment an elastic server moves on without us. Wire
+        # bytes and ordering are identical; a send failure still kills the
+        # manager visibly (re-raised from the next submit / finish).
+        self.async_uplink = async_uplink
+        self._sender = None
         # model-space adversary (chaos/adversary.py): when this rank is in
         # the plan's schedule, its upload is perturbed AFTER the honest
         # local fit and BEFORE packing/sparsification — the Byzantine
@@ -109,4 +118,52 @@ class FedAvgClientManager(ClientManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
         if buf is not None:  # span buffer + clock stamps ride the uplink
             msg.add_params(TRACE_KEY, buf.upload_blob())
-        self.send_message(msg)
+        self._send_upload(msg)
+
+    def _send_upload(self, msg):
+        if not self.async_uplink:
+            self.send_message(msg)
+            return
+        if self._sender is None:  # lazy: only a manager that uploads pays
+            from fedml_tpu.core.pipeline import AsyncSender
+
+            self._sender = AsyncSender(self.send_message,
+                                       name=f"fedml-uplink-r{self.rank}",
+                                       on_error=self._on_uplink_error)
+        self._sender.submit(msg)
+
+    def _on_uplink_error(self, exc):
+        """Sender-worker failure hook (runs on the worker thread). Without
+        it a failed upload would HANG this rank: the next wake-up would be
+        a broadcast the server will never send (it is still waiting for the
+        upload that just died), so no submit/close remains to re-raise
+        from. Shut the manager down instead — the same visible-death
+        semantics the synchronous send path had."""
+        import logging
+
+        logging.getLogger("fedml_tpu.distributed.fedavg").error(
+            "rank %d: uplink send failed (%s) — shutting down instead of "
+            "waiting for a broadcast the server cannot send", self.rank, exc)
+        self._sender = None  # worker already dead; nothing left to flush
+        self.finish()
+
+    def warmup(self) -> dict | None:
+        """AOT-compile the local fit before run() blocks on the first
+        broadcast (engine.warmup() analogue; see DistributedTrainer.warmup)."""
+        if hasattr(self.trainer, "warmup"):
+            return self.trainer.warmup()
+        return None
+
+    def finish(self):
+        sender, self._sender = self._sender, None
+        try:
+            if sender is not None:
+                # flush the queued uplink (normally empty: FINISH only
+                # arrives after the server collected the last round) and
+                # surface any send failure before reporting a clean exit
+                sender.close()
+        finally:
+            # the transport must stop even when close() raises — a wedged
+            # sender should fail THIS rank loudly, not leak its receive
+            # loop as well
+            super().finish()

@@ -41,6 +41,51 @@ class DistributedTrainer:
 
         self.net = task.init(init_key, jnp.asarray(dataset.train_x[: cfg.batch_size]))
 
+    def warmup(self) -> dict:
+        """AOT-compile the local-fit program before the first broadcast
+        arrives, through the persistent compile cache (enable_compile_cache)
+        — the engine.warmup() analogue for the cross-process client: rank
+        1's warm-up populates the disk cache, so the N-1 sibling ranks of a
+        launch (and every later run) deserialize instead of recompiling.
+
+        fit() packs the ASSIGNED client's own batch depth (pack_clients
+        caps B per client), so heterogeneous partitions dispatch several
+        distinct shapes; warm the <=4 most-common depths (deepest kept, so
+        the max-size clients are always covered) — the long tail of rare
+        depths compiles lazily. Returns the compile report (see
+        core/pipeline.compile_concurrently)."""
+        from collections import Counter
+
+        import jax as _jax
+
+        from fedml_tpu.core.pipeline import compile_concurrently
+
+        if not getattr(_jax.config, "jax_compilation_cache_dir", None):
+            from fedml_tpu.utils.metrics import enable_compile_cache
+
+            enable_compile_cache()
+        bs = self.cfg.batch_size
+        counts = Counter(
+            min(self.num_batches, -(-len(ix) // bs))
+            for ix in self.dataset.train_idx_map.values())
+        counts.pop(0, None)  # empty clients dispatch nothing
+        depths = sorted(counts, key=lambda b: (-counts[b], -b))[:4]
+        deepest = max(counts) if counts else self.num_batches
+        if deepest not in depths:
+            depths = depths[:-1] + [deepest] if depths else [deepest]
+        tx, ty = self.dataset.train_x, self.dataset.train_y
+        rng = jax.random.PRNGKey(0)
+        lowered = {
+            f"local_fit_b{B}": self.local_update.lower(
+                rng, self.net,
+                np.zeros((B, bs) + tx.shape[1:], tx.dtype),
+                np.zeros((B, bs) + ty.shape[1:], ty.dtype),
+                np.zeros((B, bs), np.float32))
+            for B in sorted(depths)}
+        rep = compile_concurrently(lowered)
+        rep.pop("executables", None)
+        return rep
+
     def update_model(self, wire_leaves) -> None:
         self.net = unpack_pytree(self.net, wire_leaves)
 

@@ -33,6 +33,11 @@ from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
 
 
 class FedAvgRobustAggregator(FedAvgAggregator):
+    # the clipping defense unpacks/re-packs every upload host-side at the
+    # barrier (pack_pytree = np.asarray) — arrival-time device staging
+    # would just bounce each update device->host again under the lock
+    _stage_uploads_on_arrival = False
+
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
                  defense_type: str = "norm_diff_clipping",  # |'weak_dp'|'dp'|'none'
                  norm_bound: float = 30.0, stddev: float = 0.025,

@@ -74,6 +74,10 @@ class SecureTrainer(DistributedTrainer):
 class TAAggregator(FedAvgAggregator):
     """Sums share matrices in GF(p); reconstructs only the aggregate."""
 
+    # Shamir shares are int64 host math (mod-p numpy) — device staging at
+    # arrival would buy nothing and jnp would truncate the field elements
+    _stage_uploads_on_arrival = False
+
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
                  n_shares=5, threshold_t=2, quant_scale=2**16):
         super().__init__(dataset, task, cfg, worker_num)

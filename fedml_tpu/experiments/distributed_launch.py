@@ -67,6 +67,13 @@ def add_args(p: argparse.ArgumentParser):
                    help="mqtt: namespaces topics so jobs sharing a "
                         "persistent broker cannot cross-talk; every rank of "
                         "a job must pass the same value")
+    p.add_argument("--warmup", type=int, default=1,
+                   help="client ranks: AOT-compile the local-fit program "
+                        "(through the persistent compile cache) before "
+                        "entering the receive loop, so the first broadcast "
+                        "hits a warm executable instead of paying the "
+                        "compile inside round 0 (docs/PERFORMANCE.md; "
+                        "--warmup 0 restores lazy first-round compiles)")
     p.add_argument("--timeout_s", type=float, default=None,
                    help="failure-detection watchdog (server logs stragglers)")
     p.add_argument("--round_timeout_s", type=float, default=None,
@@ -329,6 +336,17 @@ def main(argv=None):
         telemetry = Telemetry(log_dir=args.telemetry_dir or args.trace_dir,
                               trace_dir=args.trace_dir)
     mgr = init_role(args, data, task, cfg, backend_kw, telemetry=telemetry)
+    if args.warmup and args.rank != 0 and hasattr(mgr, "warmup"):
+        # AOT-compile before blocking on the first broadcast; rides the
+        # persistent compile cache enabled above, so across launches (and
+        # across this launch's ranks on one host) only one rank pays the
+        # real compile
+        rep = mgr.warmup()
+        if rep:
+            logging.getLogger("fedml_tpu.launch").info(
+                "warmup: %s in %.2fs (%d fresh compiles, %d cache hits)",
+                rep.get("variants"), rep.get("seconds", 0.0),
+                rep.get("fresh_compiles", 0), rep.get("cache_hits", 0))
     try:
         mgr.run()
     finally:

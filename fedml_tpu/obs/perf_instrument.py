@@ -1,0 +1,149 @@
+"""Performance instrumentation — XLA compile accounting + pipeline metrics.
+
+Two metric groups land in the process-wide ``metrics.REGISTRY``:
+
+**Compile accounting** (fed by ``jax.monitoring`` listeners, installed once
+per process by :func:`install`):
+
+    fed_xla_compiles_total            backend compile passes — every
+                                      ``/jax/core/compile/backend_compile_
+                                      duration`` event. NOTE: on this jax a
+                                      persistent-cache HIT still records
+                                      one (the deserialize is timed under
+                                      the same event), so a FRESH compile
+                                      is defined by the cache counters
+                                      below, not this one
+    fed_xla_compile_seconds           (histogram) per-pass wall clock
+    fed_xla_cache_requests_total      compile requests that consulted the
+                                      persistent cache (0 = cache off)
+    fed_xla_cache_hits_total          persistent compile-cache hits
+    fed_xla_cache_misses_total        persistent compile-cache misses —
+                                      the real "fresh compile" count when
+                                      the cache is enabled
+
+``engine.warmup()`` (algorithms/fedavg.py) diffs these around its AOT
+compile pass, which is how the "repeat run performs zero fresh compiles"
+contract is asserted rather than assumed: with the cache enabled,
+fresh = cache misses; with it off (requests delta 0), fresh = compile
+passes.
+
+**Pipeline metrics** (fed by core/pipeline.py and the pipelined drivers):
+
+    fed_h2d_seconds                   (histogram) host time issuing a round
+                                      batch's host->device transfers —
+                                      the device_put call, not the DMA
+                                      itself (which is async on TPU)
+    fed_prefetch_stall_seconds        (histogram) time the round driver
+                                      waited for the prefetch thread — 0 on
+                                      every round means the accelerator
+                                      never saw a host-side pack stall
+    fed_dispatch_depth                (gauge) rounds dispatched but not yet
+                                      drained — the async-dispatch depth;
+                                      the pipeline keeps this >= drain_lag
+
+All hooks are host-side and cheap (a dict lookup + float add via memoized
+children, same pattern as obs/comm_instrument.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import lru_cache
+
+from fedml_tpu.obs.metrics import REGISTRY
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+@lru_cache(maxsize=8)
+def _counter(name: str):
+    return REGISTRY.counter(name)
+
+
+@lru_cache(maxsize=8)
+def _hist(name: str):
+    return REGISTRY.histogram(name)
+
+
+@lru_cache(maxsize=64)
+def _span_hist(name: str):
+    # the SAME family RoundTracer spans feed (obs/tracing.py) so the
+    # prefetch thread's pack/transfer spans and the engine's host spans
+    # read through one Prometheus name
+    return REGISTRY.histogram("fed_span_seconds", span=name)
+
+
+# ------------------------------------------------------ compile accounting
+def _on_event(name: str, **kw) -> None:
+    if name == "/jax/compilation_cache/cache_hits":
+        _counter("fed_xla_cache_hits_total").inc()
+    elif name == "/jax/compilation_cache/cache_misses":
+        _counter("fed_xla_cache_misses_total").inc()
+    elif name == "/jax/compilation_cache/compile_requests_use_cache":
+        _counter("fed_xla_cache_requests_total").inc()
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    if name.endswith("/backend_compile_duration"):
+        _counter("fed_xla_compiles_total").inc()
+        _hist("fed_xla_compile_seconds").observe(secs)
+
+
+def install() -> bool:
+    """Register the jax.monitoring listeners feeding the compile counters.
+    Idempotent (listeners cannot be individually unregistered, so exactly
+    one pair is ever installed); returns False when jax.monitoring is
+    unavailable (counters then stay at 0 — callers must treat a 0 as
+    "uninstrumented", not "no compiles")."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return True
+        try:
+            from jax import monitoring
+        except Exception:  # noqa: BLE001 — instrumentation is best-effort
+            return False
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _installed = True
+        return True
+
+
+def compiles_total() -> float:
+    """XLA backend compile passes so far (callers diff around a phase;
+    includes cache-hit deserializes — see module docstring)."""
+    return REGISTRY.total("fed_xla_compiles_total")
+
+
+def cache_hits_total() -> float:
+    return REGISTRY.total("fed_xla_cache_hits_total")
+
+
+def cache_misses_total() -> float:
+    return REGISTRY.total("fed_xla_cache_misses_total")
+
+
+def cache_requests_total() -> float:
+    return REGISTRY.total("fed_xla_cache_requests_total")
+
+
+# ------------------------------------------------------- pipeline metrics
+def record_h2d(seconds: float) -> None:
+    _hist("fed_h2d_seconds").observe(seconds)
+    _span_hist("h2d").observe(seconds)
+
+
+def record_prefetch_stall(seconds: float) -> None:
+    _hist("fed_prefetch_stall_seconds").observe(seconds)
+
+
+def set_dispatch_depth(n: int) -> None:
+    REGISTRY.gauge("fed_dispatch_depth").set(n)
+
+
+def record_span(name: str, seconds: float) -> None:
+    """A host span observed off the engine's RoundTracer (the prefetch
+    thread must not touch the tracer's per-round dict — see
+    docs/PERFORMANCE.md §Tracing caveat)."""
+    _span_hist(name).observe(seconds)

@@ -51,8 +51,14 @@ def render_table(records: list[dict]) -> str:
             "round": r["round"],
             "clients": len(r.get("clients", [])) or None,
             "round_s": sp.get("round"),
-            "pack_s": sp.get("pack"),
+            "pack_s": sp.get("pack") or sp.get("prefetch_pack"),
             "agg_s": sp.get("aggregate"),
+            # pipelined rounds (docs/PERFORMANCE.md): host stall waiting on
+            # the prefetch thread, H2D issue time, and the async-dispatch
+            # depth at push — columns hide on non-pipelined logs
+            "stall_s": sp.get("prefetch_stall"),
+            "h2d_s": sp.get("h2d"),
+            "depth": (r.get("pipeline") or {}).get("depth"),
             "loss": (m["loss_sum"] / n) if "loss_sum" in m else None,
             "upd_norm": m.get("update_norm"),
             "drift": m.get("client_drift_mean"),

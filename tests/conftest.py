@@ -109,6 +109,11 @@ _SMOKE_TESTS = {
     "test_partition.py::test_dirichlet_partition_properties",
     "test_data_extras.py::test_synthetic_leaf_exact_split_reconstruction",
     "test_param_parity.py::test_cnn_original_fedavg_param_counts",
+    # round-6 additions: pipelined round execution (docs/PERFORMANCE.md) —
+    # the prefetch-on ≡ prefetch-off identity AND the overlap oracle
+    "test_round_pipeline.py::test_prefetch_on_equals_off_per_round",
+    "test_round_pipeline.py::test_round_r_plus_1_transfer_before_round_r_drain",
+    "test_round_pipeline.py::test_warmup_compiles_all_bucket_variants",
 }
 
 

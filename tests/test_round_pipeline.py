@@ -1,0 +1,394 @@
+"""Pipelined round execution oracles (core/pipeline.py + the FedAvg drivers).
+
+The pipeline's contract is double-sided and both sides are asserted here:
+
+- **identity**: prefetch on ≡ prefetch off, bit for bit — final model bits
+  AND quarantine-ledger entries, per-round and block paths, with and
+  without a mesh (packing is a pure function of (seed, round), the rng
+  chain goes through the same _dispatch_round, and drains flush in order);
+- **overlap**: the pipeline actually overlaps — round r+1's host->device
+  transfer is issued BEFORE round r's metrics are fetched (the
+  instrumented-event ordering test), which is the property the identity
+  tests alone could fake with a fully serial implementation.
+
+Plus the warm-up contract: engine.warmup() AOT-compiles every bucket
+variant concurrently, and a repeat warm-up against the persistent compile
+cache performs zero fresh compiles (compile-count instrumentation from
+obs/perf_instrument, not wall-clock guesswork).
+"""
+
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.pipeline import (
+    AsyncSender,
+    InflightRing,
+    Prefetcher,
+    compile_concurrently,
+)
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+
+
+@pytest.fixture(scope="module")
+def lr_data():
+    return synthetic_lr(num_clients=8, dim=20, num_classes=5, seed=0)
+
+
+@pytest.fixture(scope="module")
+def lr_task():
+    return classification_task(LogisticRegression(num_classes=5))
+
+
+def _cfg(**kw):
+    base = dict(comm_round=6, client_num_in_total=8, client_num_per_round=4,
+                epochs=1, batch_size=16, lr=0.05, seed=0, max_batches=4,
+                frequency_of_the_test=100)
+    base.update(kw)
+    return FedAvgConfig(**base)
+
+
+def _leaves(api):
+    return [np.asarray(v) for v in jax.tree.leaves(api.net.params)]
+
+
+def _assert_bitwise(a, b, what="final model"):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y, err_msg=f"{what} diverged")
+
+
+# ---------------------------------------------------------------- identity
+def test_prefetch_on_equals_off_per_round(lr_data, lr_task):
+    """Per-round path: 6 pipelined rounds ≡ 6 synchronous rounds, model
+    bits AND quarantine-ledger entries (a NaN adversary populates the
+    ledger so the comparison is non-vacuous)."""
+    from fedml_tpu.chaos import AdversaryPlan
+
+    plan = AdversaryPlan.from_json(
+        {"seed": 3, "rules": [{"attack": "nan", "ranks": [2]}]})
+    kw = dict(sanitize=True, adversary_plan=plan)
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), **kw)
+    for r in range(6):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), prefetch=2, **kw)
+    out = b.run_pipelined(0, 6)
+    _assert_bitwise(a, b)
+    assert [r for r, _ in out] == list(range(6))  # drained in order
+    assert a.quarantine.canonical(), "adversary never quarantined"
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+
+
+def test_prefetch_on_equals_off_per_round_mesh(lr_data, lr_task, mesh8):
+    cfg = _cfg(client_num_per_round=8)
+    a = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh8)
+    for r in range(4):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh8, prefetch=2)
+    b.run_pipelined(0, 4)
+    _assert_bitwise(a, b, "mesh per-round")
+
+
+def test_prefetch_on_equals_off_block(lr_data, lr_task):
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), device_data=True)
+    a.run_rounds(0, 3)
+    a.run_rounds(3, 3)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), device_data=True, prefetch=2)
+    out = b.run_blocks_pipelined(0, 2, 3)
+    _assert_bitwise(a, b, "block")
+    assert [s for s, _ in out] == [0, 3]
+
+
+def test_prefetch_on_equals_off_block_mesh(lr_data, lr_task, mesh8):
+    cfg = _cfg(client_num_per_round=8)
+    a = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh8, device_data=True)
+    a.run_rounds(0, 3)
+    a.run_rounds(3, 3)
+    b = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh8, device_data=True,
+                  prefetch=2)
+    b.run_blocks_pipelined(0, 2, 3)
+    _assert_bitwise(a, b, "mesh block")
+
+
+def test_pipelined_train_matches_sequential_history(lr_data, lr_task):
+    """train() with the pipeline armed: same model bits AND the same eval
+    history records (eval rounds drain the ring for their own metrics)."""
+    cfg = _cfg(frequency_of_the_test=3)
+    a = FedAvgAPI(lr_data, lr_task, cfg)
+    a.train(6)
+    b = FedAvgAPI(lr_data, lr_task, cfg, prefetch=2)
+    b.train(6)
+    _assert_bitwise(a, b, "train()")
+    ka = [(h["round"], h["train_loss"], h["test_acc"]) for h in a.history]
+    kb = [(h["round"], h["train_loss"], h["test_acc"]) for h in b.history]
+    assert ka == kb
+
+
+def test_pack_round_host_is_stateless(lr_data, lr_task):
+    """Satellite: the dense host pack comes from an explicit argument, not
+    a mutate-self-and-restore toggle (which would race with the packer
+    thread) — and it never flips the engine's device_data flag."""
+    api = FedAvgAPI(lr_data, lr_task, _cfg(), device_data=True)
+    cb = api._pack_round_host(0)
+    assert hasattr(cb, "x") and api.device_data is True
+    ib = api._pack_round(0)
+    assert hasattr(ib, "idx")  # engine plane unchanged
+    np.testing.assert_array_equal(np.asarray(cb.num_samples),
+                                  np.asarray(ib.num_samples))
+
+
+# ----------------------------------------------------------------- overlap
+def test_round_r_plus_1_transfer_before_round_r_drain(lr_data, lr_task):
+    """The overlap oracle: the prefetch thread finishes round r+1's pack +
+    device_put ('produced', fired after the H2D issue) before the driver
+    fetches round r's metrics ('drained'). A serial implementation that
+    packs on demand and syncs every round cannot produce this order."""
+    api = FedAvgAPI(lr_data, lr_task, _cfg(), prefetch=2)
+    events = []
+    api._pipe_on_event = lambda kind, key: events.append((kind, key))
+    api.run_pipelined(0, 6)
+    for r in range(5):
+        produced = events.index(("produced", r + 1))
+        drained = events.index(("drained", r))
+        assert produced < drained, (
+            f"round {r + 1}'s H2D was issued after round {r}'s drain — "
+            f"no overlap: {events}")
+    # drains trail dispatch by drain_lag and flush in order
+    drains = [k for kind, k in events if kind == "drained"]
+    assert drains == list(range(6))
+
+
+def test_dispatch_depth_gauge_and_record(lr_data, lr_task):
+    """fed_dispatch_depth is exported, and each drained round record
+    carries the pipeline depth + prefetch/h2d spans (what report.py
+    renders)."""
+    from fedml_tpu.obs import Telemetry
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    tel = Telemetry()  # in-memory sink
+    api = FedAvgAPI(lr_data, lr_task, _cfg(), prefetch=2, telemetry=tel)
+    api.run_pipelined(0, 5)
+    recs = [r for r in tel.events.sink.records if r.get("kind") == "round"]
+    assert [r["round"] for r in recs] == list(range(5))
+    for r in recs:
+        assert r["pipeline"]["depth"] >= 1
+        assert "prefetch_pack" in r["spans"] and "h2d" in r["spans"]
+    snap = REGISTRY.snapshot()
+    assert "fed_dispatch_depth" in snap
+    assert "fed_prefetch_stall_seconds" in snap
+    assert "fed_h2d_seconds" in snap
+
+
+# ------------------------------------------------------------------ warmup
+def test_warmup_compiles_all_bucket_variants(lr_data, lr_task, tmp_path,
+                                             monkeypatch):
+    """warmup() AOT-compiles every ladder bucket (+ block variants), and a
+    repeat warm-up on the persistent cache performs ZERO fresh compiles —
+    asserted via the compile-count instrumentation, not assumed."""
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    # tiny test programs compile in <1s — persist them anyway so the
+    # repeat-run contract is observable at test scale
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        a = FedAvgAPI(lr_data, lr_task, _cfg(), device_data=True,
+                      bucket_batches=True)
+        rep = a.warmup(block_rounds=3)
+        ladder = a._b_ladder
+        assert len(ladder) > 1, "ladder degenerate — bucket oracle vacuous"
+        for B in ladder:
+            assert f"round_b{B}" in rep["variants"]
+            assert f"block_r3_b{B}" in rep["variants"]
+        if not rep["instrumented"]:
+            pytest.skip("jax.monitoring unavailable")
+        assert rep["fresh_compiles"] > 0  # cold cache really compiled
+        b = FedAvgAPI(lr_data, lr_task, _cfg(), device_data=True,
+                      bucket_batches=True)
+        rep2 = b.warmup(block_rounds=3)
+        assert rep2["variants"] == rep["variants"]
+        assert rep2["fresh_compiles"] == 0, rep2
+        assert rep2["cache_hits"] >= len(rep2["variants"])
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_min)
+
+
+def test_compile_concurrently_uses_thread_pool():
+    """The <=4 variants compile CONCURRENTLY (thread pool), not serially."""
+    seen = []
+    barrier = threading.Barrier(3, timeout=10)
+
+    class FakeLowered:
+        def compile(self):
+            seen.append(threading.get_ident())
+            barrier.wait()  # deadlocks unless 3 compiles run concurrently
+            return "exe"
+
+    rep = compile_concurrently({f"v{i}": FakeLowered() for i in range(3)})
+    assert len(set(seen)) == 3
+    assert rep["variants"] == ["v0", "v1", "v2"]
+    assert set(rep["executables"].values()) == {"exe"}
+
+
+# ------------------------------------------------------------- primitives
+def test_prefetcher_orders_and_surfaces_errors():
+    out = []
+    pf = Prefetcher(lambda k: k * 10, range(5), depth=2)
+    for k in range(5):
+        item, stall = pf.get(k)
+        assert item == k * 10 and stall >= 0.0
+        out.append(item)
+    pf.close()
+    assert out == [0, 10, 20, 30, 40]
+
+    def boom(k):
+        if k == 1:
+            raise ValueError("pack failed")
+        return k
+
+    pf = Prefetcher(boom, range(3), depth=2)
+    assert pf.get(0)[0] == 0
+    with pytest.raises(RuntimeError, match="prefetch"):
+        pf.get(1)
+    pf.close()
+
+
+def test_inflight_ring_lag_semantics():
+    drained = []
+    ring = InflightRing(2, lambda k, e: drained.append((k, e)) or k)
+    assert ring.push(0, "a") == []
+    assert ring.push(1, "b") == []
+    assert ring.push(2, "c") == [0]  # exceeds lag 2 -> oldest drains
+    assert ring.push(3, "d") == [1]
+    assert ring.drain_all() == [2, 3]
+    assert drained == [(0, "a"), (1, "b"), (2, "c"), (3, "d")]
+
+
+def test_async_sender_preserves_order_and_raises():
+    sent = []
+    s = AsyncSender(lambda m: (time.sleep(0.001), sent.append(m)))
+    for i in range(20):
+        s.submit(i)
+    s.close()
+    assert sent == list(range(20))
+
+    def flaky(m):
+        if m == 2:
+            raise ConnectionError("link down")
+        sent.append(m)
+
+    s = AsyncSender(flaky)
+    for i in range(3):
+        s.submit(i)
+    with pytest.raises(RuntimeError, match="sender"):
+        s.close()
+
+
+def test_async_sender_on_error_hook_fires():
+    """A failed send must fire on_error on the worker thread — the owner's
+    only wake-up when no further submit/close is coming (a client whose
+    upload died will never see the next broadcast; the hook is what stops
+    it hanging forever)."""
+    fired = []
+
+    def boom(_m):
+        raise ConnectionError("link down")
+
+    s = AsyncSender(boom, on_error=lambda e: fired.append(type(e).__name__))
+    s.submit("x")
+    deadline = time.time() + 5
+    while not fired and time.time() < deadline:
+        time.sleep(0.01)
+    assert fired == ["ConnectionError"]
+    with pytest.raises(RuntimeError, match="sender"):
+        s.close()
+
+
+# -------------------------------------------------------- cross-process
+def test_loopback_async_uplink_equals_sync(lr_data, lr_task):
+    """The sender worker changes WHERE encoding runs, never the bytes or
+    the aggregate: async-uplink run ≡ sync-uplink run, bit for bit."""
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.distributed.fedavg.api import init_client, init_server
+    from fedml_tpu.distributed.utils import launch_simulated
+
+    cfg = _cfg(comm_round=3, client_num_per_round=2, frequency_of_the_test=1)
+
+    def run(job, async_uplink):
+        size = cfg.client_num_per_round + 1
+        server = init_server(lr_data, lr_task, cfg, size, "LOOPBACK",
+                             job_id=job)
+        clients = [init_client(lr_data, lr_task, cfg, r, size, "LOOPBACK",
+                               job_id=job, async_uplink=async_uplink)
+                   for r in range(1, size)]
+        launch_simulated(server, clients)
+        return server.aggregator
+
+    a = run("pipe-async-on", True)
+    b = run("pipe-async-off", False)
+    for x, y in zip(pack_pytree(a.net), pack_pytree(b.net)):
+        np.testing.assert_array_equal(x, y)
+    assert a.history == b.history
+
+
+def test_trainer_warmup_compiles_local_fit(lr_data, lr_task, tmp_path):
+    from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+
+    old_dir = jax.config.jax_compilation_cache_dir
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    try:
+        tr = DistributedTrainer(1, lr_data, lr_task, _cfg())
+        rep = tr.warmup()
+        # equal-size synthetic clients -> exactly one batch depth, and it
+        # must be the depth fit() actually dispatches (the deepest)
+        assert len(rep["variants"]) == 1
+        assert rep["variants"][0] == f"local_fit_b{tr.num_batches}"
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+
+
+# --------------------------------------------------------------- satellite
+def test_json_codec_arrifies_known_keys_without_manifest():
+    """ADVICE r5 item 1: a manifest-less json frame (stock peer) must come
+    back with ndarrays for EVERY known protocol array key — split_nn
+    acts/grads, fedgkt feats/logits, vfl sel, sparse idx/val — not just
+    model_params."""
+    from fedml_tpu.comm.message import Message
+
+    doc = {
+        "msg_type": "split_c2s_acts", "sender": 1, "receiver": 0,
+        "acts": [[0.5, 1.5], [2.5, 3.5]],
+        "grads": [[1.0, -1.0]],
+        "feats": [[0.25]],
+        "logits": [0.1, 0.2, 0.7],
+        "labels": [1, 2],
+        "mask": [1.0, 0.0],
+        "sel": [3, 1, 2],
+        "sparse_idx": [[0, 2]],
+        "sparse_val": [[0.5, -1.0]],
+        "model_params": [[1.0, 2.0], [3.0]],
+        "num_samples": 12,
+    }
+    msg = Message.from_bytes(json.dumps(doc).encode())
+    p = msg.get_params()
+    assert p["acts"].dtype == np.float32 and p["acts"].shape == (2, 2)
+    assert p["grads"].shape == (1, 2)
+    assert p["logits"].shape == (3,)
+    assert p["labels"].dtype == np.int64
+    assert p["sel"].dtype == np.int64 and p["sel"].tolist() == [3, 1, 2]
+    assert isinstance(p["sparse_idx"], list)
+    assert p["sparse_idx"][0].dtype == np.int32
+    assert p["sparse_val"][0].dtype == np.float32
+    assert isinstance(p["model_params"], list)
+    assert [a.tolist() for a in p["model_params"]] == [[1.0, 2.0], [3.0]]
+    assert p["num_samples"] == 12  # scalars untouched

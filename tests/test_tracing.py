@@ -137,6 +137,13 @@ def _build_golden_trace():
     both report, rank 2 (fewer spans -> later T3 relative to fake-clock
     ticks) straggles. Ids are sha256 of (run, round, rank, counter) and
     the clock is injected, so the export is byte-stable."""
+    from fedml_tpu.obs import comm_instrument as _ci
+
+    # an earlier test's loopback sim may have run a dispatch loop on THIS
+    # thread, leaving a thread-local last-dispatch latency behind — which
+    # ClientSpanBuffer.span would dutifully attach as a queue_wait attr and
+    # break the byte-stable golden comparison (order-dependent flake)
+    _ci._tls.last_dispatch_s = None
     clock = _fixed_clock()
     tr = DistributedTracer("golden-run", clock=clock)
     tr.begin_round(0)
