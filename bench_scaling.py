@@ -32,7 +32,10 @@ _MEMO: dict = {}
 
 
 def _one_point(args, data, task, k):
+    import os
+
     import jax
+    import numpy as np
 
     from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
 
@@ -42,8 +45,29 @@ def _one_point(args, data, task, k):
         frequency_of_the_test=10_000, max_batches=args.max_batches,
         remat=bool(args.remat),
     )
+    # FEDML_BENCH_SHARDED_AGG=0|1 — the replicated-vs-sharded server-state
+    # A/B (docs/PERFORMANCE.md §Partitioned server state): both legs run
+    # the SAME mesh over every local device (so the comparison isolates
+    # the server-plane layout, not mesh-vs-single-chip), 1 additionally
+    # partitions the global model per the rule table. Unset = the
+    # historical single-chip sweep, untouched.
+    sharded_env = os.environ.get("FEDML_BENCH_SHARDED_AGG")
+    mesh, shard = None, False
+    if sharded_env is not None:
+        ndev = jax.device_count()
+        if ndev > 1 and k % ndev == 0:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()), ("clients",))
+            # same lenient spelling as bench.py's FEDML_BENCH_PIPELINE
+            shard = sharded_env != "0"
+        else:
+            why = ("only one device visible" if ndev <= 1
+                   else f"k={k} not a multiple of {ndev} devices")
+            print(f"bench_scaling: FEDML_BENCH_SHARDED_AGG set but {why} "
+                  "— point runs unmeshed", file=sys.stderr)
     api = FedAvgAPI(data, task, cfg, device_data=bool(args.device_data),
-                    donate=True,
+                    donate=True, mesh=mesh, shard_server_state=shard,
                     block_working_set=bool(args.device_data)
                     and bool(args.working_set))
 
@@ -84,6 +108,23 @@ def _one_point(args, data, task, k):
         "dtype": "bf16" if args.bf16 else "f32",
         "remat": bool(args.remat),
     }
+    if mesh is not None:
+        # per-device memory stats for the A/B blob: the rule-table figure
+        # (exact, what fed_server_state_bytes exports) plus the backend's
+        # live allocator view where it exists (TPU; CPU returns nothing)
+        rec["server_state"] = {
+            "mode": api._state_placement,
+            "bytes_per_device": api._agg_record[
+                "server_state_bytes_per_device"],
+            "devices": int(np.prod(list(mesh.shape.values()))),
+        }
+        try:
+            mstats = jax.devices()[0].memory_stats() or {}
+            if "bytes_in_use" in mstats:
+                rec["server_state"]["device0_bytes_in_use"] = int(
+                    mstats["bytes_in_use"])
+        except Exception:  # noqa: BLE001 — allocator stats are best-effort
+            pass
     # MFU vs bf16 peak (TPU only): XLA's own FLOP count of the compiled
     # forward on one batch, 3x-forward train accounting (utils/flops.py).
     # Memoized: the forward is identical across every sweep point.

@@ -47,6 +47,7 @@ from fedml_tpu.core.client_data import (
     pad_index_batches,
 )
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
+from fedml_tpu.core.partition_rules import tree_bytes as _tree_bytes
 from fedml_tpu.core.pipeline import (
     InflightRing,
     Prefetcher,
@@ -108,19 +109,27 @@ def round_stats(old_net, new_net, nets, avg, nsamp) -> dict:
       non-IID dispersion statistic FedProx/FedNova papers reason about.
     """
     out = {"update_norm": _update_norm(new_net.params, old_net.params)}
-    # [K] per-client squared distances to the aggregate
-    drift_sq = sum(
-        (jnp.sum((s - a) ** 2, axis=tuple(range(1, s.ndim)))
-         for s, a in zip(jax.tree.leaves(nets.params),
-                         jax.tree.leaves(avg.params))),
-        jnp.zeros(nsamp.shape),
-    )
-    drift = jnp.sqrt(drift_sq)
-    real = (nsamp > 0).astype(drift.dtype)
+    drift, real = _client_drift(nets.params, avg.params, nsamp)
     n_real = jnp.maximum(jnp.sum(real), 1.0)
     out["client_drift_mean"] = jnp.sum(drift * real) / n_real
     out["client_drift_max"] = jnp.max(drift * real)
     return out
+
+
+def _client_drift(net_params, avg_params, nsamp):
+    """[K] per-client ||net_k - avg|| over params plus the real-client mask
+    (zero-sample padding excluded) — the ONE definition of client drift.
+    ``round_stats`` reduces it locally; ``_mesh_drift_stats`` via
+    psum/pmax, so the two stay in sync by construction."""
+    drift_sq = sum(
+        (jnp.sum((s - a) ** 2, axis=tuple(range(1, s.ndim)))
+         for s, a in zip(jax.tree.leaves(net_params),
+                         jax.tree.leaves(avg_params))),
+        jnp.zeros(nsamp.shape),
+    )
+    drift = jnp.sqrt(drift_sq)
+    real = (nsamp > 0).astype(drift.dtype)
+    return drift, real
 
 
 def agg_weights(nsamp, uniform: bool):
@@ -131,6 +140,23 @@ def agg_weights(nsamp, uniform: bool):
     if not uniform:
         return nsamp
     return jnp.where(nsamp > 0, jnp.ones_like(nsamp), jnp.zeros_like(nsamp))
+
+
+def _mesh_drift_stats(net_params, avg_params, nsamp, axis) -> dict:
+    """The client-drift half of ``round_stats`` under shard_map: each
+    device computes its client shard's ||net_k - avg|| distances and the
+    mean/max are psum/pmax-reduced over the mesh — so the mesh paths emit
+    the SAME record keys as the standalone engine instead of only a
+    partial stat set (``update_norm`` joins outside, where the updated
+    params exist). Zero-sample padding is excluded exactly as in
+    ``round_stats`` (shared ``_client_drift``)."""
+    drift, real = _client_drift(net_params, avg_params, nsamp)
+    n_real = jnp.maximum(jax.lax.psum(jnp.sum(real), axis), 1.0)
+    return {
+        "client_drift_mean": jax.lax.psum(jnp.sum(drift * real), axis)
+        / n_real,
+        "client_drift_max": jax.lax.pmax(jnp.max(drift * real), axis),
+    }
 
 
 def _shard_aggregate(nets, metrics, nsamp, axis):
@@ -267,6 +293,8 @@ class FedAvgAPI:
         adversary_plan=None,
         prefetch: int = 0,
         drain_lag: int = 2,
+        shard_server_state: bool = False,
+        partition_rules=None,
     ):
         self.data = dataset
         self.task = task
@@ -431,7 +459,49 @@ class FedAvgAPI:
             extra = jax.tree.map(lambda v: jax.device_put(v, rep),
                                  self.net.extra)
             self.net = self.net._replace(params=params, extra=extra)
+        # Mesh-sharded server state (core/partition_rules.py,
+        # docs/PERFORMANCE.md §Partitioned server state): the global model
+        # + server optimizer state live PARTITIONED over the client mesh
+        # axis per a regex partition-rule table; the round program
+        # constrains the aggregate and the updated state to that layout, so
+        # XLA reduce-scatters the weighted update sum into each device's
+        # shard, runs the server update shard-locally, and all-gathers only
+        # at the broadcast into the next round's local fits
+        # (arXiv:2004.13336). Bitwise-identical to the replicated mesh path
+        # (test-enforced: resharding moves bits, the psum aggregation math
+        # is byte-for-byte the same program).
+        self._sharded = bool(shard_server_state)
+        self.partitioner = None
+        self._agg_reshard = None
+        if self._sharded:
+            if mesh is None:
+                raise ValueError("shard_server_state partitions the server "
+                                 "plane over a mesh — pass mesh=")
+            if self._tp:
+                raise ValueError(
+                    "shard_server_state composes with the pure 'clients' "
+                    "mesh; a ('clients','model') TP mesh already shards "
+                    "params over 'model'")
+            from fedml_tpu.core.partition_rules import ServerStatePartitioner
+            from fedml_tpu.core.robust_agg import COORDINATEWISE
+
+            self.partitioner = ServerStatePartitioner(
+                mesh, rules=partition_rules)
+            self.net = self.partitioner.shard(self.net)
+            # coordinate-wise estimators run shard-local after an
+            # all-to-all to param-sharded stacked layout (specs derived
+            # from the NET template so custom rule tables apply);
+            # krum/geo-median keep the gathered path (COORDINATEWISE)
+            if isinstance(aggregator, str) and aggregator in COORDINATEWISE:
+                self._agg_reshard = self.partitioner.stacked_constrainer(
+                    self.net)
         self.server_opt_state = server_opt_init(self.net.params) if server_opt_init else ()
+        if self._sharded and server_opt_init is not None:
+            # fedopt-style server optimizer state (momenta mirror the param
+            # tree) shards by the same rule table — the Adam moments are
+            # the 2x multiplier that makes sharding the server plane matter
+            self.server_opt_state = self.partitioner.shard(
+                self.server_opt_state)
 
         self.round_fn = self._build_round_fn()
         self._test_cache = None
@@ -442,6 +512,28 @@ class FedAvgAPI:
         # here touches the jitted round program)
         self.tracer = RoundTracer(
             sink=telemetry.tracer if telemetry is not None else None)
+        # server-plane sizing + per-round aggregation-bytes accounting
+        # (obs/perf_instrument: fed_server_state_bytes{placement} /
+        # fed_agg_bytes_total{mode}) — the metrics the sharded-vs-
+        # replicated HBM claim is asserted on
+        # sized component-by-component: one (net, opt) tuple would prefix
+        # every leaf path with '0/'/'1/' and anchored custom rules would
+        # resolve differently here than they did in shard()
+        per_dev = (
+            self.partitioner.bytes_per_device(self.net)
+            + self.partitioner.bytes_per_device(self.server_opt_state)
+            if self._sharded
+            else _tree_bytes((self.net, self.server_opt_state)))
+        self._state_placement = "sharded" if self._sharded else "replicated"
+        self._agg_bytes_round = (_tree_bytes(self.net)
+                                 * config.client_num_per_round)
+        _perf.set_server_state_bytes(self._state_placement, per_dev)
+        # rides every telemetry round record (report.py renders srv_B/mode)
+        self._agg_record = {
+            "mode": self._state_placement,
+            "server_state_bytes_per_device": int(per_dev),
+            "bytes_per_round": int(self._agg_bytes_round),
+        }
 
     # ------------------------------------------------------------------ round
     def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp,
@@ -479,16 +571,18 @@ class FedAvgAPI:
         if self._needs_stacked:
             # gate -> estimator -> suspected merge -> all-rejected
             # fallback, via the ONE composition both runtimes share
-            # (core/robust_agg.gated_aggregate)
+            # (core/robust_agg.gated_aggregate). With a sharded server
+            # state, coordinate-wise estimators get the partitioner's
+            # stacked-layout constraint so their sorts run shard-local.
             avg, _, reasons = gated_aggregate(
                 nets, net, self._agg_weights(nsamp),
-                robust_fn=self._robust_agg, norm_mult=self._sanitize_mult)
+                robust_fn=self._robust_agg, norm_mult=self._sanitize_mult,
+                reshard_fn=self._agg_reshard)
         else:
             avg = tree_weighted_mean(nets, self._agg_weights(nsamp))
             reasons = None
-        new_net, new_opt = self.server_update(net, avg, server_opt_state)
-        if self.post_aggregate_hook is not None:
-            new_net = self.post_aggregate_hook(new_net, post_key)
+        new_net, new_opt = self._update_from_aggregate(
+            net, avg, server_opt_state, post_key)
         agg_metrics = {k: jnp.sum(v) for k, v in metrics.items()}
         if self._emit_stats:
             agg_metrics.update(round_stats(net, new_net, nets, avg, nsamp))
@@ -497,6 +591,27 @@ class FedAvgAPI:
             # popped host-side into the quarantine ledger (never floated)
             agg_metrics["__quarantine"] = reasons
         return new_net, new_opt, agg_metrics
+
+    def _update_from_aggregate(self, net, avg, server_opt_state, post_key):
+        """constrain(aggregate) -> server_update -> post hook ->
+        constrain(new state): the ONE server-side update composition every
+        driver dispatches (stacked/robust, mesh per-round, sharded block).
+        The sharded constraint points live only here, so the bitwise
+        block ≡ per-round ≡ sharded parity contract cannot drift between
+        copies; with a replicated state the constraints are skipped and
+        this is plain server_update + hook. The avg constraint is the
+        reduce-scatter point: the aggregate lands in rule-table layout, so
+        the server update runs shard-local and the new state never
+        materializes replicated (arXiv:2004.13336)."""
+        if self._sharded:
+            avg = self.partitioner.constrain(avg)
+        new_net, new_opt = self.server_update(net, avg, server_opt_state)
+        if self.post_aggregate_hook is not None:
+            new_net = self.post_aggregate_hook(new_net, post_key)
+        if self._sharded:
+            new_net = self.partitioner.constrain(new_net)
+            new_opt = self.partitioner.constrain(new_opt)
+        return new_net, new_opt
 
     def _materialize(self, batch):
         """(x, y, mask, nsamp) from either data plane. IndexBatch -> on-device
@@ -564,8 +679,17 @@ class FedAvgAPI:
 
         def shard_body(keys, net, x, y, mask, nsamp, hook_key):
             nets, metrics = shard_fits(keys, net, x, y, mask, hook_key)
-            return _shard_aggregate(nets, metrics, self._agg_weights(nsamp),
-                                    axis)
+            avg, msum = _shard_aggregate(nets, metrics,
+                                         self._agg_weights(nsamp), axis)
+            if self._emit_stats:
+                # full round_stats on the mesh too (the drift half lives
+                # here, where the per-client nets exist; update_norm joins
+                # after the server update) — replicated and sharded runs
+                # emit identical record keys, and so do mesh vs standalone
+                msum = dict(msum)
+                msum.update(_mesh_drift_stats(nets.params, avg.params,
+                                              nsamp, axis))
+            return avg, msum
 
         smapped = jax.shard_map(
             shard_body,
@@ -587,6 +711,9 @@ class FedAvgAPI:
             out_specs=(P(), P()),
             **self._smap_kw,
         )
+        # the sharded block driver re-dispatches this per-round body from
+        # an outer scan (_build_block_fn) — keep a handle
+        self._smapped_dd = smapped_dd
 
         if self._needs_stacked:
             # Robust aggregation needs the FULL stacked client set (sorts,
@@ -646,12 +773,13 @@ class FedAvgAPI:
                 avg, metrics = smapped(
                     keys, net, batch.x, batch.y, batch.mask, batch.num_samples, kh
                 )
-            new_net, new_opt = self.server_update(net, avg, server_opt_state)
-            if self.post_aggregate_hook is not None:
-                new_net = self.post_aggregate_hook(new_net, kp)
+            new_net, new_opt = self._update_from_aggregate(
+                net, avg, server_opt_state, kp)
             if self._emit_stats:
-                # drift needs the per-client nets, which live inside
-                # shard_map — the mesh path reports the update norm only
+                # the drift half rode out of shard_body; the update norm
+                # joins here, where the post-update params exist (on a
+                # sharded state GSPMD psums the shard-local partials, so
+                # the record still carries the FULL norm)
                 metrics = dict(metrics)
                 metrics["update_norm"] = _update_norm(new_net.params,
                                                       net.params)
@@ -798,6 +926,43 @@ class FedAvgAPI:
         server_update = self.server_update
         local_update = self.local_update
 
+        if self._sharded:
+            # Sharded block: the replicated block scans INSIDE one
+            # shard_map, where state is per-device-manual and a partitioned
+            # carry cannot be expressed. Here the scan runs in the OUTER
+            # jit instead, re-dispatching the per-round shard_mapped body
+            # each step — same per-element ops, so block ≡ per-round stays
+            # bitwise — with the carry constrained to the rule-table layout
+            # (server update shard-local; net all-gathered at each step's
+            # shard_map broadcast boundary, exactly like the per-round fn).
+            smapped_dd = self._smapped_dd
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def sharded_block_fn(rng, net, opt, dev_x, dev_y, idx, mask,
+                                 nsamp, ids, round_idxs):
+                rng, (khs, kps) = derive_hook_keys(rng, idx.shape[0])
+
+                def step(carry, inp):
+                    net, opt = carry
+                    idx_r, mask_r, nsamp_r, ids_r, r, kh, kp = inp
+                    keys = client_keys(r, ids_r)
+                    avg, msum = smapped_dd(keys, net, dev_x, dev_y,
+                                           idx_r, mask_r, nsamp_r, kh)
+                    old_net = net
+                    net, opt = self._update_from_aggregate(net, avg, opt, kp)
+                    if self._emit_stats:
+                        msum = dict(msum)
+                        msum["update_norm"] = _update_norm(net.params,
+                                                           old_net.params)
+                    return (net, opt), msum
+
+                (net, opt), ms = jax.lax.scan(
+                    step, (net, opt),
+                    (idx, mask, nsamp, ids, round_idxs, khs, kps))
+                return rng, net, opt, ms
+
+            return sharded_block_fn
+
         def shard_block(net, opt, dev_x, dev_y, idx, mask, nsamp, ids, rounds,
                         khs, kps):
             # idx/mask/nsamp/ids carry this device's client slice on axis 1:
@@ -822,12 +987,16 @@ class FedAvgAPI:
                 avg, msum = _shard_aggregate(
                     nets, metrics, self._agg_weights(nsamp_r), axis)
                 old_net = net
-                net, opt = server_update(net, avg, opt)
-                if self.post_aggregate_hook is not None:
-                    net = self.post_aggregate_hook(net, kp)
+                # self._sharded is always False here (the sharded block
+                # scans in the outer jit above), so this is plain
+                # server_update + hook — but through the ONE composition
+                net, opt = self._update_from_aggregate(net, avg, opt, kp)
                 if self._emit_stats:
-                    # mesh parity with the per-round path: update norm only
+                    # full round_stats, like shard_body: drift from the
+                    # in-shard nets, update norm from the post-update params
                     msum = dict(msum)
+                    msum.update(_mesh_drift_stats(nets.params, avg.params,
+                                                  nsamp_r, axis))
                     msum["update_norm"] = _update_norm(net.params,
                                                        old_net.params)
                 return (net, opt), msum
@@ -947,6 +1116,8 @@ class FedAvgAPI:
             self.rng, self.net, self.server_opt_state, dev_x, dev_y,
             *blocks, rounds,
         )
+        _perf.record_agg_bytes(self._state_placement,
+                               self._agg_bytes_round * rounds.shape[0])
         return ms
 
     def _emit_block_records(self, start_round: int, num_rounds: int, ids_l,
@@ -960,7 +1131,7 @@ class FedAvgAPI:
             self.telemetry.emit_round(
                 start_round + i, clients=ids_l[i].tolist(),
                 metrics={k: float(v[i]) for k, v in ms_host.items()},
-                block=True,
+                block=True, agg=self._agg_record,
                 **self._quarantine_extra(start_round + i))
 
     def _drain_block_entry(self, start_round: int, entry):
@@ -1213,6 +1384,7 @@ class FedAvgAPI:
                 rk, self.net, self.server_opt_state, cb,
                 jnp.int32(round_idx), jnp.asarray(ids, jnp.int32),
             )
+        _perf.record_agg_bytes(self._state_placement, self._agg_bytes_round)
         return metrics
 
     def run_round(self, round_idx: int):
@@ -1233,6 +1405,7 @@ class FedAvgAPI:
                 round_idx, clients=np.asarray(ids).tolist(),
                 spans=self._span_delta(spans_before),
                 metrics={k: float(v) for k, v in metrics.items()},
+                agg=self._agg_record,
                 **self._quarantine_extra(round_idx))
             if self.telemetry.tracer is not None:
                 # close the trace envelope HERE: left open it would absorb
@@ -1289,6 +1462,7 @@ class FedAvgAPI:
                 round_idx, clients=np.asarray(ids).tolist(),
                 spans=spans, pipeline=pipeline,
                 metrics={k: float(v) for k, v in host.items()},
+                agg=self._agg_record,
                 **self._quarantine_extra(round_idx))
         return round_idx, host
 
@@ -1451,6 +1625,16 @@ class FedAvgAPI:
 
                 params, self.tp_specs = shard_params(net.params, self.mesh)
                 net = net._replace(params=params, extra=put(net.extra))
+            elif self._sharded:
+                # checkpoints are saved gathered (core/checkpoint.py's
+                # gather-on-save layout) — re-partition per the rule table
+                # so resume lands in exactly the round program's layout
+                net = self.partitioner.shard(net)
+                server_opt_state = self.partitioner.shard(server_opt_state)
+                rng = put(rng)
+                self.net, self.server_opt_state, self.rng = (
+                    net, server_opt_state, rng)
+                return
             else:
                 net = put(net)
             server_opt_state, rng = put(server_opt_state), put(rng)

@@ -8,3 +8,9 @@ from fedml_tpu.core.partition import (
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.core.local import LocalSpec, make_local_update, make_eval_fn
 from fedml_tpu.core.robust import norm_diff_clipping, add_gaussian_noise
+from fedml_tpu.core.partition_rules import (
+    ServerStatePartitioner,
+    match_partition_rules,
+    rules_from_json,
+    rules_to_json,
+)

@@ -13,10 +13,27 @@ import jax
 import numpy as np
 
 
+def _gather_leaf(v):
+    """Gather-on-save for mesh-partitioned server state: a sharded leaf is
+    assembled to one full host array before serialization. Without this a
+    partitioned pytree either crashes the npz fallback or round-trips a
+    layout tied to one mesh shape; gathered checkpoints are shard-agnostic
+    — a state saved from an 8-way sharded run restores onto 4 devices, 1
+    device, or a different rule table (the engine re-partitions at
+    ``load_state``). Single-process only, like everything in this module:
+    every shard is addressable, so ``device_get`` assembles exactly."""
+    if isinstance(v, jax.Array) and not v.is_fully_replicated:
+        return np.asarray(jax.device_get(v))
+    return v
+
+
 def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
                history: list | None = None, keep: int = 3,
                extra_state: dict | None = None):
     """Save a round checkpoint via orbax (falls back to npz if orbax breaks).
+
+    Sharded server state (FedAvgAPI(shard_server_state=True)) is gathered
+    on save — see :func:`_gather_leaf`.
 
     ``extra_state``: additional top-level entries (e.g. the DP accountant's
     RDP totals) — restore templates must declare the same keys."""
@@ -30,6 +47,7 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
     }
     if extra_state:
         state.update(extra_state)
+    state = jax.tree.map(_gather_leaf, state)
     try:
         import orbax.checkpoint as ocp
 

@@ -1,0 +1,321 @@
+"""Regex partition-rule table over a param pytree -> per-leaf NamedShardings.
+
+The server plane's scaling problem (ROADMAP item 2): a replicated global
+model plus stack-and-average aggregation costs HBM and FLOPs proportional
+to model size x cohort on EVERY device. "Automatic Cross-Replica Sharding
+of Weight Update in Data-Parallel Training" (arXiv:2004.13336) shows the
+weight-update step can instead be sharded across replicas — reduce-scatter
+the update sum, apply the server step shard-locally, all-gather only when
+the full weights are needed — at no convergence cost. XLA implements that
+rewrite automatically once the state carries sharded layouts; this module
+supplies the layouts.
+
+Shape (after the ``match_partition_rules`` + partitioner idiom the LLM/FL
+training stacks converged on — SNIPPETS.md [1]/[3]): an ordered table of
+``(regex, rule)`` pairs is matched against each leaf's ``/``-joined tree
+path (first match wins); the winning rule resolves to a
+``PartitionSpec`` given the leaf's shape and the mesh axis being sharded
+over. Rules:
+
+- ``"replicated"`` / ``None``  — ``P()`` (every device holds the leaf);
+- ``"auto"``                   — shard the LARGEST dim divisible by the
+                                 mesh-axis size (ties: lowest dim index);
+                                 nothing divisible -> replicated;
+- ``int d``                    — shard dim ``d`` (must divide; loud error
+                                 otherwise — an explicit rule that cannot
+                                 apply is a config bug, not a fallback);
+- ``[e0, e1, ...]``            — an explicit per-dim spec entry list
+                                 (``None`` or the axis name), i.e. a raw
+                                 ``PartitionSpec``.
+
+Scalars and single-element leaves are never partitioned (the snippet's
+guard), whatever the table says. ``default`` covers leaves no rule
+matches: a rule value (applied), or ``None`` to make an unmatched leaf a
+hard error (the strict mode of SNIPPETS.md [1]).
+
+The default table — ``((".*", "auto"),)`` — is the pure data-parallel
+server plane: every large tensor sharded over the one server axis, biases
+and scalars replicated. Model-specific tables (e.g. keep embeddings
+replicated, shard attention kernels on the head dim) are plain data:
+``rules_to_json`` / ``rules_from_json`` round-trip them through configs.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DEFAULT_RULES: tuple = ((r".*", "auto"),)
+
+
+def _key_str(entry) -> str:
+    """One tree-path entry -> its name segment (DictKey / GetAttrKey /
+    SequenceKey / FlattenedIndexKey all carry exactly one payload attr)."""
+    for attr in ("key", "name", "idx"):
+        if hasattr(entry, attr):
+            return str(getattr(entry, attr))
+    return str(entry)
+
+
+def leaf_names(tree, sep: str = "/") -> list[str]:
+    """The ``sep``-joined tree path of every leaf, in ``jax.tree.leaves``
+    order — the strings the rule regexes match against. A NetState param
+    leaf reads like ``params/Dense_0/kernel``; an optax state leaf like
+    ``0/mu/Dense_0/kernel`` — so kernel/bias-style rules hit the optimizer
+    moments exactly as they hit the params they mirror."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [sep.join(_key_str(k) for k in path) for path, _ in flat]
+
+
+def match_partition_rules(rules, tree, default: Any = "replicated",
+                          sep: str = "/") -> dict[str, Any]:
+    """``{leaf path: raw rule value}`` (still unresolved — see
+    :meth:`ServerStatePartitioner.resolve`) matched leaf-by-leaf: first
+    ``re.search`` hit in ``rules`` wins; ``default`` covers misses
+    (``default=None`` -> unmatched leaves raise). Scalar / single-element
+    leaves always resolve to ``"replicated"``. Returned as a name-keyed
+    dict rather than the snippet's rule pytree: explicit-spec rule values
+    are python tuples, which ``jax.tree`` would silently traverse as
+    subtrees."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out: dict[str, Any] = {}
+    for path, leaf in flat:
+        name = sep.join(_key_str(k) for k in path)
+        shape = np.shape(leaf)
+        if len(shape) == 0 or math.prod(shape) == 1:
+            out[name] = "replicated"
+            continue
+        for pattern, rule in rules:
+            if re.search(pattern, name) is not None:
+                out[name] = rule
+                break
+        else:
+            if default is None:
+                raise ValueError(
+                    f"no partition rule matches leaf {name!r} and strict "
+                    "mode is on (default=None)")
+            out[name] = default
+    return out
+
+
+def rules_to_json(rules) -> list:
+    """Rule table -> a json-able ``[[pattern, rule], ...]`` (tuples become
+    lists; everything else is already a json scalar)."""
+    return [[p, list(r) if isinstance(r, (tuple, list)) else r]
+            for p, r in rules]
+
+
+def rules_from_json(obj) -> tuple:
+    """Inverse of :func:`rules_to_json` (also accepts a json string)."""
+    if isinstance(obj, str):
+        import json
+
+        obj = json.loads(obj)
+    out = []
+    for p, r in obj:
+        out.append((str(p), tuple(r) if isinstance(r, list) else r))
+    return tuple(out)
+
+
+def tree_bytes(tree) -> int:
+    """Total payload bytes of a pytree (host or device leaves)."""
+    tot = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = np.shape(leaf)
+        dt = np.dtype(getattr(leaf, "dtype", np.float32))
+        tot += math.prod(shape) * dt.itemsize
+    return tot
+
+
+class ServerStatePartitioner:
+    """Mesh placement of the server plane (global model + server optimizer
+    state) driven by a partition-rule table — the
+    ``DataParallelPartitioner``/``SPMDPartitioner`` shape of SNIPPETS.md
+    [3], specialized to the FL server axis.
+
+    ``axis`` defaults to the mesh's FIRST axis — in the FedAvg engines
+    that is the ``'clients'`` axis, which doubles as the server-shard
+    axis: during local fits it indexes client slots, between rounds it
+    indexes server-state shards (the same device set, two roles).
+    """
+
+    def __init__(self, mesh: Mesh, axis: str | None = None,
+                 rules=None, default: Any = "auto"):
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        if self.axis not in mesh.axis_names:
+            raise ValueError(f"axis {self.axis!r} not in mesh axes "
+                             f"{mesh.axis_names}")
+        self.ndev = int(mesh.shape[self.axis])
+        self.rules = tuple(rules) if rules is not None else DEFAULT_RULES
+        self.default = default
+
+    # ------------------------------------------------------------ resolve
+    def resolve(self, rule, shape) -> P:
+        """One raw rule value + a leaf shape -> the concrete
+        ``PartitionSpec`` (see the module docstring for the rule forms)."""
+        if rule is None or rule == "replicated":
+            return P()
+        if len(shape) == 0 or math.prod(shape) == 1:
+            return P()
+        if rule == "auto":
+            dims = sorted(range(len(shape)), key=lambda d: (-shape[d], d))
+            for d in dims:
+                if shape[d] >= self.ndev and shape[d] % self.ndev == 0:
+                    return P(*([None] * d + [self.axis]))
+            return P()
+        if isinstance(rule, int):
+            if not 0 <= rule < len(shape):
+                raise ValueError(f"rule dim {rule} out of range for shape "
+                                 f"{shape}")
+            if shape[rule] % self.ndev != 0:
+                raise ValueError(
+                    f"dim {rule} of shape {shape} not divisible by the "
+                    f"'{self.axis}' mesh size {self.ndev}")
+            return P(*([None] * rule + [self.axis]))
+        if isinstance(rule, (tuple, list)):
+            if len(rule) > len(shape):
+                raise ValueError(
+                    f"explicit spec {tuple(rule)} has {len(rule)} entries "
+                    f"but the leaf has shape {shape} — rule table and "
+                    "model disagree")
+            for d, e in enumerate(rule):
+                if e is None:
+                    continue
+                # explicit specs may name ANY mesh axis (or several), not
+                # just the partitioner's own — validate the names here (a
+                # typo'd axis must fail loudly at table-resolve time, not
+                # deep inside jit) and check divisibility against the size
+                # of the axes the entry actually names
+                size = self._entry_axis_size(e)
+                if shape[d] % size != 0:
+                    raise ValueError(
+                        f"dim {d} of shape {shape} not divisible by mesh "
+                        f"axes {e!r} (total size {size})")
+            return P(*rule)
+        raise ValueError(f"unknown partition rule {rule!r}")
+
+    def _entry_axis_size(self, entry) -> int:
+        """Total device count behind one PartitionSpec entry (an axis name
+        or a tuple of axis names), validated against the mesh."""
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        for n in names:
+            if n not in self.mesh.axis_names:
+                raise ValueError(f"spec axis {n!r} not in mesh axes "
+                                 f"{self.mesh.axis_names}")
+        return math.prod(int(self.mesh.shape[n]) for n in names)
+
+    def specs(self, tree):
+        """Pytree of concrete ``PartitionSpec`` per leaf (``PartitionSpec``
+        is a registered pytree LEAF, so the result maps safely)."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        matched = match_partition_rules(self.rules, tree,
+                                        default=self.default)
+        names = list(matched)
+        return jax.tree.unflatten(treedef, [
+            self.resolve(matched[n], np.shape(leaf))
+            for n, (_, leaf) in zip(names, flat)])
+
+    def shardings(self, tree):
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self.specs(tree),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def describe(self, tree) -> dict[str, str]:
+        """``{leaf path: spec}`` — the human-readable rule-table outcome
+        (docs/PERFORMANCE.md's HBM model is written against this)."""
+        names = leaf_names(tree)
+        specs = jax.tree.leaves(
+            self.specs(tree), is_leaf=lambda x: isinstance(x, P))
+        return {n: str(s) for n, s in zip(names, specs)}
+
+    # ------------------------------------------------------------- place
+    def shard(self, tree):
+        """Device placement per the rule table (eager ``device_put``)."""
+        return jax.tree.map(
+            lambda v, sh: jax.device_put(v, sh), tree, self.shardings(tree))
+
+    def replicate(self, tree):
+        """Gather: every leaf replicated over the mesh (the broadcast
+        layout; exact — resharding moves bits, never rounds them)."""
+        rep = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda v: jax.device_put(v, rep), tree)
+
+    def constrain(self, tree):
+        """In-graph ``with_sharding_constraint`` to the rule-table layout —
+        applied to the aggregate and the updated server state inside the
+        round program, this is what makes XLA reduce-scatter the update
+        sum and keep the server step shard-local (arXiv:2004.13336's
+        rewrite, done by the partitioner instead of by hand)."""
+        return jax.tree.map(
+            lambda v, sh: jax.lax.with_sharding_constraint(v, sh),
+            tree, self.shardings(tree))
+
+    def stacked_constrainer(self, template, *, leaf_list: bool = False,
+                            shape_guard: bool = False):
+        """A constraint fn for STACKED ``[K, ...]`` client-update trees
+        matching ``template``'s treedef: each leaf takes the template
+        leaf's rule-table spec shifted one dim right (client axis
+        replicated, the param dim sharded) — the layout under which
+        coordinate-wise estimators (median / trimmed-mean sorts along K)
+        run shard-local. Specs are matched against the TEMPLATE — the
+        unstacked server state, whose leaf paths the regexes were written
+        for — because a stacked tree inside jit has lost its names; this
+        keeps custom tables (e.g. a replicated-embeddings rule) consistent
+        between the state layout and the stacked-update layout.
+
+        ``leaf_list=True``: the returned fn takes/returns a flat LIST of
+        stacked leaves in ``jax.tree.leaves(template)`` order (the wire
+        runtimes aggregate over packed leaf lists, not pytrees).
+        ``shape_guard=True``: leaves whose trailing dims no longer match
+        the template (codec-transformed uploads) pass through
+        unconstrained instead of erroring. ONE definition of the stacked
+        layout — the standalone engine and the cross-process server must
+        never grow separate dialects of it."""
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, P(None, *s)),
+            self.specs(template), is_leaf=lambda x: isinstance(x, P))
+        if leaf_list:
+            shs = jax.tree.leaves(shardings)
+            shapes = [np.shape(v) for v in jax.tree.leaves(template)]
+
+            def constrain_list(stacked):
+                return [
+                    jax.lax.with_sharding_constraint(v, sh)
+                    if not shape_guard or np.shape(v)[1:] == shp else v
+                    for v, sh, shp in zip(stacked, shs, shapes)]
+
+            return constrain_list
+
+        def constrain(stacked):
+            return jax.tree.map(
+                lambda v, sh: jax.lax.with_sharding_constraint(v, sh),
+                stacked, shardings)
+
+        return constrain
+
+    # ------------------------------------------------------------- sizing
+    def bytes_per_device(self, tree) -> int:
+        """Per-device resident bytes of ``tree`` under the rule table —
+        sharded dims divided by the axis size, replicated leaves counted
+        whole. Feeds ``fed_server_state_bytes{placement="sharded"}``."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        specs = jax.tree.leaves(self.specs(tree),
+                                is_leaf=lambda x: isinstance(x, P))
+        tot = 0
+        for (_, leaf), spec in zip(flat, specs):
+            shape = list(np.shape(leaf))
+            for d, e in enumerate(spec):
+                if e is not None:
+                    # divide by the size of the axes this entry names —
+                    # an explicit spec may shard over a different mesh
+                    # axis than the partitioner's own
+                    shape[d] //= self._entry_axis_size(e)
+            dt = np.dtype(getattr(leaf, "dtype", np.float32))
+            tot += math.prod(shape) * dt.itemsize
+        return tot

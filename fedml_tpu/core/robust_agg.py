@@ -72,6 +72,17 @@ DEFAULT_NORM_MULT = 4.0
 AGGREGATORS = ("mean", "median", "trimmed_mean", "krum", "multi_krum",
                "geometric_median")
 
+# Estimators whose math is per-coordinate (sorts/cumsums along the client
+# axis only — no arithmetic reduction whose grouping a resharding could
+# change): under a mesh-sharded server state these run SHARD-LOCAL after an
+# all-to-all from client-sharded to param-sharded stacked layout
+# (gated_aggregate's ``reshard_fn``), bit-identical to the gathered path.
+# krum / multi_krum / geometric_median need full flattened per-client
+# vectors (pairwise distances, Weiszfeld) and keep the gathered path; the
+# plain mean is excluded too — resharding would regroup its weighted-sum
+# reduction and cost the bitwise replicated≡sharded parity contract.
+COORDINATEWISE = frozenset({"median", "trimmed_mean"})
+
 
 def _wshape(w, leaf):
     """[K] weights broadcast-shaped against a [K, ...] leaf."""
@@ -316,7 +327,7 @@ def sanitize_updates(stacked, global_tree, weights,
 
 
 def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
-                    norm_mult: float | None = None):
+                    norm_mult: float | None = None, reshard_fn=None):
     """The full verdict composition, jittable, defined ONCE for both
     runtimes (their quarantine ledgers must agree entry-for-entry, so the
     composition rule must not exist in two dialects):
@@ -327,6 +338,15 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
     rejected, fall back to the global model instead of averaging an empty
     survivor set.
 
+    ``reshard_fn`` (mesh-sharded server state only): a layout constraint
+    applied to the gated stacked updates AFTER the gate and BEFORE the
+    estimator — the sharded engines pass the partitioner's
+    ``stacked_constrainer(net)`` for COORDINATEWISE estimators so their
+    per-coordinate sorts run shard-local (client-sharded -> param-sharded
+    all-to-all). A pure resharding: bits move, values don't, and the gate
+    itself always sees the estimator's input in the same layout both
+    paths produce.
+
     Returns ``(avg_tree, surviving_weights, reasons)``; ``reasons`` is
     None only when the gate is off AND the estimator reported nothing.
     """
@@ -336,6 +356,8 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
     if norm_mult is not None:
         agg_in, w, reasons = sanitize_updates(stacked, global_tree, w,
                                               norm_mult=norm_mult)
+    if reshard_fn is not None:
+        agg_in = reshard_fn(agg_in)
     if robust_fn is not None:
         avg, info = robust_fn(agg_in, w)
         sus = info.get("suspected")

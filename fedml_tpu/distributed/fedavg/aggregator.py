@@ -42,13 +42,16 @@ from fedml_tpu.comm.message import pack_pytree, unpack_pytree
 from fedml_tpu.core.client_data import FederatedData, batch_global
 from fedml_tpu.core.local import Task, make_eval_fn
 from fedml_tpu.core.robust_agg import (
+    COORDINATEWISE,
     DEFAULT_NORM_MULT,
     QuarantineLedger,
     gated_aggregate,
     make_robust_aggregator,
 )
+from fedml_tpu.core.partition_rules import tree_bytes as _tree_bytes
 from fedml_tpu.core.sampling import sample_clients
 from fedml_tpu.obs import comm_instrument as _obs
+from fedml_tpu.obs import perf_instrument as _perf
 
 log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
@@ -57,7 +60,9 @@ class FedAvgAggregator:
     def __init__(self, dataset: FederatedData, task: Task, cfg: FedAvgConfig,
                  worker_num: int, aggregator: str | None = None,
                  aggregator_params: dict | None = None,
-                 sanitize: bool | float | None = None):
+                 sanitize: bool | float | None = None,
+                 shard_server_state: bool = False,
+                 partition_rules=None):
         if cfg.sampling != "uniform":
             # this runtime's client_sampling + weighted aggregate implement
             # the uniform scheme only — refuse rather than silently ignore
@@ -106,6 +111,89 @@ class FedAvgAggregator:
         self._gagg = jax.jit(partial(gated_aggregate, robust_fn=robust,
                                      norm_mult=mult))
         self.quarantine = QuarantineLedger()
+        # Mesh-sharded server state on the cross-process server (the
+        # standalone engine's shard_server_state, wired to the wire path):
+        # the global model lives partitioned over this process's local
+        # devices, arriving uploads are staged straight to their shard's
+        # device placement (decode-on-arrival lands each leaf already
+        # distributed), the jitted gated aggregate runs under GSPMD with
+        # the output re-partitioned, and the gather happens only at
+        # broadcast-pack time (get_global_model_params). Values are
+        # bit-exact either way — the layout changes, the math does not.
+        self._partitioner = None
+        self._upload_shardings = None
+        if shard_server_state:
+            devs = jax.local_devices()
+            if len(devs) > 1:
+                from jax.sharding import Mesh, NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                from fedml_tpu.core.partition_rules import (
+                    ServerStatePartitioner,
+                )
+
+                # same axis NAME as the standalone engine's mesh, so an
+                # explicit rule table (specs naming 'clients') is portable
+                # between the two runtimes; here the axis only ever plays
+                # the server-shard role
+                mesh = Mesh(np.asarray(devs), ("clients",))
+                self._partitioner = ServerStatePartitioner(
+                    mesh, rules=partition_rules)
+                self.net = self._partitioner.shard(self.net)
+                # (leaf shape, shard placement) per wire slot — staging
+                # matches by shape so codec-transformed leaves (sparse
+                # idx/val pairs) fall back to the plain device_put
+                self._upload_shardings = [
+                    (np.shape(v), sh) for v, sh in zip(
+                        jax.tree.leaves(self.net),
+                        jax.tree.leaves(
+                            self._partitioner.shardings(self.net)))]
+                # coordinate-wise estimators run shard-local here too
+                # (COORDINATEWISE, same as the standalone engine): the
+                # stacked wire leaves get the partitioner's stacked layout
+                # — client axis replicated, param dim sharded — before the
+                # sorts; leaf-list mode with the shape guard so
+                # codec-transformed leaves pass through unconstrained
+                reshard = None
+                if isinstance(aggregator, str) and \
+                        aggregator in COORDINATEWISE:
+                    reshard = self._partitioner.stacked_constrainer(
+                        self.net, leaf_list=True, shape_guard=True)
+                # pin the jitted aggregate's outputs to the rule-table
+                # layout: the new global model lands sharded INSIDE the
+                # compiled program — no eager tree-wide re-partitioning
+                # pass afterwards (resharding moves bits, never rounds, so
+                # parity is unaffected; weights/reason codes are tiny and
+                # naturally replicated)
+                rep = NamedSharding(mesh, P())
+                self._gagg = jax.jit(
+                    partial(gated_aggregate, robust_fn=robust,
+                            norm_mult=mult, reshard_fn=reshard),
+                    out_shardings=([sh for _, sh in self._upload_shardings],
+                                   rep, rep))
+            else:
+                log.warning("shard_server_state ignored: one local device "
+                            "(nothing to partition over)")
+        self._state_placement = ("sharded" if self._partitioner is not None
+                                 else "replicated")
+        self._model_nbytes = _tree_bytes(self.net)
+        self._record_server_state_bytes()
+
+    def _record_server_state_bytes(self, opt_state=()) -> None:
+        """Export fed_server_state_bytes{placement} (PER-DEVICE bytes of
+        model + server optimizer state). Subclasses that carry server
+        optimizer state re-call this with it once built (FedOptAggregator)
+        — the gauge must count the whole server plane, or a FedOpt-Adam
+        server would report a third of its real footprint. Sized
+        component-by-component — wrapping (net, opt_state) in one tuple
+        would prefix every leaf path with '0/'/'1/' and anchored custom
+        rules would resolve differently here than in shard()."""
+        if self._partitioner is not None:
+            per_dev = (self._partitioner.bytes_per_device(self.net)
+                       + self._partitioner.bytes_per_device(opt_state))
+        else:
+            per_dev = _tree_bytes((self.net, opt_state))
+        _perf.set_server_state_bytes(self._state_placement, per_dev)
 
     def get_global_model_params(self):
         return pack_pytree(self.net)
@@ -123,6 +211,21 @@ class FedAvgAggregator:
     def _stage_upload(self, wire_leaves):
         if not self._stage_uploads_on_arrival:
             return wire_leaves
+        if self._upload_shardings is not None and \
+                len(wire_leaves) == len(self._upload_shardings):
+            # sharded server state: each float leaf goes straight to its
+            # shard's device placement as the frame arrives — the H2D is
+            # already distributed over the local devices by the time the
+            # round barrier trips (non-float and codec-transformed leaves
+            # whose shape no longer matches the model pass through plain)
+            def put(v, shp, sh):
+                if not (isinstance(v, np.ndarray) and v.dtype == np.float32):
+                    return v
+                return jax.device_put(v, sh if np.shape(v) == shp else None)
+
+            return [put(v, shp, sh)
+                    for v, (shp, sh) in zip(wire_leaves,
+                                            self._upload_shardings)]
         return [jax.device_put(v)
                 if isinstance(v, np.ndarray) and v.dtype == np.float32
                 else v
@@ -171,6 +274,15 @@ class FedAvgAggregator:
 
     # ----------------------------------------------------------- aggregate
     def aggregate(self):
+        self._aggregate_core()
+        return pack_pytree(self.net)
+
+    def _aggregate_core(self):
+        """Gate + estimate + update ``self.net`` WITHOUT packing it for the
+        wire — subclasses that transform the state further before broadcast
+        (FedOpt's server step, the robust noise pass) call this and pack
+        once at the end, so a sharded server plane is gathered exactly once
+        per round (the gather belongs at broadcast-pack time only)."""
         t0 = time.perf_counter()
         ranks = sorted(self.model_dict)
         stacked = [
@@ -181,10 +293,22 @@ class FedAvgAggregator:
 
         # the shared composition: gate (non-finite unconditionally; norm
         # outliers when armed) -> estimator -> suspected merge -> keep the
-        # global model when every upload was quarantined
-        global_leaves = [jnp.asarray(v) for v in pack_pytree(self.net)]
+        # global model when every upload was quarantined. Sharded server
+        # state hands the jit the device-resident partitioned leaves
+        # directly (pack_pytree would gather to host every round — the
+        # gather belongs at broadcast-pack time only).
+        if self._partitioner is not None:
+            global_leaves = list(jax.tree.leaves(self.net))
+        else:
+            global_leaves = [jnp.asarray(v) for v in pack_pytree(self.net)]
         avg_leaves, new_w, reasons = self._gagg(stacked, global_leaves,
                                                 weights)
+        # (sharded server state: _gagg's out_shardings already pin the new
+        # model to the rule-table layout — nothing to re-partition here)
+        # bytes actually folded this round: elastic partial aggregation may
+        # stack fewer than worker_num uploads — count the realized cohort
+        _perf.record_agg_bytes(self._state_placement,
+                               self._model_nbytes * len(ranks))
         reasons = np.asarray(reasons)
         if reasons.any():
             # slot i holds worker index ranks[i] -> 1-based rank + the
@@ -202,7 +326,6 @@ class FedAvgAggregator:
         self.model_dict.clear()
         self.sample_num_dict.clear()
         log.info("aggregate time cost: %.3fs", time.perf_counter() - t0)
-        return pack_pytree(self.net)
 
     # ------------------------------------------------------------ sampling
     def client_sampling(self, round_idx: int) -> np.ndarray:

@@ -70,6 +70,8 @@ def run_simulated(
     sanitize: bool | float | None = None,
     adversary_plan=None,
     warmup: bool = False,
+    shard_server_state: bool = False,
+    partition_rules=None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -90,7 +92,15 @@ def run_simulated(
     ranks — one rank's warm-up seeds the disk cache the sibling ranks (and
     repeat runs) then deserialize from (docs/PERFORMANCE.md §Warm-up). Off
     by default: on tiny test workloads the extra AOT pass costs more than
-    the compiles it saves."""
+    the compiles it saves.
+
+    ``shard_server_state``: partition the server's global model over this
+    process's local devices (core/partition_rules.py); uploads stage to
+    their shard's placement on arrival and the gather happens only at
+    broadcast-pack time (docs/PERFORMANCE.md §Partitioned server state).
+    Bit-exact vs the replicated server; no-op with one local device.
+    ``partition_rules`` overrides the default rule table (same format as
+    the standalone engine's — ``rules_from_json`` output is accepted)."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -101,7 +111,9 @@ def run_simulated(
         aggregator_ = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1,
                                        aggregator=aggregator,
                                        aggregator_params=aggregator_params,
-                                       sanitize=sanitize)
+                                       sanitize=sanitize,
+                                       shard_server_state=shard_server_state,
+                                       partition_rules=partition_rules)
         server = FedAvgServerManager(aggregator_, rank=0, size=size,
                                      backend=backend, ckpt_dir=ckpt_dir,
                                      round_timeout_s=round_timeout_s,

@@ -179,9 +179,21 @@ class FedAvgServerManager(ServerManager):
 
         template = dict(self._ckpt_state_template(), round=np.asarray(0, np.int64))
         state = restore_round(self.ckpt_dir, r, template)
-        self.aggregator.net = state["net"]
+        # sharded server plane: checkpoints gather on save (shard-agnostic
+        # layout; the npz fallback restores plain host arrays) — re-partition
+        # per the rule table so the device-resident-sharded invariant
+        # survives resume, mirroring the standalone engine's load_state,
+        # and refresh the per-device sizing gauge
+        part = getattr(self.aggregator, "_partitioner", None)
+        self.aggregator.net = (part.shard(state["net"]) if part is not None
+                               else state["net"])
         if hasattr(self.aggregator, "_server_opt_state"):
-            self.aggregator._server_opt_state = state["server_opt_state"]
+            opt = state["server_opt_state"]
+            self.aggregator._server_opt_state = (
+                part.shard(opt) if part is not None else opt)
+        if part is not None:
+            self.aggregator._record_server_state_bytes(
+                getattr(self.aggregator, "_server_opt_state", ()))
         if hasattr(self.aggregator, "_noise_rng"):
             self.aggregator._noise_rng = state["rng"]
         if "dp_rdp" in state and getattr(self.aggregator, "accountant",

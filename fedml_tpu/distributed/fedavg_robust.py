@@ -81,7 +81,14 @@ class FedAvgRobustAggregator(FedAvgAggregator):
         def noise(net: NetState, rng, sd) -> NetState:
             return NetState(add_gaussian_noise(rng, net.params, sd), net.extra)
 
-        self._clip, self._noise = clip, jax.jit(noise)
+        noise_jit_kw = {}
+        if self._partitioner is not None:
+            # pin the noised state to the rule-table layout inside the
+            # compiled pass — the server plane stays partitioned round
+            # over round with no eager re-sharding afterwards
+            noise_jit_kw["out_shardings"] = self._partitioner.shardings(
+                self.net)
+        self._clip, self._noise = clip, jax.jit(noise, **noise_jit_kw)
 
     def aggregate(self):
         if self.defense_type in ("norm_diff_clipping", "weak_dp", "dp"):
@@ -93,7 +100,7 @@ class FedAvgRobustAggregator(FedAvgAggregator):
             # uniform average: the C/m sensitivity the noise assumes does
             # not survive sample-count weighting on unbalanced data
             self.sample_num_dict = {r: 1 for r in self.sample_num_dict}
-        out = super().aggregate()  # weighted average -> self.net
+        self._aggregate_core()  # weighted average -> self.net, unpacked
         if self.defense_type in ("weak_dp", "dp"):
             if self.defense_type == "dp":
                 sd = self._dp_z * self._dp_C / max(m_received, 1)
@@ -102,9 +109,10 @@ class FedAvgRobustAggregator(FedAvgAggregator):
             else:
                 sd = self._stddev
             self._noise_rng, k = jax.random.split(self._noise_rng)
+            # out_shardings pin the noised state to the rule-table layout
+            # when the server plane is sharded
             self.net = self._noise(self.net, k, sd)
-            out = pack_pytree(self.net)
-        return out
+        return pack_pytree(self.net)
 
     def epsilon(self, delta: float = 1e-5) -> float:
         """Cumulative (ε, δ)-DP spent so far (defense_type='dp')."""

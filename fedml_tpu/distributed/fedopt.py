@@ -34,18 +34,31 @@ class FedOptAggregator(FedAvgAggregator):
         super().__init__(dataset, task, cfg, worker_num, **agg_kw)
         tx = make_server_optimizer(server_optimizer, server_lr, server_momentum)
         self._server_opt_state = tx.init(self.net.params)
+        if self._partitioner is not None:
+            # the moments shard like the params they mirror, and the
+            # exported per-device bytes must count the whole server plane
+            self._server_opt_state = self._partitioner.shard(
+                self._server_opt_state)
+        self._record_server_state_bytes(self._server_opt_state)
 
-        @jax.jit
         def step(old: NetState, avg: NetState, opt_state):
             pseudo_grad = tree_sub(old.params, avg.params)
             updates, new_state = tx.update(pseudo_grad, opt_state, old.params)
             return NetState(optax.apply_updates(old.params, updates), avg.extra), new_state
 
-        self._server_step = step
+        jit_kw = {}
+        if self._partitioner is not None:
+            # pin the step's outputs to the rule-table layout so the server
+            # plane stays partitioned round over round inside the compiled
+            # program — no eager re-sharding pass per round
+            jit_kw["out_shardings"] = (
+                self._partitioner.shardings(self.net),
+                self._partitioner.shardings(self._server_opt_state))
+        self._server_step = jax.jit(step, **jit_kw)
 
     def aggregate(self):
         old = self.net
-        super().aggregate()  # weighted average -> self.net
+        self._aggregate_core()  # weighted average -> self.net, unpacked
         self.net, self._server_opt_state = self._server_step(
             old, self.net, self._server_opt_state
         )

@@ -98,6 +98,22 @@ def add_args(p: argparse.ArgumentParser):
                    help="Byzantine budget f for krum/multi_krum/"
                         "trimmed_mean (default (n-3)//2; krum needs "
                         "n >= 2f+3)")
+    p.add_argument("--shard_server_state", type=int, default=0,
+                   help="rank 0: partition the global model over this "
+                        "process's local devices per the regex "
+                        "partition-rule table (core/partition_rules.py); "
+                        "uploads stage straight to their shard's device "
+                        "placement on arrival and the gather happens only "
+                        "at broadcast-pack time (docs/PERFORMANCE.md "
+                        "§Partitioned server state). No-op with one local "
+                        "device; ignored by --algo turboaggregate (no "
+                        "device-resident server plane).")
+    p.add_argument("--partition-rules", "--partition_rules",
+                   dest="partition_rules", type=str, default=None,
+                   help="rank 0, with --shard_server_state: override the "
+                        "default partition-rule table — a JSON file path "
+                        "or inline JSON [[pattern, rule], ...] "
+                        "(core/partition_rules.rules_from_json)")
     p.add_argument("--adversary-plan", "--adversary_plan",
                    dest="adversary_plan", type=str, default=None,
                    help="model-space adversary schedule "
@@ -188,6 +204,26 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         agg_kw["aggregator"] = args.aggregator
         if getattr(args, "byzantine_f", None) is not None:
             agg_kw["aggregator_params"] = {"f": args.byzantine_f}
+    if getattr(args, "shard_server_state", 0):
+        agg_kw["shard_server_state"] = True
+        pr = getattr(args, "partition_rules", None)
+        # server-only: clients never build an aggregator, and a multi-host
+        # launch hands identical argv to every rank — a rules FILE that
+        # exists only on the server host must not crash the clients
+        if pr and args.rank == 0:
+            import os
+
+            from fedml_tpu.core.partition_rules import rules_from_json
+
+            if os.path.exists(pr):
+                with open(pr) as f:
+                    pr = f.read()
+            elif not pr.lstrip().startswith("["):
+                # looks like a path, not inline JSON — a typo'd file must
+                # fail as file-not-found, not 'Expecting value: line 1'
+                raise FileNotFoundError(
+                    f"--partition_rules file not found: {pr!r}")
+            agg_kw["partition_rules"] = rules_from_json(pr)
     if args.rank == 0:
         if args.algo == "fedopt":
             from fedml_tpu.distributed.fedopt import FedOptAggregator
@@ -207,6 +243,12 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         elif args.algo == "turboaggregate":
             from fedml_tpu.distributed.turboaggregate import TAAggregator
 
+            if agg_kw.get("shard_server_state"):
+                logging.getLogger("fedml_tpu.launch").warning(
+                    "--shard_server_state ignored for turboaggregate: "
+                    "Shamir shares aggregate host-side in the finite "
+                    "field, there is no device-resident server plane to "
+                    "partition")
             agg = TAAggregator(data, task, cfg, worker_num=args.world_size - 1)
         else:  # fedavg / fedprox share the plain weighted-average server
             agg = FedAvgAggregator(data, task, cfg,

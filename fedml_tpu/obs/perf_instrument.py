@@ -41,6 +41,16 @@ passes.
                                       drained — the async-dispatch depth;
                                       the pipeline keeps this >= drain_lag
 
+**Sharded-server-state metrics** (fed by the engines; docs/PERFORMANCE.md
+§Partitioned server state):
+
+    fed_agg_bytes_total{mode}         client-update bytes aggregated, by
+                                      server-state mode (replicated |
+                                      sharded)
+    fed_server_state_bytes{placement} (gauge) PER-DEVICE bytes of the
+                                      server plane (model + server opt
+                                      state); sharded ~ replicated/ndev
+
 All hooks are host-side and cheap (a dict lookup + float add via memoized
 children, same pattern as obs/comm_instrument.py).
 """
@@ -147,3 +157,28 @@ def record_span(name: str, seconds: float) -> None:
     thread must not touch the tracer's per-round dict — see
     docs/PERFORMANCE.md §Tracing caveat)."""
     _span_hist(name).observe(seconds)
+
+
+# --------------------------------------------- sharded-server-state metrics
+# docs/PERFORMANCE.md §Partitioned server state. ``mode``/``placement`` is
+# "replicated" or "sharded" so an A/B run exports both label sets side by
+# side and the ~1/ndev per-device scaling is a metrics assertion, not a
+# code comment.
+@lru_cache(maxsize=8)
+def _agg_bytes(mode: str):
+    return REGISTRY.counter("fed_agg_bytes_total", mode=mode)
+
+
+def record_agg_bytes(mode: str, nbytes: float) -> None:
+    """Client-update bytes folded through aggregation this round (stacked
+    cohort payload: K x model bytes) under the given server-state mode."""
+    _agg_bytes(mode).inc(nbytes)
+
+
+def set_server_state_bytes(placement: str, per_device_bytes: float) -> None:
+    """PER-DEVICE resident bytes of the server plane (global model +
+    server optimizer state). Sharded runs report ~1/ndev of the
+    replicated figure — the acceptance metric for the partitioned
+    server state (ISSUE 6)."""
+    REGISTRY.gauge("fed_server_state_bytes",
+                   placement=placement).set(per_device_bytes)

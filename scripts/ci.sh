@@ -148,6 +148,65 @@ print("pipeline smoke ok: 3 pipelined rounds bit-identical, "
       "metric families exported")
 PY
   python scripts/report.py "$PIPE_DIR/events.jsonl"
+  echo "== sharded-aggregation smoke (forced 4-device mesh: sharded ≡ replicated; fed_agg_bytes/fed_server_state_bytes exported) =="
+  # the partitioned server state (docs/PERFORMANCE.md §Partitioned server
+  # state) must (a) reproduce the replicated mesh path's model bits AND
+  # quarantine ledger on a forced multi-device host mesh, (b) report
+  # per-device server-state bytes that actually shrink vs replicated, and
+  # (c) export the new metric families through Telemetry.close()
+  SHARD_DIR=./tmp/ci_sharded; rm -rf "$SHARD_DIR"
+  XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+  python - "$SHARD_DIR" <<'PY'
+import os, sys
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+assert jax.device_count() == 4, jax.device_count()
+mesh = Mesh(np.array(jax.devices()), ("clients",))
+data = synthetic_lr(num_clients=8, dim=20, num_classes=5, seed=0)
+task = classification_task(LogisticRegression(num_classes=5))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=4, batch_size=16, lr=0.05,
+                   max_batches=4, frequency_of_the_test=100)
+# a tight norm gate quarantines natural outliers -> non-vacuous ledgers
+kw = dict(aggregator="median", sanitize=0.9)
+a = FedAvgAPI(data, task, cfg, mesh=mesh, **kw)
+for r in range(3):
+    a.run_round(r)
+tel = Telemetry(log_dir=d)
+b = FedAvgAPI(data, task, cfg, mesh=mesh, shard_server_state=True,
+              telemetry=tel, **kw)
+for r in range(3):
+    b.run_round(r)
+for x, y in zip(jax.tree.leaves(a.net.params), jax.tree.leaves(b.net.params)):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                  err_msg="sharded diverged from replicated")
+assert a.quarantine.canonical() == b.quarantine.canonical()
+kern = [v for v in jax.tree.leaves(b.net.params) if v.ndim == 2][0]
+assert not kern.is_fully_replicated, "kernel never partitioned"
+tel.close()
+prom = open(os.path.join(d, "metrics.prom")).read()
+for fam in ("fed_agg_bytes_total", "fed_server_state_bytes"):
+    assert fam in prom, f"{fam} missing from the Prometheus export"
+rep = [float(l.split()[-1]) for l in prom.splitlines()
+       if l.startswith('fed_server_state_bytes{placement="replicated"}')][0]
+sh = [float(l.split()[-1]) for l in prom.splitlines()
+      if l.startswith('fed_server_state_bytes{placement="sharded"}')][0]
+assert sh < rep, f"sharded per-device bytes {sh} not below replicated {rep}"
+print(f"sharded-aggregation smoke ok: 3 rounds bit-identical, ledger "
+      f"{len(b.quarantine.canonical())} entries, per-device bytes "
+      f"{sh:.0f} vs {rep:.0f} replicated")
+PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi

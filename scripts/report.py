@@ -59,6 +59,12 @@ def render_table(records: list[dict]) -> str:
             "stall_s": sp.get("prefetch_stall"),
             "h2d_s": sp.get("h2d"),
             "depth": (r.get("pipeline") or {}).get("depth"),
+            # sharded-server-state runs (docs/PERFORMANCE.md §Partitioned
+            # server state): aggregation mode + per-device server-plane
+            # bytes — columns hide on logs that predate the field
+            "srv": (r.get("agg") or {}).get("mode"),
+            "srv_dev_B": (r.get("agg") or {}).get(
+                "server_state_bytes_per_device"),
             "loss": (m["loss_sum"] / n) if "loss_sum" in m else None,
             "upd_norm": m.get("update_norm"),
             "drift": m.get("client_drift_mean"),

@@ -114,6 +114,11 @@ _SMOKE_TESTS = {
     "test_round_pipeline.py::test_prefetch_on_equals_off_per_round",
     "test_round_pipeline.py::test_round_r_plus_1_transfer_before_round_r_drain",
     "test_round_pipeline.py::test_warmup_compiles_all_bucket_variants",
+    # round-7 additions: mesh-sharded server state (docs/PERFORMANCE.md
+    # §Partitioned server state) — the sharded ≡ replicated identity and
+    # the rule-table matcher contract
+    "test_sharded_agg.py::test_sharded_equals_replicated_per_round",
+    "test_sharded_agg.py::test_rule_precedence_first_match_wins",
 }
 
 

@@ -1,0 +1,523 @@
+"""Mesh-sharded server state (core/partition_rules.py + the sharded FedAvg
+drivers, docs/PERFORMANCE.md §Partitioned server state).
+
+Two contract halves, both asserted here:
+
+- **rule table**: the regex partition-rule matcher — precedence (first
+  match wins), unmatched-leaf default vs strict mode, the scalar guard,
+  auto-dim selection, loud indivisibility errors, and json round-trip;
+- **parity battery**: sharded ≡ replicated, BITWISE — final model bits
+  AND quarantine-ledger entries — on a forced multi-device host mesh,
+  across every driver the engine has: per-round, scanned block,
+  pipelined prefetch, robust aggregators (shard-local median AND
+  gathered krum), fedopt server optimizer state, and checkpoint resume.
+  Constraints only change layouts; the psum aggregation math is
+  byte-for-byte the same program — which is exactly what these tests pin.
+
+Plus the sizing contract: per-device server-state bytes reported by
+``fed_server_state_bytes{placement}`` scale ~1/ndev for the sharded path.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.partition_rules import (
+    DEFAULT_RULES,
+    ServerStatePartitioner,
+    leaf_names,
+    match_partition_rules,
+    rules_from_json,
+    rules_to_json,
+    tree_bytes,
+)
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+
+
+@pytest.fixture(scope="module")
+def lr_data():
+    # dim 20 : divisible by the 4-device mesh -> the kernel actually shards
+    return synthetic_lr(num_clients=8, dim=20, num_classes=5, seed=0)
+
+
+@pytest.fixture(scope="module")
+def lr_task():
+    return classification_task(LogisticRegression(num_classes=5))
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    devs = jax.devices()
+    assert len(devs) >= 4, f"expected >=4 virtual cpu devices, got {len(devs)}"
+    return Mesh(np.asarray(devs[:4]), ("clients",))
+
+
+def _cfg(**kw):
+    base = dict(comm_round=6, client_num_in_total=8, client_num_per_round=4,
+                epochs=1, batch_size=16, lr=0.05, seed=0, max_batches=4,
+                frequency_of_the_test=100)
+    base.update(kw)
+    return FedAvgConfig(**base)
+
+
+def _assert_bitwise(a, b, what="final model"):
+    la = [np.asarray(v) for v in jax.tree.leaves(a.net.params)]
+    lb = [np.asarray(v) for v in jax.tree.leaves(b.net.params)]
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y, err_msg=f"{what} diverged")
+
+
+def _kernel(api):
+    return [v for v in jax.tree.leaves(api.net.params) if v.ndim == 2][0]
+
+
+# ------------------------------------------------------------- rule table
+def test_rule_precedence_first_match_wins(mesh4):
+    tree = {"dense": {"kernel": np.zeros((8, 4), np.float32),
+                      "bias": np.zeros((8,), np.float32)}}
+    pt = ServerStatePartitioner(
+        mesh4, rules=((r"kernel", "replicated"), (r".*", "auto")))
+    specs = pt.specs(tree)
+    # the kernel-specific rule shadows the catch-all despite both matching
+    assert specs["dense"]["kernel"] == P()
+    assert specs["dense"]["bias"] == P("clients")
+
+
+def test_unmatched_leaf_default_and_strict_mode(mesh4):
+    tree = {"kernel": np.zeros((8, 4), np.float32),
+            "other": np.zeros((8,), np.float32)}
+    matched = match_partition_rules(((r"kernel", 0),), tree,
+                                    default="replicated")
+    assert matched == {"kernel": 0, "other": "replicated"}
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules(((r"kernel", 0),), tree, default=None)
+    # the partitioner's default plugs the same hole
+    pt = ServerStatePartitioner(mesh4, rules=((r"kernel", 0),),
+                                default="replicated")
+    assert pt.specs(tree)["other"] == P()
+
+
+def test_scalar_and_indivisible_leaves_never_partition(mesh4):
+    pt = ServerStatePartitioner(mesh4)  # DEFAULT_RULES: ((".*", "auto"),)
+    tree = {"scalar": np.zeros((), np.float32),
+            "one": np.zeros((1,), np.float32),
+            "odd": np.zeros((7, 3), np.float32),     # nothing divides by 4
+            "big": np.zeros((3, 8), np.float32)}     # dim 1 divides
+    specs = pt.specs(tree)
+    assert specs["scalar"] == P() and specs["one"] == P()
+    assert specs["odd"] == P()
+    # auto picks the LARGEST divisible dim, wherever it sits
+    assert specs["big"] == P(None, "clients")
+
+
+def test_explicit_rule_indivisibility_is_loud(mesh4):
+    pt = ServerStatePartitioner(mesh4, rules=((r".*", 0),))
+    with pytest.raises(ValueError, match="not divisible"):
+        pt.specs({"kernel": np.zeros((7, 4), np.float32)})
+    # an explicit spec longer than the leaf's rank is a config bug too —
+    # contextual error, not a bare IndexError
+    pt = ServerStatePartitioner(mesh4, rules=((r".*", (None, "clients")),))
+    with pytest.raises(ValueError, match="shape"):
+        pt.specs({"bias": np.zeros((8,), np.float32)})
+
+
+def test_explicit_spec_names_other_mesh_axes():
+    # explicit specs may shard over ANY mesh axis: divisibility, typo
+    # detection, and per-device sizing all follow the NAMED axis, not the
+    # partitioner's own
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(f"needs 8 virtual devices for a (4,2) mesh, "
+                    f"have {len(devs)}")
+    mesh = Mesh(np.asarray(devs[:8]).reshape(4, 2), ("clients", "model"))
+    tree = {"kernel": np.zeros((6, 6), np.float32)}
+    pt = ServerStatePartitioner(
+        mesh, axis="clients", rules=((r"kernel", (None, "model")),))
+    # dim 1 (6) divides the 2-way 'model' axis though not the 4-way
+    # 'clients' axis — the rule must resolve, not raise
+    assert pt.specs(tree)["kernel"] == P(None, "model")
+    # per-device bytes divide by the size of the axis the spec names
+    assert pt.bytes_per_device(tree) == 6 * 3 * 4
+    bad = ServerStatePartitioner(
+        mesh, axis="clients", rules=((r"kernel", (None, "modle")),))
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        bad.specs(tree)
+
+
+def test_stacked_constrainer_honors_rule_table(mesh4):
+    """The stacked-update layout follows the TEMPLATE's rule-table match
+    (leaf names), not a shape-driven default — a custom replicated-kernel
+    rule must keep the stacked kernel updates replicated too."""
+    import jax.numpy as jnp
+
+    tree = {"kernel": np.zeros((8, 4), np.float32),
+            "bias": np.zeros((8,), np.float32)}
+    pt = ServerStatePartitioner(
+        mesh4, rules=((r"kernel", "replicated"), (r".*", "auto")))
+    fn = pt.stacked_constrainer(tree)
+    stacked = {"kernel": jnp.zeros((6, 8, 4)), "bias": jnp.zeros((6, 8))}
+    out = jax.jit(fn)(stacked)
+    assert out["kernel"].sharding.is_fully_replicated
+    assert not out["bias"].sharding.is_fully_replicated
+
+
+def test_rule_table_round_trip(mesh4):
+    rules = ((r"embed", "replicated"), (r"kernel", 1),
+             (r"attn", (None, "clients")), (r".*", "auto"))
+    assert rules_from_json(rules_to_json(rules)) == rules
+    # and through an actual json string (the config-file path)
+    import json
+
+    assert rules_from_json(json.dumps(rules_to_json(rules))) == rules
+    # equal tables resolve to equal specs
+    tree = {"embed": np.zeros((8, 4), np.float32),
+            "kernel": np.zeros((4, 8), np.float32)}
+    a = ServerStatePartitioner(mesh4, rules=rules).specs(tree)
+    b = ServerStatePartitioner(
+        mesh4, rules=rules_from_json(rules_to_json(rules))).specs(tree)
+    assert a == b
+
+
+def test_optax_state_paths_carry_param_names():
+    """An Adam moment's tree path ends in the same kernel/bias name as the
+    param it mirrors — the property that lets ONE rule table cover params
+    and server optimizer state alike."""
+    import optax
+
+    params = {"Dense_0": {"kernel": np.zeros((4, 2), np.float32)}}
+    names = leaf_names(optax.adam(0.1).init(params))
+    assert any(n.endswith("kernel") for n in names), names
+
+
+def test_bytes_per_device_model(mesh4, lr_data, lr_task):
+    api = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                    shard_server_state=True)
+    pt = api.partitioner
+    state = (api.net, api.server_opt_state)
+    per_dev, total = pt.bytes_per_device(state), tree_bytes(state)
+    # LR: kernel [20,5] shards 4-way, bias [5] replicates -> exact model
+    assert per_dev == total - 400 + 100
+    # the acceptance shape: ~1/ndev, within the replicated-bias slack
+    assert per_dev <= total / 4 + 20 * 4
+
+
+# --------------------------------------------------------- parity battery
+def test_sharded_equals_replicated_per_round(lr_data, lr_task, mesh4):
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4)
+    for r in range(6):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True)
+    for r in range(6):
+        b.run_round(r)
+    _assert_bitwise(a, b)
+    # and the state really is partitioned (a fully-replicated "sharded"
+    # run would pass parity vacuously)
+    assert not _kernel(b).is_fully_replicated
+    assert _kernel(a).is_fully_replicated
+
+
+def test_sharded_equals_replicated_block(lr_data, lr_task, mesh4):
+    """Scanned R-round block, sharded vs replicated vs per-round — all
+    three bitwise."""
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4, device_data=True)
+    a.run_rounds(0, 6)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4, device_data=True,
+                  shard_server_state=True)
+    b.run_rounds(0, 6)
+    _assert_bitwise(a, b, "sharded block")
+    c = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True)
+    for r in range(6):
+        c.run_round(r)
+    _assert_bitwise(b, c, "sharded block vs per-round")
+
+
+def test_sharded_equals_replicated_pipelined(lr_data, lr_task, mesh4):
+    """Prefetch pipeline over a sharded state: run_pipelined ≡ the
+    synchronous replicated driver, bit for bit."""
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4)
+    for r in range(6):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True, prefetch=2)
+    out = b.run_pipelined(0, 6)
+    _assert_bitwise(a, b, "pipelined sharded")
+    assert [r for r, _ in out] == list(range(6))
+
+
+def test_sharded_robust_median_parity_with_ledger(lr_data, lr_task, mesh4):
+    """Shard-local coordinate-wise estimator (median behind a TIGHT norm
+    gate so the quarantine ledger is non-vacuous): model bits AND ledger
+    entries identical to the replicated robust mesh path."""
+    kw = dict(aggregator="median", sanitize=0.9)
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4, **kw)
+    for r in range(4):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True, **kw)
+    for r in range(4):
+        b.run_round(r)
+    _assert_bitwise(a, b, "sharded median")
+    assert a.quarantine.canonical(), "tight gate quarantined nothing"
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+    assert not _kernel(b).is_fully_replicated
+
+
+def test_sharded_robust_krum_gathered_path_parity(lr_data, lr_task, mesh4):
+    """krum keeps the gathered estimator path (pairwise distances need the
+    full flattened stack) over a still-sharded state."""
+    cfg = _cfg(client_num_per_round=8)
+    kw = dict(aggregator="krum", aggregator_params={"f": 2})
+    a = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh4, **kw)
+    for r in range(3):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, cfg, mesh=mesh4,
+                  shard_server_state=True, **kw)
+    for r in range(3):
+        b.run_round(r)
+    _assert_bitwise(a, b, "sharded krum")
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+
+
+def test_sharded_fedopt_moments_partitioned(lr_data, lr_task, mesh4):
+    """FedOpt-Adam: the server optimizer state shards through the same
+    rule table (the 3x-model HBM case sharding exists for) and the run
+    stays bitwise-identical to the replicated server."""
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+    kw = dict(server_optimizer="adam", server_lr=0.1)
+    a = FedOptAPI(lr_data, lr_task, _cfg(), mesh=mesh4, **kw)
+    for r in range(4):
+        a.run_round(r)
+    b = FedOptAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True, **kw)
+    for r in range(4):
+        b.run_round(r)
+    _assert_bitwise(a, b, "sharded fedopt")
+    mu = [v for v in jax.tree.leaves(b.server_opt_state)
+          if getattr(v, "ndim", 0) == 2][0]
+    assert not mu.is_fully_replicated, "Adam moment never partitioned"
+
+
+def test_sharded_checkpoint_resume_parity(lr_data, lr_task, mesh4,
+                                          tmp_path):
+    """Gather-on-save + re-partition-on-restore: interrupt a sharded run
+    at round 3, resume in a FRESH sharded engine, and land bitwise on the
+    uninterrupted run's model."""
+    from fedml_tpu.core.checkpoint import restore_round, save_round
+
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+    kw = dict(server_optimizer="adam", server_lr=0.1)
+    full = FedOptAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                     shard_server_state=True, **kw)
+    for r in range(6):
+        full.run_round(r)
+
+    first = FedOptAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                      shard_server_state=True, **kw)
+    for r in range(3):
+        first.run_round(r)
+    save_round(str(tmp_path), 3, first.net, first.server_opt_state,
+               first.rng)
+
+    resumed = FedOptAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                        shard_server_state=True, **kw)
+    tmpl = {"net": jax.device_get(resumed.net),
+            "server_opt_state": jax.device_get(resumed.server_opt_state),
+            "rng": jax.device_get(resumed.rng),
+            "round": np.asarray(0, np.int64)}
+    st = restore_round(str(tmp_path), 3, tmpl)
+    resumed.load_state(st["net"], st["server_opt_state"], st["rng"])
+    for r in range(3, 6):
+        resumed.run_round(r)
+    _assert_bitwise(full, resumed, "resumed sharded run")
+    assert not _kernel(resumed).is_fully_replicated
+
+
+def test_mesh_round_records_carry_full_stats(lr_data, lr_task, mesh4,
+                                             tmp_path):
+    """The closed telemetry gap: mesh paths (replicated AND sharded) now
+    emit the full round_stats family — update_norm plus the psum'd client
+    drift — with identical record keys, and the agg sizing block rides
+    every record."""
+    from fedml_tpu.obs import Telemetry
+
+    keysets, aggs = [], []
+    for i, shard in enumerate((False, True)):
+        tel = Telemetry(log_dir=str(tmp_path / f"t{i}"))
+        api = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                        shard_server_state=shard, telemetry=tel)
+        m = api.run_round(0)
+        keysets.append(set(m))
+        aggs.append(dict(api._agg_record))
+        tel.close()
+    assert keysets[0] == keysets[1]
+    assert {"update_norm", "client_drift_mean",
+            "client_drift_max"} <= keysets[0]
+    assert aggs[0]["mode"] == "replicated" and aggs[1]["mode"] == "sharded"
+    assert (aggs[1]["server_state_bytes_per_device"]
+            < aggs[0]["server_state_bytes_per_device"])
+
+
+def test_server_state_bytes_metric_scales(lr_data, lr_task, mesh4):
+    """fed_server_state_bytes{placement}: the sharded gauge reads ~1/ndev
+    of the replicated one (exactly: kernel/4 + replicated bias)."""
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4)
+    rep = REGISTRY.gauge("fed_server_state_bytes",
+                         placement="replicated").value
+    FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4, shard_server_state=True)
+    sh = REGISTRY.gauge("fed_server_state_bytes",
+                        placement="sharded").value
+    assert rep == 420.0 and sh == 120.0  # [20,5] kernel + [5] bias, f32
+
+
+def test_anchored_rules_size_like_they_place(lr_data, lr_task, mesh4):
+    """A path-ANCHORED rule (^params/...) must drive the exported gauge
+    exactly like it drives shard(): the sizing is computed per component
+    (net, then opt state) — wrapping both in one tuple would prefix every
+    leaf path with '0/'/'1/' and the anchored rule would silently miss,
+    reporting a sharded plane as replicated-sized."""
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    rules = ((r"^params/.*kernel", 0), (r".*", "replicated"))
+    api = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                    shard_server_state=True, partition_rules=rules)
+    assert not _kernel(api).is_fully_replicated  # the rule DID place
+    sh = REGISTRY.gauge("fed_server_state_bytes",
+                        placement="sharded").value
+    # [20,5] kernel f32 sharded 4-way + [5] bias replicated
+    assert sh == 20 * 5 * 4 / 4 + 5 * 4
+    assert sh == api.partitioner.bytes_per_device(api.net)
+
+
+def test_custom_rule_table_parity(lr_data, lr_task, mesh4):
+    """A non-default rule table (replicated bias spelled out, kernel
+    pinned to dim 0, shard-local median) stays bitwise-identical to the
+    replicated path — custom layouts change placement, never values."""
+    rules = ((r"bias", "replicated"), (r".*", 0))
+    kw = dict(aggregator="median", sanitize=0.9)
+    a = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4, **kw)
+    for r in range(3):
+        a.run_round(r)
+    b = FedAvgAPI(lr_data, lr_task, _cfg(), mesh=mesh4,
+                  shard_server_state=True, partition_rules=rules, **kw)
+    for r in range(3):
+        b.run_round(r)
+    _assert_bitwise(a, b, "custom rule table")
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+    assert not _kernel(b).is_fully_replicated
+
+
+def test_cross_process_sharded_server_bitwise(lr_task):
+    """run_simulated(shard_server_state=True): the loopback server rank
+    partitions its global model over the local devices, stages uploads to
+    their shard placement, and still lands bit-exactly on the replicated
+    server's model."""
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    # dim 16: the [16, 5] kernel divides the full 8-device local mesh
+    data = synthetic_lr(num_clients=4, dim=16, num_classes=5, seed=1)
+    cfg = _cfg(comm_round=2, client_num_in_total=4, client_num_per_round=2,
+               frequency_of_the_test=1)
+    a = run_simulated(data, lr_task, cfg, job_id="shard-rep")
+    b = run_simulated(data, lr_task, cfg, job_id="shard-sh",
+                      shard_server_state=True)
+    for x, y in zip(pack_pytree(a.net), pack_pytree(b.net)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    kern = [v for v in jax.tree.leaves(b.net)
+            if getattr(v, "ndim", 0) == 2][0]
+    assert not kern.is_fully_replicated
+
+
+def test_xproc_sharded_median_parity_with_ledger(lr_data, lr_task):
+    """FedAvgAggregator(aggregator='median', shard_server_state=True): the
+    coordinate-wise estimator gets the stacked-layout reshard (shard-local
+    sorts, same as the standalone engine) and the result — model bits AND
+    quarantine ledger — is bit-exact vs the replicated server."""
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+
+    data = synthetic_lr(num_clients=4, dim=16, num_classes=5, seed=1)
+    cfg = _cfg(client_num_in_total=4, client_num_per_round=4)
+    kw = dict(aggregator="median", sanitize=0.9)
+
+    def drive(**extra):
+        agg = FedAvgAggregator(data, lr_task, cfg, worker_num=4,
+                               **kw, **extra)
+        shapes = [np.shape(v) for v in pack_pytree(agg.net)]
+        for rnd in range(2):
+            agg.begin_round(rnd)
+            up_rng = np.random.default_rng(100 + rnd)
+            for i in range(4):
+                leaves = [up_rng.normal(scale=0.1, size=s)
+                          .astype(np.float32) for s in shapes]
+                agg.add_local_trained_result(i, leaves, 10 + i, rnd)
+            agg.aggregate()
+        return agg
+
+    a = drive()
+    b = drive(shard_server_state=True)
+    for x, y in zip(pack_pytree(a.net), pack_pytree(b.net)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+    kern = [v for v in jax.tree.leaves(b.net)
+            if getattr(v, "ndim", 0) == 2][0]
+    assert not kern.is_fully_replicated
+
+
+def test_xproc_fedopt_gauge_counts_moments(lr_data, lr_task):
+    """The cross-process FedOpt server's fed_server_state_bytes gauge
+    counts the WHOLE server plane — params plus both Adam moments, all
+    sharded — not the model alone."""
+    from fedml_tpu.distributed.fedopt import FedOptAggregator
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    sagg = FedOptAggregator(lr_data, lr_task, _cfg(), worker_num=4,
+                            server_optimizer="adam", shard_server_state=True)
+    sh = REGISTRY.gauge("fed_server_state_bytes", placement="sharded").value
+    # what matters here is the 3x: params + mu + nu all counted (plus
+    # Adam's int32 step counter) — the exact figure follows the rule table
+    # under whatever local-device mesh the harness forced, so compute it
+    # with the aggregator's own partitioner rather than hard-coding a
+    # device count
+    from fedml_tpu.core.partition_rules import tree_bytes
+
+    agg = FedOptAggregator(lr_data, lr_task, _cfg(), worker_num=4,
+                           server_optimizer="adam")
+    total = tree_bytes((agg.net, agg._server_opt_state))
+    rep = REGISTRY.gauge("fed_server_state_bytes",
+                         placement="replicated").value
+    assert rep == total and total >= 3 * tree_bytes(agg.net)
+    pt = sagg._partitioner
+    assert sh == pt.bytes_per_device((sagg.net, sagg._server_opt_state))
+    # > model alone (same layout) -> the moments were counted
+    assert sh > pt.bytes_per_device(sagg.net)
+
+
+def test_sharded_requires_mesh_and_rejects_tp(lr_data, lr_task):
+    with pytest.raises(ValueError, match="mesh"):
+        FedAvgAPI(lr_data, lr_task, _cfg(), shard_server_state=True)
+    # a ('clients','model') TP mesh already owns the param shardings —
+    # shard_server_state on top of it must refuse, not fight the layout
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip(f"needs 4 devices for a (2,2) TP mesh, have {len(devs)}")
+    tp_mesh = Mesh(np.asarray(devs[:4]).reshape(2, 2), ("clients", "model"))
+    with pytest.raises(ValueError, match="TP mesh"):
+        FedAvgAPI(lr_data, lr_task, _cfg(), mesh=tp_mesh,
+                  shard_server_state=True)
+
+
+def test_default_rules_shape():
+    assert DEFAULT_RULES == ((r".*", "auto"),)
