@@ -501,8 +501,88 @@ def _probe_backend() -> tuple[dict, str]:
                        "probes both failed)")
 
 
+def _measure_async() -> None:
+    """FEDML_BENCH_ASYNC A/B (docs/ROBUSTNESS.md §Asynchronous buffered
+    rounds): the loopback cross-process stack under a seeded 1-rank
+    straggler plan, synchronous barrier vs buffered-async — same number of
+    global updates, wall-clock compared. The straggler owns every sync
+    round (PR 3's critical path); async keeps aggregating without it. The
+    env var picks the HEADLINE leg (lenient 0|1 spelling like
+    FEDML_BENCH_PIPELINE); both legs always ride the blob. Runs forced-CPU
+    loopback — the measurement isolates the round-coordination protocol,
+    not device throughput."""
+    t0 = time.perf_counter()
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.chaos import FaultPlan
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.models.linear import LogisticRegression
+
+    rounds = _env_int("FEDML_BENCH_ASYNC_ROUNDS", 6)
+    world = _env_int("FEDML_BENCH_ASYNC_WORLD", 4)
+    delay_s = float(os.environ.get("FEDML_BENCH_ASYNC_STRAGGLE_S", "0.3"))
+    data = synthetic_images(num_clients=8, image_shape=(8, 8, 1),
+                            num_classes=4, samples_per_client=24,
+                            test_samples=96, seed=3)
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                       client_num_per_round=world - 1, batch_size=8, lr=0.1,
+                       frequency_of_the_test=10_000, seed=0)
+    plan = lambda: FaultPlan.from_json(  # noqa: E731 — rebuilt per leg
+        {"seed": 11, "rules": [{"fault": "straggle", "src": [2], "dst": [0],
+                                "delay_s": delay_s}]})
+    run_simulated(data, task, cfg, job_id="bench-async-warm")  # compile leg
+    _mark(t0, "async A/B warm run done")
+
+    def leg(async_mode: bool) -> dict:
+        tl = time.perf_counter()
+        agg = run_simulated(
+            data, task, cfg, job_id=f"bench-async-{int(async_mode)}",
+            chaos_plan=plan(), round_timeout_s=10.0,
+            **(dict(async_buffer_k=max(2, (world - 1) // 2),
+                    staleness="poly:0.5") if async_mode else {}))
+        dt = time.perf_counter() - tl
+        if not agg.history or agg.history[-1]["round"] != rounds - 1:
+            raise RuntimeError(
+                f"async A/B leg(async={async_mode}) did not complete "
+                f"{rounds} global updates: {agg.history[-1:]}")
+        return {"seconds": round(dt, 3),
+                "rounds_per_sec": round(rounds / dt, 3),
+                "updates": rounds}
+
+    ab = {"off": leg(False), "on": leg(True)}
+    _mark(t0, f"async A/B measured: {ab}")
+    head = "on" if os.environ.get("FEDML_BENCH_ASYNC", "1") != "0" else "off"
+    rec = {
+        "metric": "fedavg_async_buffered_rounds_per_sec",
+        "value": ab[head]["rounds_per_sec"],
+        "unit": "rounds/sec",
+        "mode": f"async_ab_{head}",
+        "async_ab": ab,
+        "straggle_s": delay_s,
+        "rounds": rounds,
+        "world_size": world,
+        "speedup_async_vs_sync": round(
+            ab["off"]["seconds"] / max(ab["on"]["seconds"], 1e-9), 2),
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     here = os.path.abspath(__file__)
+    if os.environ.get("FEDML_BENCH_ASYNC") is not None:
+        # protocol-level A/B — forced-CPU child (loopback threads; the
+        # accelerator adds nothing but lease risk to this measurement)
+        rc, out = _run_child([here, "--measure", "async"],
+                             _cpu_env(os.environ),
+                             _env_int("FEDML_BENCH_ASYNC_TIMEOUT", 600))
+        rec = _last_json_line(out)
+        if rec is None:
+            raise RuntimeError(f"bench: async A/B child failed (rc={rc})")
+        _emit(rec)
+        return
     env, backend = _probe_backend()
 
     cheap_timeout = _env_int("FEDML_BENCH_CHEAP_TIMEOUT", 900)
@@ -636,6 +716,9 @@ def _last_recorded_tpu_result(base: str | None = None) -> dict | None:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
-        _measure(sys.argv[2])
+        if sys.argv[2] == "async":
+            _measure_async()
+        else:
+            _measure(sys.argv[2])
     else:
         main()

@@ -1610,6 +1610,39 @@ class FedAvgAPI:
             self.tracer.next_round()
         return self.net
 
+    # ------------------------------------------------------------------ async
+    def run_async(self, num_updates: int, buffer_k: int,
+                  staleness="constant", staleness_bound: int | None = None,
+                  deadline_s: float | None = None,
+                  capacity: int | None = None, chaos_plan=None,
+                  adversary_plan=None, base_duration_s: float = 1.0):
+        """Buffered-async rounds on a virtual clock (docs/ROBUSTNESS.md
+        §Asynchronous buffered rounds; core/async_buffer.py): worker slots
+        train continuously against possibly-stale globals, the server
+        aggregates every ``buffer_k`` sanitized arrivals with
+        staleness-discounted weights through this engine's own gate/
+        estimator/server_update composition, and admission control
+        rejects-and-requeues updates staler than ``staleness_bound``. A
+        chaos FaultPlan's straggle/crash rules drive the virtual durations,
+        so async-vs-sync wall-clock claims are deterministic and replay
+        bit-for-bit. ``buffer_k = cohort`` with ``staleness_bound = 0`` is
+        bitwise-identical to the run_round loop — model bits AND quarantine
+        ledger (test-enforced).
+
+        Returns the runner (``.history`` per-update records, ``.stats()``
+        wall-clock/staleness/shed summary); the engine's net/opt/rng/
+        quarantine advance exactly as if the updates had run
+        synchronously."""
+        from fedml_tpu.core.async_buffer import VirtualClockAsyncRunner
+
+        runner = VirtualClockAsyncRunner(
+            self, buffer_k, staleness=staleness,
+            staleness_bound=staleness_bound, deadline_s=deadline_s,
+            capacity=capacity, chaos_plan=chaos_plan,
+            adversary_plan=adversary_plan, base_duration_s=base_duration_s)
+        runner.run(num_updates)
+        return runner
+
     # ------------------------------------------------------------------ state
     def load_state(self, net, server_opt_state, rng):
         """Install restored state, re-placing it for the engine's mesh (a

@@ -173,13 +173,55 @@ class GrpcCommManager(BaseCommManager):
                 self._channels[dest] = ch
         return ch.unary_unary(f"/{_SERVICE}/{_METHOD}")
 
+    # transient-retry policy: bounded exponential backoff (base doubling,
+    # capped) with deterministic half-jitter — sha256 of (src, dst, seq,
+    # attempt), not a shared RNG, so two ranks retrying the same dead peer
+    # desynchronize without perturbing any seeded replay
+    _RETRY_BASE_S = 0.25
+    _RETRY_CAP_S = 5.0
+    # per-attempt RPC deadline, ESCALATING per retry (30, 60, 120, ... up
+    # to the remaining budget): a single attempt must not absorb the whole
+    # send budget — or DEADLINE_EXCEEDED could only ever mean "budget
+    # gone" and the retry path would never see a wedged stream as
+    # transient — but a genuinely slow large-frame transfer must
+    # eventually get a window as wide as the budget allows, or the cap
+    # itself would starve links the uncapped sender handled fine
+    _ATTEMPT_TIMEOUT_S = 30.0
+
+    def _retry_reason(self, e) -> str | None:
+        """Status-code label when ``e`` is transient (retry), else None
+        (permanent — surface it). UNAVAILABLE = peer restarting/not yet
+        listening; DEADLINE_EXCEEDED = one attempt timed out (congestion,
+        a wedged stream) — the NEXT attempt on a fresh channel often
+        lands. Everything else (UNIMPLEMENTED, INVALID_ARGUMENT, resource
+        exhaustion) is a real error retries would only hide."""
+        code = e.code() if hasattr(e, "code") else None
+        if code == self._grpc.StatusCode.UNAVAILABLE:
+            return "unavailable"
+        if code == self._grpc.StatusCode.DEADLINE_EXCEEDED:
+            return "deadline_exceeded"
+        return None
+
+    @staticmethod
+    def _retry_jitter(src: int, dest: int, seq: int, attempt: int) -> float:
+        """Uniform [0, 1) draw, pure in its arguments (the chaos plan's
+        sha256-counter idiom)."""
+        import hashlib
+
+        h = hashlib.sha256(
+            f"grpc-retry|{src}|{dest}|{seq}|{attempt}".encode()).digest()
+        return int.from_bytes(h[:8], "little") / 2.0 ** 64
+
     def send_message(self, msg: Message) -> None:
         """Deliver one frame. ``wait_for_ready`` queues the RPC until the
         peer's server is actually listening (peers boot in arbitrary order —
         the reference sidesteps this only because mpirun barriers before
         main; a raw send here would fail fast with UNAVAILABLE while the
-        receiver is still starting jax). A short retry loop covers the
-        remaining transient-drop window (peer restart between frames)."""
+        receiver is still starting jax). Transient failures (UNAVAILABLE /
+        DEADLINE_EXCEEDED) retry under bounded exponential backoff with
+        deterministic jitter until ``send_timeout_s`` is spent — each retry
+        counted in ``comm_send_retries_total{reason}`` — and a permanent
+        failure raises loudly instead of wedging the rank."""
         import time
 
         dest = int(msg.get_receiver_id())
@@ -193,23 +235,36 @@ class GrpcCommManager(BaseCommManager):
         attempt = 0
         while True:
             try:
+                attempt_cap = self._ATTEMPT_TIMEOUT_S * (2.0 ** attempt)
                 self._stub(dest)(
-                    frame, timeout=max(1.0, deadline - time.monotonic()),
+                    frame,
+                    timeout=max(1.0, min(attempt_cap,
+                                         deadline - time.monotonic())),
                     wait_for_ready=True,
                 )
                 return
             except self._grpc.RpcError as e:
-                code = e.code() if hasattr(e, "code") else None
-                retriable = code == self._grpc.StatusCode.UNAVAILABLE
-                if not retriable or time.monotonic() >= deadline:
+                reason = self._retry_reason(e)
+                if reason is None or time.monotonic() >= deadline:
+                    # permanent (or budget exhausted): the caller decides —
+                    # the elastic server marks the rank undeliverable, a
+                    # client dies visibly — but never a silent hang
+                    log.error(
+                        "send to rank %d failed permanently after %d "
+                        "retr%s (%s)", dest, attempt,
+                        "y" if attempt == 1 else "ies",
+                        reason or getattr(e, "code", lambda: e)())
                     raise
                 attempt += 1
                 # wire accounting: _encode counted this frame once (logical
-                # send); each retry moves the bytes again
+                # send); each retry moves the bytes again — plus the
+                # per-reason attempt counter the flaky-link diagnosis needs
                 from fedml_tpu.obs import comm_instrument as _obs
 
+                _obs.record_send_retry(self.backend_name, reason)
                 _obs.record_retransmit(self.backend_name, len(frame))
-                log.warning("send to rank %d unavailable (attempt %d), retrying", dest, attempt)
+                log.warning("send to rank %d %s (attempt %d), retrying",
+                            dest, reason, attempt)
                 # Drop (don't close) the cached channel: a dead peer's channel
                 # can linger in TRANSIENT_FAILURE with long reconnect backoff,
                 # but close() would cancel another thread's in-flight RPC on
@@ -222,8 +277,13 @@ class GrpcCommManager(BaseCommManager):
                 # wait_for_ready throttles only connection establishment; if
                 # the peer accepts connections but fails RPCs (restart loop,
                 # GOAWAY during shutdown) each attempt returns immediately —
-                # the capped sleep bounds the spin.
-                time.sleep(min(0.5 * attempt, 5.0))
+                # the backoff bounds the spin, the jitter de-thunders it.
+                back = min(self._RETRY_BASE_S * (2.0 ** (attempt - 1)),
+                           self._RETRY_CAP_S)
+                back *= 0.5 + 0.5 * self._retry_jitter(self.rank, dest, seq,
+                                                       attempt)
+                time.sleep(min(back, max(0.0,
+                                         deadline - time.monotonic())))
 
     def stop_receive_message(self) -> None:
         super().stop_receive_message()

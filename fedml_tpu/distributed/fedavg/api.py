@@ -72,6 +72,12 @@ def run_simulated(
     warmup: bool = False,
     shard_server_state: bool = False,
     partition_rules=None,
+    async_buffer_k: int | None = None,
+    staleness="constant",
+    staleness_bound: int | None = None,
+    buffer_deadline_s: float | None = None,
+    buffer_capacity: int | None = None,
+    heartbeat_max_age_s: float | None = None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -100,7 +106,26 @@ def run_simulated(
     broadcast-pack time (docs/PERFORMANCE.md §Partitioned server state).
     Bit-exact vs the replicated server; no-op with one local device.
     ``partition_rules`` overrides the default rule table (same format as
-    the standalone engine's — ``rules_from_json`` output is accepted)."""
+    the standalone engine's — ``rules_from_json`` output is accepted).
+
+    ``async_buffer_k``: arm buffered-async rounds (docs/ROBUSTNESS.md
+    §Asynchronous buffered rounds) — the server aggregates as soon as K
+    sanitized arrivals are staged (or ``buffer_deadline_s`` fires),
+    weighting each by the ``staleness`` discount ('constant' | 'poly:A' |
+    'exp:A'); ``staleness_bound`` rejects-and-requeues staler updates
+    (bound 0 = the synchronous barrier expressed async — bitwise-identical
+    to the sync path at K = cohort, test-enforced); ``buffer_capacity``
+    bounds the staging queue (overflow sheds the stalest, never blocks);
+    ``heartbeat_max_age_s`` arms heartbeat-driven cohort admission (sync
+    AND async: silent ranks are excluded until a reprobe brings them
+    back)."""
+    if async_buffer_k is not None and sparsify_ratio:
+        # fail at launch, not inside the server's receive handler after a
+        # full local fit: a top-k delta is relative to the exact broadcast
+        # the client received, which the async server has advanced past
+        raise ValueError("async_buffer_k requires dense uploads — drop "
+                         "sparsify_ratio (sparse deltas densify against a "
+                         "broadcast the async server no longer holds)")
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -117,7 +142,14 @@ def run_simulated(
         server = FedAvgServerManager(aggregator_, rank=0, size=size,
                                      backend=backend, ckpt_dir=ckpt_dir,
                                      round_timeout_s=round_timeout_s,
-                                     telemetry=telemetry, **kw)
+                                     telemetry=telemetry,
+                                     async_buffer_k=async_buffer_k,
+                                     staleness=staleness,
+                                     staleness_bound=staleness_bound,
+                                     buffer_deadline_s=buffer_deadline_s,
+                                     buffer_capacity=buffer_capacity,
+                                     heartbeat_max_age_s=heartbeat_max_age_s,
+                                     **kw)
         clients = [
             init_client(dataset, task, cfg, rank, size, backend,
                         sparsify_ratio=sparsify_ratio,

@@ -89,12 +89,23 @@ class FedAvgClientManager(ClientManager):
             buf.on_broadcast(blob)
         span = buf.span if buf is not None else \
             (lambda _name: contextlib.nullcontext())
+        # buffered-async dispatch (docs/ROBUSTNESS.md §Asynchronous buffered
+        # rounds): the server's dispatch-wave counter is the work-unit key —
+        # the local fit folds its rng/batch order by the WAVE (so a
+        # requeued dispatch within one global version draws fresh batches,
+        # matching the virtual-clock simulator's key chain), and the wave
+        # is echoed on the upload so the server attributes it exactly even
+        # with two dispatches in flight after a reprobe. Absent on
+        # synchronous rounds: round_idx keys the fit, nothing is echoed,
+        # and the wire is unchanged.
+        wave = msg_params.get(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE)
         global_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
         with span("unpack"):
             self.trainer.update_model(global_leaves)
             self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         with span("local_fit"):
-            wire_leaves, local_sample_num = self.trainer.train(self.round_idx)
+            wire_leaves, local_sample_num = self.trainer.train(
+                self.round_idx if wave is None else int(wave))
         if self.adversary_plan is not None:
             from fedml_tpu.chaos.adversary import perturb_leaves
 
@@ -116,6 +127,13 @@ class FedAvgClientManager(ClientManager):
                 msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
             msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if wave is not None:  # echo the async work-unit key verbatim
+                msg.add_params(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE, int(wave))
+                # ... and the client id, so the server's ingest path never
+                # rebuilds the seeded sampling permutation per upload
+                msg.add_params(
+                    MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                    int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         if buf is not None:  # span buffer + clock stamps ride the uplink
             msg.add_params(TRACE_KEY, buf.upload_blob())
         self._send_upload(msg)

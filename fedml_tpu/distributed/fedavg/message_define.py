@@ -16,6 +16,14 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_ROUND = "round_idx"
+    # buffered-async dispatch (docs/ROBUSTNESS.md §Asynchronous buffered
+    # rounds): the rank's dispatch-wave counter rides the downlink and is
+    # echoed verbatim on the upload — the server must not reconstruct it
+    # from its own counter (a reprobe can put two dispatches in flight),
+    # and the client folds its local-fit rng/batch order by the WAVE, so
+    # a requeued dispatch draws fresh batches instead of replaying the
+    # version-keyed ones. Absent on synchronous rounds (wire unchanged).
+    MSG_ARG_KEY_DISPATCH_WAVE = "dispatch_wave"
     # sparse uplink (comm/sparse.py): flat top-k indices + values per leaf,
     # replacing MODEL_PARAMS; the server densifies against its global
     MSG_ARG_KEY_SPARSE_IDX = "sparse_idx"

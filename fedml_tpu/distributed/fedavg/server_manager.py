@@ -18,6 +18,15 @@ construction, resumes from the latest checkpoint — a server restart
 continues the job exactly where it stopped (clients are stateless between
 rounds: they receive the global model each sync), so crash-resume ≡ an
 uninterrupted run (tested).
+
+Buffered-async mode (``async_buffer_k=K`` — docs/ROBUSTNESS.md
+§Asynchronous buffered rounds) replaces the barrier with an event-driven
+loop: each upload is admitted (staleness bound / non-finite quarantine),
+staged into a bounded buffer, and its rank immediately re-dispatched;
+K staged arrivals (or ``buffer_deadline_s``) flush one staleness-
+discounted buffered aggregate through the aggregator's usual
+composition. ``heartbeat_max_age_s`` arms heartbeat-driven cohort
+admission on BOTH modes.
 """
 
 from __future__ import annotations
@@ -39,13 +48,85 @@ log = logging.getLogger("fedml_tpu.distributed.fedavg")
 class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0,
                  backend="LOOPBACK", round_timeout_s: float | None = None,
-                 ckpt_dir: str | None = None, telemetry=None, **kw):
+                 ckpt_dir: str | None = None, telemetry=None,
+                 async_buffer_k: int | None = None,
+                 staleness="constant", staleness_bound: int | None = None,
+                 buffer_deadline_s: float | None = None,
+                 buffer_capacity: int | None = None,
+                 heartbeat_max_age_s: float | None = None, **kw):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
         self._bcast_leaves = None  # this round's packed broadcast (sparse)
         self.round_timeout_s = round_timeout_s
         self.ckpt_dir = ckpt_dir
+        # Buffered-async mode (docs/ROBUSTNESS.md §Asynchronous buffered
+        # rounds): ``async_buffer_k`` arms the event-driven loop — clients
+        # train continuously against possibly-stale globals, each upload is
+        # admitted (staleness bound; non-finite quarantined at the door),
+        # staged into a bounded AsyncBuffer (overflow sheds the stalest,
+        # counted, never blocks), and a full buffer (or deadline) flushes a
+        # staleness-discounted gated aggregate, after which the uploading
+        # ranks are immediately re-dispatched with the fresh global.
+        # ``round_idx`` then counts GLOBAL UPDATES (buffer flushes), so the
+        # checkpoint/eval/telemetry cadence carries over unchanged. None =
+        # the synchronous barrier, untouched.
+        self._async = async_buffer_k is not None
+        self._buffer = None
+        if self._async:
+            from fedml_tpu.core.async_buffer import (AsyncBuffer,
+                                                     StalenessPolicy)
+
+            self._staleness = StalenessPolicy.from_spec(
+                staleness, bound=staleness_bound)
+            self._discount_np = self._staleness.discount_np()
+            self._buffer = AsyncBuffer(int(async_buffer_k),
+                                       capacity=buffer_capacity)
+            self.buffer_deadline_s = buffer_deadline_s
+            self._buffer_epoch = 0
+            self._buffer_first_t: float | None = None
+            # per-rank dispatch counters (the sampling key: rank r's n-th
+            # dispatch trains client_sampling(n)[r-1], the same structure
+            # the sync round loop uses), outstanding-dispatch set (dedups
+            # chaos-duplicated uploads and drives the reprobe), and the
+            # bound-0 parking lot (see StalenessPolicy.synchronous)
+            self._dispatch_wave: dict[int, int] = {}
+            # rank -> the ONE outstanding dispatch's wave: the upload gate
+            # folds exactly the wave it awaits, so a reprobe's superseded
+            # twin (or a chaos duplicate) is dropped instead of spawning a
+            # second self-perpetuating dispatch stream
+            self._awaiting: dict[int, int] = {}
+            self._parked: list[int] = []
+            self._last_dispatch_version: dict[int, int] = {}
+            self._bcast_version = -1
+            self._bcast_pack = None
+            # graceful drain: after the last flush the server keeps its
+            # receive loop up until every outstanding dispatch's upload
+            # landed (and was discarded), so in-flight clients never race
+            # a torn-down transport; a grace timer bounds the wait when a
+            # rank crashed mid-dispatch
+            self._draining = False
+            self._drain_grace_s = round_timeout_s or 2.0
+            # reprobe grace is WALL-CLOCK, not versions: with small K and a
+            # large fleet, _DEAD_RANK_REPROBE_ROUNDS global updates can
+            # elapse faster than one slow rank's honest fit — declaring its
+            # wave lost on version age alone would drop every upload it
+            # ever produces (permanent starvation). A wave is only declared
+            # lost after this many SECONDS since its dispatch.
+            self._reprobe_grace_s = (round_timeout_s or buffer_deadline_s
+                                     or 30.0)
+            self._last_dispatch_t: dict[int, float] = {}
+            # per-JOB shed tally for round records (the registry counter is
+            # process-cumulative: soak campaigns run many jobs per process,
+            # and trial N's records must not carry trial N-1's sheds)
+            self._shed_counts: dict[str, int] = {}
+            from fedml_tpu.obs import perf_instrument as _perf
+
+            # pre-register every shed reason so the Prometheus export
+            # carries the full fed_async_shed_total family (zeros
+            # included) the moment async mode is armed
+            _perf.ensure_async_shed_families()
+        self.heartbeat_max_age_s = heartbeat_max_age_s
         # rank -> round its delivery last failed. Initialized HERE, not
         # lazily at first failure: two sender paths (round loop + watchdog
         # thread) can fail concurrently, and a hasattr-then-create race
@@ -60,6 +141,17 @@ class FedAvgServerManager(ServerManager):
         # Telemetry bundle opted in (trace_dir / trace=True). None = no
         # __trace params on any frame — the wire is byte-identical.
         self._dtracer = telemetry.tracer if telemetry is not None else None
+        if self._async and self._dtracer is not None:
+            # the per-round distributed-trace model is sequential
+            # (begin_round..finish_round); async flushes overlap in-flight
+            # client work — same policy as the pipelined drivers: say so
+            # loudly, emit no round traces (round records still carry the
+            # async staleness/shed block)
+            log.warning("async buffered mode emits no per-round distributed "
+                        "traces (client work overlaps flushes; the trace "
+                        "model is sequential) — run synchronously for "
+                        "trace-dir runs")
+            self._dtracer = None
         if telemetry is not None:
             import dataclasses
 
@@ -248,6 +340,35 @@ class FedAvgServerManager(ServerManager):
         # answer the broadcast — uploads tagged with any other round are
         # rejected at the slotting layer (add_local_trained_result)
         self.aggregator.begin_round(self.round_idx)
+        # heartbeat-driven cohort admission (docs/ROBUSTNESS.md
+        # §Asynchronous buffered rounds): ranks silent past the age
+        # threshold are excluded from this round — no send, and the round
+        # barrier does not wait for them (the aggregator's excluded set) —
+        # except on reprobe rounds, which re-invite them so a resumed rank
+        # rejoins; its first frame resets the age and readmits it for good
+        suspects = _obs.suspect_ranks(
+            range(1, self.size), self.heartbeat_max_age_s, self.round_idx,
+            self._DEAD_RANK_REPROBE_ROUNDS)
+        self.aggregator.excluded = {r - 1 for r in suspects}
+        if (self.heartbeat_max_age_s is not None
+                and self.round_idx % self._DEAD_RANK_REPROBE_ROUNDS == 0):
+            # reprobe round: force a REAL send attempt to every silent rank
+            # — the elastic undeliverable skip runs on its own (failed_at
+            # anchored) cadence, and the two schedules can otherwise never
+            # align, leaving a resumed rank permanently uninvited
+            silent = _obs.suspect_ranks(
+                range(1, self.size), self.heartbeat_max_age_s,
+                self.round_idx, 0)  # reprobe_every=0: the raw verdict
+            for rank in list(self._undeliverable):
+                if rank in silent:
+                    self._undeliverable.pop(rank, None)
+            self._update_alive_gauge()
+        if suspects:
+            log.warning("round %d: heartbeat-suspect ranks %s excluded "
+                        "from the cohort (age > %.2fs; reprobed every %d "
+                        "rounds)", self.round_idx, sorted(suspects),
+                        self.heartbeat_max_age_s,
+                        self._DEAD_RANK_REPROBE_ROUNDS)
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
         # codec their deltas are relative to the decoded broadcast
         self._bcast_leaves = codec_roundtrip(global_params)
@@ -255,6 +376,8 @@ class FedAvgServerManager(ServerManager):
         if tr is not None:
             tr.begin_round(self.round_idx)
         for rank in range(1, self.size):
+            if rank in suspects:
+                continue
             msg = Message(msg_type, self.rank, rank)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
@@ -266,8 +389,340 @@ class FedAvgServerManager(ServerManager):
             tr.end_broadcast()
 
     def send_init_msg(self):
+        if self._async:
+            # async boot: every rank gets wave-0 work individually (same
+            # cohort assignment as the sync broadcast — rank r trains
+            # client_sampling(0)[r-1]); from here on dispatch is
+            # event-driven, one rank at a time as uploads land
+            self.aggregator.begin_round(self.round_idx)
+            for rank in range(1, self.size):
+                self._dispatch_one(rank, MyMessage.MSG_TYPE_S2C_INIT_CONFIG)
+            return
         self._broadcast_model(MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
                               self.aggregator.get_global_model_params())
+
+    # ------------------------------------------------- async buffered mode
+    # The event-driven loop of docs/ROBUSTNESS.md §Asynchronous buffered
+    # rounds. All state below is touched under _round_lock only.
+    def _dispatch_one(self, rank: int,
+                      msg_type: str | None = None) -> None:
+        """Hand ``rank`` its next unit of work: the current global model
+        (packed once per version) + the client its dispatch-wave counter
+        samples. Heartbeat-suspect ranks are skipped (admission control) —
+        the flush-time reprobe re-dispatches them once they may have
+        resumed."""
+        suspects = _obs.suspect_ranks(
+            range(1, self.size), self.heartbeat_max_age_s, self.round_idx,
+            self._DEAD_RANK_REPROBE_ROUNDS)
+        if rank in suspects:
+            self._record_shed("suspect")
+            log.warning("async: not dispatching to heartbeat-suspect rank "
+                        "%d (reprobed every %d updates)", rank,
+                        self._DEAD_RANK_REPROBE_ROUNDS)
+            return
+        import time as _time
+
+        wave = self._dispatch_wave.get(rank, 0)
+        self._dispatch_wave[rank] = wave + 1
+        self._last_dispatch_version[rank] = self.round_idx
+        self._last_dispatch_t[rank] = _time.monotonic()
+        if self._bcast_version != self.round_idx or self._bcast_pack is None:
+            self._bcast_pack = self.aggregator.get_global_model_params()
+            self._bcast_version = self.round_idx
+        cid = int(self.aggregator.client_sampling(wave)[rank - 1])
+        msg = Message(msg_type or MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                      self.rank, rank)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self._bcast_pack)
+        msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, cid)
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        # the wave rides the dispatch and comes back on the upload: it is
+        # the work-unit key (sampling + the client's rng/batch fold), and
+        # reconstructing it server-side from the counter would misattribute
+        # a delayed upload once a reprobe puts two dispatches in flight
+        msg.add_params(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE, wave)
+        self._awaiting[rank] = wave
+        self.send_message(msg)
+        if rank in self._undeliverable:
+            # elastic send failure: nothing is outstanding for this rank —
+            # the flush-time reprobe owns bringing it back
+            self._awaiting.pop(rank, None)
+
+    def _handle_async_upload(self, msg_params) -> None:
+        """Admission -> staging -> maybe flush -> re-dispatch. Caller holds
+        _round_lock."""
+        import time as _time
+
+        import numpy as np
+
+        from fedml_tpu.core.async_buffer import BufferedUpdate
+
+        sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+        if self._draining or self.round_idx >= self.round_num:
+            # post-FINISH drain: absorb (and discard) the uploads that
+            # were in flight when the job completed, then stop the loop —
+            # clients never see a torn-down transport mid-upload
+            self._awaiting.pop(sender, None)
+            if self._draining and not self._awaiting:
+                log.info("async: drain complete — stopping")
+                self.finish()
+            return
+        if MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params:
+            raise RuntimeError(
+                "async buffered mode requires dense uploads: a top-k delta "
+                "is relative to the exact broadcast the client received, "
+                "and the async server has advanced past it — launch "
+                "clients without sparsify under --async_buffer_k")
+        expected_wave = self._awaiting.get(sender)
+        # the echoed dispatch wave is authoritative (see _dispatch_one);
+        # the fallback covers interop peers that drop unknown keys
+        wave = msg_params.get(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE)
+        wave = expected_wave if wave is None else int(wave)
+        if expected_wave is None or wave != expected_wave:
+            # chaos-duplicated or superseded upload: either the rank has no
+            # outstanding dispatch, or this is the abandoned twin of a
+            # reprobe (the reprobe DECLARED that wave lost and reissued) —
+            # exactly-once folding, like the sync round-tag gate
+            _obs.record_stale_upload("stale")
+            log.warning("async: drop upload from rank %d for wave %s "
+                        "(awaiting %s)", sender, wave, expected_wave)
+            return
+        self._awaiting.pop(sender, None)
+        trained_version = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                             self.round_idx))
+        staleness = self.round_idx - trained_version
+        if not self._staleness.admits(staleness):
+            # admission control: reject-and-requeue with the fresh global
+            self._record_shed("stale")
+            log.warning("async: rejecting upload from rank %d at staleness "
+                        "%d > bound %d — requeued", sender, staleness,
+                        self._staleness.bound)
+            self._dispatch_one(sender)
+            return
+        wire_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        # the work unit's client id: echoed from the dispatch frame (like
+        # the wave) so the hot path never rebuilds the O(client_num_in_
+        # total) seeded sampling permutation under _round_lock; the
+        # fallback recomputes it for interop peers that drop unknown keys
+        client = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        client = (int(self.aggregator.client_sampling(wave)[sender - 1])
+                  if client is None else int(client))
+        finite = all(np.isfinite(v).all() for v in wire_leaves
+                     if isinstance(v, np.ndarray)
+                     and np.issubdtype(v.dtype, np.floating))
+        if not finite:
+            # PR-4 quarantine at the door: a non-finite arrival never
+            # enters the buffer (norm outliers still gate at flush, where
+            # the cohort median exists)
+            self.aggregator.quarantine.record(
+                self.round_idx, sender, "nonfinite", client=client)
+            _obs.record_update_rejected("nonfinite")
+            self._record_shed("nonfinite")
+            self._dispatch_one(sender)
+            return
+        now = _time.monotonic()
+        if len(self._buffer) == 0:
+            self._buffer_first_t = now
+            self._arm_deadline()
+        entry = BufferedUpdate(
+            rank=sender, client=client,
+            version=trained_version, wave=wave,
+            payload=self.aggregator._stage_upload(wire_leaves),
+            nsamp=float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]),
+            seq=wave * self.size + sender, t_arrival=now)
+        for victim in self._buffer.add(entry):
+            # backpressure: shed the stalest pending update, never block.
+            # Counting is ALL a victim needs: an old victim's rank already
+            # has outstanding work (it was re-dispatched when its entry was
+            # staged — or parked, in bound-0 mode), and a shed-on-arrival
+            # sender gets its one park-or-redispatch below like any other
+            # consumed upload
+            self._record_shed("overflow")
+            log.warning("async: buffer overflow shed rank %d's update "
+                        "(trained at version %d)", victim.rank,
+                        victim.version)
+        if self._staleness.synchronous:
+            # bound 0 = the barrier expressed async: work dispatched now
+            # would be born stale post-flush — park until the flush lands
+            self._parked.append(sender)
+        else:
+            self._dispatch_one(sender)
+        if self._buffer.ready:
+            self._flush_buffer()
+
+    def _flush_buffer(self) -> None:
+        """One buffered aggregate = one global update: staleness-discounted
+        weights through the aggregator's gated composition (the SUBCLASS
+        ``aggregate()``, so FedOpt server momentum / robust clip+noise
+        apply on top), then eval/checkpoint/telemetry at the sync round
+        cadence, then re-dispatch of every parked rank with the fresh
+        global. Caller holds _round_lock."""
+        import time as _time
+
+        import numpy as np
+
+        from fedml_tpu.obs import perf_instrument as _perf
+
+        entries = self._buffer.drain()
+        self._buffer_epoch += 1
+        if not entries or self.round_idx >= self.round_num:
+            return
+        version = self.round_idx
+        self.aggregator.begin_round(version)
+        stale = np.asarray([version - e.version for e in entries],
+                           np.float32)
+        discounts = [float(d) for d in self._discount_np(stale)]
+        weights = [e.nsamp * d for e, d in zip(entries, discounts)]
+        self.aggregator.load_buffered(entries, weights,
+                                      discounts=discounts)
+        for s in stale:
+            _perf.record_update_staleness(float(s))
+        now = _time.monotonic()
+        fill_s = now - (self._buffer_first_t
+                        if self._buffer_first_t is not None else now)
+        _perf.record_buffer_fill(fill_s)
+        self._buffer_first_t = None
+        tel = self.telemetry
+        try:
+            if tel is not None:
+                old_leaves = [np.asarray(v) for v in
+                              self.aggregator.get_global_model_params()]
+                with self._tracer.span("aggregate"):
+                    global_params = self.aggregator.aggregate()
+                with self._tracer.span("eval"):
+                    self.aggregator.test_on_server_for_all_clients(version)
+                upd_sq = sum(float(np.sum((np.asarray(n) - o) ** 2))
+                             for n, o in zip(global_params, old_leaves))
+                hist = self.aggregator.history
+                q = self.aggregator.quarantine.for_round(version)
+                tel.emit_round(
+                    version, clients=[e.client for e in entries],
+                    spans=dict(self._tracer.rounds[-1]),
+                    metrics={"update_norm": float(np.sqrt(upd_sq)),
+                             "num_samples": float(sum(e.nsamp
+                                                      for e in entries))},
+                    evals=(hist[-1] if hist
+                           and hist[-1].get("round") == version else None),
+                    **{"async": {
+                        "k": len(entries),
+                        "staleness": [int(s) for s in stale],
+                        "buffer_fill_s": round(fill_s, 6),
+                        "shed": self._shed_snapshot()}},
+                    **({"quarantine": q} if q else {}))
+                self._tracer.next_round()
+            else:
+                self.aggregator.aggregate()
+                self.aggregator.test_on_server_for_all_clients(version)
+        finally:
+            self.aggregator._async_meta = None
+        self._maybe_save()
+        self.round_idx += 1
+        self._bcast_pack = None  # repack lazily at the next dispatch
+        if self.round_idx >= self.round_num:
+            self._finish_async()
+            return
+        parked, self._parked = self._parked, []
+        for rank in parked:
+            self._dispatch_one(rank)
+        self._async_reprobe()
+
+    def _finish_async(self) -> None:
+        """Broadcast FINISH, then DRAIN instead of tearing down: the
+        receive loop stays up until every outstanding dispatch's upload
+        has landed (each is discarded by the drain gate above), bounded by
+        a grace timer for ranks that died mid-dispatch. Caller holds
+        _round_lock."""
+        # final best-effort delivery to EVERY rank, including ones the
+        # elastic sender had marked undeliverable — a skipped FINISH
+        # leaves that client blocked in its receive loop until the
+        # simulated-launch join timeout abandons the thread
+        self._undeliverable.clear()
+        self._update_alive_gauge()
+        for rank in range(1, self.size):
+            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH,
+                                      self.rank, rank))
+        if not self._awaiting:
+            self.finish()
+            return
+        self._draining = True
+        log.info("async: job complete — draining %d in-flight upload(s) "
+                 "(grace %.1fs)", len(self._awaiting), self._drain_grace_s)
+        t = threading.Timer(self._drain_grace_s, self.finish)
+        t.daemon = True
+        t.start()
+
+    def _record_shed(self, reason: str) -> None:
+        """One shed verdict: the process-wide metric family AND this job's
+        own tally (round records must scope to this job)."""
+        from fedml_tpu.obs import perf_instrument as _perf
+
+        _perf.record_async_shed(reason)
+        self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+
+    def _shed_snapshot(self) -> dict:
+        return dict(self._shed_counts)
+
+    def _async_reprobe(self, force: bool = False) -> None:
+        """Bring silent ranks back: a rank whose dispatch went nowhere
+        (send failed elastically, heartbeat-skipped) OR whose upload was
+        lost on the wire (still marked awaiting, but silent for
+        ``_DEAD_RANK_REPROBE_ROUNDS`` global updates) is re-dispatched —
+        the reissue DECLARES the old wave lost, so if its upload turns up
+        late after all, the wave-matched awaiting gate drops it (no second
+        dispatch stream). ``force`` skips the recently-dispatched check:
+        the idle watchdog calls it after ``round_timeout_s`` of total
+        silence, which is staleness evidence in itself — round_idx only
+        advances on flushes, so a fully stalled fleet would otherwise
+        never look old enough to reprobe. Both paths still respect the
+        WALL-CLOCK grace: version age alone would starve any honest rank
+        slower than _DEAD_RANK_REPROBE_ROUNDS flush intervals (small-K
+        fleets flush fast), declaring its in-flight wave lost over and
+        over while its uploads die at the gate. Caller holds
+        _round_lock."""
+        import time as _time
+
+        now = _time.monotonic()
+        for rank in range(1, self.size):
+            if rank in self._parked:
+                continue
+            last = self._last_dispatch_version.get(rank)
+            if not force and last is not None and \
+                    (self.round_idx - last) < \
+                    self._DEAD_RANK_REPROBE_ROUNDS:
+                continue  # recently dispatched: give it time
+            t_disp = self._last_dispatch_t.get(rank)
+            if t_disp is not None and \
+                    (now - t_disp) < self._reprobe_grace_s:
+                continue  # dispatched recently in WALL-CLOCK: still alive
+            log.info("async: reprobing silent rank %d", rank)
+            # the reprobe IS the re-invitation: drop the elastic
+            # undeliverable mark so the send is actually attempted
+            self._undeliverable.pop(rank, None)
+            self._update_alive_gauge()
+            self._awaiting.pop(rank, None)
+            self._dispatch_one(rank)
+
+    def _arm_deadline(self) -> None:
+        """Deadline flush: a buffer that has waited ``buffer_deadline_s``
+        since its first arrival aggregates PARTIAL instead of waiting out a
+        straggler cohort — the async analogue of the elastic round
+        timeout."""
+        if self.buffer_deadline_s is None:
+            return
+        epoch = self._buffer_epoch
+        t = threading.Timer(self.buffer_deadline_s, self._deadline_fire,
+                            args=(epoch,))
+        t.daemon = True
+        t.start()
+
+    def _deadline_fire(self, epoch: int) -> None:
+        with self._round_lock:
+            if (self._finished.is_set() or epoch != self._buffer_epoch
+                    or len(self._buffer) == 0):
+                return
+            log.warning("async: buffer deadline fired with %d/%d staged — "
+                        "flushing partial", len(self._buffer),
+                        self._buffer.flush_threshold)
+            self._flush_buffer()
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
@@ -277,6 +732,9 @@ class FedAvgServerManager(ServerManager):
 
     def handle_message_receive_model_from_client(self, msg_params):
         with self._round_lock:
+            if self._async:
+                self._handle_async_upload(msg_params)
+                return
             sender = msg_params[Message.MSG_ARG_KEY_SENDER]
             msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             if int(msg_round) != self.round_idx:
@@ -363,6 +821,25 @@ class FedAvgServerManager(ServerManager):
     def on_timeout(self, idle_s: float):
         """Watchdog (own thread): no traffic for round_timeout_s."""
         with self._round_lock:
+            if self._async:
+                # async analogue of elastic partial aggregation: a stalled
+                # fleet flushes whatever is staged; a fully empty buffer
+                # means every rank is dark — reprobe them instead of
+                # waiting forever. A DRAINING server is quiet by design
+                # (FINISH is out; reprobing would hand new work to clients
+                # that already exited) — let the grace timer finish it.
+                if self._finished.is_set() or self._draining:
+                    return
+                if len(self._buffer):
+                    log.warning("async: fleet idle %.1fs — flushing %d "
+                                "staged update(s)", idle_s,
+                                len(self._buffer))
+                    self._flush_buffer()
+                else:
+                    log.error("async: fleet idle %.1fs with an empty "
+                              "buffer — reprobing silent ranks", idle_s)
+                    self._async_reprobe(force=True)
+                return
             received = [i + 1 for i, v in
                         self.aggregator.flag_client_model_uploaded.items() if v]
             missing = [i + 1 for i, v in

@@ -98,8 +98,15 @@ class FedAvgRobustAggregator(FedAvgAggregator):
         m_received = len(self.model_dict)
         if self.defense_type == "dp":
             # uniform average: the C/m sensitivity the noise assumes does
-            # not survive sample-count weighting on unbalanced data
-            self.sample_num_dict = {r: 1 for r in self.sample_num_dict}
+            # not survive sample-count weighting on unbalanced data. The
+            # DP argument drops the SAMPLE-COUNT half of the weight only —
+            # an async buffered flush's staleness discount (load_buffered's
+            # side table) still applies, or --staleness would be silently
+            # disabled exactly when the defense is on
+            disc = getattr(self, "_async_discounts", None)
+            self.sample_num_dict = {
+                r: (1 if disc is None else disc.get(r, 1.0))
+                for r in self.sample_num_dict}
         self._aggregate_core()  # weighted average -> self.net, unpacked
         if self.defense_type in ("weak_dp", "dp"):
             if self.defense_type == "dp":

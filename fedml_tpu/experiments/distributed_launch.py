@@ -83,6 +83,41 @@ def add_args(p: argparse.ArgumentParser):
                         "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
                    help="server round checkpoints; restart resumes the job")
+    p.add_argument("--async_buffer_k", "--async-buffer-k",
+                   dest="async_buffer_k", type=int, default=None,
+                   help="rank 0: buffered-async rounds (docs/ROBUSTNESS.md "
+                        "§Asynchronous buffered rounds) — no round barrier; "
+                        "clients train continuously and the server "
+                        "aggregates every K sanitized arrivals with "
+                        "staleness-discounted weights, so stragglers "
+                        "degrade throughput instead of serializing the "
+                        "fleet. K = cohort with --staleness_bound 0 is "
+                        "bitwise the synchronous path. Unset = the "
+                        "synchronous barrier. --algo fedavg/fedopt/"
+                        "fedprox/fedavg_robust; incompatible with "
+                        "--sparsify_ratio")
+    p.add_argument("--staleness", type=str, default="constant",
+                   help="async staleness discount: 'constant' | 'poly:A' "
+                        "((1+s)^-A) | 'exp:A' (e^-As) "
+                        "(core/async_buffer.py)")
+    p.add_argument("--staleness_bound", "--staleness-bound",
+                   dest="staleness_bound", type=int, default=None,
+                   help="async admission bound: reject-and-requeue updates "
+                        "staler than this many global updates (0 = the "
+                        "synchronous barrier expressed async; unset = "
+                        "admit any staleness, discount-only)")
+    p.add_argument("--buffer_deadline_s", "--buffer-deadline-s",
+                   dest="buffer_deadline_s", type=float, default=None,
+                   help="async: flush a partially-filled buffer after this "
+                        "many seconds from its first arrival (the async "
+                        "analogue of --round_timeout_s)")
+    p.add_argument("--heartbeat_max_age_s", "--heartbeat-max-age-s",
+                   dest="heartbeat_max_age_s", type=float, default=None,
+                   help="heartbeat-driven cohort admission (sync AND "
+                        "async): exclude ranks whose "
+                        "fed_last_heartbeat_age_seconds exceeds this from "
+                        "the cohort, with a periodic reprobe so a resumed "
+                        "rank rejoins (docs/ROBUSTNESS.md)")
     p.add_argument("--aggregator", type=str, default=None,
                    choices=["mean", "median", "trimmed_mean", "krum",
                             "multi_krum", "geometric_median"],
@@ -253,10 +288,28 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         else:  # fedavg / fedprox share the plain weighted-average server
             agg = FedAvgAggregator(data, task, cfg,
                                    worker_num=args.world_size - 1, **agg_kw)
+        srv_kw: dict = {}
+        if getattr(args, "async_buffer_k", None) is not None:
+            if args.algo == "turboaggregate":
+                raise ValueError(
+                    "--async_buffer_k is not wired for turboaggregate "
+                    "(Shamir shares need the full synchronous cohort)")
+            if getattr(args, "sparsify_ratio", None):
+                raise ValueError(
+                    "--async_buffer_k requires dense uploads "
+                    "(--sparsify_ratio deltas are relative to a broadcast "
+                    "the async server has advanced past)")
+            srv_kw.update(async_buffer_k=args.async_buffer_k,
+                          staleness=args.staleness,
+                          staleness_bound=args.staleness_bound,
+                          buffer_deadline_s=args.buffer_deadline_s)
         return FedAvgServerManager(agg, rank=0, size=args.world_size,
                                    backend=backend, ckpt_dir=args.ckpt_dir,
                                    round_timeout_s=args.round_timeout_s,
-                                   telemetry=telemetry, **backend_kw)
+                                   heartbeat_max_age_s=getattr(
+                                       args, "heartbeat_max_age_s", None),
+                                   telemetry=telemetry, **srv_kw,
+                                   **backend_kw)
 
     # sparse uplinks apply where the upload is plain weights; a
     # turboaggregate share is a masked tensor whose top-k entries are

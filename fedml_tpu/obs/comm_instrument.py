@@ -104,6 +104,21 @@ def record_retransmit(backend: str, nbytes: int) -> None:
     byts.inc(nbytes)
 
 
+@lru_cache(maxsize=32)
+def _send_retries(backend: str, reason: str):
+    return REGISTRY.counter("comm_send_retries_total", backend=backend,
+                            reason=reason)
+
+
+def record_send_retry(backend: str, reason: str) -> None:
+    """A send the transport is about to RETRY after a transient failure,
+    labeled by the failure reason (gRPC status-code name: ``unavailable``,
+    ``deadline_exceeded``). Complements ``comm_retransmits_total`` (bytes
+    moved again) with the per-cause attempt count a flaky-channel
+    diagnosis needs; permanent failures are raised, never counted here."""
+    _send_retries(backend, reason).inc()
+
+
 @lru_cache(maxsize=16)
 def _duplicates(backend: str):
     return REGISTRY.counter("comm_duplicates_dropped_total", backend=backend)
@@ -223,6 +238,57 @@ def refresh_liveness() -> None:
         items = list(_hb_last_seen.items())
     for rank, ts in items:
         _hb_gauge(rank).set(max(0.0, now - ts))
+
+
+def heartbeat_ages(now: float | None = None) -> dict[int, float]:
+    """rank -> seconds since its last decoded frame (the raw stamps behind
+    ``fed_last_heartbeat_age_seconds``), for the heartbeat-driven cohort
+    admission gate (docs/ROBUSTNESS.md §Asynchronous buffered rounds). A
+    rank with no frame yet is absent — never seen is 'unknown', not
+    'infinitely suspect' (a cohort must be dispatchable at boot)."""
+    if now is None:
+        now = time.time()
+    with _hb_lock:
+        return {r: max(0.0, now - ts) for r, ts in _hb_last_seen.items()}
+
+
+def reset_heartbeats() -> None:
+    """Clear the per-process last-seen table (tests: loopback simulations
+    share the process-wide stamps, so a previous job's silence must not
+    mark the next job's ranks suspect)."""
+    with _hb_lock:
+        _hb_last_seen.clear()
+
+
+def suspect_ranks(ranks, max_age_s: float | None, round_idx: int,
+                  reprobe_every: int = 4,
+                  ages: dict[int, float] | None = None) -> set[int]:
+    """The heartbeat admission verdict, as a pure function (unit-testable
+    with injected ``ages``): a rank is suspect when its heartbeat age
+    exceeds the FRESHEST cohort member's age by more than ``max_age_s`` —
+    RELATIVE, not absolute, because ranks are only heard from once per
+    round: during a server-side stall every healthy rank's absolute age
+    grows past any fixed threshold together (and an absolute rule would
+    exclude the whole cohort and deadlock the barrier), while a dead rank
+    keeps falling behind its liveliest peer without bound. Suspects are
+    re-invited on reprobe rounds (every ``reprobe_every``-th) so a rank
+    that resumed (crash window over, partition healed) can rejoin: its
+    next frame resets the age and readmits it everywhere. A rank with no
+    frame yet is unknown, not suspect (the cohort must be dispatchable at
+    boot)."""
+    if max_age_s is None:
+        return set()
+    if ages is None:
+        ages = heartbeat_ages()
+    if reprobe_every > 0 and round_idx % reprobe_every == 0:
+        return set()
+    known = [ages[int(r)] for r in ranks if ages.get(int(r)) is not None]
+    if not known:
+        return set()
+    base = min(known)
+    return {int(r) for r in ranks
+            if ages.get(int(r)) is not None
+            and ages[int(r)] - base > max_age_s}
 
 
 def set_ranks_alive(n: int) -> None:

@@ -182,3 +182,45 @@ def set_server_state_bytes(placement: str, per_device_bytes: float) -> None:
     server state (ISSUE 6)."""
     REGISTRY.gauge("fed_server_state_bytes",
                    placement=placement).set(per_device_bytes)
+
+
+# ------------------------------------------------ buffered-async metrics
+# docs/ROBUSTNESS.md §Asynchronous buffered rounds. Fed by the async server
+# mode (distributed/fedavg/server_manager.py) and the virtual-clock
+# simulator (core/async_buffer.py) identically:
+#
+#     fed_buffer_fill_seconds        (histogram) first arrival -> flush of
+#                                    each buffered aggregate (virtual
+#                                    seconds in the simulator)
+#     fed_update_staleness           (histogram; prometheus quantile
+#                                    labels) server version at aggregation
+#                                    minus the version each folded update
+#                                    trained against
+#     fed_async_shed_total{reason}   arrivals the ingest path refused or
+#                                    evicted: stale (admission bound),
+#                                    overflow (backpressure shed-stalest),
+#                                    nonfinite (quarantined at the door),
+#                                    crash (simulator: dead-rank dispatch)
+def record_buffer_fill(seconds: float) -> None:
+    _hist("fed_buffer_fill_seconds").observe(seconds)
+
+
+def record_update_staleness(staleness: float) -> None:
+    _hist("fed_update_staleness").observe(float(staleness))
+
+
+@lru_cache(maxsize=8)
+def _async_shed(reason: str):
+    return REGISTRY.counter("fed_async_shed_total", reason=reason)
+
+
+def record_async_shed(reason: str) -> None:
+    _async_shed(reason).inc()
+
+
+def ensure_async_shed_families() -> None:
+    """Pre-register every shed-reason child at zero so an async run's
+    Prometheus export always carries the full family — a clean run must
+    read as 'nothing shed', not 'metric missing'."""
+    for reason in ("stale", "overflow", "nonfinite", "crash", "suspect"):
+        _async_shed(reason)

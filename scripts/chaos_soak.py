@@ -72,14 +72,24 @@ def random_plan(seed: int, world_size: int, elastic: bool = True):
 
 def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              round_timeout_s: float = 1.0, adversary_plan=None,
-             aggregator: str | None = None) -> dict:
+             aggregator: str | None = None,
+             async_buffer_k: int | None = None) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
     ``adversary_plan`` layers model-space faults (chaos/adversary.py) on
     top of the wire-level plan; pair with ``aggregator`` so the trial also
     exercises the sanitation gate + robust estimator, whose verdicts land
-    in the record's ``quarantine`` counts."""
+    in the record's ``quarantine`` counts.
+
+    ``async_buffer_k`` runs the trial in buffered-async mode
+    (docs/ROBUSTNESS.md §Asynchronous buffered rounds): K-arrival flushes
+    with a polynomial staleness discount and a buffer deadline standing in
+    for the elastic round timeout. Arrival order AND dispatch counts are
+    thread-scheduled, so async replays assert liveness (every global
+    update completes under the seeded fault pressure), not ledger/model
+    equality (the bit-for-bit async replay lives in the virtual-clock
+    simulator, tests/test_async_buffer.py)."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.distributed.fedavg import run_simulated
 
@@ -94,13 +104,18 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     if aggregator in ("krum", "multi_krum"):
         # krum needs n >= 2f+3 — derive a legal budget for small worlds
         agg_params = {"f": max((per_round - 3) // 2, 0)}
+    async_kw = {}
+    if async_buffer_k:
+        async_kw = dict(async_buffer_k=int(async_buffer_k),
+                        staleness="poly:0.5",
+                        buffer_deadline_s=round_timeout_s)
     try:
         agg = run_simulated(data, task, cfg, backend="LOOPBACK",
                             job_id=f"soak-{plan.seed}-{time.time_ns()}",
                             chaos_plan=plan, round_timeout_s=round_timeout_s,
                             adversary_plan=adversary_plan,
                             aggregator=aggregator,
-                            aggregator_params=agg_params)
+                            aggregator_params=agg_params, **async_kw)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
     completed = bool(agg and agg.history
@@ -189,6 +204,15 @@ def main(argv=None) -> int:
                     help="robust aggregator defending adversary trials "
                          "(core/robust_agg.py; only used with "
                          "--adversary-plan)")
+    ap.add_argument("--async-buffer-k", "--async_buffer_k",
+                    dest="async_buffer_k", type=int, default=None,
+                    help="run every trial in buffered-async mode with this "
+                         "buffer K (docs/ROBUSTNESS.md §Asynchronous "
+                         "buffered rounds); replays then assert liveness "
+                         "under the seeded fault pressure, not ledger/"
+                         "model bits (dispatch counts are thread-"
+                         "scheduled — the bit-for-bit async replay is the "
+                         "virtual-clock simulator's)")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
 
@@ -222,7 +246,8 @@ def main(argv=None) -> int:
         plan = random_plan(seed, args.world_size)
         rec = run_plan(data, task, plan, rounds=args.rounds,
                        world_size=args.world_size, adversary_plan=adv(),
-                       aggregator=aggregator)
+                       aggregator=aggregator,
+                       async_buffer_k=args.async_buffer_k)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
@@ -230,12 +255,24 @@ def main(argv=None) -> int:
 
             rec2 = run_plan(data, task, random_plan(seed, args.world_size),
                             rounds=args.rounds, world_size=args.world_size,
-                            adversary_plan=adv(), aggregator=aggregator)
-            replay_ok = (rec2["ledger"] == rec["ledger"]
-                         and rec2["qledger"] == rec["qledger"] and all(
-                np.array_equal(np.asarray(a), np.asarray(b))
-                for a, b in zip(pack_pytree(rec["net"]),
-                                pack_pytree(rec2["net"]))))
+                            adversary_plan=adv(), aggregator=aggregator,
+                            async_buffer_k=args.async_buffer_k)
+            if args.async_buffer_k:
+                # async dispatch counts and arrival order are
+                # thread-scheduled, so even per-link fault draws shift
+                # between runs: the replay invariant is LIVENESS — the
+                # replayed job completes every global update under the
+                # same seeded fault pressure — not ledger/model equality
+                # (the bit-for-bit async replay is the virtual-clock
+                # simulator's, tests/test_async_buffer.py)
+                replay_ok = (rec2["completed_rounds"]
+                             == rec["completed_rounds"] == args.rounds)
+            else:
+                replay_ok = (rec2["ledger"] == rec["ledger"]
+                             and rec2["qledger"] == rec["qledger"] and all(
+                    np.array_equal(np.asarray(a), np.asarray(b))
+                    for a, b in zip(pack_pytree(rec["net"]),
+                                    pack_pytree(rec2["net"]))))
             rec["replay_deterministic"] = replay_ok
             if not replay_ok:
                 rec["ok"] = False
@@ -263,6 +300,8 @@ def main(argv=None) -> int:
         "faults_injected_total": sum(t["n_faults"] for t in trials),
         "records": trials,
     }
+    if args.async_buffer_k:
+        summary["async_buffer_k"] = args.async_buffer_k
     if adv_spec is not None:
         summary["adversary_plan"] = json.loads(adv_spec)
         summary["aggregator"] = aggregator

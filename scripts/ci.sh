@@ -207,6 +207,80 @@ print(f"sharded-aggregation smoke ok: 3 rounds bit-identical, ledger "
       f"{len(b.quarantine.canonical())} entries, per-device bytes "
       f"{sh:.0f} vs {rep:.0f} replicated")
 PY
+  echo "== async buffered smoke (K=cohort bitwise ≡ sync; straggler A/B: async < sync wall-clock; staleness/shed metrics exported) =="
+  # buffered-async rounds (docs/ROBUSTNESS.md §Asynchronous buffered
+  # rounds) must (a) reduce bitwise to the synchronous path at K=cohort /
+  # staleness bound 0 (model bits AND quarantine ledger), (b) complete the
+  # same number of global updates in less wall-clock than the sync barrier
+  # under a seeded 1-rank straggle plan while still converging, and (c)
+  # export the new metric families through Telemetry.close()
+  ASYNC_DIR=./tmp/ci_async; rm -rf "$ASYNC_DIR"
+  python - "$ASYNC_DIR" <<'PY'
+import os, sys, time
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.chaos import FaultPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=48, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=4, batch_size=6, lr=0.1,
+                   frequency_of_the_test=100)
+# standalone leg: K=cohort / bound 0 bitwise ≡ the run_round loop, with the
+# sanitation gate armed so the quarantine ledgers are non-vacuous
+kw = dict(aggregator="median", sanitize=0.9)
+a = FedAvgAPI(data, task, cfg, **kw)
+for r in range(3):
+    a.run_round(r)
+b = FedAvgAPI(data, task, cfg, **kw)
+b.run_async(3, buffer_k=4, staleness="constant", staleness_bound=0)
+import jax
+for x, y in zip(jax.tree.leaves(a.net.params), jax.tree.leaves(b.net.params)):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                  err_msg="async K=cohort diverged from sync")
+assert a.quarantine.canonical() == b.quarantine.canonical()
+assert len(b.quarantine.canonical()) > 0
+# cross-process leg: seeded 1-rank straggler; async completes the same
+# number of global updates in measurably less wall-clock than the barrier
+cfg2 = FedAvgConfig(comm_round=4, client_num_in_total=8,
+                    client_num_per_round=3, batch_size=6, lr=0.1,
+                    frequency_of_the_test=1)
+run_simulated(data, task, cfg2, job_id="ci-async-warm")  # compile leg
+plan = lambda: FaultPlan.from_json({"seed": 3, "rules": [
+    {"fault": "straggle", "src": [2], "dst": [0], "delay_s": 0.25}]})
+t0 = time.perf_counter()
+s = run_simulated(data, task, cfg2, job_id="ci-async-s", chaos_plan=plan(),
+                  round_timeout_s=5.0)
+sync_t = time.perf_counter() - t0
+tel = Telemetry(log_dir=d)
+t0 = time.perf_counter()
+asy = run_simulated(data, task, cfg2, job_id="ci-async-a", chaos_plan=plan(),
+                    round_timeout_s=5.0, async_buffer_k=2,
+                    staleness="poly:0.5", telemetry=tel)
+async_t = time.perf_counter() - t0
+assert asy.history and asy.history[-1]["round"] == 3, asy.history[-1:]
+assert async_t < sync_t, f"async {async_t:.2f}s not below sync {sync_t:.2f}s"
+assert float(asy.history[-1]["test_acc"]) >= 0.9, asy.history[-1]
+tel.close()
+prom = open(os.path.join(d, "metrics.prom")).read()
+for fam in ("fed_buffer_fill_seconds", "fed_update_staleness",
+            "fed_async_shed_total"):
+    assert fam in prom, f"{fam} missing from the Prometheus export"
+print(f"async buffered smoke ok: K=cohort bitwise (ledger "
+      f"{len(b.quarantine.canonical())} entries), straggler A/B "
+      f"{sync_t:.2f}s sync vs {async_t:.2f}s async, families exported")
+PY
+  python scripts/report.py "$ASYNC_DIR/events.jsonl"
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi
@@ -290,4 +364,10 @@ python scripts/chaos_soak.py --trials 5 --rounds 3 --out ./tmp/chaos_soak.json
 python scripts/chaos_soak.py --trials 3 --rounds 3 \
   --adversary-plan '{"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [1], "factor": 10.0}]}' \
   --out ./tmp/chaos_soak_byz.json
+# buffered-async tier: the same seeded wire faults over the event-driven
+# async server (K-arrival flushes, staleness discounts, buffer deadline);
+# replays assert the fault ledger + completion (arrival order is
+# thread-scheduled — the bit-for-bit async replay is tier-1's virtual clock)
+python scripts/chaos_soak.py --trials 3 --rounds 3 --async-buffer-k 2 \
+  --out ./tmp/chaos_soak_async.json
 echo "CI GREEN"

@@ -33,6 +33,23 @@ def _fmt(v, width: int) -> str:
     return s.rjust(width)
 
 
+def _staleness_quantile(rec: dict, q: float):
+    """Per-round staleness quantile from an async round record's folded
+    staleness list; None (column hides) on pre-async logs or sync runs."""
+    st = (rec.get("async") or {}).get("staleness")
+    if not st:
+        return None
+    st = sorted(st)
+    return st[min(int(q * (len(st) - 1) + 0.5), len(st) - 1)]
+
+
+def _shed_total(rec: dict):
+    shed = (rec.get("async") or {}).get("shed")
+    if shed is None:
+        return None
+    return int(sum(shed.values()))
+
+
 def render_table(records: list[dict]) -> str:
     """Round-by-round text table; eval rows are folded into their round."""
     evals: dict[int, dict] = {}
@@ -65,6 +82,15 @@ def render_table(records: list[dict]) -> str:
             "srv": (r.get("agg") or {}).get("mode"),
             "srv_dev_B": (r.get("agg") or {}).get(
                 "server_state_bytes_per_device"),
+            # buffered-async runs (docs/ROBUSTNESS.md §Asynchronous
+            # buffered rounds): buffer size folded, staleness quantiles of
+            # the folded updates, cumulative shed count, buffer fill time
+            # — columns hide on pre-async logs
+            "buf_k": (r.get("async") or {}).get("k"),
+            "stale_p50": _staleness_quantile(r, 0.5),
+            "stale_max": _staleness_quantile(r, 1.0),
+            "shed": _shed_total(r),
+            "fill_s": (r.get("async") or {}).get("buffer_fill_s"),
             "loss": (m["loss_sum"] / n) if "loss_sum" in m else None,
             "upd_norm": m.get("update_norm"),
             "drift": m.get("client_drift_mean"),

@@ -119,6 +119,11 @@ _SMOKE_TESTS = {
     # the rule-table matcher contract
     "test_sharded_agg.py::test_sharded_equals_replicated_per_round",
     "test_sharded_agg.py::test_rule_precedence_first_match_wins",
+    # round-8 additions: buffered asynchronous rounds (docs/ROBUSTNESS.md
+    # §Asynchronous buffered rounds) — the K=cohort/bound-0 ≡ sync
+    # identity and the deterministic async-beats-the-barrier claim
+    "test_async_buffer.py::test_async_k_cohort_bound0_bitwise_equals_sync",
+    "test_async_buffer.py::test_async_straggler_beats_sync_barrier_virtual_clock",
 }
 
 

@@ -1,0 +1,471 @@
+"""Buffered asynchronous rounds (docs/ROBUSTNESS.md §Asynchronous buffered
+rounds; core/async_buffer.py + the async server mode) —
+
+- every staleness discount matches its numpy oracle (jittable contract);
+- the degenerate mode (K = cohort, staleness bound 0) is BITWISE the
+  synchronous path: model bits AND quarantine ledger, standalone and
+  cross-process;
+- under a seeded straggler chaos plan, async completes the same number of
+  global updates in measurably less wall-clock than the sync barrier
+  (virtual clock: deterministic; loopback: real time) while converging;
+- admission control rejects-and-requeues past the staleness bound; a
+  non-finite arrival is quarantined at the door and NEVER enters the
+  buffer; overflow sheds the stalest pending update;
+- a seeded async chaos run replays bit-for-bit (virtual clock);
+- heartbeat-driven cohort admission excludes silent ranks (sync AND
+  async) and reprobes them back in once they resume — driven against the
+  PR-2 crash-window plan;
+- the gRPC send path retries transient channel errors under bounded
+  exponential backoff with jitter, counted per reason.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.chaos import FaultPlan
+from fedml_tpu.core.async_buffer import (
+    AsyncBuffer,
+    BufferedUpdate,
+    StalenessPolicy,
+    VirtualClockAsyncRunner,
+    make_staleness_fn,
+    staleness_oracle,
+    sync_virtual_wallclock,
+)
+from fedml_tpu.obs.metrics import REGISTRY
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(scope="module")
+def lr_setup():
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=48, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    return data, task
+
+
+def _cfg(rounds=3, per_round=4, seed=0, freq=100):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=per_round, epochs=1,
+                        batch_size=6, lr=0.1, frequency_of_the_test=freq,
+                        seed=seed)
+
+
+def _engine(lr_setup, cfg=None, **kw):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, task = lr_setup
+    return FedAvgAPI(data, task, cfg or _cfg(), **kw)
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+# ------------------------------------------------------ staleness discounts
+def test_staleness_discounts_match_numpy_oracle():
+    s = np.array([0, 1, 2, 5, 17], np.int32)
+    for kind, a in (("constant", 0.5), ("polynomial", 0.5),
+                    ("polynomial", 2.0), ("exponential", 0.3),
+                    ("exponential", 1.0)):
+        jitted = jax.jit(make_staleness_fn(kind, a))
+        np.testing.assert_allclose(
+            np.asarray(jitted(s)), staleness_oracle(kind, a)(s),
+            rtol=1e-6, err_msg=f"{kind}:{a}")
+    # constant multiplies by EXACTLY 1.0 (the bitwise-parity weight half)
+    assert np.asarray(jax.jit(make_staleness_fn("constant"))(s)).tolist() \
+        == [1.0] * len(s)
+    # discounts are monotone non-increasing in staleness
+    for kind in ("polynomial", "exponential"):
+        d = staleness_oracle(kind, 0.7)(s)
+        assert all(d[i] >= d[i + 1] for i in range(len(s) - 1))
+
+
+def test_staleness_policy_spec_parsing():
+    p = StalenessPolicy.from_spec("poly:0.8", bound=2)
+    assert (p.kind, p.a, p.bound) == ("polynomial", 0.8, 2)
+    assert StalenessPolicy.from_spec("exp:0.3").kind == "exponential"
+    assert StalenessPolicy.from_spec(None).kind == "constant"
+    assert StalenessPolicy.from_spec(p) is p  # pass-through
+    assert StalenessPolicy.from_spec(p, bound=0).synchronous
+    assert p.admits(2) and not p.admits(3)
+    with pytest.raises(ValueError):
+        StalenessPolicy.from_spec("fancy:1")
+    with pytest.raises(ValueError):
+        StalenessPolicy(bound=-1)
+
+
+# ------------------------------------------------------------- buffer unit
+def _bu(rank, version, seq, nsamp=1.0):
+    return BufferedUpdate(rank=rank, client=rank - 1, version=version,
+                          wave=version, payload=None, nsamp=nsamp, seq=seq,
+                          t_arrival=float(seq))
+
+
+def test_async_buffer_overflow_sheds_stalest():
+    buf = AsyncBuffer(k=8, capacity=3)
+    assert buf.flush_threshold == 3  # capacity clamps K
+    shed = []
+    for i, v in enumerate([5, 2, 7]):
+        shed += buf.add(_bu(rank=i + 1, version=v, seq=i))
+    assert not shed and len(buf) == 3
+    # a 4th arrival evicts the stalest pending (version 2), never blocks
+    shed = buf.add(_bu(rank=4, version=6, seq=3))
+    assert [e.version for e in shed] == [2]
+    assert len(buf) == 3
+    # drain order is (rank, seq) — deterministic given contents
+    assert [e.rank for e in buf.drain()] == [1, 3, 4]
+    assert len(buf) == 0
+    with pytest.raises(ValueError):
+        AsyncBuffer(k=0)
+
+
+# ----------------------------------------------- degenerate bitwise parity
+def test_async_k_cohort_bound0_bitwise_equals_sync(lr_setup):
+    sync = _engine(lr_setup)
+    for r in range(3):
+        sync.run_round(r)
+    eng = _engine(lr_setup)
+    runner = eng.run_async(3, buffer_k=4, staleness="constant",
+                           staleness_bound=0)
+    assert _leaves_equal(sync.net.params, eng.net.params)
+    assert _leaves_equal(sync.net.extra, eng.net.extra)
+    st = runner.stats()
+    assert st["staleness_max"] == 0 and st["shed"]["stale"] == 0
+
+
+def test_async_k_cohort_gated_matches_sync_model_and_ledger(lr_setup):
+    # a tight norm gate quarantines natural outliers -> non-vacuous ledgers
+    kw = dict(aggregator="median", sanitize=0.9)
+    sync = _engine(lr_setup, **kw)
+    for r in range(3):
+        sync.run_round(r)
+    eng = _engine(lr_setup, **kw)
+    eng.run_async(3, buffer_k=4, staleness="constant", staleness_bound=0)
+    assert _leaves_equal(sync.net.params, eng.net.params)
+    assert sync.quarantine.canonical() == eng.quarantine.canonical()
+    assert len(sync.quarantine.canonical()) > 0
+
+
+def test_async_fedopt_momentum_on_buffered_aggregate(lr_setup):
+    # server-side FedOpt momentum composes on top of the buffered
+    # aggregate through the same server_update hook, bitwise at K=cohort
+    from fedml_tpu.algorithms.fedopt import FedOptAPI
+
+    data, task = lr_setup
+    sync = FedOptAPI(data, task, _cfg(), server_optimizer="adam",
+                     server_lr=0.05)
+    for r in range(3):
+        sync.run_round(r)
+    eng = FedOptAPI(data, task, _cfg(), server_optimizer="adam",
+                    server_lr=0.05)
+    eng.run_async(3, buffer_k=4, staleness="constant", staleness_bound=0)
+    assert _leaves_equal(sync.net.params, eng.net.params)
+    assert _leaves_equal(sync.server_opt_state, eng.server_opt_state)
+
+
+# ------------------------------------------------- straggler beats barrier
+def _straggle_plan(delay_s=2.0, rank=2, seed=7):
+    return FaultPlan.from_json({"seed": seed, "rules": [
+        {"fault": "straggle", "src": [rank], "delay_s": delay_s}]})
+
+
+def test_async_straggler_beats_sync_barrier_virtual_clock(lr_setup):
+    plan = _straggle_plan()
+    eng = _engine(lr_setup, _cfg(rounds=6))
+    runner = eng.run_async(6, buffer_k=3, staleness="poly:0.5",
+                           chaos_plan=plan)
+    sync_clock = sync_virtual_wallclock(plan, 4, 6)
+    assert runner.version == 6  # same number of global updates
+    assert runner.clock < sync_clock, (runner.clock, sync_clock)
+    # the straggler's updates fold late: staleness was actually exercised
+    assert runner.stats()["staleness_max"] >= 1
+    # and the final model still converges on the separable synthetic set
+    assert float(eng.evaluate()["acc"]) >= 0.9
+
+
+def test_async_chaos_replay_bit_for_bit(lr_setup):
+    plan = _straggle_plan()
+    kw = dict(aggregator="median", sanitize=0.9)
+    a = _engine(lr_setup, _cfg(rounds=5), **kw)
+    ra = a.run_async(5, buffer_k=3, staleness="exp:0.3", chaos_plan=plan)
+    b = _engine(lr_setup, _cfg(rounds=5), **kw)
+    rb = b.run_async(5, buffer_k=3, staleness="exp:0.3",
+                     chaos_plan=plan.fresh())
+    assert _leaves_equal(a.net.params, b.net.params)
+    assert a.quarantine.canonical() == b.quarantine.canonical()
+    assert ra.stats() == rb.stats()
+    assert [h["staleness"] for h in ra.history] \
+        == [h["staleness"] for h in rb.history]
+
+
+# --------------------------------------------------------------- admission
+def test_admission_bound_rejects_and_requeues(lr_setup):
+    plan = _straggle_plan(delay_s=3.5)
+    eng = _engine(lr_setup, _cfg(rounds=5))
+    runner = eng.run_async(5, buffer_k=3, staleness="constant",
+                           staleness_bound=1, chaos_plan=plan)
+    st = runner.stats()
+    assert st["updates"] == 5            # progress despite rejections
+    assert st["shed"]["stale"] > 0       # the bound actually fired
+    assert st["staleness_max"] <= 1      # nothing staler was ever folded
+
+
+def test_nonfinite_arrival_never_enters_buffer(lr_setup):
+    from fedml_tpu.chaos import AdversaryPlan
+
+    adv = AdversaryPlan.from_json(
+        {"seed": 5, "rules": [{"attack": "nan", "ranks": [2],
+                               "rounds": [1, 3]}]})
+    eng = _engine(lr_setup, _cfg(rounds=4))
+    runner = VirtualClockAsyncRunner(eng, buffer_k=3, staleness="poly:0.5",
+                                     adversary_plan=adv)
+    orig_add = runner.buffer.add
+
+    def checked_add(entry):
+        assert all(np.isfinite(np.asarray(v)).all()
+                   for v in jax.tree.leaves(entry.payload)
+                   if np.issubdtype(np.asarray(v).dtype, np.floating)), \
+            "a non-finite arrival reached the buffer"
+        return orig_add(entry)
+
+    runner.buffer.add = checked_add
+    runner.run(4)
+    assert runner.shed_counts["nonfinite"] > 0
+    ledger = eng.quarantine.canonical()
+    assert any(e[1] == 2 and e[2] == "nonfinite" for e in ledger)
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in jax.tree.leaves(eng.net.params))
+
+
+def test_deadline_flushes_partial_buffer(lr_setup):
+    # only one slot is faster than the deadline: the buffer can never
+    # reach K=cohort before it fires, so every flush is deadline-driven
+    # and partial — progress continues without the straggler cohort
+    plan = FaultPlan.from_json({"seed": 7, "rules": [
+        {"fault": "straggle", "src": [2, 3, 4], "delay_s": 9.0}]})
+    eng = _engine(lr_setup, _cfg(rounds=2))
+    runner = eng.run_async(2, buffer_k=4, staleness="poly:0.5",
+                           chaos_plan=plan, deadline_s=2.0)
+    assert runner.version == 2
+    assert all(h["k"] < 4 for h in runner.history), runner.history
+
+
+# ------------------------------------------------------------ cross-process
+def test_xproc_async_k_cohort_bitwise_equals_sync(lr_setup):
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=3, per_round=3, freq=1)
+    sync = run_simulated(data, task, cfg, job_id="async-par-sync")
+    asy = run_simulated(data, task, cfg, job_id="async-par-async",
+                        async_buffer_k=3, staleness="constant",
+                        staleness_bound=0)
+    assert all(np.array_equal(x, y) for x, y in
+               zip(pack_pytree(sync.net), pack_pytree(asy.net)))
+    assert sync.history == asy.history
+    assert sync.quarantine.canonical() == asy.quarantine.canonical()
+
+
+def test_xproc_async_straggler_faster_than_sync_wall_clock(lr_setup):
+    import time
+
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=4, per_round=3)
+    run_simulated(data, task, cfg, job_id="async-ab-warm")  # compile leg
+
+    def plan():
+        return FaultPlan.from_json({"seed": 3, "rules": [
+            {"fault": "straggle", "src": [2], "dst": [0],
+             "delay_s": 0.25}]})
+
+    t0 = time.perf_counter()
+    run_simulated(data, task, cfg, job_id="async-ab-s", chaos_plan=plan(),
+                  round_timeout_s=5.0)
+    sync_t = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    asy = run_simulated(data, task, cfg, job_id="async-ab-a",
+                        chaos_plan=plan(), round_timeout_s=5.0,
+                        async_buffer_k=2, staleness="poly:0.5")
+    async_t = time.perf_counter() - t0
+    # the straggler owns every sync round (>= 4 x 0.25s of barrier time);
+    # async flushes K=2 buffers without waiting on it
+    assert asy.history and asy.history[-1]["round"] == 3
+    assert async_t < sync_t, (async_t, sync_t)
+    assert float(asy.history[-1]["test_acc"]) >= 0.9
+    # the new metric families made it into the process registry
+    prom = REGISTRY.to_prometheus()
+    for fam in ("fed_buffer_fill_seconds", "fed_update_staleness",
+                "fed_async_shed_total"):
+        assert fam in prom, fam
+
+
+# ---------------------------------------------------- heartbeat admission
+def test_suspect_ranks_pure_function():
+    from fedml_tpu.obs.comm_instrument import suspect_ranks
+
+    ages = {1: 0.1, 2: 9.0, 3: 0.2}
+    # rank 2 trails the freshest peer past the threshold; rank 4 was never
+    # seen (unknown is dispatchable, not infinitely suspect)
+    assert suspect_ranks([1, 2, 3, 4], 1.0, round_idx=1, ages=ages) == {2}
+    # the verdict is RELATIVE to the freshest peer: during a fleet-wide
+    # stall every age grows together and nobody becomes suspect (an
+    # absolute rule would exclude the whole cohort and deadlock)
+    stalled = {1: 5.0, 2: 5.2, 3: 9.0}
+    assert suspect_ranks([1, 2, 3], 1.0, round_idx=1, ages=stalled) == {3}
+    assert suspect_ranks([1, 2], 1.0, round_idx=1,
+                         ages={1: 50.0, 2: 50.3}) == set()
+    # reprobe rounds re-invite everyone
+    assert suspect_ranks([1, 2, 3], 1.0, round_idx=4, reprobe_every=4,
+                         ages=ages) == set()
+    # disarmed gate excludes nobody
+    assert suspect_ranks([1, 2], None, round_idx=1, ages=ages) == set()
+    assert suspect_ranks([1, 2, 3], 10.0, round_idx=1, ages=ages) == set()
+
+
+def test_heartbeat_admission_crash_window_excludes_then_readmits(lr_setup):
+    import time
+
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.obs.comm_instrument import (heartbeat_ages,
+                                               reset_heartbeats)
+
+    reset_heartbeats()  # earlier loopback jobs' silence must not leak in
+    data, task = lr_setup
+    cfg = _cfg(rounds=7, per_round=3, freq=1)
+    # PR-2 crash-window plan: rank 2 is dark for protocol rounds [1, 3)
+    plan = FaultPlan.from_json({"seed": 9, "rules": [
+        {"fault": "crash", "ranks": [2], "rounds": [1, 3]}]})
+    t0 = time.perf_counter()
+    agg = run_simulated(data, task, cfg, job_id="hb-crash",
+                        chaos_plan=plan, round_timeout_s=0.5,
+                        heartbeat_max_age_s=0.35)
+    wall = time.perf_counter() - t0
+    # the job completed every round: crashed rounds degraded elastically,
+    # suspect rounds skipped the dead rank WITHOUT waiting out the 0.5s
+    # deadline each time (bound: 2 watchdog stalls + compute, not 6 stalls)
+    assert agg.history and agg.history[-1]["round"] == 6
+    assert wall < 6 * 0.5 + 2.5, wall
+    # the rank resumed after the window: its heartbeat is fresh again
+    # (readmission evidence — a still-dark rank's age would exceed the
+    # whole post-window runtime)
+    assert heartbeat_ages().get(2, 1e9) < 5.0
+
+
+# ------------------------------------------------------------- gRPC retry
+class _FakeRpcError:
+    """Built lazily as a grpc.RpcError subclass (grpc import only here)."""
+
+    def __new__(cls, code):
+        import grpc
+
+        class E(grpc.RpcError):
+            def __init__(self, c):
+                self._c = c
+
+            def code(self):
+                return self._c
+
+        return E(code)
+
+
+@pytest.fixture()
+def grpc_mgr():
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    mgr = GrpcCommManager(0, 2, base_port=56840)
+    yield mgr
+    mgr.stop_receive_message()
+
+
+def _msg(dest=1):
+    from fedml_tpu.comm.message import Message
+
+    m = Message("t", 0, dest)
+    m.add_params("x", 1)
+    return m
+
+
+def test_grpc_send_retries_transient_errors_with_backoff(grpc_mgr,
+                                                         monkeypatch):
+    import grpc
+
+    mgr = grpc_mgr
+    mgr.send_timeout_s = 30.0
+    calls = {"n": 0}
+    fails = [_FakeRpcError(grpc.StatusCode.UNAVAILABLE),
+             _FakeRpcError(grpc.StatusCode.DEADLINE_EXCEEDED)]
+
+    def stub(dest):
+        def invoke(frame, **kw):
+            calls["n"] += 1
+            if fails:
+                raise fails.pop(0)
+
+        return invoke
+
+    sleeps = []
+    monkeypatch.setattr(mgr, "_stub", stub)
+    monkeypatch.setattr("time.sleep", lambda s: sleeps.append(s))
+    before_u = REGISTRY.total("comm_send_retries_total")
+    mgr.send_message(_msg())
+    assert calls["n"] == 3  # two transient failures, then success
+    assert REGISTRY.total("comm_send_retries_total") - before_u == 2
+    # bounded exponential backoff with jitter: each sleep in (0, cap]
+    assert len(sleeps) == 2 and all(0 < s <= 5.0 for s in sleeps)
+    # jitter is deterministic in its arguments (seeded-replay-safe)
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    assert GrpcCommManager._retry_jitter(0, 1, 7, 1) \
+        == GrpcCommManager._retry_jitter(0, 1, 7, 1)
+    assert GrpcCommManager._retry_jitter(0, 1, 7, 1) \
+        != GrpcCommManager._retry_jitter(0, 1, 7, 2)
+
+
+def test_grpc_permanent_error_raises_not_hangs(grpc_mgr, monkeypatch):
+    import grpc
+
+    mgr = grpc_mgr
+    mgr.send_timeout_s = 30.0
+
+    def stub(dest):
+        def invoke(frame, **kw):
+            raise _FakeRpcError(grpc.StatusCode.INVALID_ARGUMENT)
+
+        return invoke
+
+    monkeypatch.setattr(mgr, "_stub", stub)
+    with pytest.raises(grpc.RpcError):
+        mgr.send_message(_msg())
+
+
+# ----------------------------------------------------------------- report
+def test_report_renders_async_columns_and_legacy_logs():
+    from scripts.report import render_table
+
+    async_rec = {"kind": "round", "round": 0, "clients": [1, 2],
+                 "metrics": {"loss_sum": 1.0, "count": 2.0},
+                 "async": {"k": 2, "staleness": [0, 3],
+                           "buffer_fill_s": 0.01, "shed": {"stale": 1}}}
+    out = render_table([async_rec])
+    for col in ("buf_k", "stale_p50", "stale_max", "shed", "fill_s"):
+        assert col in out, (col, out)
+    # pre-PR-7 logs: no async block, columns hide, no crash
+    legacy = {"kind": "round", "round": 0, "clients": [1],
+              "metrics": {"loss_sum": 1.0, "count": 2.0}}
+    out = render_table([legacy])
+    assert "buf_k" not in out and "(no round records)" not in out
