@@ -570,8 +570,100 @@ def _measure_async() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _measure_codec() -> None:
+    """FEDML_BENCH_CODEC A/B (docs/PERFORMANCE.md §Wire efficiency): the
+    loopback cross-process stack run once per uplink codec tier — dense
+    f32, lossless round-delta, deadzoned int8 delta, 1-bit scaled sign,
+    top-k — at MATCHED round count and seed, measuring actual wire bytes
+    per direction (``comm_bytes_total{codec,direction}`` deltas around
+    each leg) against each tier's convergence curve. The blob is the
+    bytes-vs-convergence evidence: per tier, uplink/downlink bytes,
+    bytes/round, reduction vs dense, per-round losses, final eval. Runs
+    forced-CPU loopback — the measurement isolates wire bytes and codec
+    math, not device throughput."""
+    t0 = time.perf_counter()
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs.comm_instrument import directional_bytes
+
+    rounds = _env_int("FEDML_BENCH_CODEC_ROUNDS", 10)
+    world = _env_int("FEDML_BENCH_CODEC_WORLD", 5)
+    # ~16k params: big enough that frame headers don't dilute the byte
+    # ratios (the regime the tiers target is models >> headers)
+    data = synthetic_images(num_clients=8, image_shape=(40, 40, 1),
+                            num_classes=10, samples_per_client=24,
+                            test_samples=96, seed=3)
+    task = classification_task(LogisticRegression(num_classes=10))
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                       client_num_per_round=world - 1, epochs=1,
+                       batch_size=8, lr=0.05, frequency_of_the_test=1,
+                       seed=0)
+
+    tiers = {
+        "dense": {},
+        "delta": {"update_codec": "delta"},
+        "delta-int8": {"update_codec": "delta-int8"},
+        "delta-sign1": {"update_codec": "delta-sign1"},
+        "topk0.1": {"sparsify_ratio": 0.1},
+    }
+    out: dict = {}
+    for name, kw in tiers.items():
+        before = directional_bytes()
+        tl = time.perf_counter()
+        agg = run_simulated(data, task, cfg, job_id=f"bench-codec-{name}",
+                            **kw)
+        after = directional_bytes()
+        if not agg.history or agg.history[-1]["round"] != rounds - 1:
+            raise RuntimeError(f"codec leg {name} did not complete "
+                               f"{rounds} rounds: {agg.history[-1:]}")
+        up = after["uplink"] - before["uplink"]
+        out[name] = {
+            "uplink_bytes": int(up),
+            "downlink_bytes": int(after["downlink"] - before["downlink"]),
+            "uplink_bytes_per_round": round(up / rounds, 1),
+            "losses": [round(float(h["test_loss"]), 6)
+                       for h in agg.history],
+            "final_loss": round(float(agg.history[-1]["test_loss"]), 6),
+            "final_acc": round(float(agg.history[-1]["test_acc"]), 4),
+            "seconds": round(time.perf_counter() - tl, 2),
+        }
+        _mark(t0, f"codec leg {name}: {out[name]['uplink_bytes']} uplink B, "
+                  f"final loss {out[name]['final_loss']}")
+    dense_up = out["dense"]["uplink_bytes"]
+    for name, rec in out.items():
+        rec["uplink_reduction_vs_dense"] = round(
+            dense_up / max(rec["uplink_bytes"], 1), 2)
+    rec = {
+        "metric": "fedavg_uplink_reduction_int8_delta",
+        "value": out["delta-int8"]["uplink_reduction_vs_dense"],
+        "unit": "x_vs_dense_f32",
+        "mode": "codec_ab",
+        "rounds": rounds,
+        "world_size": world,
+        "uplink_reduction_sign1": out["delta-sign1"]
+        ["uplink_reduction_vs_dense"],
+        "tiers": out,
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     here = os.path.abspath(__file__)
+    if os.environ.get("FEDML_BENCH_CODEC") is not None:
+        # wire-efficiency A/B — forced-CPU child (loopback threads; the
+        # measurement is bytes-on-the-wire per codec tier, not FLOPs)
+        rc, out = _run_child([here, "--measure", "codec"],
+                             _cpu_env(os.environ),
+                             _env_int("FEDML_BENCH_CODEC_TIMEOUT", 600))
+        rec = _last_json_line(out)
+        if rec is None:
+            raise RuntimeError(f"bench: codec A/B child failed (rc={rc})")
+        _emit(rec)
+        return
     if os.environ.get("FEDML_BENCH_ASYNC") is not None:
         # protocol-level A/B — forced-CPU child (loopback threads; the
         # accelerator adds nothing but lease risk to this measurement)
@@ -718,6 +810,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--measure":
         if sys.argv[2] == "async":
             _measure_async()
+        elif sys.argv[2] == "codec":
+            _measure_codec()
         else:
             _measure(sys.argv[2])
     else:

@@ -67,13 +67,36 @@ class BaseCommManager(abc.ABC):
         """Serialize an outgoing message through the wire codec, recording
         messages/bytes-per-codec into the process metrics registry. Every
         backend's send path routes through here so loopback, gRPC, and MQTT
-        report identically."""
+        report identically.
+
+        Direction split: frames addressed TO rank 0 are uplink, everything
+        else downlink (rank 0 is the server in every protocol here), so
+        ``comm_bytes_total{codec,direction}`` separates the broadcast-
+        dominated downlink from the uplink byte budget the delta/quantized
+        tiers optimize. The codec label is the EFFECTIVE tier — the
+        update codec riding the message (top-k / comm/delta.py tiers)
+        composed with the frame codec — not just the frame codec."""
         from fedml_tpu.comm import message as _message
 
         frame = msg.to_bytes(codec)
-        _obs.record_send(self.backend_name,
-                         codec if codec is not None else _message._CODEC,
+        frame_codec = codec if codec is not None else _message._CODEC
+        _obs.record_send(self.backend_name, frame_codec,
                          len(frame), str(msg.get_type()))
+        params = msg.get_params()
+        upd = params.get("upd_codec")
+        if upd is None and "sparse_idx" in params:
+            upd = "topk"
+        if upd is None and "delta_params" in params:
+            upd = "delta-bcast"  # round-delta downlink (server side)
+        eff = (frame_codec if upd is None
+               else str(upd) if frame_codec == "none"
+               else f"{upd}+{frame_codec}")
+        try:
+            direction = ("uplink" if int(msg.get_receiver_id()) == 0
+                         else "downlink")
+        except (TypeError, ValueError, KeyError):
+            direction = "downlink"  # interop peers with exotic ids
+        _obs.record_wire_bytes(eff, direction, len(frame))
         return frame
 
     def _receive_frame(self, data: bytes) -> None:

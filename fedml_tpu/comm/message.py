@@ -137,16 +137,41 @@ class Message:
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
 
+    # Keys the lossy f16/q8 frame tiers must NEVER re-encode, whatever the
+    # process-wide codec says. These are codec/protocol payloads, not model
+    # tensors: a sparse top-k value array is EXACTLY what the server adds to
+    # its global (quantizing it would silently break the client's error-
+    # feedback accounting — the residual assumes what was SENT is what was
+    # APPLIED), an update-codec scale vector quantized by q8 corrupts every
+    # entry it scales, and a round-delta broadcast must reconstruct the
+    # exact base the next uplink delta is computed against. Integer leaves
+    # (sparse_idx) dodge the float tiers by dtype today, but are listed so
+    # the exemption is a protocol contract, not a dtype accident.
+    LOSSY_EXEMPT = frozenset({
+        "sparse_idx", "sparse_val",          # comm/sparse.py top-k uplinks
+        "upd_q", "upd_scale",                # comm/delta.py update tiers
+        "delta_params",                      # round-delta broadcast payload
+    })
+
     def __init__(self, type: str = "default", sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {
             Message.MSG_ARG_KEY_TYPE: type,
             Message.MSG_ARG_KEY_SENDER: sender_id,
             Message.MSG_ARG_KEY_RECEIVER: receiver_id,
         }
+        # per-message additions to LOSSY_EXEMPT (mark_lossless): e.g. the
+        # delta-broadcast protocol's dense fallback, whose model_params must
+        # land bit-exact so every rank holds the same base chain value
+        self._lossless_keys: set[str] = set()
 
     # -------------------------------------------------------- dict interface
     def add_params(self, key: str, value: Any):
         self.msg_params[key] = value
+
+    def mark_lossless(self, key: str) -> None:
+        """Exempt ``key``'s array payload from the lossy f16/q8 frame
+        tiers on THIS message (zlib still applies — it is lossless)."""
+        self._lossless_keys.add(key)
 
     def get(self, key: str, default=None):
         return self.msg_params.get(key, default)
@@ -183,12 +208,18 @@ class Message:
         scalars: dict[str, Any] = {}
         manifest: list[dict] = []
         buffers: list[bytes] = []
+        # protocol payloads the lossy tiers must not touch (class contract
+        # + per-message mark_lossless; getattr: a Message rebuilt by
+        # from_bytes and re-encoded — chaos duplicates — has no set)
+        exempt = self.LOSSY_EXEMPT | getattr(self, "_lossless_keys", set())
 
         def put_array(key, idx, arr):
             arr = np.ascontiguousarray(arr)
             ent = {"key": key, "idx": idx, "dtype": arr.dtype.str,
                    "shape": list(arr.shape)}
-            if f16 and arr.dtype == np.float32:
+            if key in exempt:
+                pass  # verbatim bits, whatever the frame codec says
+            elif f16 and arr.dtype == np.float32:
                 ent["orig"], ent["dtype"] = arr.dtype.str, "<f2"
                 arr = _f16_wire(arr)
             elif q8 and arr.dtype == np.float32:
@@ -297,6 +328,9 @@ class Message:
         "params": ("<f4", "leaves"),         # vfl final host params
         "sparse_idx": ("<i4", "leaves"),     # comm/sparse top-k uplinks
         "sparse_val": ("<f4", "leaves"),
+        "upd_q": ("|u1", "leaves"),          # comm/delta quantized payloads
+        "upd_scale": ("<f4", "array"),       # comm/delta per-leaf scales
+        "delta_params": ("<f4", "leaves"),   # round-delta broadcast
         "acts": ("<f4", "array"),            # split_nn activations
         "grads": ("<f4", "array"),           # split_nn / vfl cotangents
         "feats": ("<f4", "array"),           # fedgkt features

@@ -17,11 +17,22 @@ with error feedback) applies cleanly to FedAvg:
 ``ratio=1.0`` transmits every entry — numerically equivalent to the dense
 protocol (zero residual; the reconstruction ``g + (w - g)`` carries f32
 roundoff, so the oracle in tests/test_comm.py compares at 2e-5, not
-bitwise). Residuals
-are per-RANK (the parameter-server convention): under cross-device
+bitwise).
+
+Residual OWNERSHIP moved to :mod:`fedml_tpu.comm.ef` (PR 9): the client
+manager threads one shared :class:`~fedml_tpu.comm.ef.ErrorFeedback`
+through every lossy tier (top-k here, the int8/1-bit delta tiers in
+comm/delta.py); ``topk_residual`` remains the top-k shortcut for
+``compensated - shipped`` and the conservation oracle. Residuals are
+per-RANK (the parameter-server convention): under cross-device
 reassignment a rank's residual mixes the clients it hosted — acceptable
 in practice and zero extra protocol state; cross-silo (fixed assignment)
-is the setting this targets.
+is the setting this targets (docs/PERFORMANCE.md §Wire efficiency).
+
+Versioned bases (PR 9): the server densifies a sparse uplink against its
+per-version broadcast stash keyed by the upload's round tag — which is
+what lets top-k compose with buffered-async dispatch waves
+(distributed/fedavg/server_manager._decode_upload).
 
 Non-float leaves (e.g. integer counters in a model's extra state) ship
 dense, marked by a sentinel index of [-1].

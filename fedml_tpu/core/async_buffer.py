@@ -58,8 +58,13 @@ STALENESS_KINDS = ("constant", "polynomial", "exponential")
 
 # shed-reason vocabulary for fed_async_shed_total{reason}; admission and
 # backpressure verdicts share it so dashboards see one family ('suspect'
-# is the cross-process server's heartbeat-admission skip)
-SHED_REASONS = ("stale", "overflow", "nonfinite", "crash", "suspect")
+# is the cross-process server's heartbeat-admission skip; 'undecodable' is
+# an encoded uplink — top-k / delta / quantized, comm/delta.py — whose
+# payload was structural garbage: quarantined at decode, requeued). Note
+# encoded uplinks also shed 'stale' when their versioned base was evicted
+# from the server's bounded broadcast stash.
+SHED_REASONS = ("stale", "overflow", "nonfinite", "crash", "suspect",
+                "undecodable")
 
 
 # ------------------------------------------------------ staleness discounts

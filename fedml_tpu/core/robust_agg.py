@@ -60,8 +60,13 @@ import jax.numpy as jnp
 
 from fedml_tpu.utils.tree import tree_weighted_mean
 
-# per-slot quarantine reason codes (int32 in-graph; names in ledgers)
-REASONS = ("ok", "nonfinite", "norm_outlier", "suspected")
+# per-slot quarantine reason codes (int32 in-graph; names in ledgers).
+# 'undecodable' is ledger-only (no in-graph code): the server records it
+# when an encoded uplink's payload is structurally garbage — a chaos
+# bit-flip that survived CRC, a truncated deflate stream — and the upload
+# never reaches the stacked aggregate at all (docs/PERFORMANCE.md §Wire
+# efficiency). Appended AFTER the in-graph codes so 0..3 stay stable.
+REASONS = ("ok", "nonfinite", "norm_outlier", "suspected", "undecodable")
 REASON_OK, REASON_NONFINITE, REASON_NORM_OUTLIER, REASON_SUSPECTED = range(4)
 
 # sanitation default: reject ||update|| > 4x the weighted-median norm.

@@ -25,6 +25,18 @@ aggregation):
   multi_krum / geometric_median) over the same stacked-leaf layout,
   sharing the exact jitted code the standalone engine runs so the two
   runtimes' quarantine ledgers agree entry-for-entry.
+
+Wire-efficiency composition (docs/PERFORMANCE.md §Wire efficiency): the
+encoded uplink tiers (top-k, delta, int8/1-bit quantized) are DECODED TO
+DENSE F32 by the server manager (``_decode_upload``, against the
+version-stamped broadcast stash) before they reach
+``add_local_trained_result`` — so everything here, the gate included,
+sees the same stacked-leaf layout whatever rode the wire, and weighted-
+mean over decoded ±scale sign updates IS scaled-sign aggregation.
+Decoded quantized garbage (NaN scales from a poisoned client, corrupt
+payloads surviving CRC) either arrives non-finite and dies at the
+unconditional gate or never arrives at all (quarantined ``undecodable``
+at decode).
 """
 
 from __future__ import annotations
@@ -325,6 +337,13 @@ class FedAvgAggregator:
         per round (the gather belongs at broadcast-pack time only)."""
         t0 = time.perf_counter()
         ranks = sorted(self.model_dict)
+        if not ranks:
+            # every upload this round was discarded before slotting (e.g.
+            # all structurally undecodable under a codec tier) — keep the
+            # current global model, exactly like the all-quarantined case
+            log.warning("round %d: no decodable uploads — keeping the "
+                        "current global model", self.current_round)
+            return
         stacked = [
             jnp.stack([jnp.asarray(self.model_dict[r][i]) for r in ranks])
             for i in range(len(self.model_dict[ranks[0]]))

@@ -62,6 +62,9 @@ def run_simulated(
     broker_host: str = "127.0.0.1",
     broker_port: int = 1883,
     sparsify_ratio: float | None = None,
+    update_codec: str | None = None,
+    error_feedback: bool = True,
+    delta_broadcast: bool = False,
     telemetry=None,
     chaos_plan=None,
     round_timeout_s: float | None = None,
@@ -118,14 +121,17 @@ def run_simulated(
     bounds the staging queue (overflow sheds the stalest, never blocks);
     ``heartbeat_max_age_s`` arms heartbeat-driven cohort admission (sync
     AND async: silent ranks are excluded until a reprobe brings them
-    back)."""
-    if async_buffer_k is not None and sparsify_ratio:
-        # fail at launch, not inside the server's receive handler after a
-        # full local fit: a top-k delta is relative to the exact broadcast
-        # the client received, which the async server has advanced past
-        raise ValueError("async_buffer_k requires dense uploads — drop "
-                         "sparsify_ratio (sparse deltas densify against a "
-                         "broadcast the async server no longer holds)")
+    back).
+
+    ``update_codec``: delta/quantized uplink tier ('delta' | 'delta-int8'
+    | 'delta-sign1', comm/delta.py) with client-side error feedback
+    (``error_feedback=False`` is the convergence-ablation knob only).
+    ``delta_broadcast``: round-delta downlinks to warm clients with a
+    dense fallback for joiners/reprobes (docs/PERFORMANCE.md §Wire
+    efficiency). Encoded uplinks — top-k AND the delta tiers — compose
+    with ``async_buffer_k``: they densify against the version-stamped
+    broadcast the dispatch wave carried (the former dense-only refusal is
+    lifted; only a genuinely unversioned base is an error)."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -149,10 +155,13 @@ def run_simulated(
                                      buffer_deadline_s=buffer_deadline_s,
                                      buffer_capacity=buffer_capacity,
                                      heartbeat_max_age_s=heartbeat_max_age_s,
+                                     delta_broadcast=delta_broadcast,
                                      **kw)
         clients = [
             init_client(dataset, task, cfg, rank, size, backend,
                         sparsify_ratio=sparsify_ratio,
+                        update_codec=update_codec,
+                        error_feedback=error_feedback,
                         adversary_plan=adversary_plan, **kw)
             for rank in range(1, size)
         ]

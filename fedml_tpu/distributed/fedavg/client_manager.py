@@ -26,7 +26,9 @@ from fedml_tpu.obs.tracing import TRACE_KEY, ClientSpanBuffer
 class FedAvgClientManager(ClientManager):
     def __init__(self, trainer: DistributedTrainer, rank, size,
                  backend="LOOPBACK", sparsify_ratio: float | None = None,
-                 adversary_plan=None, async_uplink: bool = True, **kw):
+                 adversary_plan=None, async_uplink: bool = True,
+                 update_codec: str | None = None,
+                 error_feedback: bool = True, **kw):
         self.trainer = trainer
         self.round_idx = 0
         # async_uplink: uplink frame encoding (tree flatten + buffer copies
@@ -53,7 +55,39 @@ class FedAvgClientManager(ClientManager):
             raise ValueError(
                 f"sparsify_ratio must be in (0, 1], got {sparsify_ratio}")
         self.sparsify_ratio = sparsify_ratio
-        self._residual = None
+        # delta/quantized uplink tier (comm/delta.py, docs/PERFORMANCE.md
+        # §Wire efficiency): 'delta' | 'delta-int8' | 'delta-sign1';
+        # None/'dense' = the full-model protocol. Validated at launch for
+        # the same reason as sparsify_ratio. The tiers are mutually
+        # exclusive with top-k: both replace MODEL_PARAMS on the wire.
+        if update_codec in ("dense", ""):
+            update_codec = None
+        if update_codec is not None:
+            from fedml_tpu.comm.delta import UPDATE_CODECS
+
+            if update_codec not in UPDATE_CODECS:
+                raise ValueError(f"unknown update_codec {update_codec!r} "
+                                 f"(one of {UPDATE_CODECS} or 'dense')")
+            if sparsify_ratio:
+                raise ValueError(
+                    "update_codec and sparsify_ratio are mutually "
+                    "exclusive uplink tiers — pick one")
+        self.update_codec = update_codec
+        # one shared error-feedback residual (comm/ef.py) owned by ALL
+        # lossy tiers (top-k AND the quantized delta tiers); error_feedback
+        # =False is the ablation knob the convergence tests use — never
+        # the production setting (untracked compression error accumulates)
+        self._ef = None
+        if error_feedback and (sparsify_ratio or
+                               update_codec in ("delta-int8", "delta-sign1")):
+            from fedml_tpu.comm.ef import ErrorFeedback
+
+            self._ef = ErrorFeedback()
+        # the decoded broadcast currently held + its version tag — the
+        # base every delta tier encodes against, and what a round-delta
+        # broadcast (MSG_ARG_KEY_DELTA_PARAMS) reconstructs from
+        self._held = None
+        self._held_version: int | None = None
         self._trace_buf: ClientSpanBuffer | None = None  # lazy: see module doc
         super().__init__(rank, size, backend, **kw)
 
@@ -99,7 +133,30 @@ class FedAvgClientManager(ClientManager):
         # synchronous rounds: round_idx keys the fit, nothing is echoed,
         # and the wire is unchanged.
         wave = msg_params.get(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE)
-        global_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        if MyMessage.MSG_ARG_KEY_DELTA_PARAMS in msg_params:
+            # round-delta broadcast (docs/ROBUSTNESS.md §Delta broadcast):
+            # reconstruct global@r = held@base + delta. The server only
+            # sends deltas to ranks whose last UPLOAD proved they hold the
+            # base version, so a mismatch here is a protocol violation
+            # (e.g. a restarted client the server still believes warm) —
+            # fail loudly rather than train against a wrong base.
+            from fedml_tpu.comm.delta import apply_delta
+
+            base_v = int(msg_params[MyMessage.MSG_ARG_KEY_BASE_VERSION])
+            if self._held is None or self._held_version != base_v:
+                raise RuntimeError(
+                    f"rank {self.rank}: delta broadcast against version "
+                    f"{base_v} but this client holds "
+                    f"{self._held_version} — the server's warm-rank "
+                    "tracking and this client disagree (restarted client?)")
+            global_leaves = apply_delta(
+                self._held, msg_params[MyMessage.MSG_ARG_KEY_DELTA_PARAMS])
+        else:
+            global_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        # the held base: what every delta tier encodes against, and the
+        # next round-delta broadcast reconstructs from
+        self._held = global_leaves
+        self._held_version = self.round_idx
         with span("unpack"):
             self.trainer.update_model(global_leaves)
             self.trainer.update_dataset(int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
@@ -118,11 +175,30 @@ class FedAvgClientManager(ClientManager):
                 from fedml_tpu.comm.sparse import (topk_delta, topk_encode,
                                                    topk_residual)
 
-                delta = topk_delta(wire_leaves, global_leaves, self._residual)
-                idx, vals = topk_encode(delta, self.sparsify_ratio)
-                self._residual = topk_residual(delta, idx)
+                delta = topk_delta(wire_leaves, global_leaves)
+                comp = self._ef.compensate(delta) if self._ef else delta
+                idx, vals = topk_encode(comp, self.sparsify_ratio)
+                if self._ef:
+                    # topk_residual IS comp - shipped: install it directly
+                    self._ef.update_residual(topk_residual(comp, idx))
                 msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_IDX, idx)
                 msg.add_params(MyMessage.MSG_ARG_KEY_SPARSE_VAL, vals)
+            elif self.update_codec:
+                from fedml_tpu.comm.delta import (decode_update,
+                                                  encode_update, round_delta)
+
+                delta = round_delta(wire_leaves, global_leaves)
+                comp = self._ef.compensate(delta) if self._ef else delta
+                payload, scales = encode_update(comp, self.update_codec)
+                if self._ef:
+                    # residual tracks the SERVER's view: comp minus the
+                    # decoded form of what actually went on the wire
+                    self._ef.update(comp, decode_update(
+                        payload, scales, self.update_codec, wire_leaves))
+                msg.add_params(MyMessage.MSG_ARG_KEY_UPDATE_CODEC,
+                               self.update_codec)
+                msg.add_params(MyMessage.MSG_ARG_KEY_UPDATE_PAYLOAD, payload)
+                msg.add_params(MyMessage.MSG_ARG_KEY_UPDATE_SCALE, scales)
             else:
                 msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
             msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)

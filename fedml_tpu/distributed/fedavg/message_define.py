@@ -25,6 +25,24 @@ class MyMessage:
     # version-keyed ones. Absent on synchronous rounds (wire unchanged).
     MSG_ARG_KEY_DISPATCH_WAVE = "dispatch_wave"
     # sparse uplink (comm/sparse.py): flat top-k indices + values per leaf,
-    # replacing MODEL_PARAMS; the server densifies against its global
+    # replacing MODEL_PARAMS; the server densifies against the stashed
+    # broadcast of the version the upload's ROUND tag names
     MSG_ARG_KEY_SPARSE_IDX = "sparse_idx"
     MSG_ARG_KEY_SPARSE_VAL = "sparse_val"
+    # quantized/delta uplink (comm/delta.py, docs/PERFORMANCE.md §Wire
+    # efficiency): UPDATE_CODEC names the tier ('delta' | 'delta-int8' |
+    # 'delta-sign1'), UPDATE_PAYLOAD carries one encoded array per model
+    # leaf, UPDATE_SCALE the per-leaf f32 scales. All replace MODEL_PARAMS;
+    # the base version is the echoed ROUND tag (same stash lookup as the
+    # sparse tier). Payload/scale keys are in Message.LOSSY_EXEMPT — the
+    # lossy frame tiers must never re-encode them.
+    MSG_ARG_KEY_UPDATE_CODEC = "upd_codec"
+    MSG_ARG_KEY_UPDATE_PAYLOAD = "upd_q"
+    MSG_ARG_KEY_UPDATE_SCALE = "upd_scale"
+    # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
+    # MODEL_PARAMS and BASE_VERSION names the global version the delta was
+    # computed against — the client must hold exactly that version (the
+    # server only sends deltas to ranks whose last upload PROVED it); cold
+    # ranks (joiners, reprobes, elastic re-sends) get the dense fallback
+    MSG_ARG_KEY_DELTA_PARAMS = "delta_params"
+    MSG_ARG_KEY_BASE_VERSION = "base_version"

@@ -53,11 +53,34 @@ class FedAvgServerManager(ServerManager):
                  staleness="constant", staleness_bound: int | None = None,
                  buffer_deadline_s: float | None = None,
                  buffer_capacity: int | None = None,
-                 heartbeat_max_age_s: float | None = None, **kw):
+                 heartbeat_max_age_s: float | None = None,
+                 delta_broadcast: bool = False, **kw):
         self.aggregator = aggregator
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
-        self._bcast_leaves = None  # this round's packed broadcast (sparse)
+        self._bcast_leaves = None  # latest decoded broadcast (legacy alias)
+        # version -> the broadcast AS CLIENTS HOLD IT (decoded through the
+        # frame codec; under delta_broadcast the exact chain value). Every
+        # encoded uplink (top-k, delta, quantized — comm/delta.py) names
+        # the version it encoded against via its ROUND tag, and densifies
+        # against THIS table — which is what lets sparsified/quantized
+        # uplinks compose with buffered-async dispatch waves. Bounded: old
+        # versions are pruned; an upload whose base was evicted is shed as
+        # stale (async requeues it), while a version NEVER stashed is a
+        # loud protocol error.
+        self._version_pack: dict[int, list] = {}
+        # rank -> the version its last upload PROVED it holds (the upload's
+        # round tag: a client can only have encoded against a broadcast it
+        # decoded). Drives the delta-broadcast warm set — optimistic
+        # send-side tracking would desync after a dropped/corrupt frame,
+        # proof-based tracking self-heals to the dense fallback.
+        self._rank_version: dict[int, int] = {}
+        # round-delta downlink (docs/ROBUSTNESS.md §Delta broadcast): warm
+        # ranks get global@r - global@r-1, cold ranks (joiners, reprobes,
+        # ranks that missed a round) the dense fallback. Sync mode only —
+        # async dispatch is per-rank at arbitrary versions, so it stays
+        # dense (warned below).
+        self.delta_broadcast = bool(delta_broadcast)
         self.round_timeout_s = round_timeout_s
         self.ckpt_dir = ckpt_dir
         # Buffered-async mode (docs/ROBUSTNESS.md §Asynchronous buffered
@@ -73,6 +96,13 @@ class FedAvgServerManager(ServerManager):
         # the synchronous barrier, untouched.
         self._async = async_buffer_k is not None
         self._buffer = None
+        if self._async and self.delta_broadcast:
+            log.warning("delta_broadcast ignored in async buffered mode: "
+                        "per-rank dispatch holds arbitrary versions, so "
+                        "downlinks stay dense (uplink delta/quantized "
+                        "tiers still apply)")
+            self.delta_broadcast = False
+        self._staleness_bound = staleness_bound
         if self._async:
             from fedml_tpu.core.async_buffer import (AsyncBuffer,
                                                      StalenessPolicy)
@@ -370,8 +400,29 @@ class FedAvgServerManager(ServerManager):
                         self.heartbeat_max_age_s,
                         self._DEAD_RANK_REPROBE_ROUNDS)
         # stash the pack AS CLIENTS WILL SEE IT: under a lossy wire
-        # codec their deltas are relative to the decoded broadcast
-        self._bcast_leaves = codec_roundtrip(global_params)
+        # codec their deltas are relative to the decoded broadcast; under
+        # delta_broadcast the stash IS the base chain every rank holds
+        delta, base_v = None, self.round_idx - 1
+        if self.delta_broadcast:
+            import numpy as np
+
+            from fedml_tpu.comm.delta import apply_delta, round_delta
+
+            pack = [np.asarray(v) for v in global_params]
+            prev = self._version_pack.get(base_v)
+            if prev is not None:
+                delta = round_delta(pack, prev)
+                # the canonical held value is the CHAIN value prev + delta
+                # (f32 adds), not the pack: warm clients compute exactly
+                # this, and the dense fallback ships it verbatim (marked
+                # lossless) so every rank holds the same base bitwise
+                stash = apply_delta(prev, delta)
+            else:
+                stash = pack
+        else:
+            stash = codec_roundtrip(global_params)
+        self._bcast_leaves = stash
+        self._stash_version(self.round_idx, stash)
         tr = self._dtracer
         if tr is not None:
             tr.begin_round(self.round_idx)
@@ -379,7 +430,17 @@ class FedAvgServerManager(ServerManager):
             if rank in suspects:
                 continue
             msg = Message(msg_type, self.rank, rank)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_params)
+            if delta is not None and self._rank_version.get(rank) == base_v:
+                # warm rank: its last upload proved it holds base_v
+                msg.add_params(MyMessage.MSG_ARG_KEY_DELTA_PARAMS, delta)
+                msg.add_params(MyMessage.MSG_ARG_KEY_BASE_VERSION, base_v)
+            else:
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, stash
+                               if self.delta_broadcast else global_params)
+                if self.delta_broadcast:
+                    # the dense fallback must land bit-exact: the next
+                    # delta is computed against this chain value
+                    msg.mark_lossless(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             if tr is not None:  # trace context rides the header scalars
@@ -387,6 +448,80 @@ class FedAvgServerManager(ServerManager):
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
+
+    # ------------------------------------------- versioned broadcast stash
+    # Retain enough versions to cover any admissible async staleness, with
+    # a floor for the unbounded-staleness mode; sync rounds only ever look
+    # up the current one.
+    _VERSION_RETAIN = 16
+
+    def _stash_version(self, version: int, decoded_leaves) -> None:
+        self._version_pack[int(version)] = decoded_leaves
+        if self._async:
+            retain = max(self._VERSION_RETAIN,
+                         (self._staleness_bound or 0) + 2)
+        else:
+            # sync rounds: the round-tag gate drops anything but the
+            # current round before densify, and the delta chain needs only
+            # r-1 — two stashed versions, not 16 model copies
+            retain = 2
+        for v in [v for v in self._version_pack if v <= version - retain]:
+            del self._version_pack[v]
+
+    def _decode_upload(self, msg_params, sender: int, version: int):
+        """Densify one upload's wire payload into full model leaves:
+        top-k (comm/sparse.py) and delta/quantized tiers (comm/delta.py)
+        decode against the stashed broadcast of ``version``; dense uploads
+        pass through. Returns None when the payload is structurally
+        undecodable (quarantined + counted — a chaos bit-flip that
+        survived CRC must cost one upload, not the server); raises on a
+        genuinely unversioned base (a protocol bug, not wire damage)."""
+        has_sparse = MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
+        has_upd = MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params
+        if not (has_sparse or has_upd):
+            return msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        base = self._version_pack.get(int(version))
+        if base is None:
+            raise RuntimeError(
+                f"upload from rank {sender} is encoded against version "
+                f"{version}, which was never broadcast (or predates this "
+                f"server) — encoded uplinks require a versioned base "
+                f"(stashed: {sorted(self._version_pack)})")
+        try:
+            if has_sparse:
+                from fedml_tpu.comm.delta import CorruptPayload
+                from fedml_tpu.comm.sparse import topk_decode
+
+                idx = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_IDX]
+                val = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_VAL]
+                if len(idx) != len(base) or len(val) != len(base):
+                    # zip would silently truncate a leaf-count mismatch —
+                    # validate like the delta branch does
+                    raise CorruptPayload(
+                        f"sparse payload has {len(idx)}/{len(val)} leaves, "
+                        f"model has {len(base)}")
+                return topk_decode(base, idx, val)
+            from fedml_tpu.comm.delta import apply_delta, decode_update
+
+            codec = str(msg_params[MyMessage.MSG_ARG_KEY_UPDATE_CODEC])
+            delta = decode_update(
+                msg_params[MyMessage.MSG_ARG_KEY_UPDATE_PAYLOAD],
+                msg_params[MyMessage.MSG_ARG_KEY_UPDATE_SCALE],
+                codec, base)
+            return apply_delta(base, delta)
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            # structural garbage that survived the CRC: quarantine at the
+            # gate's ledger (reason 'undecodable'), count, drop — VALUE
+            # garbage (corrupt scales -> non-finite decode) flows through
+            # and dies at the sanitation gate instead. IndexError: a
+            # bit-flipped sparse index lands out of range in topk_decode's
+            # scatter.
+            self.aggregator.quarantine.record(
+                self.round_idx, sender, "undecodable")
+            _obs.record_update_rejected("undecodable")
+            log.warning("quarantining undecodable upload from rank %d "
+                        "(%s)", sender, e)
+            return None
 
     def send_init_msg(self):
         if self._async:
@@ -429,6 +564,10 @@ class FedAvgServerManager(ServerManager):
         if self._bcast_version != self.round_idx or self._bcast_pack is None:
             self._bcast_pack = self.aggregator.get_global_model_params()
             self._bcast_version = self.round_idx
+            # versioned base stash: encoded uplinks from THIS dispatch wave
+            # densify against the broadcast as the client decodes it
+            self._stash_version(self.round_idx,
+                                codec_roundtrip(self._bcast_pack))
         cid = int(self.aggregator.client_sampling(wave)[rank - 1])
         msg = Message(msg_type or MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                       self.rank, rank)
@@ -466,12 +605,6 @@ class FedAvgServerManager(ServerManager):
                 log.info("async: drain complete — stopping")
                 self.finish()
             return
-        if MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params:
-            raise RuntimeError(
-                "async buffered mode requires dense uploads: a top-k delta "
-                "is relative to the exact broadcast the client received, "
-                "and the async server has advanced past it — launch "
-                "clients without sparsify under --async_buffer_k")
         expected_wave = self._awaiting.get(sender)
         # the echoed dispatch wave is authoritative (see _dispatch_one);
         # the fallback covers interop peers that drop unknown keys
@@ -498,7 +631,32 @@ class FedAvgServerManager(ServerManager):
                         self._staleness.bound)
             self._dispatch_one(sender)
             return
-        wire_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+        # encoded uplinks (top-k / delta / quantized) compose with the
+        # async waves because they densify against the stashed broadcast
+        # of the version the dispatch carried (the PR-8 dense-only refusal
+        # is lifted): an admissible-staleness upload whose base was
+        # EVICTED from the bounded stash is shed as stale and requeued —
+        # only a version never broadcast stays a loud protocol error
+        # (_decode_upload raises)
+        encoded = (MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
+                   or MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params)
+        if encoded and trained_version not in self._version_pack \
+                and 0 <= trained_version <= self.round_idx:
+            self._record_shed("stale")
+            log.warning("async: rank %d's upload encoded against evicted "
+                        "base version %d (stash floor %s) — requeued",
+                        sender, trained_version,
+                        min(self._version_pack, default=None))
+            self._dispatch_one(sender)
+            return
+        wire_leaves = self._decode_upload(msg_params, sender,
+                                          trained_version)
+        if wire_leaves is None:
+            # undecodable payload: quarantined + counted by _decode_upload;
+            # the rank gets fresh work like any other consumed upload
+            self._record_shed("undecodable")
+            self._dispatch_one(sender)
+            return
         # the work unit's client id: echoed from the dispatch frame (like
         # the wave) so the hot path never rebuilds the O(client_num_in_
         # total) seeded sampling permutation under _round_lock; the
@@ -748,19 +906,29 @@ class FedAvgServerManager(ServerManager):
                 # the arrival alone keeps slack computable)
                 self._dtracer.on_upload(int(sender),
                                         msg_params.get(TRACE_KEY))
-            if MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params:
-                # sparse uplink: densify against the global this round
-                # broadcast — the ALREADY-PACKED leaves stashed at send
-                # time (re-packing the full model per upload would cost N
-                # device→host materializations per round under this lock)
-                from fedml_tpu.comm.sparse import topk_decode
-
-                wire_leaves = topk_decode(
-                    self._bcast_leaves,
-                    msg_params[MyMessage.MSG_ARG_KEY_SPARSE_IDX],
-                    msg_params[MyMessage.MSG_ARG_KEY_SPARSE_VAL])
-            else:
-                wire_leaves = msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]
+            # proof of possession: an upload tagged round v means the
+            # sender decoded broadcast v — the delta-downlink warm set
+            self._rank_version[int(sender)] = int(msg_round)
+            # densify encoded uplinks (top-k / delta / quantized) against
+            # the STASHED broadcast of the upload's version — the already-
+            # decoded leaves kept at send time (re-packing the full model
+            # per upload would cost N device→host materializations per
+            # round under this lock); the round gate above means sync
+            # lookups always hit the current round's stash
+            wire_leaves = self._decode_upload(msg_params, int(sender),
+                                              int(msg_round))
+            if wire_leaves is None:
+                # undecodable: quarantined + counted, but the ARRIVAL still
+                # satisfies the barrier — with no elastic timeout armed, a
+                # skipped slot would otherwise hang the round forever. The
+                # round degrades to the exact partial aggregate over the
+                # decodable uploads (the elastic-partial shape; an
+                # all-undecodable round keeps the global model).
+                if (sender - 1) in self.aggregator.flag_client_model_uploaded:
+                    self.aggregator.flag_client_model_uploaded[sender - 1] = True
+                if self.aggregator.check_whether_all_receive():
+                    self._advance_round()
+                return
             self.aggregator.add_local_trained_result(
                 sender - 1,
                 wire_leaves,

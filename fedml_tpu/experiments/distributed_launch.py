@@ -209,7 +209,39 @@ def add_args(p: argparse.ArgumentParser):
                    help="top-k sparsified uplinks with error feedback "
                         "(comm/sparse.py): ship only this fraction of the "
                         "model delta per upload; 1.0 = exact dense "
-                        "equivalence, unset = dense protocol")
+                        "equivalence, unset = dense protocol. Composes "
+                        "with --async_buffer_k (uplinks densify against "
+                        "the version the dispatch wave carried)")
+    p.add_argument("--update_codec", "--update-codec", dest="update_codec",
+                   type=str, default=None,
+                   choices=["dense", "delta", "delta-int8", "delta-sign1"],
+                   help="delta/quantized uplink tier (comm/delta.py, "
+                        "docs/PERFORMANCE.md §Wire efficiency): clients "
+                        "upload local - global@version; 'delta-int8' "
+                        "quantizes it to deadzoned int8 (+deflate, >= 8x "
+                        "uplink vs dense f32), 'delta-sign1' to 1-bit "
+                        "scaled sign (>= 25x), both with client-side "
+                        "error feedback so convergence matches dense. "
+                        "Mutually exclusive with --sparsify_ratio; "
+                        "composes with --async_buffer_k and the frame "
+                        "--compression (payloads are exempt from the "
+                        "lossy f16/q8 frame tiers)")
+    p.add_argument("--delta_broadcast", "--delta-broadcast",
+                   dest="delta_broadcast", type=int, default=0,
+                   help="rank 0: broadcast global@r - global@r-1 to warm "
+                        "clients (ranks whose last upload proved they "
+                        "hold r-1) with a dense fallback for joiners/"
+                        "reprobes — the downlink half of the wire-"
+                        "efficiency layer. Sync rounds only (ignored "
+                        "with --async_buffer_k); delta payloads ride the "
+                        "frame lossless, so pair with --compression "
+                        "zlib, not f16/q8")
+    p.add_argument("--error_feedback", "--error-feedback",
+                   dest="error_feedback", type=int, default=1,
+                   help="client-side error-feedback residual for the "
+                        "lossy uplink tiers (comm/ef.py); 0 is the "
+                        "convergence-ablation knob, never the production "
+                        "setting")
     p.add_argument("--compression", type=str, default="none",
                    choices=["none", "f16", "q8", "zlib", "f16+zlib",
                             "q8+zlib", "json"],
@@ -294,11 +326,6 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                 raise ValueError(
                     "--async_buffer_k is not wired for turboaggregate "
                     "(Shamir shares need the full synchronous cohort)")
-            if getattr(args, "sparsify_ratio", None):
-                raise ValueError(
-                    "--async_buffer_k requires dense uploads "
-                    "(--sparsify_ratio deltas are relative to a broadcast "
-                    "the async server has advanced past)")
             srv_kw.update(async_buffer_k=args.async_buffer_k,
                           staleness=args.staleness,
                           staleness_bound=args.staleness_bound,
@@ -308,28 +335,37 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                                    round_timeout_s=args.round_timeout_s,
                                    heartbeat_max_age_s=getattr(
                                        args, "heartbeat_max_age_s", None),
+                                   delta_broadcast=bool(getattr(
+                                       args, "delta_broadcast", 0)),
                                    telemetry=telemetry, **srv_kw,
                                    **backend_kw)
 
-    # sparse uplinks apply where the upload is plain weights; a
-    # turboaggregate share is a masked tensor whose top-k entries are
-    # meaningless (the mask dominates), so it stays dense
+    # sparse/quantized uplinks apply where the upload is plain weights; a
+    # turboaggregate share is a masked tensor whose top-k entries (and
+    # round delta) are meaningless (the mask dominates), so it stays dense
     sp = getattr(args, "sparsify_ratio", None) or None
+    codec_kw = dict(sparsify_ratio=sp,
+                    update_codec=getattr(args, "update_codec", None),
+                    error_feedback=bool(getattr(args, "error_feedback", 1)))
     adv = _load_adversary_plan(getattr(args, "adversary_plan", None))
     if args.algo == "fedprox":
         from fedml_tpu.distributed.fedprox import prox_spec
 
         return init_client(data, task, cfg, args.rank, args.world_size, backend,
                            local_spec=prox_spec(cfg, args.fedprox_mu),
-                           sparsify_ratio=sp, adversary_plan=adv, **backend_kw)
+                           adversary_plan=adv, **codec_kw, **backend_kw)
     if args.algo == "turboaggregate":
         from fedml_tpu.distributed.turboaggregate import SecureTrainer
 
+        if codec_kw["update_codec"] or sp:
+            raise ValueError(
+                "--update_codec/--sparsify_ratio are not wired for "
+                "turboaggregate (Shamir shares ship dense)")
         trainer = SecureTrainer(args.rank, data, task, cfg)
         return FedAvgClientManager(trainer, rank=args.rank, size=args.world_size,
                                    backend=backend, **backend_kw)
     return init_client(data, task, cfg, args.rank, args.world_size, backend,
-                       sparsify_ratio=sp, adversary_plan=adv, **backend_kw)
+                       adversary_plan=adv, **codec_kw, **backend_kw)
 
 
 def _load_adversary_plan(spec: str | None):

@@ -16,6 +16,7 @@ managers, one process), gRPC, and MQTT runs all read through the same names:
 
     comm_messages_sent_total{backend,type}
     comm_bytes_sent_total{backend,codec}
+    comm_bytes_total{codec,direction}        (direction = uplink|downlink)
     comm_messages_received_total{backend}
     comm_bytes_received_total{backend}
     comm_dispatch_latency_seconds{backend}   (histogram)
@@ -63,6 +64,38 @@ def _dispatch_hist(backend: str):
 def record_send(backend: str, codec: str, nbytes: int, msg_type: str) -> None:
     _sent_msgs(backend, msg_type).inc()
     _sent_bytes(backend, codec).inc(nbytes)
+
+
+@lru_cache(maxsize=128)
+def _bytes_total(codec: str, direction: str):
+    return REGISTRY.counter("comm_bytes_total", codec=codec,
+                            direction=direction)
+
+
+def record_wire_bytes(codec: str, direction: str, nbytes: int) -> None:
+    """Per-direction wire accounting (``comm_bytes_total{codec,direction}``,
+    direction = uplink | downlink): at fleet fan-in the two directions have
+    opposite economics — broadcast dominates downlink, per-client updates
+    dominate uplink, and the uplink is the byte budget the delta/quantized
+    tiers optimize (docs/PERFORMANCE.md §Wire efficiency). ``codec`` is the
+    EFFECTIVE tier: the update codec (topk / delta / delta-int8 /
+    delta-sign1) composed with the frame codec when both apply, else the
+    frame codec alone — so the A/B evidence separates 'dense f32 frames'
+    from 'quantized delta frames' without a second label."""
+    _bytes_total(codec, direction).inc(nbytes)
+
+
+def directional_bytes(registry: MetricsRegistry | None = None) -> dict:
+    """{'uplink': bytes, 'downlink': bytes} summed over codecs (0.0 for a
+    direction with no traffic / pre-PR-9 processes)."""
+    reg = registry or REGISTRY
+    out = {"uplink": 0.0, "downlink": 0.0}
+    fam = reg.snapshot().get("comm_bytes_total", {})
+    for label_s, v in fam.items():
+        for d in out:
+            if f"direction={d}" in label_s:
+                out[d] += float(v)
+    return out
 
 
 def record_receive(backend: str, nbytes: int) -> None:
@@ -305,11 +338,17 @@ def comm_counters(registry: MetricsRegistry | None = None) -> dict:
     log. Includes dispatch-latency quantiles when any message was timed."""
     refresh_liveness()  # age gauges must be fresh in any snapshot
     reg = registry or REGISTRY
+    dirs = directional_bytes(reg)
     out = {
         "messages_sent": reg.total("comm_messages_sent_total"),
         "bytes_sent": reg.total("comm_bytes_sent_total"),
         "messages_received": reg.total("comm_messages_received_total"),
         "bytes_received": reg.total("comm_bytes_received_total"),
+        # per-direction split (comm_bytes_total{codec,direction}): uplink
+        # is the byte budget the delta/quantized tiers optimize; one
+        # undirected counter hides that broadcast dominates downlink
+        "bytes_uplink": dirs["uplink"],
+        "bytes_downlink": dirs["downlink"],
     }
     snap = reg.snapshot().get("comm_dispatch_latency_seconds", {})
     n = sum(s.get("count", 0) for s in snap.values())

@@ -209,7 +209,7 @@ def record_update_staleness(staleness: float) -> None:
     _hist("fed_update_staleness").observe(float(staleness))
 
 
-@lru_cache(maxsize=8)
+@lru_cache(maxsize=16)
 def _async_shed(reason: str):
     return REGISTRY.counter("fed_async_shed_total", reason=reason)
 
@@ -222,5 +222,8 @@ def ensure_async_shed_families() -> None:
     """Pre-register every shed-reason child at zero so an async run's
     Prometheus export always carries the full family — a clean run must
     read as 'nothing shed', not 'metric missing'."""
-    for reason in ("stale", "overflow", "nonfinite", "crash", "suspect"):
+    # mirrors core/async_buffer.SHED_REASONS (obs must not import core —
+    # the dependency points the other way; drift is test-pinned)
+    for reason in ("stale", "overflow", "nonfinite", "crash", "suspect",
+                   "undecodable"):
         _async_shed(reason)

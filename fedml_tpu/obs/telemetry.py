@@ -84,7 +84,8 @@ class Telemetry:
         now = comm_counters(REGISTRY)
         delta = {k: now[k] - self._last_comm.get(k, 0.0)
                  for k in ("messages_sent", "bytes_sent",
-                           "messages_received", "bytes_received")}
+                           "messages_received", "bytes_received",
+                           "bytes_uplink", "bytes_downlink")}
         delta["total_bytes_sent"] = now["bytes_sent"]
         delta["total_messages_sent"] = now["messages_sent"]
         # dispatch stats come from a run-cumulative histogram (no per-round

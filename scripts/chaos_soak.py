@@ -73,7 +73,9 @@ def random_plan(seed: int, world_size: int, elastic: bool = True):
 def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              round_timeout_s: float = 1.0, adversary_plan=None,
              aggregator: str | None = None,
-             async_buffer_k: int | None = None) -> dict:
+             async_buffer_k: int | None = None,
+             update_codec: str | None = None,
+             sparsify_ratio: float | None = None) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
@@ -115,7 +117,9 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
                             chaos_plan=plan, round_timeout_s=round_timeout_s,
                             adversary_plan=adversary_plan,
                             aggregator=aggregator,
-                            aggregator_params=agg_params, **async_kw)
+                            aggregator_params=agg_params,
+                            update_codec=update_codec,
+                            sparsify_ratio=sparsify_ratio, **async_kw)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
     completed = bool(agg and agg.history
@@ -213,6 +217,16 @@ def main(argv=None) -> int:
                          "model bits (dispatch counts are thread-"
                          "scheduled — the bit-for-bit async replay is the "
                          "virtual-clock simulator's)")
+    ap.add_argument("--compression", type=str, default=None,
+                    help="run every trial under a wire-compression tier "
+                         "(docs/PERFORMANCE.md §Wire efficiency): a frame "
+                         "codec (zlib | f16 | q8 | ...) set process-wide "
+                         "for the campaign, an update codec (delta | "
+                         "delta-int8 | delta-sign1 — clients upload "
+                         "encoded deltas with error feedback), or "
+                         "'topk:R' (top-k with ratio R). Replays must "
+                         "still reproduce ledger + model bits — the "
+                         "codec layer is deterministic")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
 
@@ -240,6 +254,24 @@ def main(argv=None) -> int:
         return AdversaryPlan.from_json(adv_spec)
 
     aggregator = args.aggregator if adv_spec is not None else None
+    # --compression tier: frame codec (process-wide), update codec
+    # (per-client encoded deltas), or topk:R sparsification
+    frame_codec, update_codec, sparsify_ratio = None, None, None
+    if args.compression:
+        from fedml_tpu.comm.delta import UPDATE_CODECS
+
+        if args.compression in UPDATE_CODECS:
+            update_codec = args.compression
+        elif args.compression.startswith("topk:"):
+            sparsify_ratio = float(args.compression.split(":", 1)[1])
+        else:
+            frame_codec = args.compression  # validated by set_wire_codec
+    codec_kw = dict(update_codec=update_codec,
+                    sparsify_ratio=sparsify_ratio)
+    if frame_codec:
+        from fedml_tpu.comm.message import set_wire_codec
+
+        set_wire_codec(frame_codec)
     trials = []
     for i in range(args.trials):
         seed = args.seed0 + i
@@ -247,7 +279,7 @@ def main(argv=None) -> int:
         rec = run_plan(data, task, plan, rounds=args.rounds,
                        world_size=args.world_size, adversary_plan=adv(),
                        aggregator=aggregator,
-                       async_buffer_k=args.async_buffer_k)
+                       async_buffer_k=args.async_buffer_k, **codec_kw)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
@@ -256,7 +288,7 @@ def main(argv=None) -> int:
             rec2 = run_plan(data, task, random_plan(seed, args.world_size),
                             rounds=args.rounds, world_size=args.world_size,
                             adversary_plan=adv(), aggregator=aggregator,
-                            async_buffer_k=args.async_buffer_k)
+                            async_buffer_k=args.async_buffer_k, **codec_kw)
             if args.async_buffer_k:
                 # async dispatch counts and arrival order are
                 # thread-scheduled, so even per-link fault draws shift
@@ -286,6 +318,10 @@ def main(argv=None) -> int:
               f"({rec['n_faults']} faults, {rec['seconds']}s)",
               file=sys.stderr)
 
+    if frame_codec:
+        from fedml_tpu.comm.message import set_wire_codec
+
+        set_wire_codec("none")  # don't leak into an embedding process
     n_ok = sum(t["ok"] for t in trials)
     # BENCH-blob-shaped summary (obs/export conventions): one metric line a
     # dashboard can ingest, with the trial records riding along
@@ -302,6 +338,8 @@ def main(argv=None) -> int:
     }
     if args.async_buffer_k:
         summary["async_buffer_k"] = args.async_buffer_k
+    if args.compression:
+        summary["compression"] = args.compression
     if adv_spec is not None:
         summary["adversary_plan"] = json.loads(adv_spec)
         summary["aggregator"] = aggregator

@@ -281,6 +281,72 @@ print(f"async buffered smoke ok: K=cohort bitwise (ledger "
       f"{sync_t:.2f}s sync vs {async_t:.2f}s async, families exported")
 PY
   python scripts/report.py "$ASYNC_DIR/events.jsonl"
+  echo "== wire-codec smoke (delta+int8 round-trip parity; quantized garbage quarantines; comm_bytes_total{direction} exported) =="
+  # the wire-efficiency layer (docs/PERFORMANCE.md §Wire efficiency) must
+  # (a) round-trip the delta+int8 tier (encode/decode oracle + a loopback
+  # run that matches the dense protocol within the EF tolerance), (b)
+  # quarantine decoded quantized garbage (a NaN client under delta-int8
+  # must die at the sanitation gate, never poison the aggregate), and (c)
+  # export the per-direction byte accounting through Telemetry.close()
+  CODEC_DIR=./tmp/ci_codec; rm -rf "$CODEC_DIR"
+  python - "$CODEC_DIR" <<'PY'
+import os, sys
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan
+from fedml_tpu.comm.delta import (apply_delta, decode_update, encode_update,
+                                  round_delta)
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+# (a) numpy round-trip oracle: delta -> int8 -> decode within half a step
+rs = np.random.RandomState(0)
+local = [rs.randn(32, 8).astype(np.float32), np.arange(4, dtype=np.int64)]
+base = [rs.randn(32, 8).astype(np.float32), np.zeros(4, np.int64)]
+delta = round_delta(local, base)
+payload, scales = encode_update(delta, "delta-int8", deadzone=0.0)
+dec = decode_update(payload, scales, "delta-int8", base)
+assert np.max(np.abs(dec[0] - delta[0])) <= scales[0] / 2 + 1e-7
+np.testing.assert_array_equal(apply_delta(base, dec)[1], local[1])
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=48, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=4, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+tel = Telemetry(log_dir=d)
+a = run_simulated(data, task, cfg, job_id="ci-codec-dense", telemetry=tel)
+b = run_simulated(data, task, cfg, job_id="ci-codec-q8",
+                  update_codec="delta-int8")
+for x, y in zip(pack_pytree(a.net), pack_pytree(b.net)):
+    # matched rounds, EF tolerance: int8+EF stays in the dense ballpark
+    assert float(np.max(np.abs(np.asarray(x) - np.asarray(y)))) < 0.15
+assert b.history[-1]["test_acc"] >= 0.9, b.history[-1]
+# (b) a NaN upload under the quantized tier quarantines at the gate
+plan = AdversaryPlan.from_json(
+    {"seed": 1, "rules": [{"attack": "nan", "ranks": [2]}]})
+g = run_simulated(data, task, cfg, job_id="ci-codec-nan",
+                  update_codec="delta-int8", adversary_plan=plan)
+led = g.quarantine.canonical()
+assert led and any(e[1] == 2 for e in led), f"NaN client not quarantined: {led}"
+assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(g.net))
+tel.close()
+prom = open(os.path.join(d, "metrics.prom")).read()
+assert "comm_bytes_total" in prom, "comm_bytes_total missing from export"
+for direction in ("uplink", "downlink"):
+    assert f'direction="{direction}"' in prom, \
+        f"direction={direction} missing from comm_bytes_total"
+print(f"wire-codec smoke ok: int8 round-trip within half a step, NaN "
+      f"quarantined ({g.quarantine.counts()}), directions exported")
+PY
+  python scripts/report.py "$CODEC_DIR/events.jsonl"
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi
@@ -370,4 +436,9 @@ python scripts/chaos_soak.py --trials 3 --rounds 3 \
 # thread-scheduled — the bit-for-bit async replay is tier-1's virtual clock)
 python scripts/chaos_soak.py --trials 3 --rounds 3 --async-buffer-k 2 \
   --out ./tmp/chaos_soak_async.json
+# wire-codec tier: the same seeded wire faults with clients uploading
+# deadzoned-int8 deltas (error feedback on); replays must still reproduce
+# ledger + final model bits — the codec layer is deterministic
+python scripts/chaos_soak.py --trials 3 --rounds 3 --compression delta-int8 \
+  --out ./tmp/chaos_soak_codec.json
 echo "CI GREEN"

@@ -97,6 +97,12 @@ def render_table(records: list[dict]) -> str:
             "test_acc": ev.get("test_acc"),
             "tx_msgs": r.get("comm", {}).get("messages_sent"),
             "tx_bytes": r.get("comm", {}).get("bytes_sent"),
+            # per-direction wire accounting (comm_bytes_total{direction},
+            # docs/PERFORMANCE.md §Wire efficiency): uplink is the byte
+            # budget the delta/quantized tiers optimize — columns hide on
+            # pre-PR-9 logs that predate the split
+            "tx_up_B": r.get("comm", {}).get("bytes_uplink"),
+            "tx_down_B": r.get("comm", {}).get("bytes_downlink"),
         })
     if not rows:
         return "(no round records)"
