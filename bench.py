@@ -243,7 +243,13 @@ def _measure(mode: str) -> None:
     # Implies telemetry (the spans ride the same bundle); a measured
     # VARIANT like the event log, never the headline default.
     trdir = os.environ.get("FEDML_BENCH_TRACE_DIR")
-    if tdir or trdir:
+    # FEDML_BENCH_METRICS_PORT=<port>: live /metrics + /healthz for the
+    # measuring child (docs/OBSERVABILITY.md §Live endpoints) — watch a
+    # long TPU bench instead of waiting for its one JSON line. 0 = an
+    # ephemeral port (logged + in the run header). Implies telemetry
+    # (same measured-variant caveat as the event log).
+    mport = os.environ.get("FEDML_BENCH_METRICS_PORT")
+    if tdir or trdir or mport is not None:
         import atexit
 
         from fedml_tpu.obs import Telemetry
@@ -253,10 +259,17 @@ def _measure(mode: str) -> None:
         # runs' round records (duplicate round numbers, mixed span bases)
         # and the second child's close() would clobber the first's
         # metrics.prom
-        telemetry = Telemetry(log_dir=os.path.join(tdir or trdir, mode),
+        telemetry = Telemetry(log_dir=(os.path.join(tdir or trdir, mode)
+                                       if tdir or trdir else None),
                               trace_dir=(os.path.join(trdir, mode)
                                          if trdir else None),
-                              run_id=f"bench_{mode}")
+                              run_id=f"bench_{mode}",
+                              http_port=(int(mport) if mport is not None
+                                         else None))
+        if telemetry.http_port is not None:
+            print(f"bench: live endpoints on "
+                  f"http://127.0.0.1:{telemetry.http_port}/metrics",
+                  file=sys.stderr)
         atexit.register(telemetry.close)
     api = FedAvgAPI(data, task, cfg, device_data=(mode == "block"),
                     donate=True, mesh=mesh,

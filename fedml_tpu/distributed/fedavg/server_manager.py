@@ -1012,9 +1012,34 @@ class FedAvgServerManager(ServerManager):
                         self.aggregator.flag_client_model_uploaded.items() if v]
             missing = [i + 1 for i, v in
                        self.aggregator.flag_client_model_uploaded.items() if not v]
-            if self.round_timeout_s is None or not received or self._finished.is_set():
+            if self.round_timeout_s is None or self._finished.is_set():
                 log.error("round %d stalled %.1fs: waiting on client ranks %s",
                           self.round_idx, idle_s, missing)
+                return
+            if not received:
+                # elastic round with NOTHING to aggregate: advancing would
+                # fold an empty cohort, but returning silently wedged the
+                # job forever (every upload lost to corrupt-drop/crash in
+                # one round = no progress, and the watchdog used to just
+                # log). Re-broadcast the current global instead — each
+                # resend draws fresh wire-fault outcomes and a recovered
+                # rank gets a fresh shot at the round; the health layer's
+                # stall rule (obs/health.py) reports the condition while
+                # this nudge works on clearing it.
+                log.error("round %d stalled %.1fs with NO uploads — "
+                          "re-broadcasting round state to ranks %s",
+                          self.round_idx, idle_s, missing)
+                # forced reprobe first (the async branch's analogue): a
+                # rank marked undeliverable THIS round is skipped by
+                # send_message until round_idx moves — which it cannot
+                # while stalled — so without clearing the marks an
+                # all-downlink-failure stall would re-broadcast to nobody.
+                # A re-failed send re-marks the rank immediately.
+                self._undeliverable.clear()
+                self._update_alive_gauge()
+                self._broadcast_model(
+                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                    self.aggregator.get_global_model_params())
                 return
             log.warning(
                 "round %d: elastic partial aggregation over ranks %s "

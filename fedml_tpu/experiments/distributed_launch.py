@@ -178,6 +178,17 @@ def add_args(p: argparse.ArgumentParser):
                         "byte/message counters; docs/OBSERVABILITY.md) and "
                         "a Prometheus text dump at exit; render with "
                         "scripts/report.py")
+    p.add_argument("--metrics_port", "--metrics-port", dest="metrics_port",
+                   type=int, default=None, metavar="PORT",
+                   help="every rank: serve live /metrics (Prometheus text) "
+                        "+ /healthz (JSON run health) over HTTP "
+                        "(docs/OBSERVABILITY.md §Live endpoints). Each "
+                        "rank binds PORT + rank so one flag covers a "
+                        "single-host launch; PORT 0 binds an ephemeral "
+                        "port per rank (logged, and in rank 0's run "
+                        "header). Rank 0 serves the full health verdict "
+                        "(obs/health.py rule table + memory telemetry); "
+                        "client ranks serve their process registry")
     p.add_argument("--trace-dir", "--trace_dir", dest="trace_dir",
                    type=str, default=None,
                    help="rank 0: enable cross-rank distributed tracing "
@@ -458,14 +469,36 @@ def main(argv=None):
     else:
         backend_kw.update(job_id="launch")
 
+    # --metrics_port N: rank r binds N + r (0 = ephemeral everywhere) —
+    # live /metrics + /healthz per rank, docs/OBSERVABILITY.md §Live
+    # endpoints. Rank 0's server rides its Telemetry bundle (health rules +
+    # memwatch implied); client ranks serve a bare registry endpoint.
+    rank_port = (args.metrics_port + (args.rank if args.metrics_port else 0)
+                 if args.metrics_port is not None else None)
+    metrics_server = None
     telemetry = None
-    if (args.telemetry_dir or args.trace_dir) and args.rank == 0:
+    if args.rank == 0 and (args.telemetry_dir or args.trace_dir
+                           or rank_port is not None):
         from fedml_tpu.obs import Telemetry
 
         # --trace-dir alone implies telemetry: the event log (with the
-        # critical-path round records) lands next to trace.json
+        # critical-path round records) lands next to trace.json;
+        # --metrics_port alone gets an in-memory event log (the live
+        # endpoints are the output)
         telemetry = Telemetry(log_dir=args.telemetry_dir or args.trace_dir,
-                              trace_dir=args.trace_dir)
+                              trace_dir=args.trace_dir,
+                              http_port=rank_port)
+        if telemetry.http_port is not None:
+            logging.getLogger("fedml_tpu.launch").info(
+                "live endpoints: http://127.0.0.1:%d/metrics (+ /healthz)",
+                telemetry.http_port)
+    elif args.rank != 0 and rank_port is not None:
+        from fedml_tpu.obs import start_metrics_server
+
+        metrics_server = start_metrics_server(port=rank_port)
+        logging.getLogger("fedml_tpu.launch").info(
+            "live endpoints: http://127.0.0.1:%d/metrics (+ /healthz)",
+            metrics_server.port)
     mgr = init_role(args, data, task, cfg, backend_kw, telemetry=telemetry)
     if args.warmup and args.rank != 0 and hasattr(mgr, "warmup"):
         # AOT-compile before blocking on the first broadcast; rides the
@@ -483,6 +516,8 @@ def main(argv=None):
     finally:
         if telemetry is not None:
             telemetry.close()
+        if metrics_server is not None:
+            metrics_server.close()
     if args.chaos_plan:
         from fedml_tpu import chaos
 

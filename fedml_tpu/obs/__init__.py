@@ -24,7 +24,14 @@ reports through:
 - ``clock``          — the NTP-style clock-offset estimator the stitcher
                        rebases client spans with;
 - ``trace_export``   — Chrome trace-event JSON (Perfetto /
-                       chrome://tracing) + the critical-path renderer.
+                       chrome://tracing) + the critical-path renderer;
+- ``httpd``          — live per-rank ``/metrics`` + ``/healthz`` HTTP
+                       endpoints (``Telemetry(http_port=)``);
+- ``memwatch``       — device-HBM / host-RSS gauges + the ``mem`` block
+                       on round records (``Telemetry(memwatch=True)``);
+- ``health``         — rule-driven ``HealthMonitor``: edge-triggered
+                       alerts (convergence/slowdown/quorum/memory/stall)
+                       into the event log + ``fed_alerts_total``.
 
 scripts/report.py renders a run's events.jsonl; docs/OBSERVABILITY.md has
 the schema and metric-name reference.
@@ -32,22 +39,30 @@ the schema and metric-name reference.
 
 from fedml_tpu.obs.comm_instrument import comm_counters
 from fedml_tpu.obs.events import EventLog, JsonlSink, MemorySink, read_jsonl
+from fedml_tpu.obs.health import DEFAULT_RULES, HealthMonitor
+from fedml_tpu.obs.httpd import MetricsHTTPServer, start_metrics_server
+from fedml_tpu.obs.memwatch import MemoryWatcher
 from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
 from fedml_tpu.obs.telemetry import Telemetry
 from fedml_tpu.obs.tracing import (TRACE_KEY, ClientSpanBuffer,
                                    DistributedTracer, RoundTracer)
 
 __all__ = [
+    "DEFAULT_RULES",
     "REGISTRY",
     "TRACE_KEY",
     "ClientSpanBuffer",
     "DistributedTracer",
     "EventLog",
+    "HealthMonitor",
     "JsonlSink",
     "MemorySink",
+    "MemoryWatcher",
+    "MetricsHTTPServer",
     "MetricsRegistry",
     "RoundTracer",
     "Telemetry",
     "comm_counters",
     "read_jsonl",
+    "start_metrics_server",
 ]

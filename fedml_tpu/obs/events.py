@@ -24,16 +24,25 @@ import time
 
 
 class MemorySink:
-    """In-memory sink — tests and short-lived tools read ``records``."""
+    """In-memory sink — tests and short-lived tools read ``records``.
+
+    Locked like ``JsonlSink``: the HealthMonitor's background thread
+    (obs/health.py) emits alert records concurrently with the engine
+    thread's round emits, and an unsynchronized list.append can drop a
+    record mid-resize on some interpreters — same discipline, both
+    sinks."""
 
     def __init__(self):
         self.records: list[dict] = []
+        self._lock = threading.Lock()
 
     def write(self, rec: dict) -> None:
-        self.records.append(rec)
+        with self._lock:
+            self.records.append(rec)
 
     def close(self) -> None:
-        pass
+        with self._lock:
+            pass  # nothing to flush; the lock keeps close/write ordered
 
 
 class JsonlSink:
@@ -100,16 +109,23 @@ class EventLog:
         self.sink.close()
 
 
-def read_jsonl(path: str, kinds: tuple[str, ...] | None = None) -> list[dict]:
-    """Load a JSONL event file (rotated predecessors first, so records come
-    back in emission order). Unparseable lines are skipped — a run killed
-    mid-write must not make its whole log unreadable."""
+def read_jsonl(path: str, kinds: tuple[str, ...] | None = None,
+               backups: bool = True) -> list[dict]:
+    """Load a JSONL event file. ``backups=True`` (the default) folds the
+    rotated stack back in first (``.N`` ... ``.1``, oldest to newest, then
+    the active file) so a run that rotated mid-flight comes back in
+    emission order with its oldest retained rounds intact — report.py and
+    ``bench_blob`` would otherwise silently lose them. ``backups=False``
+    reads the active file alone (tail-only tools). Unparseable lines are
+    skipped — a run killed mid-write must not make its whole log
+    unreadable."""
     paths = []
-    i = 1
-    while os.path.exists(f"{path}.{i}"):
-        paths.append(f"{path}.{i}")
-        i += 1
-    paths.reverse()  # .N is oldest
+    if backups:
+        i = 1
+        while os.path.exists(f"{path}.{i}"):
+            paths.append(f"{path}.{i}")
+            i += 1
+        paths.reverse()  # .N is oldest
     if os.path.exists(path):
         paths.append(path)
     out = []

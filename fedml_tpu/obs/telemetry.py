@@ -10,11 +10,23 @@ One object ties together the three obs primitives:
   reusing utils.tracing.trace);
 - optionally a ``DistributedTracer`` (``trace_dir=``/``trace=True``) — the
   cross-rank per-round trace stitcher (obs/tracing.py); ``close()`` writes
-  its Chrome trace-event JSON next to the event log.
+  its Chrome trace-event JSON next to the event log;
+- optionally the live run-health layer (docs/OBSERVABILITY.md §Live
+  endpoints): ``http_port=`` binds a per-rank ``/metrics`` + ``/healthz``
+  HTTP server (obs/httpd.py; port 0 = ephemeral, the bound port rides the
+  run header), ``memwatch=`` samples device HBM + host RSS into gauges
+  and a ``mem`` block on round records (obs/memwatch.py), and
+  ``health=``/``health_rules=`` arm the rule-driven ``HealthMonitor``
+  (obs/health.py) whose alerts land in this event log. ``http_port``
+  alone implies memwatch + health — a live endpoint with no health
+  verdict behind it would be an empty promise; pass ``memwatch=False`` /
+  ``health=False`` to strip them.
 
 Contract with the engines: a ``telemetry=None`` engine is bit-identical to
 the pre-telemetry engine — no extra outputs in the jitted round program, no
-extra device syncs, no host work. All cost is opt-in.
+extra device syncs, no host work. All cost is opt-in, and the new layers
+follow the same rule: with http/memwatch/health off (the default) this
+bundle starts zero threads and binds zero sockets.
 """
 
 from __future__ import annotations
@@ -33,7 +45,12 @@ class Telemetry:
                  round_stats: bool = True,
                  rotate_bytes: int = 64 << 20, backups: int = 3,
                  trace_dir: str | None = None, trace: bool = False,
-                 trace_clock=None):
+                 trace_clock=None,
+                 http_port: int | None = None, http_host: str = "127.0.0.1",
+                 memwatch: bool | None = None, mem_interval_s: float = 5.0,
+                 health: bool | None = None, health_rules=None,
+                 health_interval_s: float = 5.0,
+                 expected_ranks: int | None = None):
         self.log_dir = log_dir
         # ``registry`` is where THIS bundle's own metrics live and what
         # close() dumps. Comm deltas always read the process-wide REGISTRY
@@ -64,6 +81,37 @@ class Telemetry:
 
             self.tracer = DistributedTracer(
                 self.events.run_id, clock=trace_clock or _time.time)
+        # --- live run-health layer (all opt-in; docs/OBSERVABILITY.md
+        # §Live endpoints / §Memory telemetry / §Health rules). None means
+        # "follow http_port": a live endpoint without memory gauges or a
+        # health verdict would scrape hollow.
+        self.health = None
+        self.memwatch = None
+        self.httpd = None
+        self.http_port = None
+        if health is None:
+            health = health_rules is not None or http_port is not None
+        if memwatch is None:
+            memwatch = http_port is not None
+        if health:
+            from fedml_tpu.obs.health import HealthMonitor
+
+            self.health = HealthMonitor(telemetry=self, rules=health_rules,
+                                        registry=self.registry,
+                                        expected_ranks=expected_ranks)
+            self.health.start(health_interval_s)
+        if memwatch:
+            from fedml_tpu.obs.memwatch import MemoryWatcher
+
+            self.memwatch = MemoryWatcher(interval_s=mem_interval_s,
+                                          registry=self.registry).start()
+        if http_port is not None:
+            from fedml_tpu.obs.httpd import MetricsHTTPServer
+
+            self.httpd = MetricsHTTPServer(port=http_port, host=http_host,
+                                           registry=self.registry,
+                                           health=self.health)
+            self.http_port = self.httpd.port
         self._header_emitted = False
         self._last_comm = comm_counters(REGISTRY)
 
@@ -74,6 +122,14 @@ class Telemetry:
         if self._header_emitted:
             return
         self._header_emitted = True
+        if self.http_port is not None:
+            # the bound port (http_port=0 asked for an ephemeral one) —
+            # the run header is where a log reader learns where to scrape
+            fields.setdefault("http_port", self.http_port)
+        if (self.health is not None and self.health.expected_ranks is None
+                and isinstance(fields.get("world_size"), int)):
+            # the quorum rule's cohort: everyone but the server rank
+            self.health.expected_ranks = fields["world_size"] - 1
         self.events.emit("run", config=config or {}, **fields)
 
     def comm_delta(self) -> dict:
@@ -113,14 +169,29 @@ class Telemetry:
             rec["eval"] = {k: (float(v) if isinstance(v, (int, float)) else v)
                            for k, v in evals.items()}
         rec["comm"] = self.comm_delta()
+        if self.memwatch is not None:
+            # exact-at-emit memory block (the background thread only keeps
+            # the gauges fresh between rounds for live scrapes)
+            mem = self.memwatch.sample()
+            if mem:
+                rec["mem"] = mem
         rec.update(extra)
-        return self.events.emit("round", **rec)
+        out = self.events.emit("round", **rec)
+        if self.health is not None:
+            # the per-round health hook: every engine that emits a round
+            # record (standalone, pipelined drain, sync server, async
+            # flush) feeds the rule table through this one seam
+            self.health.on_round(out)
+        return out
 
     def emit_eval(self, round_idx: int, evals: dict) -> dict:
-        return self.events.emit(
+        out = self.events.emit(
             "eval", round=int(round_idx),
             eval={k: (float(v) if isinstance(v, (int, float)) else v)
                   for k, v in evals.items()})
+        if self.health is not None:
+            self.health.on_eval(out)
+        return out
 
     # ------------------------------------------------------------ profiler
     def profile(self, logdir: str):
@@ -137,6 +208,12 @@ class Telemetry:
         Prometheus text dump of the registry next to it. With tracing on
         and a trace_dir, write the stitched Chrome trace (trace.json —
         load it in Perfetto / chrome://tracing)."""
+        if self.httpd is not None:
+            self.httpd.close()
+        if self.memwatch is not None:
+            self.memwatch.stop()
+        if self.health is not None:
+            self.health.stop()
         if self.tracer is not None:
             self.tracer.finish()
             if self.trace_dir:

@@ -94,11 +94,18 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     simulator, tests/test_async_buffer.py)."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.obs import Telemetry
 
     per_round = (world_size - 1) if world_size else 3
     cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=data.num_clients,
                        client_num_per_round=per_round, epochs=1, batch_size=8,
                        lr=0.1, frequency_of_the_test=1, seed=0)
+    # the run-health monitor rides every trial (in-memory event log): the
+    # soak campaign is exactly the adversarial weather the rule table
+    # exists for, and its alert ledger becomes part of the summary —
+    # notably the quorum rule must fire once per crash window and resolve
+    # once the reprobe readmits the rank (asserted below)
+    tel = Telemetry(health=True)
     t0 = time.perf_counter()
     err = None
     agg = None
@@ -119,15 +126,49 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
                             aggregator=aggregator,
                             aggregator_params=agg_params,
                             update_codec=update_codec,
-                            sparsify_ratio=sparsify_ratio, **async_kw)
+                            sparsify_ratio=sparsify_ratio,
+                            telemetry=tel, **async_kw)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
+    finally:
+        tel.close()
     completed = bool(agg and agg.history
                      and agg.history[-1]["round"] == rounds - 1)
+    # health-alert ledger (obs/health.py): every fired/resolved transition
+    # this trial. The quorum invariant is checkable from the plan alone:
+    # a crash window [lo, hi) fails the rank's downlink at round lo ->
+    # exactly ONE quorum firing (edge-triggered, deduped — not one per
+    # crashed round); the elastic reprobe at lo + 4 readmits the rank, so
+    # when the run is long enough to reach it the alert must also resolve
+    # exactly once. Sync trials only: the async server's dispatch waves
+    # are thread-scheduled, so crash timing vs flush cadence is not
+    # deterministic enough to pin transition counts.
+    alerts = [{k: a.get(k) for k in ("rule", "severity", "state", "round",
+                                     "value", "threshold")}
+              for a in (tel.health.alerts if tel.health else [])]
+    quorum_err = None
+    crash_rounds = [r.rounds[0] for r in plan.rules
+                    if r.fault == "crash" and r.rounds
+                    and r.rounds[0] < rounds]  # a post-run window never fires
+    if err is None and completed and not async_buffer_k:
+        fired = sum(1 for a in alerts
+                    if a["rule"] == "quorum" and a["state"] == "fired")
+        resolved = sum(1 for a in alerts
+                       if a["rule"] == "quorum" and a["state"] == "resolved")
+        want_fired = len(crash_rounds)
+        # the reprobe lands 4 rounds after the failure; a resolve also
+        # needs one more completed round for the post-reprobe health check
+        want_resolved = sum(1 for lo in crash_rounds if lo + 4 < rounds)
+        if fired != want_fired or resolved < want_resolved:
+            quorum_err = (f"quorum alerts: fired {fired} (want {want_fired}),"
+                          f" resolved {resolved} (want >= {want_resolved})"
+                          f" for crash windows at {crash_rounds}")
     return {
         "seed": plan.seed,
-        "ok": err is None and completed,
-        "error": err,
+        "ok": err is None and completed and quorum_err is None,
+        "error": err or quorum_err,
+        "alerts": alerts,
+        "crash_windows": crash_rounds,
         "completed_rounds": (agg.history[-1]["round"] + 1
                              if agg and agg.history else 0),
         "faults": plan.ledger.counts(),
@@ -334,8 +375,18 @@ def main(argv=None) -> int:
         "passed": n_ok,
         "rounds_per_trial": args.rounds,
         "faults_injected_total": sum(t["n_faults"] for t in trials),
+        # campaign-wide health-alert ledger roll-up (obs/health.py): how
+        # often each rule fired across the trials — the per-trial
+        # transitions live on each record's "alerts"
+        "alerts_fired_total": {},
         "records": trials,
     }
+    for t in trials:
+        for a in t.get("alerts") or []:
+            if a["state"] == "fired":
+                k = a["rule"]
+                summary["alerts_fired_total"][k] = \
+                    summary["alerts_fired_total"].get(k, 0) + 1
     if args.async_buffer_k:
         summary["async_buffer_k"] = args.async_buffer_k
     if args.compression:

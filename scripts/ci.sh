@@ -27,13 +27,17 @@ unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 if [ "$TIER" = "smoke" ]; then
   echo "== smoke tier (every engine oracle, minimal shapes) =="
   python -m pytest tests/ -q -m smoke
-  echo "== tracing smoke (2-round loopback sim, span-schema + Chrome-trace validation) =="
+  echo "== tracing + live-health smoke (2-round loopback sim; mid-run /metrics + /healthz scrape; span-schema + Chrome-trace validation) =="
   # a stitched cross-rank trace must come out of a plain loopback sim and
   # validate against the documented span schema (docs/OBSERVABILITY.md
-  # §Tracing); scripts/report.py must render its critical path
+  # §Tracing); scripts/report.py must render its critical path. The same
+  # leg now also proves the live run-health layer (§Live endpoints): a
+  # scraper thread hits /metrics + /healthz over real HTTP WHILE the sim
+  # runs — the new families (fed_alerts_total, fed_host_rss_bytes) must be
+  # in the live text and the health status must read ok
   TRACE_DIR=./tmp/ci_trace; rm -rf "$TRACE_DIR"
   python - "$TRACE_DIR" <<'PY'
-import json, os, sys
+import json, os, sys, threading, time, urllib.request
 
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.core.tasks import classification_task
@@ -46,12 +50,35 @@ from fedml_tpu.obs.trace_export import validate_chrome_trace, validate_spans
 d = sys.argv[1]
 data = synthetic_images(num_clients=4, image_shape=(6, 6, 1), num_classes=3,
                         samples_per_client=12, test_samples=24, seed=0)
-tel = Telemetry(log_dir=d, trace_dir=d)
+tel = Telemetry(log_dir=d, trace_dir=d, http_port=0)  # 0 = ephemeral port
+scrapes, stop = [], threading.Event()
+
+def scraper():
+    while not stop.is_set():
+        try:
+            prom = urllib.request.urlopen(tel.httpd.url("/metrics"),
+                                          timeout=2).read().decode()
+            hz = json.loads(urllib.request.urlopen(
+                tel.httpd.url("/healthz"), timeout=2).read())
+            scrapes.append((prom, hz))
+        except OSError:
+            pass
+        time.sleep(0.05)
+
+t = threading.Thread(target=scraper, daemon=True)
+t.start()
 run_simulated(data, classification_task(LogisticRegression(num_classes=3)),
               FedAvgConfig(comm_round=2, client_num_in_total=4,
-                           client_num_per_round=2, batch_size=6,
+                           client_num_per_round=2, batch_size=6, lr=0.1,
                            frequency_of_the_test=1),
               job_id="ci-trace-smoke", telemetry=tel)
+stop.set(); t.join(timeout=5)
+assert scrapes, "no successful mid-run scrape"
+prom, hz = scrapes[-1]
+for fam in ("fed_alerts_total", "fed_host_rss_bytes"):
+    assert fam in prom, f"{fam} missing from the live /metrics scrape"
+assert hz["status"] == "ok", f"/healthz not ok mid-run: {hz}"
+assert hz["run"] and hz["port"] == tel.http_port
 errs = validate_spans(tel.tracer.spans())
 assert not errs, f"span schema violations: {errs}"
 tel.close()
@@ -63,10 +90,21 @@ rounds = [json.loads(line) for line in open(os.path.join(d, "events.jsonl"))
           if '"round"' in line]
 cps = [r.get("critical_path") for r in rounds if r.get("kind") == "round"]
 assert cps and all(cps), "round records missing critical_path"
-print(f"tracing smoke ok: {len(doc['traceEvents'])} events, "
-      f"straggler ranks {[c['straggler'] for c in cps]}")
+print(f"tracing + live-health smoke ok: {len(doc['traceEvents'])} events, "
+      f"straggler ranks {[c['straggler'] for c in cps]}, "
+      f"{len(scrapes)} live scrapes, status {hz['status']}")
 PY
-  python scripts/report.py "$TRACE_DIR/events.jsonl" --critical-path
+  python scripts/report.py "$TRACE_DIR/events.jsonl" --critical-path --alerts
+  echo "== bench regression gate (smoke blob vs committed tolerances) =="
+  # the smoke leg's event log doubles as a bench artifact: report.py folds
+  # it into a BENCH blob and bench_gate.py compares it against the
+  # committed tolerance file — a PR that tanks the smoke run's structure
+  # or accuracy (or its throughput by an order of magnitude) fails here
+  # instead of drifting silently (docs/OBSERVABILITY.md §Bench gate)
+  python scripts/report.py "$TRACE_DIR/events.jsonl" \
+    --bench-json ./tmp/ci_trace_blob.json
+  python scripts/bench_gate.py ./tmp/ci_trace_blob.json \
+    --gate scripts/ci_bench_gate.json
   echo "== byzantine smoke (2-round loopback: 1 sign-flip adversary vs krum) =="
   # the robust-aggregation gate must quarantine the attacker (non-empty
   # ledger) and the defended run must stay finite (docs/ROBUSTNESS.md

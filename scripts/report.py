@@ -103,6 +103,11 @@ def render_table(records: list[dict]) -> str:
             # pre-PR-9 logs that predate the split
             "tx_up_B": r.get("comm", {}).get("bytes_uplink"),
             "tx_down_B": r.get("comm", {}).get("bytes_downlink"),
+            # memory telemetry (obs/memwatch.py, docs/OBSERVABILITY.md
+            # §Memory telemetry): host RSS + summed device bytes at emit —
+            # columns hide on logs that predate the mem block
+            "rss_B": (r.get("mem") or {}).get("host_rss_bytes"),
+            "dev_B": (r.get("mem") or {}).get("device_bytes_in_use"),
         })
     if not rows:
         return "(no round records)"
@@ -116,6 +121,27 @@ def render_table(records: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_alerts(records: list[dict]) -> str:
+    """The run's health-alert ledger (obs/health.py): one line per
+    fired/resolved transition with the measured value vs the rule's
+    threshold. Logs that predate the health layer degrade to a notice —
+    same contract as the async/codec columns."""
+    alerts = [r for r in records if r.get("kind") == "alert"]
+    if not alerts:
+        return ("(no alert records — clean run, or the log predates the "
+                "health monitor)")
+    lines = ["alerts:"]
+    for a in alerts:
+        val = a.get("value")
+        val_s = f"{val:.4g}" if isinstance(val, (int, float)) else "nan"
+        lines.append(
+            f"  {a.get('state', '?'):>8}  {a.get('rule', '?'):<14}"
+            f"severity={a.get('severity', '?'):<9}"
+            f"round={a.get('round') if a.get('round') is not None else '-':<6}"
+            f"value={val_s} threshold={a.get('threshold')}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser("fedml_tpu run reporter")
     p.add_argument("events", help="path to a run's events.jsonl")
@@ -124,6 +150,11 @@ def main(argv=None) -> int:
                         "('-' = stdout as the last line)")
     p.add_argument("--csv", default=None, metavar="PATH",
                    help="also write the round records as CSV")
+    p.add_argument("--alerts", action="store_true",
+                   help="render the run's health-alert ledger (rule, "
+                        "severity, fired/resolved round, value vs "
+                        "threshold — obs/health.py); logs that predate "
+                        "the health monitor degrade to a notice")
     p.add_argument("--critical-path", action="store_true",
                    help="render the per-round critical-path/straggler "
                         "attribution (straggler rank, phase breakdown, "
@@ -146,6 +177,9 @@ def main(argv=None) -> int:
         h = headers[0]
         print(f"run: {h.get('run')}  engine: {h.get('engine', '?')}")
     print(render_table(records))
+    if args.alerts:
+        print()
+        print(render_alerts(records))
     if args.critical_path:
         print()
         print(render_critical_path(records))
