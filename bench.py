@@ -664,8 +664,121 @@ def _measure_codec() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _stream_dataset_dir() -> tuple[str, int]:
+    """Deterministic packed-npy population under ./tmp (built once,
+    reused by both A/B legs so they read identical bytes) — the ONE
+    shared fixture writer (data/synthetic.synthetic_packed_population),
+    so this and the ci.sh flat-memory smoke cannot drift."""
+    from fedml_tpu.core.client_source import PackedNpySource
+    from fedml_tpu.data.synthetic import synthetic_packed_population
+
+    n = _env_int("FEDML_BENCH_STREAM_CLIENTS", 100_000)
+    dim = _env_int("FEDML_BENCH_STREAM_DIM", 16)
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tmp",
+                     f"bench_stream_{n}x{dim}")
+    if not os.path.isfile(os.path.join(d, "meta.json")):
+        synthetic_packed_population(d, n, dim=dim)
+        PackedNpySource(d).close()  # smoke the layout before the legs run
+    return d, n
+
+
+def _measure_stream(leg: str) -> None:
+    """One FEDML_BENCH_STREAM A/B leg in its own process (RSS is a
+    process-level number — sharing a process would contaminate it):
+    ``streamed`` runs the engine over the PackedNpySource (only the
+    sampled cohort's rows ever reach memory), ``materialized`` loads the
+    same population into a full FederatedData first (the pre-PR data
+    plane). Matched rounds/seed/cohort; reports end RSS, across-round RSS
+    growth, pack seconds, rounds/s."""
+    import jax
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.client_source import PackedNpySource
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs.memwatch import host_rss_bytes
+
+    t0 = time.perf_counter()
+    d, n = _stream_dataset_dir()
+    rounds = _env_int("FEDML_BENCH_STREAM_ROUNDS", 12)
+    if leg == "streamed":
+        data = PackedNpySource(d)
+    else:
+        from fedml_tpu.core.client_data import FederatedData
+
+        src = PackedNpySource(d)
+        offsets = np.load(os.path.join(d, "offsets.npy"))
+        data = FederatedData(
+            train_x=np.load(os.path.join(d, "x.npy")),
+            train_y=np.load(os.path.join(d, "y.npy")),
+            test_x=src.test_x, test_y=src.test_y,
+            train_idx_map={c: np.arange(offsets[c], offsets[c + 1])
+                           for c in range(n)},
+            test_idx_map=None, class_num=5)
+        src.close()
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=n,
+                       client_num_per_round=16, batch_size=8, lr=0.1,
+                       frequency_of_the_test=10_000, seed=0)
+    task = classification_task(LogisticRegression(num_classes=5))
+    api = FedAvgAPI(data, task, cfg, bucket_batches=True)
+    api.warmup()  # every bucket variant AOT-compiled before measuring
+    api.run_round(0)
+    api.run_round(1)
+    _mark(t0, f"stream leg {leg}: warm (2 rounds)")
+    rss0 = host_rss_bytes() or 0
+    tl = time.perf_counter()
+    for r in range(2, rounds):
+        api.run_round(r)
+    jax.block_until_ready(jax.tree.leaves(api.net.params))
+    dt = time.perf_counter() - tl
+    rss1 = host_rss_bytes() or 0
+    rec = {
+        "leg": leg, "clients": n, "rounds": rounds,
+        "rss_end_bytes": int(rss1),
+        "rss_growth_bytes": int(rss1 - rss0),
+        "rss_growth_ratio": round(rss1 / max(rss0, 1), 4),
+        "pack_seconds": round(float(
+            api.tracer.rounds[-1].get("pack", 0.0)), 3),
+        "seconds": round(dt, 3),
+        "rounds_per_sec": round((rounds - 2) / dt, 3),
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def main() -> None:
     here = os.path.abspath(__file__)
+    if os.environ.get("FEDML_BENCH_STREAM") is not None:
+        # streamed-vs-materialized data-plane A/B (docs/PERFORMANCE.md
+        # §Streaming & cohort bucketing) — one forced-CPU child PER LEG
+        # (RSS is process-level; a shared process would contaminate it)
+        legs = {}
+        for leg in ("materialized", "streamed"):
+            rc, out = _run_child([here, "--measure", f"stream_{leg}"],
+                                 _cpu_env(os.environ),
+                                 _env_int("FEDML_BENCH_STREAM_TIMEOUT",
+                                          900))
+            rec = _last_json_line(out)
+            if rec is None:
+                raise RuntimeError(
+                    f"bench: stream A/B {leg} child failed (rc={rc})")
+            legs[leg] = rec
+        ratio = round(legs["streamed"]["rss_end_bytes"]
+                      / max(legs["materialized"]["rss_end_bytes"], 1), 4)
+        _emit({
+            "metric": "fedavg_stream_rss_end_ratio",
+            "value": ratio,
+            "unit": "streamed_rss/materialized_rss",
+            "mode": "stream_ab",
+            "stream_ab": legs,
+            "stream_clients": legs["streamed"]["clients"],
+            "stream_rss_growth_bytes":
+                legs["streamed"]["rss_growth_bytes"],
+            "stream_rss_growth_ratio":
+                legs["streamed"]["rss_growth_ratio"],
+            "platform": "cpu",
+        })
+        return
     if os.environ.get("FEDML_BENCH_CODEC") is not None:
         # wire-efficiency A/B — forced-CPU child (loopback threads; the
         # measurement is bytes-on-the-wire per codec tier, not FLOPs)
@@ -825,6 +938,8 @@ if __name__ == "__main__":
             _measure_async()
         elif sys.argv[2] == "codec":
             _measure_codec()
+        elif sys.argv[2].startswith("stream_"):
+            _measure_stream(sys.argv[2][len("stream_"):])
         else:
             _measure(sys.argv[2])
     else:

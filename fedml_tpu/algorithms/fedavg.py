@@ -46,6 +46,10 @@ from fedml_tpu.core.client_data import (
     pad_batches,
     pad_index_batches,
 )
+from fedml_tpu.core.client_source import (
+    ClientDataSource,
+    pack_clients_source,
+)
 from fedml_tpu.core.local import LocalSpec, Task, make_eval_fn, make_local_update
 from fedml_tpu.core.partition_rules import tree_bytes as _tree_bytes
 from fedml_tpu.core.pipeline import (
@@ -300,6 +304,29 @@ class FedAvgAPI:
         self.task = task
         self.cfg = config
         self.mesh = mesh
+        # Streamed client state (core/client_source.py, docs/PERFORMANCE.md
+        # §Streaming & cohort bucketing): a ClientDataSource keeps per-client
+        # payload OUT of host memory — packing reads only the sampled
+        # cohort's rows, so host RSS stays flat in population size (the
+        # memwatch fed_host_rss_bytes gauge is the live evidence). The
+        # device-resident planes require the full train set in HBM, which is
+        # exactly what a streamed population cannot afford — refuse loudly.
+        self._source = dataset if isinstance(dataset, ClientDataSource) \
+            else None
+        if self._source is not None and (device_data or block_working_set):
+            raise ValueError(
+                "device_data/block_working_set park the FULL train set on "
+                "device — incompatible with a streamed ClientDataSource "
+                "(pass the host-packed plane, or materialize the dataset)")
+        if self._source is not None \
+                and config.local_test_on_all_clients == "on":
+            # 'auto' already degrades to the global test set (sources carry
+            # no per-client test splits); a FORCED per-client eval would
+            # die mid-run in evaluate_per_client — refuse at construction
+            raise ValueError(
+                "local_test_on_all_clients='on' iterates every client's "
+                "own split — not available on a streamed ClientDataSource "
+                "(use 'auto'/'off': the global test split is evaluated)")
         # Pipelined round execution (core/pipeline.py, docs/PERFORMANCE.md):
         # ``prefetch`` > 0 arms the double-buffered host->device prefetch —
         # a packer thread prepares round r+1's batch and issues its
@@ -411,9 +438,13 @@ class FedAvgAPI:
             self._dev_y = put(dataset.train_y)
 
         # static per-client batch budget: fixed across rounds so the round
-        # program compiles once (see SURVEY.md §7 "hard parts" (1))
-        counts = [len(v) for v in dataset.train_idx_map.values()]
-        b_needed = int(np.ceil(max(counts) / config.batch_size))
+        # program compiles once (see SURVEY.md §7 "hard parts" (1)).
+        # Streamed sources answer from size METADATA — no payload read.
+        if self._source is not None:
+            max_count = int(np.max(self._source.client_sizes))
+        else:
+            max_count = max(len(v) for v in dataset.train_idx_map.values())
+        b_needed = int(np.ceil(max_count / config.batch_size))
         self.num_batches = min(config.max_batches or b_needed, b_needed)
         # bucket_batches: shrink each round's (or block's) common batch
         # depth to the max the SAMPLED clients actually need, rounded up a
@@ -442,7 +473,10 @@ class FedAvgAPI:
 
         # init model
         self.rng, init_key = jax.random.split(self.rng)
-        x_sample = jnp.asarray(dataset.train_x[: config.batch_size])
+        x_sample = jnp.asarray(
+            self._source.init_batch(config.batch_size)
+            if self._source is not None
+            else dataset.train_x[: config.batch_size])
         self.net = task.init(init_key, x_sample)
         # federated TENSOR parallelism: a ('clients','model') mesh shards
         # each client's local fit over 'model' (Megatron specs, GSPMD
@@ -506,6 +540,12 @@ class FedAvgAPI:
         self.round_fn = self._build_round_fn()
         self._test_cache = None
         self.history: list[dict] = []
+        # per-round pack/bucket accounting (docs/PERFORMANCE.md §Streaming
+        # & cohort bucketing): written at pack time (possibly on the
+        # prefetch thread — single-key dict writes are GIL-atomic), popped
+        # into the telemetry round record at emit time. Bounded by the
+        # prefetch depth.
+        self._pack_stats: dict[int, dict] = {}
         # pack/compute/eval spans (SURVEY.md §5); with a tracing-enabled
         # Telemetry bundle, the same spans also feed the distributed
         # tracer's single-rank timeline (all host-side — nothing traced
@@ -805,6 +845,37 @@ class FedAvgAPI:
                 return b
         return self.num_batches
 
+    def _record_pack_stats(self, round_idx: int, b_needed: int,
+                           batch) -> None:
+        """One round's pack/bucket accounting: the dispatched batch depth
+        (the ladder bucket when bucket_batches is on), the natural depth
+        the cohort needed, the fraction of batch slots that are pure
+        padding, and the packed host bytes — the numbers that show whether
+        a skewed population is paying for its largest client every round."""
+        if self.telemetry is None:
+            return  # nobody will pop it — don't grow the dict forever
+        if isinstance(batch, IndexBatch):
+            K, B = batch.idx.shape[0], batch.idx.shape[1]
+            nbytes = batch.idx.nbytes + batch.mask.nbytes
+        else:
+            K, B = batch.x.shape[0], batch.x.shape[1]
+            nbytes = batch.x.nbytes + batch.y.nbytes + batch.mask.nbytes
+        used = float(np.sum(np.ceil(
+            np.asarray(batch.num_samples) / self.cfg.batch_size)))
+        slots = float(K * B)
+        self._pack_stats[round_idx] = {
+            "bucket_B": int(B), "b_needed": int(b_needed),
+            "budget_B": int(self.num_batches),
+            "pad_frac": round(1.0 - used / slots, 4) if slots else 0.0,
+            "bytes": int(nbytes),
+        }
+
+    def _pack_extra(self, round_idx: int) -> dict:
+        """The optional ``pack`` block a telemetry round record carries —
+        absent when nothing was recorded (engines that override packing)."""
+        ps = self._pack_stats.pop(round_idx, None)
+        return {"pack": ps} if ps else {}
+
     def _pack_round_indices_host(self, round_idx: int,
                                  pad_to: int | None = None) -> IndexBatch:
         """Host-side padded IndexBatch (no device placement) — shared by the
@@ -817,9 +888,13 @@ class FedAvgAPI:
             self.data, ids, cfg.batch_size, max_batches=self.num_batches,
             seed=cfg.seed, round_idx=round_idx,
         )
+        b_needed = ib.idx.shape[1]
         if pad_to is None:
-            pad_to = (self._bucketed_B(ib.idx.shape[1])
+            pad_to = (self._bucketed_B(b_needed)
                       if self.bucket_batches else self.num_batches)
+            ib = pad_index_batches(ib, pad_to)
+            self._record_pack_stats(round_idx, b_needed, ib)
+            return ib
         return pad_index_batches(ib, pad_to)
 
     def _shard_round_batch(self, batch):
@@ -843,14 +918,23 @@ class FedAvgAPI:
             ib = self._pack_round_indices_host(round_idx)
             return self._shard_round_batch(ib)
         ids = self._sampled_ids(round_idx)
-        cb = pack_clients(
-            self.data, ids, cfg.batch_size, max_batches=self.num_batches,
-            seed=cfg.seed, round_idx=round_idx,
-        )
+        if self._source is not None:
+            # streamed plane: only the sampled cohort's rows are read
+            cb = pack_clients_source(
+                self._source, ids, cfg.batch_size,
+                max_batches=self.num_batches, seed=cfg.seed,
+                round_idx=round_idx)
+        else:
+            cb = pack_clients(
+                self.data, ids, cfg.batch_size, max_batches=self.num_batches,
+                seed=cfg.seed, round_idx=round_idx,
+            )
         # fixed B across rounds -> single compilation (or, with
         # bucket_batches, the round's ladder bucket -> <=4 compilations)
-        cb = pad_batches(cb, self._bucketed_B(cb.num_batches)
+        b_needed = cb.num_batches
+        cb = pad_batches(cb, self._bucketed_B(b_needed)
                          if self.bucket_batches else self.num_batches)
+        self._record_pack_stats(round_idx, b_needed, cb)
         return self._shard_round_batch(cb)
 
     def _sampled_ids(self, round_idx: int):
@@ -1070,25 +1154,28 @@ class FedAvgAPI:
         (seed, rounds), safe on the prefetch thread. Returns
         (rounds, ids_l, idx_stack, mask_stack, ns_stack), all numpy."""
         ids_l, idx_l, mask_l, ns_l = [], [], [], []
-        # bucketed: pack at natural depth first, then pad every round
-        # to the BLOCK's common bucket (the scan needs one B; jit
-        # caches per bucket, <=4 variants)
-        pad_to = 0 if self.bucket_batches else self.num_batches
+        # pack at natural depth first, then pad every round to the BLOCK's
+        # common depth — the ladder bucket when bucket_batches is on (the
+        # scan needs one B; jit caches per bucket, <=4 variants), the
+        # static budget otherwise. One path, so the per-round pack stats
+        # are recorded identically in both modes.
         for r in range(start_round, start_round + num_rounds):
             # host-side pack: the stacked block is device_put ONCE in
             # _place_block (per-round device_puts would round-trip, and on
             # multi-host meshes a sharded array can't return via np.asarray)
-            ib = self._pack_round_indices_host(r, pad_to=pad_to)
+            ib = self._pack_round_indices_host(r, pad_to=0)
             ids_l.append(np.asarray(self._sampled_ids(r), np.int32))
             idx_l.append(ib.idx)
             mask_l.append(ib.mask)
             ns_l.append(ib.num_samples)
-        if self.bucket_batches:
-            B = self._bucketed_B(max(a.shape[1] for a in idx_l))
-            for i, (ix, mk, ns) in enumerate(zip(idx_l, mask_l, ns_l)):
-                ib = pad_index_batches(
-                    IndexBatch(idx=ix, mask=mk, num_samples=ns), B)
-                idx_l[i], mask_l[i] = ib.idx, ib.mask
+        B = (self._bucketed_B(max(a.shape[1] for a in idx_l))
+             if self.bucket_batches else self.num_batches)
+        for i, (ix, mk, ns) in enumerate(zip(idx_l, mask_l, ns_l)):
+            b_needed = ix.shape[1]
+            ib = pad_index_batches(
+                IndexBatch(idx=ix, mask=mk, num_samples=ns), B)
+            idx_l[i], mask_l[i] = ib.idx, ib.mask
+            self._record_pack_stats(start_round + i, b_needed, ib)
         rounds = np.arange(start_round, start_round + num_rounds,
                            dtype=np.int32)
         return rounds, ids_l, np.stack(idx_l), np.stack(mask_l), np.stack(ns_l)
@@ -1132,6 +1219,7 @@ class FedAvgAPI:
                 start_round + i, clients=ids_l[i].tolist(),
                 metrics={k: float(v[i]) for k, v in ms_host.items()},
                 block=True, agg=self._agg_record,
+                **self._pack_extra(start_round + i),
                 **self._quarantine_extra(start_round + i))
 
     def _drain_block_entry(self, start_round: int, entry):
@@ -1211,10 +1299,15 @@ class FedAvgAPI:
                 mask=np.zeros((K, B, bs), np.float32),
                 num_samples=np.zeros((K,), np.float32))
             return self._shard_round_batch(ib)
-        x, y = self.data.train_x, self.data.train_y
+        if self._source is not None:
+            (xs, xd), (ys, yd) = self._source.row_meta()
+        else:
+            x, y = self.data.train_x, self.data.train_y
+            (xs, xd), (ys, yd) = ((x.shape[1:], x.dtype),
+                                  (y.shape[1:], y.dtype))
         cb = ClientBatch(
-            x=np.zeros((K, B, bs) + x.shape[1:], x.dtype),
-            y=np.zeros((K, B, bs) + y.shape[1:], y.dtype),
+            x=np.zeros((K, B, bs) + xs, xd),
+            y=np.zeros((K, B, bs) + ys, yd),
             mask=np.zeros((K, B, bs), np.float32),
             num_samples=np.zeros((K,), np.float32))
         return self._shard_round_batch(cb)
@@ -1406,6 +1499,7 @@ class FedAvgAPI:
                 spans=self._span_delta(spans_before),
                 metrics={k: float(v) for k, v in metrics.items()},
                 agg=self._agg_record,
+                **self._pack_extra(round_idx),
                 **self._quarantine_extra(round_idx))
             if self.telemetry.tracer is not None:
                 # close the trace envelope HERE: left open it would absorb
@@ -1463,6 +1557,7 @@ class FedAvgAPI:
                 spans=spans, pipeline=pipeline,
                 metrics={k: float(v) for k, v in host.items()},
                 agg=self._agg_record,
+                **self._pack_extra(round_idx),
                 **self._quarantine_extra(round_idx))
         return round_idx, host
 
@@ -1593,8 +1688,12 @@ class FedAvgAPI:
         cfg = self.cfg
         rounds = num_rounds or cfg.comm_round
         if self.telemetry is not None:
+            from fedml_tpu.data import dataset_source
+
             self.telemetry.run_header(dataclasses.asdict(cfg),
-                                      engine="standalone")
+                                      engine="standalone",
+                                      dataset_source=dataset_source(
+                                          self.data))
         if self.prefetch and rounds > 0:
             return self._train_pipelined(rounds)
         for r in range(rounds):
@@ -1633,6 +1732,13 @@ class FedAvgAPI:
         wall-clock/staleness/shed summary); the engine's net/opt/rng/
         quarantine advance exactly as if the updates had run
         synchronously."""
+        if self._source is not None:
+            # the virtual-clock runner packs through pack_clients (index
+            # maps) — refuse HERE instead of AttributeError-ing deep in
+            # its event loop after warmup time is spent
+            raise ValueError(
+                "run_async is not wired for streamed ClientDataSources "
+                "yet — materialize the dataset for the async simulator")
         from fedml_tpu.core.async_buffer import VirtualClockAsyncRunner
 
         runner = VirtualClockAsyncRunner(

@@ -7,6 +7,12 @@ global round (trainer.py:32-43). The reference CI asserts that with
 global_rounds x group_rounds held constant the result matches flat FedAvg
 (CI-script-fedavg.sh:51-58) — reproduced in tests/test_hierarchical.py.
 
+This module is the SPMD simulation of the hierarchy; the real
+cross-process 2-tier topology (edge aggregator ranks tree-reducing their
+worker blocks' uplinks, root fan-in O(edges), tree == flat bitwise) lives
+in fedml_tpu/distributed/fedavg/hierarchy.py — docs/ROBUSTNESS.md
+§Hierarchical tiers.
+
 TPU form: group state is a stacked pytree [G, ...]; one jitted sub-round
 program vmaps (groups) x vmaps (clients) the local update and does the
 group-level weighted mean; the global aggregation is a weighted mean over the
@@ -38,6 +44,27 @@ class HierarchicalFLAPI(FedAvgAPI):
         mesh=None,
         **kwargs,
     ):
+        # The mesh contract, stated up front (it used to look like the
+        # argument was silently discarded): a hierarchical mesh MUST carry
+        # ('groups', 'clients') axes and drives the GROUP round program
+        # (group_round_mesh below + the shardable-K padding in
+        # _pack_groups). The PARENT engine deliberately gets mesh=None —
+        # its flat round_fn is never dispatched by this subclass
+        # (run_round is overridden), and handing it a ('groups','clients')
+        # mesh would make it treat 'groups' as the client axis. Any other
+        # mesh shape is refused HERE, before the parent pays its engine
+        # build, instead of half-working with the mesh ignored.
+        if mesh is not None:
+            if ("groups" not in mesh.axis_names
+                    or "clients" not in mesh.axis_names):
+                raise ValueError(
+                    "hierarchical mesh needs axes ('groups','clients') "
+                    f"(mesh.make_hierarchical_mesh), got {mesh.axis_names}"
+                    " — a plain ('clients',) mesh is not supported here")
+            if group_num % mesh.shape["groups"] != 0:
+                raise ValueError(
+                    f"group_num={group_num} not divisible by mesh groups "
+                    f"axis {mesh.shape['groups']}")
         super().__init__(dataset, task, config, mesh=None, **kwargs)
         if config.sampling != "uniform":
             # group sub-rounds sample WITHIN groups (sample_clients over
@@ -94,13 +121,7 @@ class HierarchicalFLAPI(FedAvgAPI):
             # sub-rounds (on a multislice mesh 'groups' rides DCN — the
             # hierarchy exists precisely so the frequent intra-group syncs
             # stay on the fast axis).
-            if "groups" not in mesh.axis_names or "clients" not in mesh.axis_names:
-                raise ValueError(
-                    f"hierarchical mesh needs axes ('groups','clients'), got {mesh.axis_names}")
-            if group_num % mesh.shape["groups"] != 0:
-                raise ValueError(
-                    f"group_num={group_num} not divisible by mesh groups axis "
-                    f"{mesh.shape['groups']}")
+            # (mesh axes/divisibility validated up front, before super())
             from jax import lax
             from jax.sharding import PartitionSpec as P
 

@@ -264,6 +264,110 @@ def make_robust_aggregator(name: str, n: int, f: int | None = None,
     return lambda s, w: (geometric_median(s, w, iters=iters), {})
 
 
+# -------------------------------------------------- pairwise association
+# Canonical balanced-binary summation — the hierarchical-aggregation
+# contract (docs/ROBUSTNESS.md §Hierarchical tiers). IEEE float addition
+# is not associative, so a tree of edge aggregators that forwards partial
+# weighted sums can only be BITWISE-identical to a flat aggregation if
+# both reduce with the SAME association. The pairwise fold below is that
+# association: at every level adjacent pairs are added (odd tails padded
+# with exact-zero terms), so the fold over K slots is a complete binary
+# tree aligned at every power-of-two boundary. An edge tier whose blocks
+# are contiguous, power-of-two-sized slot ranges computes exactly the
+# internal nodes of that tree — root combine ≡ flat fold, bit for bit.
+# Opt-in (``gated_aggregate(pairwise=True)`` / the cross-process
+# aggregator's ``sum_assoc='pairwise'``): the default weighted mean keeps
+# its historical tensordot association, so existing bitwise contracts
+# (sharded ≡ replicated, async ≡ sync, ...) are untouched.
+
+def pairwise_sum(x):
+    """Fold a [N, ...] array over axis 0 with the canonical pairwise
+    association. Composable: folding contiguous power-of-two-sized blocks
+    and then folding the block partials is bitwise the same as folding
+    everything at once (property-tested)."""
+    n = x.shape[0]
+    if n == 0:
+        return jnp.zeros(x.shape[1:], x.dtype)
+    while n > 1:
+        if n % 2:
+            x = jnp.concatenate([x, jnp.zeros_like(x[:1])], axis=0)
+            n += 1
+        x = x[0::2] + x[1::2]
+        n //= 2
+    return x[0]
+
+
+def pairwise_weighted_stats(stacked, weights):
+    """(weighted-sum tree, total weight) over the leading client axis with
+    the canonical association: terms ``w_k * u_k`` are formed per slot
+    (f32) and pairwise-folded; the weight total folds the same way. The
+    mean is ``wsum / total`` — division happens ONCE, at the final
+    consumer (``pairwise_finalize``), which is what lets an edge tier ship
+    raw partials without a lossy divide-then-remultiply round trip."""
+    w = jnp.asarray(weights, jnp.float32)
+    wsum = jax.tree.map(
+        lambda s: pairwise_sum(s.astype(jnp.float32) * _wshape(w, s)),
+        stacked)
+    return wsum, pairwise_sum(w)
+
+
+def pairwise_finalize(wsum, total, global_tree):
+    """wsum / total with the all-rejected fallback: zero surviving weight
+    keeps the global model (the same rule gated_aggregate applies). The
+    ONE division site shared by the flat pairwise path and the
+    hierarchical root, so the two cannot drift."""
+    alive = total > 0
+    den = jnp.maximum(total, 1e-12)
+    return jax.tree.map(
+        lambda s, g: jnp.where(alive, s / den, g.astype(s.dtype)),
+        wsum, global_tree)
+
+
+def nonfinite_gate(stacked, global_tree, weights):
+    """The per-slot half of :func:`sanitize_updates` — non-finite
+    rejection only. Verdicts depend on nothing but the slot itself, so an
+    edge aggregator gating its OWN children reaches exactly the verdicts
+    a flat server would for those slots (the norm-outlier rule is a
+    cohort statistic and is deliberately NOT available across tiers —
+    docs/ROBUSTNESS.md §Hierarchical tiers)."""
+    w = jnp.asarray(weights, jnp.float32)
+    k = w.shape[0]
+    finite = jnp.ones((k,), bool)
+    for s in jax.tree.leaves(stacked):
+        finite &= jnp.all(jnp.isfinite(s), axis=tuple(range(1, s.ndim)))
+    reasons = jnp.where(finite, REASON_OK, REASON_NONFINITE)
+    reasons = jnp.where(w > 0, reasons, REASON_OK).astype(jnp.int32)
+    new_w = jnp.where(finite, w, 0.0)
+    clean = jax.tree.map(
+        lambda s, g: jnp.where(_wshape(~finite, s),
+                               jnp.broadcast_to(g[None], s.shape)
+                               .astype(s.dtype), s),
+        stacked, global_tree)
+    return clean, new_w, reasons
+
+
+def edge_partial(stacked, global_tree, weights):
+    """One edge aggregator's jittable round step: non-finite gate over its
+    children, then the canonical pairwise partial — returns
+    ``(wsum_tree, total_weight, reasons)``. The wsum/total pair is what
+    rides the E2S uplink (one pre-aggregated update + weight: root fan-in
+    is O(edges)); reasons carry the per-child quarantine verdicts so the
+    root's ledger matches a flat run entry-for-entry."""
+    clean, w, reasons = nonfinite_gate(stacked, global_tree, weights)
+    wsum, total = pairwise_weighted_stats(clean, w)
+    return wsum, total, reasons
+
+
+def combine_edge_partials(partial_stack, totals, global_tree):
+    """The root's combine: pairwise-fold the stacked edge partials
+    ``[E, ...]`` and the ``[E]`` totals, then the shared finalize. With
+    contiguous power-of-two edge blocks this is bitwise the flat pairwise
+    aggregation over all K children (test-enforced)."""
+    wsum = jax.tree.map(pairwise_sum, partial_stack)
+    total = pairwise_sum(jnp.asarray(totals, jnp.float32))
+    return pairwise_finalize(wsum, total, global_tree), total
+
+
 # ------------------------------------------------------------------ gate
 def sanitize_updates(stacked, global_tree, weights,
                      norm_mult: float = DEFAULT_NORM_MULT):
@@ -332,7 +436,8 @@ def sanitize_updates(stacked, global_tree, weights,
 
 
 def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
-                    norm_mult: float | None = None, reshard_fn=None):
+                    norm_mult: float | None = None, reshard_fn=None,
+                    pairwise: bool = False):
     """The full verdict composition, jittable, defined ONCE for both
     runtimes (their quarantine ledgers must agree entry-for-entry, so the
     composition rule must not exist in two dialects):
@@ -352,9 +457,19 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
     itself always sees the estimator's input in the same layout both
     paths produce.
 
+    ``pairwise`` replaces the weighted-mean estimator's tensordot with
+    the canonical balanced-binary association (see :func:`pairwise_sum`)
+    — the flat twin of a hierarchical edge tier, bitwise-comparable to
+    any 2-tier topology over the same cohort. Mean only: robust
+    estimators need the full stack and have no tiered form.
+
     Returns ``(avg_tree, surviving_weights, reasons)``; ``reasons`` is
     None only when the gate is off AND the estimator reported nothing.
     """
+    if pairwise and robust_fn is not None:
+        raise ValueError("pairwise association is the weighted-mean "
+                         "contract — robust estimators need the full "
+                         "stacked cohort (no tiered form)")
     w = jnp.asarray(weights, jnp.float32)
     reasons = None
     agg_in = stacked
@@ -363,6 +478,9 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
                                               norm_mult=norm_mult)
     if reshard_fn is not None:
         agg_in = reshard_fn(agg_in)
+    if pairwise:
+        wsum, total = pairwise_weighted_stats(agg_in, w)
+        return pairwise_finalize(wsum, total, global_tree), w, reasons
     if robust_fn is not None:
         avg, info = robust_fn(agg_in, w)
         sus = info.get("suspected")

@@ -76,6 +76,9 @@ def prepare_sampling(cfg, data) -> np.ndarray | None:
     expensive engine build) and precompute what the per-round draw needs
     — per-client sizes for size_weighted, nothing for uniform."""
     if cfg.sampling == "size_weighted":
+        if hasattr(data, "client_sizes"):
+            # streamed ClientDataSource: sizes are metadata, no payload read
+            return np.asarray(data.client_sizes)[: cfg.client_num_in_total]
         return np.asarray([len(data.train_idx_map[c])
                            for c in range(cfg.client_num_in_total)])
     if cfg.sampling != "uniform":

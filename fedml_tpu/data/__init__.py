@@ -14,3 +14,16 @@ fallback is flagged on the returned object (``synthetic_fallback=True``).
 
 from fedml_tpu.data.registry import load_dataset, DATASETS
 from fedml_tpu.core.client_data import FederatedData
+
+
+def dataset_source(data) -> str:
+    """'real' | 'synthetic' for the telemetry run header — so bench
+    artifacts can never masquerade a synthetic fallback run as
+    real-dataset evidence. Streamed ClientDataSources carry the verdict
+    themselves; FederatedData carries the loaders' synthetic_fallback
+    flag (absent = real files were read)."""
+    src = getattr(data, "source", None)
+    if isinstance(src, str):
+        return src
+    return ("synthetic" if getattr(data, "synthetic_fallback", False)
+            else "real")

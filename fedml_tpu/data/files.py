@@ -15,10 +15,13 @@ from __future__ import annotations
 
 import glob
 import json
+import logging
 import os
 import pickle
 
 import numpy as np
+
+log = logging.getLogger("fedml_tpu.data.files")
 
 from fedml_tpu.core.client_data import FederatedData
 from fedml_tpu.core.partition import partition_data
@@ -67,8 +70,15 @@ def try_load(spec, data_dir, n_clients, partition_method, partition_alpha, seed,
             fd = _load_stackoverflow_h5(data_dir, spec, n_clients)
             if fd is not None:
                 return fd
-    except Exception:
+    except Exception:  # noqa: BLE001 — any reader failure falls back, but
+        # NEVER silently: a truncated download or schema drift must not
+        # masquerade a synthetic run as real-dataset evidence (the run
+        # header's dataset_source field is the machine-readable twin)
+        log.warning("real-dataset reader for %r failed under %s — falling "
+                    "back to synthetic data", name, data_dir, exc_info=True)
         return None
+    log.warning("no loadable %r files under %s — falling back to "
+                "synthetic data", name, data_dir)
     return None
 
 

@@ -167,6 +167,17 @@ def _load_dataset_impl(
             if uint8_pixels:
                 fd = _requantize_uint8(fd)
             return fd
+    elif not name.startswith("synthetic"):
+        import logging
+
+        # no data_dir at all for a real-file dataset: the synthetic
+        # stand-in is by design, but it must never be MISTAKEN for the
+        # real thing — say so, and the telemetry run header records
+        # dataset_source='synthetic' as the machine-readable twin
+        logging.getLogger("fedml_tpu.data").warning(
+            "dataset %r: no data_dir given — generating the synthetic "
+            "shape-identical stand-in (run scripts/download_data.sh for "
+            "the real files)", name)
 
     if name == "synthetic":
         return syn.synthetic_lr(num_clients=n_clients, seed=seed)

@@ -48,11 +48,13 @@ def synthetic_lr(
         idx_map[k] = np.arange(tr_off, tr_off + n_tr)
         test_map[k] = np.arange(te_off, te_off + (n - n_tr))
         tr_off += n_tr; te_off += n - n_tr
-    return FederatedData(
+    fd = FederatedData(
         train_x=np.concatenate(xs), train_y=np.concatenate(ys),
         test_x=np.concatenate(test_xs), test_y=np.concatenate(test_ys),
         train_idx_map=idx_map, test_idx_map=test_map, class_num=num_classes,
     )
+    fd.synthetic_fallback = True  # dataset_source: generated, not read
+    return fd
 
 
 def synthetic_leaf_exact(
@@ -152,11 +154,13 @@ def synthetic_leaf_exact(
         idx_map[k] = np.arange(tr_off, tr_off + len(tr))
         test_map[k] = np.arange(te_off, te_off + len(te))
         tr_off += len(tr); te_off += len(te)
-    return FederatedData(
+    fd = FederatedData(
         train_x=np.concatenate(xs), train_y=np.concatenate(ys),
         test_x=np.concatenate(test_xs), test_y=np.concatenate(test_ys),
         train_idx_map=idx_map, test_idx_map=test_map, class_num=num_classes,
     )
+    fd.synthetic_fallback = True  # dataset_source: generated, not read
+    return fd
 
 
 def synthetic_images(
@@ -335,3 +339,55 @@ def synthetic_sequences(
     )
     fd.synthetic_fallback = True
     return fd
+
+
+def synthetic_packed_population(path: str, num_clients: int, dim: int = 16,
+                                num_classes: int = 5, seed: int = 0,
+                                test_rows: int = 512) -> str:
+    """Write a deterministic SYNTHETIC packed-npy population straight to
+    disk (core/client_source.PackedNpySource layout) without ever
+    materializing it — the fixture for the flat-memory evidence (ci.sh
+    streamed smoke, bench.py FEDML_BENCH_STREAM): lognormal-ish ragged
+    client sizes with a heavy tail (the skew cohort bucketing exists
+    for), labels planted from ONE pass over the feature rows actually
+    written (x and y stream together — a second pass re-drawing x would
+    store uncorrelated labels), and a held-out test split from the same
+    planted mapping. Chunked writes keep the writer's RSS flat too."""
+    import json as _json
+    import os as _os
+
+    _os.makedirs(path, exist_ok=True)
+    rs = np.random.RandomState(seed)
+    sizes = rs.randint(6, 25, num_clients).astype(np.int64)
+    tail = max(num_clients // 200, 1)
+    sizes[rs.choice(num_clients, tail, replace=False)] = 96
+    offsets = np.zeros(num_clients + 1, np.int64)
+    np.cumsum(sizes, out=offsets[1:])
+    total = int(offsets[-1])
+    W = rs.randn(dim, num_classes).astype(np.float32)
+    with open(_os.path.join(path, "x.npy"), "wb") as fx, \
+            open(_os.path.join(path, "y.npy"), "wb") as fy:
+        np.lib.format.write_array_header_2_0(
+            fx, {"descr": np.lib.format.dtype_to_descr(
+                np.dtype(np.float32)),
+                "fortran_order": False, "shape": (total, dim)})
+        np.lib.format.write_array_header_2_0(
+            fy, {"descr": np.lib.format.dtype_to_descr(np.dtype(np.int64)),
+                 "fortran_order": False, "shape": (total,)})
+        chunk = 1 << 18
+        for s in range(0, total, chunk):
+            m = min(chunk, total - s)
+            x = rs.randn(m, dim).astype(np.float32)
+            fx.write(x.tobytes())
+            fy.write(np.argmax(x @ W, 1).astype(np.int64).tobytes())
+    np.save(_os.path.join(path, "offsets.npy"), offsets)
+    rs2 = np.random.RandomState(seed + 1)
+    tx = rs2.randn(test_rows, dim).astype(np.float32)
+    np.save(_os.path.join(path, "test_x.npy"), tx)
+    np.save(_os.path.join(path, "test_y.npy"),
+            np.argmax(tx @ W, 1).astype(np.int64))
+    with open(_os.path.join(path, "meta.json"), "w") as f:
+        _json.dump({"format": "fedml-packed-npy",
+                    "num_clients": num_clients,
+                    "class_num": num_classes, "source": "synthetic"}, f)
+    return path

@@ -74,7 +74,8 @@ class FedAvgAggregator:
                  aggregator_params: dict | None = None,
                  sanitize: bool | float | None = None,
                  shard_server_state: bool = False,
-                 partition_rules=None):
+                 partition_rules=None,
+                 sum_assoc: str = "auto"):
         if cfg.sampling != "uniform":
             # this runtime's client_sampling + weighted aggregate implement
             # the uniform scheme only — refuse rather than silently ignore
@@ -106,7 +107,12 @@ class FedAvgAggregator:
         # same init-key derivation as FedAvgAPI/DistributedTrainer so every
         # party (and the standalone oracle) starts from identical weights
         _, init_key = jax.random.split(jax.random.PRNGKey(cfg.seed))
-        self.net = task.init(init_key, jnp.asarray(dataset.train_x[: cfg.batch_size]))
+        from fedml_tpu.core.client_source import ClientDataSource
+
+        x_init = (dataset.init_batch(cfg.batch_size)
+                  if isinstance(dataset, ClientDataSource)
+                  else dataset.train_x[: cfg.batch_size])
+        self.net = task.init(init_key, jnp.asarray(x_init))
         self.eval_fn = make_eval_fn(task)
         self._test_cache = None
         self.history: list[dict] = []
@@ -132,8 +138,22 @@ class FedAvgAggregator:
         # the float wire path performs no clamping).
         mult = (self._sanitize_mult if self._sanitize_mult is not None
                 else float("inf"))
+        # sum_assoc='pairwise': replace the weighted mean's tensordot with
+        # the canonical balanced-binary association (robust_agg.pairwise_
+        # sum) — the flat run becomes bitwise-comparable with any 2-tier
+        # edge topology over the same cohort (docs/ROBUSTNESS.md
+        # §Hierarchical tiers). 'auto' (default) keeps the historical
+        # association, so every existing bitwise contract is untouched.
+        if sum_assoc not in ("auto", "pairwise"):
+            raise ValueError(f"sum_assoc={sum_assoc!r} "
+                             "(expected 'auto' or 'pairwise')")
+        self.sum_assoc = sum_assoc
+        if sum_assoc == "pairwise" and robust is not None:
+            raise ValueError("sum_assoc='pairwise' is the weighted-mean "
+                             "contract; robust estimators keep 'auto'")
         self._gagg = jax.jit(partial(gated_aggregate, robust_fn=robust,
-                                     norm_mult=mult))
+                                     norm_mult=mult,
+                                     pairwise=sum_assoc == "pairwise"))
         self.quarantine = QuarantineLedger()
         # Mesh-sharded server state on the cross-process server (the
         # standalone engine's shard_server_state, wired to the wire path):
@@ -146,6 +166,11 @@ class FedAvgAggregator:
         # bit-exact either way — the layout changes, the math does not.
         self._partitioner = None
         self._upload_shardings = None
+        if shard_server_state and sum_assoc == "pairwise":
+            raise ValueError(
+                "sum_assoc='pairwise' + shard_server_state is not wired: "
+                "the sharded aggregate pins its own jit composition — "
+                "run the pairwise comparison legs replicated")
         if shard_server_state:
             devs = jax.local_devices()
             if len(devs) > 1:

@@ -81,6 +81,8 @@ def run_simulated(
     buffer_deadline_s: float | None = None,
     buffer_capacity: int | None = None,
     heartbeat_max_age_s: float | None = None,
+    sum_assoc: str = "auto",
+    edges: int | None = None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -132,6 +134,38 @@ def run_simulated(
     with ``async_buffer_k``: they densify against the version-stamped
     broadcast the dispatch wave carried (the former dense-only refusal is
     lifted; only a genuinely unversioned base is an error)."""
+    if edges:
+        # hierarchical 2-tier topology (distributed/fedavg/hierarchy.py,
+        # docs/ROBUSTNESS.md §Hierarchical tiers): 1 root + E edge
+        # aggregator ranks + W workers; root fan-in is O(edges). The
+        # modes below are not wired through the edge tier — the dense
+        # synchronous protocol is the tree contract.
+        unsupported = {
+            "sparsify_ratio": sparsify_ratio, "update_codec": update_codec,
+            "delta_broadcast": delta_broadcast or None,
+            "aggregator": aggregator, "sanitize": sanitize or None,
+            "async_buffer_k": async_buffer_k,
+            "shard_server_state": shard_server_state or None,
+            "heartbeat_max_age_s": heartbeat_max_age_s,
+            "sum_assoc": None if sum_assoc == "auto" else sum_assoc,
+        }
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if bad:
+            raise ValueError(
+                f"edges={edges} (hierarchical topology) does not compose "
+                f"with {bad} — run the flat topology for those modes "
+                "(tree aggregation is pairwise by construction)")
+        from fedml_tpu.distributed.fedavg.hierarchy import (
+            run_simulated_hierarchical,
+        )
+
+        return run_simulated_hierarchical(
+            dataset, task, cfg, edges=edges, backend=backend,
+            job_id=job_id, base_port=base_port, broker_host=broker_host,
+            broker_port=broker_port, ckpt_dir=ckpt_dir,
+            telemetry=telemetry, chaos_plan=chaos_plan,
+            round_timeout_s=round_timeout_s, adversary_plan=adversary_plan,
+            warmup=warmup)
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos
@@ -144,7 +178,8 @@ def run_simulated(
                                        aggregator_params=aggregator_params,
                                        sanitize=sanitize,
                                        shard_server_state=shard_server_state,
-                                       partition_rules=partition_rules)
+                                       partition_rules=partition_rules,
+                                       sum_assoc=sum_assoc)
         server = FedAvgServerManager(aggregator_, rank=0, size=size,
                                      backend=backend, ckpt_dir=ckpt_dir,
                                      round_timeout_s=round_timeout_s,

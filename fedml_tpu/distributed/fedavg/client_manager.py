@@ -28,9 +28,15 @@ class FedAvgClientManager(ClientManager):
                  backend="LOOPBACK", sparsify_ratio: float | None = None,
                  adversary_plan=None, async_uplink: bool = True,
                  update_codec: str | None = None,
-                 error_feedback: bool = True, **kw):
+                 error_feedback: bool = True, server_rank: int = 0, **kw):
         self.trainer = trainer
         self.round_idx = 0
+        # where uploads go: rank 0 (the flat root) by default; in a 2-tier
+        # topology (distributed/fedavg/hierarchy.py) each worker's uplink
+        # targets its EDGE aggregator rank instead — everything else about
+        # the client protocol is unchanged (the downlink frames an edge
+        # relays are byte-compatible with the root's)
+        self.server_rank = int(server_rank)
         # async_uplink: uplink frame encoding (tree flatten + buffer copies
         # + CRC32 + optional deflate) and transmission run on a FIFO sender
         # worker (core/pipeline.AsyncSender) instead of the dispatch-loop
@@ -169,7 +175,8 @@ class FedAvgClientManager(ClientManager):
             wire_leaves = perturb_leaves(
                 self.adversary_plan, wire_leaves, global_leaves,
                 self.rank, self.round_idx)
-        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
+                      self.server_rank)
         with span("pack"):
             if self.sparsify_ratio:
                 from fedml_tpu.comm.sparse import (topk_delta, topk_encode,

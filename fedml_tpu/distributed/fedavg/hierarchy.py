@@ -1,0 +1,525 @@
+"""Hierarchical 2-tier cross-process FedAvg — edge aggregation ranks.
+
+``algorithms/hierarchical.py`` simulates nested aggregation inside one
+SPMD program; THIS module is the real cross-process topology the
+reference's ``hierarchical_fl`` sketches: a layer of EDGE AGGREGATOR
+ranks between the workers and the root, so the root's per-round fan-in
+is O(edges) instead of O(clients) — the piece that lets the wire runtime
+scale past one server's inbox (ROADMAP open item 4).
+
+Rank layout (world size ``1 + E + W``)::
+
+    rank 0            root server   (HierFedAvgServerManager)
+    ranks 1..E        edge aggregators (FedAvgEdgeManager)
+    ranks E+1..E+W    workers       (stock FedAvgClientManager,
+                                     server_rank = their edge)
+
+Each edge owns a CONTIGUOUS block of ``C = W/E`` cohort slots. Per round:
+the root sends ONE frame per edge (model + that block's client
+assignments); the edge fans it out, collects its children's uplinks,
+gates non-finite updates (``robust_agg.nonfinite_gate`` — per-slot, so
+verdicts match a flat server's exactly), and forwards ONE pre-aggregated
+frame: the canonical pairwise weighted SUM of the surviving updates plus
+the weight total (never a mean — the division happens once, at the
+root). The root pairwise-folds the edge partials and divides.
+
+**Exactness.** ``C`` must be a power of two (enforced): the edge blocks
+are then aligned sub-trees of the canonical pairwise fold
+(``robust_agg.pairwise_sum``), so the tree aggregate is BITWISE the flat
+pairwise aggregate over the same cohort — model bits AND quarantine
+ledger (a flat run opts into the same association with
+``sum_assoc='pairwise'``; test- and ci.sh-enforced). Sample weights ride
+the partials unscaled, so elastic partial rounds stay sample-weight
+exact. The norm-outlier gate and robust estimators need the full stacked
+cohort and are refused in tree mode (docs/ROBUSTNESS.md §Hierarchical
+tiers).
+
+Chaos (comm-manager wrap), telemetry (comm counters per link) and
+tracing (root round traces cover the edge tier — its direct children)
+ride the ordinary machinery on BOTH tiers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm.managers import DistributedManager
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.robust_agg import combine_edge_partials, edge_partial
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.distributed.fedavg.message_define import MyMessage
+from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.obs import perf_instrument as _perf
+
+log = logging.getLogger("fedml_tpu.distributed.hierarchy")
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeTopology:
+    """The 2-tier rank map. ``workers % edges == 0`` and the block size
+    ``workers // edges`` must be a power of two — that alignment is what
+    makes tree ≡ flat bitwise (see module docstring)."""
+
+    edges: int
+    workers: int
+
+    def __post_init__(self):
+        if self.edges < 1 or self.workers < 1:
+            raise ValueError(f"edges={self.edges} workers={self.workers} "
+                             "must both be >= 1")
+        if self.workers % self.edges:
+            raise ValueError(
+                f"workers={self.workers} not divisible by "
+                f"edges={self.edges} — edge blocks must be equal")
+        c = self.block
+        if c & (c - 1):
+            raise ValueError(
+                f"edge block size {c} (= {self.workers}/{self.edges}) "
+                "must be a power of two: blocks are then aligned "
+                "sub-trees of the canonical pairwise fold, which is what "
+                "keeps tree == flat bitwise")
+
+    @property
+    def block(self) -> int:
+        return self.workers // self.edges
+
+    @property
+    def world_size(self) -> int:
+        return 1 + self.edges + self.workers
+
+    def edge_rank(self, edge_idx: int) -> int:
+        return 1 + int(edge_idx)
+
+    def worker_rank(self, slot: int) -> int:
+        """Cohort slot (0-based) -> transport rank."""
+        return 1 + self.edges + int(slot)
+
+    def slot_of(self, worker_rank: int) -> int:
+        return int(worker_rank) - 1 - self.edges
+
+    def edge_of_slot(self, slot: int) -> int:
+        return int(slot) // self.block
+
+    def slots_of_edge(self, edge_idx: int) -> range:
+        return range(int(edge_idx) * self.block,
+                     (int(edge_idx) + 1) * self.block)
+
+
+class HierFedAvgAggregator(FedAvgAggregator):
+    """Root-side aggregator over EDGE partials: slots are edges, not
+    workers; ``aggregate()`` pairwise-folds the staged (wsum, weight)
+    pairs and divides once. Quarantine verdicts arrive pre-attributed by
+    cohort slot, so the ledger matches a flat run entry-for-entry."""
+
+    def __init__(self, dataset, task, cfg, topology: EdgeTopology):
+        if cfg.client_num_per_round != topology.workers:
+            raise ValueError(
+                f"client_num_per_round={cfg.client_num_per_round} != "
+                f"topology workers={topology.workers}")
+        super().__init__(dataset, task, cfg, worker_num=topology.edges)
+        self.topology = topology
+        # edge slot -> (wtotal, reasons, slots, clients); model_dict keeps
+        # the wsum leaves so the inherited barrier bookkeeping applies
+        self._edge_meta: dict[int, tuple] = {}
+        self.fanin_history: list[int] = []
+        self._combine = jax.jit(combine_edge_partials)
+
+    def add_edge_result(self, edge_idx: int, wsum_leaves, wtotal: float,
+                        reasons, slots, clients,
+                        round_idx: int | None = None) -> None:
+        """Slot one edge's pre-aggregated uplink (the e2s_agg frame).
+        Same stale/unknown rejection semantics as the per-worker path."""
+        if edge_idx not in self.flag_client_model_uploaded:
+            from fedml_tpu.obs import comm_instrument as _obs
+
+            _obs.record_stale_upload("unknown_rank")
+            log.warning("reject edge partial for unknown edge index %s "
+                        "(edges 0..%d)", edge_idx, self.worker_num - 1)
+            return
+        if round_idx is not None and int(round_idx) != self.current_round:
+            from fedml_tpu.obs import comm_instrument as _obs
+
+            _obs.record_stale_upload("stale")
+            log.warning("reject out-of-round edge partial from edge %s "
+                        "(tagged round %s, current %d)",
+                        edge_idx, round_idx, self.current_round)
+            return
+        self.model_dict[edge_idx] = self._stage_upload(list(wsum_leaves))
+        self.sample_num_dict[edge_idx] = float(wtotal)
+        self._edge_meta[edge_idx] = (
+            np.asarray(reasons, np.int32),
+            [int(s) for s in slots], [int(c) for c in clients])
+        self.flag_client_model_uploaded[edge_idx] = True
+
+    def _aggregate_core(self):
+        import time as _time
+
+        from fedml_tpu.comm.message import pack_pytree, unpack_pytree
+
+        t0 = _time.perf_counter()
+        edges = sorted(self.model_dict)
+        if not edges:
+            log.warning("round %d: no edge partials — keeping the "
+                        "current global model", self.current_round)
+            return
+        stacked = [
+            jnp.stack([jnp.asarray(self.model_dict[e][i]) for e in edges])
+            for i in range(len(self.model_dict[edges[0]]))
+        ]
+        totals = jnp.asarray([self.sample_num_dict[e] for e in edges],
+                             jnp.float32)
+        global_leaves = [jnp.asarray(v) for v in pack_pytree(self.net)]
+        avg_leaves, total_w = self._combine(stacked, totals, global_leaves)
+        self.fanin_history.append(len(edges))
+        _perf.record_agg_bytes(self._state_placement,
+                               self._model_nbytes * len(edges))
+        # fold every edge's per-child verdicts into the root ledger with
+        # the COHORT-SLOT rank (slot + 1) — the same attribution the flat
+        # aggregator records, so tree and flat ledgers compare equal
+        for e in edges:
+            reasons, slots, clients = self._edge_meta[e]
+            if reasons.any():
+                self.quarantine.record_codes(
+                    self.current_round, reasons,
+                    clients=clients, ranks=[s + 1 for s in slots])
+        if float(total_w) == 0.0 and any(
+                self._edge_meta[e][0].any() for e in edges):
+            log.warning("round %d: every child quarantined — keeping the "
+                        "current global model", self.current_round)
+        self.net = unpack_pytree(self.net, avg_leaves)
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        self._edge_meta.clear()
+        log.info("hier aggregate (%d edge partials): %.3fs",
+                 len(edges), _time.perf_counter() - t0)
+
+
+class FedAvgEdgeManager(DistributedManager):
+    """One edge aggregator rank: relay downlinks to its worker block,
+    tree-reduce their sanitized uplinks, forward one partial to the root.
+
+    Stateless across rounds except the held broadcast (the gate's
+    replacement value) — a restarted edge rejoins at the next broadcast.
+    With ``round_timeout_s`` armed, a stalled block forwards a PARTIAL
+    (missing children carry zero weight and the global value — zero terms
+    in the canonical fold), the edge-tier analogue of elastic partial
+    aggregation."""
+
+    def __init__(self, rank: int, topology: EdgeTopology,
+                 backend: str = "LOOPBACK",
+                 round_timeout_s: float | None = None, **kw):
+        self.topology = topology
+        self.edge_idx = rank - 1
+        if not 0 <= self.edge_idx < topology.edges:
+            raise ValueError(f"rank {rank} is not an edge rank "
+                             f"(edges are 1..{topology.edges})")
+        self._slots = list(topology.slots_of_edge(self.edge_idx))
+        self._round: int | None = None
+        self._global = None          # held broadcast leaves (gate value)
+        self._clients: list[int] = []  # this block's client assignment
+        self._uploads: dict[int, tuple] = {}  # local idx -> (leaves, n)
+        self._forwarded = False
+        self._lock = threading.Lock()
+        self._partial = jax.jit(edge_partial)
+        ts = kw.pop("timeout_s", None)
+        self.round_timeout_s = round_timeout_s
+        super().__init__(rank, topology.world_size, backend,
+                         timeout_s=round_timeout_s or ts, **kw)
+
+    # ------------------------------------------------------------ handlers
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+            partial(self._handle_downlink,
+                    MyMessage.MSG_TYPE_S2C_INIT_CONFIG))
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            partial(self._handle_downlink,
+                    MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT))
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+            self._handle_child_upload)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
+
+    def _handle_downlink(self, msg_type: str, msg_params) -> None:
+        """Root -> edge: hold the model, fan the SAME frame type out to
+        this block's workers (each with its own client assignment)."""
+        with self._lock:
+            self._round = int(msg_params[MyMessage.MSG_ARG_KEY_ROUND])
+            self._global = list(
+                msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS])
+            self._clients = [
+                int(c) for c in
+                msg_params[MyMessage.MSG_ARG_KEY_CHILD_CLIENTS]]
+            self._uploads = {}
+            self._forwarded = False
+        for i, slot in enumerate(self._slots):
+            msg = Message(msg_type, self.rank,
+                          self.topology.worker_rank(slot))
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self._global)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                           self._clients[i])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            self.send_message(msg)
+
+    def _handle_child_upload(self, msg_params) -> None:
+        sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+        slot = self.topology.slot_of(sender)
+        with self._lock:
+            if self._round is None:
+                return
+            tag = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            if int(tag) != self._round:
+                from fedml_tpu.obs import comm_instrument as _obs
+
+                _obs.record_stale_upload("stale")
+                log.warning("edge %d: drop stale upload from rank %d "
+                            "(round %s, now %d)", self.edge_idx, sender,
+                            tag, self._round)
+                return
+            local = slot - self._slots[0]
+            if not 0 <= local < len(self._slots):
+                from fedml_tpu.obs import comm_instrument as _obs
+
+                _obs.record_stale_upload("unknown_rank")
+                log.warning("edge %d: upload from rank %d outside this "
+                            "block (slots %s)", self.edge_idx, sender,
+                            self._slots)
+                return
+            if local in self._uploads or self._forwarded:
+                return  # chaos-duplicated upload: exactly-once folding
+            if (MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
+                    or MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params):
+                raise RuntimeError(
+                    "encoded uplinks (top-k / delta / quantized) are not "
+                    "wired through edge aggregators — run the flat "
+                    "topology or the dense protocol")
+            self._uploads[local] = (
+                list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]),
+                float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]))
+            if len(self._uploads) == len(self._slots):
+                self._forward_partial()
+
+    def _forward_partial(self) -> None:
+        """Gate + canonical pairwise partial over this block, one frame to
+        the root. Caller holds _lock. Missing children (elastic timeout)
+        carry zero weight and the global value — exact zero terms."""
+        C = len(self._slots)
+        stacked = []
+        for i, g in enumerate(self._global):
+            g = np.asarray(g)
+            rows = [np.asarray(self._uploads[local][0][i])
+                    if local in self._uploads else g
+                    for local in range(C)]
+            stacked.append(jnp.stack([jnp.asarray(r) for r in rows]))
+        weights = jnp.asarray(
+            [self._uploads[local][1] if local in self._uploads else 0.0
+             for local in range(C)], jnp.float32)
+        glob = [jnp.asarray(g) for g in self._global]
+        wsum, total, reasons = self._partial(stacked, glob, weights)
+        msg = Message(MyMessage.MSG_TYPE_E2S_SEND_AGG_TO_SERVER,
+                      self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_WSUM,
+                       [np.asarray(v) for v in wsum])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_WEIGHT, float(total))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_REASONS,
+                       np.asarray(reasons, np.int32))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SLOTS,
+                       [int(s) for s in self._slots])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_CLIENTS,
+                       list(self._clients))
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+        self._forwarded = True
+        self.send_message(msg)
+
+    def on_timeout(self, idle_s: float) -> None:
+        """Elastic edge tier: a block stalled past round_timeout_s
+        forwards the partial over the children that DID report."""
+        with self._lock:
+            if (self._round is None or self._forwarded
+                    or self.round_timeout_s is None):
+                return
+            if not self._uploads:
+                log.error("edge %d: round %d stalled %.1fs with no child "
+                          "uploads — waiting (root watchdog owns "
+                          "recovery)", self.edge_idx, self._round, idle_s)
+                return
+            missing = [self._slots[0] + i for i in range(len(self._slots))
+                       if i not in self._uploads]
+            log.warning("edge %d: elastic partial over %d/%d children "
+                        "(missing slots %s after %.1fs)", self.edge_idx,
+                        len(self._uploads), len(self._slots), missing,
+                        idle_s)
+            self._forward_partial()
+
+    def _handle_finish(self, _msg) -> None:
+        self.finish()
+
+
+class HierFedAvgServerManager(FedAvgServerManager):
+    """The root of the 2-tier topology: broadcasts one frame per EDGE and
+    advances rounds on E edge partials. Everything else — elastic
+    timeout, checkpoint/resume, telemetry, tracing (the edge tier is the
+    traced cohort) — is the stock server manager."""
+
+    def __init__(self, aggregator: HierFedAvgAggregator, topology=None,
+                 **kw):
+        if not isinstance(aggregator, HierFedAvgAggregator):
+            raise TypeError("HierFedAvgServerManager needs a "
+                            "HierFedAvgAggregator")
+        self.topology = topology or aggregator.topology
+        for flag, name in ((kw.get("async_buffer_k"), "async_buffer_k"),
+                           (kw.get("delta_broadcast"), "delta_broadcast"),
+                           (kw.get("heartbeat_max_age_s"),
+                            "heartbeat_max_age_s")):
+            if flag:
+                raise ValueError(
+                    f"{name} is not wired through edge aggregators — run "
+                    "the flat topology for that mode")
+        super().__init__(aggregator, **kw)
+
+    def _validate_world_size(self, size: int) -> None:
+        if size != self.topology.world_size:
+            raise ValueError(
+                f"world size {size} != 1 + {self.topology.edges} edges + "
+                f"{self.topology.workers} workers")
+
+    def register_message_receive_handlers(self):
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2S_SEND_AGG_TO_SERVER,
+            self.handle_message_edge_partial)
+
+    def _round_record_extra(self) -> dict:
+        hist = self.aggregator.fanin_history
+        return {"hier": {"edges": self.topology.edges,
+                         "block": self.topology.block,
+                         "fan_in": hist[-1] if hist else 0}}
+
+    def _broadcast_model(self, msg_type: str, global_params) -> None:
+        """One frame per EDGE (fan-out O(edges)): the model + that edge
+        block's client assignments + the round tag."""
+        from fedml_tpu.comm.message import codec_roundtrip
+        from fedml_tpu.obs.tracing import TRACE_KEY
+
+        topo = self.topology
+        client_indexes = self.aggregator.client_sampling(self.round_idx)
+        self._round_ids = [int(c) for c in client_indexes]
+        self.aggregator.begin_round(self.round_idx)
+        # stash AS CLIENTS SEE IT, like the flat path (frame codec round
+        # trip) — tree mode refuses encoded uplinks, but the stash keeps
+        # the versioned-base bookkeeping uniform
+        self._bcast_leaves = codec_roundtrip(global_params)
+        self._stash_version(self.round_idx, self._bcast_leaves)
+        tr = self._dtracer
+        if tr is not None:
+            tr.begin_round(self.round_idx)
+        for e in range(topo.edges):
+            rank = topo.edge_rank(e)
+            msg = Message(msg_type, self.rank, rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           global_params)
+            msg.add_params(
+                MyMessage.MSG_ARG_KEY_CHILD_CLIENTS,
+                [int(client_indexes[s]) for s in topo.slots_of_edge(e)])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if tr is not None:
+                msg.add_params(TRACE_KEY, tr.broadcast_ctx(rank))
+            self.send_message(msg)
+        if tr is not None:
+            tr.end_broadcast()
+
+    def handle_message_edge_partial(self, msg_params) -> None:
+        from fedml_tpu.obs.tracing import TRACE_KEY
+
+        with self._round_lock:
+            sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+            msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                       self.round_idx)
+            if int(msg_round) != self.round_idx:
+                from fedml_tpu.obs import comm_instrument as _obs
+
+                _obs.record_stale_upload("stale")
+                log.warning("drop stale edge partial from rank %d "
+                            "(round %s, now %d)", sender, msg_round,
+                            self.round_idx)
+                return
+            if self._dtracer is not None:
+                self._dtracer.on_upload(sender,
+                                        msg_params.get(TRACE_KEY))
+            self.aggregator.add_edge_result(
+                sender - 1,
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_WSUM],
+                float(msg_params[MyMessage.MSG_ARG_KEY_EDGE_WEIGHT]),
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_REASONS],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_SLOTS],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_CLIENTS],
+                round_idx=int(msg_round))
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._advance_round()
+
+
+def run_simulated_hierarchical(
+    dataset, task, cfg, edges: int, backend: str = "LOOPBACK",
+    job_id: str = "fedavg-hier-sim", base_port: int = 50000,
+    broker_host: str = "127.0.0.1", broker_port: int = 1883,
+    ckpt_dir: str | None = None, telemetry=None, chaos_plan=None,
+    round_timeout_s: float | None = None, adversary_plan=None,
+    warmup: bool = False,
+) -> HierFedAvgAggregator:
+    """The 2-tier analogue of ``run_simulated``: 1 root + E edges + W
+    workers as threads over the loopback (or localhost-gRPC) backend.
+    ``cfg.client_num_per_round`` is W; worker slot s trains
+    ``client_sampling(round)[s]`` exactly like the flat runtime, so the
+    tree and flat cohorts coincide round-for-round."""
+    from fedml_tpu import chaos as _chaos
+    from fedml_tpu.distributed.fedavg.client_manager import (
+        FedAvgClientManager,
+    )
+    from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+    from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+
+    topo = EdgeTopology(edges=edges, workers=cfg.client_num_per_round)
+    kw = backend_kwargs(backend, job_id, base_port, broker_host,
+                        broker_port)
+    if chaos_plan is not None:
+        _chaos.install_plan(chaos_plan)
+    try:
+        aggregator = HierFedAvgAggregator(dataset, task, cfg, topo)
+        server = HierFedAvgServerManager(
+            aggregator, rank=0, size=topo.world_size, backend=backend,
+            ckpt_dir=ckpt_dir, round_timeout_s=round_timeout_s,
+            telemetry=telemetry, **kw)
+        edge_mgrs = [
+            FedAvgEdgeManager(topo.edge_rank(e), topo, backend=backend,
+                              round_timeout_s=round_timeout_s, **kw)
+            for e in range(topo.edges)
+        ]
+        clients = []
+        for slot in range(topo.workers):
+            rank = topo.worker_rank(slot)
+            trainer = DistributedTrainer(rank, dataset, task, cfg)
+            clients.append(FedAvgClientManager(
+                trainer, rank=rank, size=topo.world_size, backend=backend,
+                server_rank=topo.edge_rank(topo.edge_of_slot(slot)),
+                adversary_plan=adversary_plan, **kw))
+        if warmup and clients:
+            from fedml_tpu.utils.metrics import enable_compile_cache
+
+            enable_compile_cache()
+            # one rank compiles, every sibling deserializes from disk
+            clients[0].warmup()
+        launch_simulated(server, edge_mgrs + clients)
+    finally:
+        if chaos_plan is not None:
+            _chaos.install_plan(None)
+    return aggregator

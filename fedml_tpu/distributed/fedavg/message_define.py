@@ -39,6 +39,25 @@ class MyMessage:
     MSG_ARG_KEY_UPDATE_CODEC = "upd_codec"
     MSG_ARG_KEY_UPDATE_PAYLOAD = "upd_q"
     MSG_ARG_KEY_UPDATE_SCALE = "upd_scale"
+    # hierarchical 2-tier topology (docs/ROBUSTNESS.md §Hierarchical
+    # tiers; distributed/fedavg/hierarchy.py): the root sends ONE s2c
+    # frame per EDGE carrying CHILD_CLIENTS (the cohort slots' client
+    # assignments for that edge's block); the edge fans it out to its
+    # workers as ordinary s2c frames, tree-reduces their sanitized
+    # uplinks, and answers with ONE e2s_agg frame — a pre-aggregated
+    # update (EDGE_WSUM, canonical pairwise weighted SUM, never a mean:
+    # the division happens once, at the root) + its weight total
+    # (EDGE_WEIGHT) + per-child quarantine verdicts (EDGE_REASONS, slot
+    # ids in EDGE_SLOTS, trained client ids in EDGE_CLIENTS). Root
+    # fan-in is O(edges), and tree ≡ flat stays bitwise under
+    # sum_assoc='pairwise' (test-enforced).
+    MSG_TYPE_E2S_SEND_AGG_TO_SERVER = "e2s_agg"
+    MSG_ARG_KEY_CHILD_CLIENTS = "child_clients"
+    MSG_ARG_KEY_EDGE_WSUM = "edge_wsum"
+    MSG_ARG_KEY_EDGE_WEIGHT = "edge_weight"
+    MSG_ARG_KEY_EDGE_REASONS = "edge_reasons"
+    MSG_ARG_KEY_EDGE_SLOTS = "edge_slots"
+    MSG_ARG_KEY_EDGE_CLIENTS = "edge_clients"
     # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
     # MODEL_PARAMS and BASE_VERSION names the global version the delta was
     # computed against — the client must hold exactly that version (the

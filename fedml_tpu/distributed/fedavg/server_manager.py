@@ -187,22 +187,19 @@ class FedAvgServerManager(ServerManager):
 
             from fedml_tpu.obs.tracing import RoundTracer
 
+            from fedml_tpu.data import dataset_source
+
             self._tracer = RoundTracer(sink=self._dtracer)
             telemetry.run_header(dataclasses.asdict(aggregator.cfg),
                                  engine="distributed", backend=backend,
                                  world_size=size,
+                                 dataset_source=dataset_source(
+                                     aggregator.dataset),
                                  tracing=self._dtracer is not None)
         if ckpt_dir is not None:
             self._maybe_resume()
         self._round_lock = threading.Lock()
-        if size - 1 != aggregator.cfg.client_num_per_round:
-            # one worker process per sampled client (FedAvgAPI.py:20-28
-            # launches client_num_per_round+1 ranks); a deficit would
-            # silently aggregate fewer clients than configured.
-            raise ValueError(
-                f"worker count {size - 1} != client_num_per_round="
-                f"{aggregator.cfg.client_num_per_round}"
-            )
+        self._validate_world_size(size)
         ts = kw.pop("timeout_s", None)
         if round_timeout_s is not None and round_timeout_s <= 0:
             # 0 would arm the elastic error-swallowing but DISARM the
@@ -216,6 +213,18 @@ class FedAvgServerManager(ServerManager):
             kw.setdefault("send_timeout_s", round_timeout_s)
         super().__init__(rank, size, backend, timeout_s=round_timeout_s or ts, **kw)
         _obs.set_ranks_alive(size - 1)  # all peers presumed reachable at boot
+
+    def _validate_world_size(self, size: int) -> None:
+        """One worker process per sampled client (FedAvgAPI.py:20-28
+        launches client_num_per_round+1 ranks); a deficit would silently
+        aggregate fewer clients than configured. The hierarchical server
+        (distributed/fedavg/hierarchy.py) overrides: its world also
+        carries the edge-aggregator ranks."""
+        if size - 1 != self.aggregator.cfg.client_num_per_round:
+            raise ValueError(
+                f"worker count {size - 1} != client_num_per_round="
+                f"{self.aggregator.cfg.client_num_per_round}"
+            )
 
     # a rank whose delivery failed is probed again only every k-th round:
     # one dead peer must not cost every round a full send deadline, but a
@@ -939,6 +948,11 @@ class FedAvgServerManager(ServerManager):
                 return
             self._advance_round()
 
+    def _round_record_extra(self) -> dict:
+        """Extra blocks a subclass rides on the telemetry round record
+        (the hierarchical server adds its ``hier`` fan-in block)."""
+        return {}
+
     def _advance_round(self):
         """Aggregate what's collected, eval, and start the next round (or
         finish). Caller holds _round_lock."""
@@ -972,7 +986,8 @@ class FedAvgServerManager(ServerManager):
                 evals=(hist[-1] if hist
                        and hist[-1].get("round") == self.round_idx else None),
                 **({"critical_path": cp} if cp else {}),
-                **({"quarantine": q} if q else {}))
+                **({"quarantine": q} if q else {}),
+                **self._round_record_extra())
             self._tracer.next_round()
         else:
             global_params = self.aggregator.aggregate()

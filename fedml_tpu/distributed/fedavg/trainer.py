@@ -23,8 +23,15 @@ class DistributedTrainer:
         self.dataset, self.task, self.cfg = dataset, task, cfg
         self.client_index = client_rank - 1  # re-assigned per round by the server
 
-        counts = [len(v) for v in dataset.train_idx_map.values()]
-        b_needed = int(np.ceil(max(counts) / cfg.batch_size))
+        from fedml_tpu.core.client_source import ClientDataSource
+
+        self._source = dataset if isinstance(dataset, ClientDataSource) \
+            else None
+        if self._source is not None:
+            max_count = int(np.max(self._source.client_sizes))
+        else:
+            max_count = max(len(v) for v in dataset.train_idx_map.values())
+        b_needed = int(np.ceil(max_count / cfg.batch_size))
         self.num_batches = min(cfg.max_batches or b_needed, b_needed)
 
         spec = local_spec or LocalSpec(
@@ -39,7 +46,10 @@ class DistributedTrainer:
         _, init_key = jax.random.split(jax.random.PRNGKey(cfg.seed))
         import jax.numpy as jnp
 
-        self.net = task.init(init_key, jnp.asarray(dataset.train_x[: cfg.batch_size]))
+        x_init = (self._source.init_batch(cfg.batch_size)
+                  if self._source is not None
+                  else dataset.train_x[: cfg.batch_size])
+        self.net = task.init(init_key, jnp.asarray(x_init))
 
     def warmup(self) -> dict:
         """AOT-compile the local-fit program before the first broadcast
@@ -65,21 +75,27 @@ class DistributedTrainer:
 
             enable_compile_cache()
         bs = self.cfg.batch_size
-        counts = Counter(
-            min(self.num_batches, -(-len(ix) // bs))
-            for ix in self.dataset.train_idx_map.values())
+        if self._source is not None:
+            sizes = [int(s) for s in self._source.client_sizes]
+            # round-invariant shapes/dtypes from metadata — no payload read
+            (xshape, xdtype), (yshape, ydtype) = self._source.row_meta()
+        else:
+            sizes = [len(ix) for ix in self.dataset.train_idx_map.values()]
+            tx, ty = self.dataset.train_x, self.dataset.train_y
+            (xshape, xdtype), (yshape, ydtype) = (
+                (tx.shape[1:], tx.dtype), (ty.shape[1:], ty.dtype))
+        counts = Counter(min(self.num_batches, -(-n // bs)) for n in sizes)
         counts.pop(0, None)  # empty clients dispatch nothing
         depths = sorted(counts, key=lambda b: (-counts[b], -b))[:4]
         deepest = max(counts) if counts else self.num_batches
         if deepest not in depths:
             depths = depths[:-1] + [deepest] if depths else [deepest]
-        tx, ty = self.dataset.train_x, self.dataset.train_y
         rng = jax.random.PRNGKey(0)
         lowered = {
             f"local_fit_b{B}": self.local_update.lower(
                 rng, self.net,
-                np.zeros((B, bs) + tx.shape[1:], tx.dtype),
-                np.zeros((B, bs) + ty.shape[1:], ty.dtype),
+                np.zeros((B, bs) + tuple(xshape), xdtype),
+                np.zeros((B, bs) + tuple(yshape), ydtype),
                 np.zeros((B, bs), np.float32))
             for B in sorted(depths)}
         rep = compile_concurrently(lowered)
@@ -95,10 +111,19 @@ class DistributedTrainer:
     def fit(self, round_idx: int) -> int:
         """Run the local fit on the currently assigned client's data
         (result in self.net); returns the local sample count."""
-        cb = pack_clients(
-            self.dataset, [self.client_index], self.cfg.batch_size,
-            max_batches=self.num_batches, seed=self.cfg.seed, round_idx=round_idx,
-        )
+        if self._source is not None:
+            from fedml_tpu.core.client_source import pack_clients_source
+
+            cb = pack_clients_source(
+                self._source, [self.client_index], self.cfg.batch_size,
+                max_batches=self.num_batches, seed=self.cfg.seed,
+                round_idx=round_idx)
+        else:
+            cb = pack_clients(
+                self.dataset, [self.client_index], self.cfg.batch_size,
+                max_batches=self.num_batches, seed=self.cfg.seed,
+                round_idx=round_idx,
+            )
         rng = jax.random.fold_in(jax.random.PRNGKey(self.cfg.seed), round_idx)
         rng = jax.random.fold_in(rng, self.client_index)
         self.net, _metrics = self.local_update(rng, self.net, cb.x[0], cb.y[0], cb.mask[0])

@@ -45,6 +45,25 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--stddev", type=float, default=0.025)
     p.add_argument("--noise_multiplier", type=float, default=1.0,
                    help="z for --defense_type dp (accounted DP-FedAvg)")
+    p.add_argument("--edges", type=int, default=0,
+                   help="hierarchical 2-tier topology (docs/ROBUSTNESS.md "
+                        "§Hierarchical tiers): ranks 1..E become EDGE "
+                        "AGGREGATORS that tree-reduce their worker "
+                        "block's sanitized uplinks and forward ONE "
+                        "pre-aggregated update each — root fan-in is "
+                        "O(edges), and tree == flat stays bitwise under "
+                        "--sum_assoc pairwise. Workers are ranks "
+                        "E+1..world_size-1; the per-edge block size "
+                        "(workers/edges) must be a power of two. 0 = "
+                        "flat (default)")
+    p.add_argument("--sum_assoc", "--sum-assoc", dest="sum_assoc",
+                   type=str, default="auto", choices=["auto", "pairwise"],
+                   help="rank 0: weighted-mean summation association. "
+                        "'pairwise' = the canonical balanced-binary fold "
+                        "(robust_agg.pairwise_sum) — a flat run becomes "
+                        "bitwise-comparable with any --edges topology "
+                        "over the same cohort; 'auto' keeps the "
+                        "historical tensordot association")
     p.add_argument("--world_size", type=int, required=True,
                    help="client_num_per_round + 1")
     p.add_argument("--backend", type=str, default="grpc",
@@ -274,10 +293,68 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 
     backend = args.backend.upper()
+    edges = int(getattr(args, "edges", 0) or 0)
+    if edges:
+        # hierarchical 2-tier topology: rank 0 root, 1..E edges, rest
+        # workers. Dense synchronous protocol only (the tree contract).
+        if args.algo not in ("fedavg", "fedprox"):
+            raise ValueError(f"--edges is wired for fedavg/fedprox only "
+                             f"(got --algo {args.algo})")
+        incompatible = [name for name, v in (
+            ("--aggregator", getattr(args, "aggregator", None)),
+            ("--async_buffer_k", getattr(args, "async_buffer_k", None)),
+            ("--sparsify_ratio", getattr(args, "sparsify_ratio", None)),
+            ("--update_codec", getattr(args, "update_codec", None)),
+            ("--delta_broadcast", getattr(args, "delta_broadcast", 0)
+             or None),
+            ("--shard_server_state", getattr(args, "shard_server_state", 0)
+             or None),
+            ("--heartbeat_max_age_s", getattr(args, "heartbeat_max_age_s",
+                                              None)),
+            ("--sum_assoc", None if getattr(args, "sum_assoc", "auto")
+             == "auto" else args.sum_assoc),  # tree IS pairwise already
+        ) if v is not None]
+        if incompatible:
+            raise ValueError(f"--edges does not compose with "
+                             f"{incompatible} — run the flat topology")
+        from fedml_tpu.distributed.fedavg.hierarchy import (
+            EdgeTopology,
+            FedAvgEdgeManager,
+            HierFedAvgAggregator,
+            HierFedAvgServerManager,
+        )
+
+        topo = EdgeTopology(edges=edges,
+                            workers=args.world_size - 1 - edges)
+        if args.rank == 0:
+            agg = HierFedAvgAggregator(data, task, cfg, topo)
+            return HierFedAvgServerManager(
+                agg, rank=0, size=args.world_size, backend=backend,
+                ckpt_dir=args.ckpt_dir,
+                round_timeout_s=args.round_timeout_s,
+                telemetry=telemetry, **backend_kw)
+        if args.rank <= edges:
+            return FedAvgEdgeManager(
+                args.rank, topo, backend=backend,
+                round_timeout_s=args.round_timeout_s, **backend_kw)
+        local_spec = None
+        if args.algo == "fedprox":
+            from fedml_tpu.distributed.fedprox import prox_spec
+
+            local_spec = prox_spec(cfg, args.fedprox_mu)
+        adv = _load_adversary_plan(getattr(args, "adversary_plan", None))
+        return init_client(
+            data, task, cfg, args.rank, args.world_size, backend,
+            local_spec=local_spec, adversary_plan=adv,
+            server_rank=topo.edge_rank(
+                topo.edge_of_slot(topo.slot_of(args.rank))),
+            **backend_kw)
     # robust aggregation (--aggregator): kwargs shared by every aggregator
     # that inherits the FedAvgAggregator gate (turboaggregate excluded —
     # a Shamir share is a masked tensor, not an update to sort or gate)
     agg_kw: dict = {}
+    if getattr(args, "sum_assoc", "auto") != "auto":
+        agg_kw["sum_assoc"] = args.sum_assoc
     if getattr(args, "aggregator", None):
         agg_kw["aggregator"] = args.aggregator
         if getattr(args, "byzantine_f", None) is not None:
@@ -397,7 +474,9 @@ def main(argv=None):
     )
     from fedml_tpu.utils.metrics import set_process_title
 
-    role = "server" if args.rank == 0 else f"client{args.rank}"
+    role = ("server" if args.rank == 0
+            else f"edge{args.rank}" if args.rank <= (args.edges or 0)
+            else f"client{args.rank}")
     set_process_title(f"fedml_tpu:{args.algo}:{role}")
     from fedml_tpu.utils.metrics import enable_compile_cache
 
@@ -433,7 +512,12 @@ def main(argv=None):
     task = {"classification": classification_task, "sequence": sequence_task,
             "tags": tag_prediction_task}[spec.task](model)
     n_total = data.num_clients
-    if (args.rank != 0 and args.world_size - 1 == n_total
+    n_workers = args.world_size - 1 - int(getattr(args, "edges", 0) or 0)
+    if n_workers < 1:
+        raise ValueError(f"--world_size {args.world_size} leaves no worker "
+                         f"ranks after {args.edges} edges + 1 server")
+    worker_slot = args.rank - 1 - int(getattr(args, "edges", 0) or 0)
+    if (args.rank != 0 and worker_slot >= 0 and n_workers == n_total
             and args.algo != "turboaggregate"):
         # turboaggregate excluded: SecureTrainer's Shamir-share weights need
         # every cohort member's sample count (turboaggregate.py _round_weight),
@@ -443,10 +527,10 @@ def main(argv=None):
         # parity — the reference's per-rank loaders, cifar10/data_loader.py:433)
         from fedml_tpu.core.client_data import subset_clients
 
-        data = subset_clients(data, [args.rank - 1])
+        data = subset_clients(data, [worker_slot])
     cfg = FedAvgConfig(
         comm_round=args.comm_round, client_num_in_total=n_total,
-        client_num_per_round=args.world_size - 1, epochs=args.epochs,
+        client_num_per_round=n_workers, epochs=args.epochs,
         batch_size=args.batch_size, client_optimizer=args.client_optimizer,
         lr=args.lr, wd=args.wd, frequency_of_the_test=args.frequency_of_the_test,
         seed=args.seed, ci=bool(args.ci),

@@ -385,6 +385,126 @@ print(f"wire-codec smoke ok: int8 round-trip within half a step, NaN "
       f"quarantined ({g.quarantine.counts()}), directions exported")
 PY
   python scripts/report.py "$CODEC_DIR/events.jsonl"
+  echo "== flat-memory streamed smoke (100k-virtual-client PackedNpySource run; fed_host_rss_bytes flat across rounds, gated via bench_gate.py) =="
+  # the streamed data plane (docs/PERFORMANCE.md §Streaming & cohort
+  # bucketing) must hold host RSS FLAT in population size: a 100k-client
+  # packed-npy population is generated chunked (the writer never
+  # materializes it either), the engine runs size-bucketed cohorts over
+  # the lazy source with memwatch telemetry on, and the round records'
+  # fed_host_rss_bytes samples are gated — growth across rounds beyond a
+  # few percent (or a dataset-sized jump = someone re-materialized the
+  # population) fails CI, not a human eyeballing a chart
+  STREAM_DIR=./tmp/ci_stream; rm -rf "$STREAM_DIR"
+  python - "$STREAM_DIR" <<'PY'
+import json, os, sys
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.client_source import PackedNpySource
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_packed_population
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+N, DIM, ROUNDS = 100_000, 16, 12
+# the ONE shared fixture writer (also FEDML_BENCH_STREAM's): chunked, so
+# the writer's RSS stays flat too, and labels correlate with the rows
+# actually written
+data_dir = synthetic_packed_population(os.path.join(d, "packed"), N,
+                                       dim=DIM)
+src = PackedNpySource(data_dir)
+tel = Telemetry(log_dir=d, memwatch=True)
+cfg = FedAvgConfig(comm_round=ROUNDS, client_num_in_total=N,
+                   client_num_per_round=16, batch_size=8, lr=0.1,
+                   frequency_of_the_test=10_000, seed=0)
+api = FedAvgAPI(src, task := classification_task(
+    LogisticRegression(num_classes=5)), cfg, bucket_batches=True,
+    telemetry=tel)
+rep = api.warmup()  # all bucket variants AOT — compile RSS paid up front
+api.train(ROUNDS)   # train() also emits the run header (dataset_source)
+tel.close()
+recs = [json.loads(line) for line in open(os.path.join(d, "events.jsonl"))]
+hdr = [r for r in recs if r.get("kind") == "run"][0]
+assert hdr["dataset_source"] == "synthetic", hdr
+rss = [r["mem"]["host_rss_bytes"] for r in recs
+       if r.get("kind") == "round" and "mem" in r]
+assert len(rss) == ROUNDS, f"expected {ROUNDS} memwatch samples, got {len(rss)}"
+packs = [r["pack"] for r in recs if r.get("kind") == "round"]
+assert any(p["bucket_B"] < p["budget_B"] for p in packs), \
+    f"bucketing never engaged: {packs[:3]}"
+base = rss[2]  # post-warm reference (rounds 0-1 absorb first dispatches)
+blob = {
+    "metric": "stream_rss_growth_ratio",
+    "value": round(max(rss[2:]) / base, 4),
+    "unit": "max_rss/post_warm_rss",
+    "stream_rss_growth_ratio": round(max(rss[2:]) / base, 4),
+    "stream_rss_growth_bytes": int(max(rss[2:]) - base),
+    "stream_clients": N,
+    "stream_rounds": ROUNDS,
+    "rss_post_warm_bytes": int(base),
+    "rss_end_bytes": int(rss[-1]),
+    "warmup_variants": rep.get("variants"),
+}
+with open("./tmp/ci_stream_blob.json", "w") as f:
+    json.dump(blob, f, indent=2)
+src.close()
+print(f"flat-memory streamed smoke ok: {N} clients, rss "
+      f"{base/1e6:.0f}MB -> {rss[-1]/1e6:.0f}MB over {ROUNDS} rounds, "
+      f"growth ratio {blob['stream_rss_growth_ratio']}, "
+      f"buckets {sorted({p['bucket_B'] for p in packs})}")
+PY
+  python scripts/bench_gate.py ./tmp/ci_stream_blob.json \
+    --gate scripts/ci_stream_gate.json
+  # the committed FEDML_BENCH_STREAM A/B artifact must stay within the
+  # same spec (streamed RSS flat AND below the materialized twin's)
+  python scripts/bench_gate.py BENCH_STREAM_r01.json \
+    --gate scripts/ci_stream_gate.json
+  python scripts/report.py "$STREAM_DIR/events.jsonl"
+  echo "== hierarchical 2-tier smoke (1 root + 2 edges + 8 workers; tree == flat pairwise, bitwise; root fan-in == edges) =="
+  # the edge-aggregation tier (docs/ROBUSTNESS.md §Hierarchical tiers)
+  # must reproduce the flat pairwise run's model bits AND quarantine
+  # ledger under seeded chaos with a NaN adversary in the cohort, with
+  # the root folding exactly E pre-aggregated partials per round
+  python - <<'PY'
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan, FaultPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=8, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+E = 2
+adv = lambda rank: AdversaryPlan.from_json(
+    {"seed": 1, "rules": [{"attack": "nan", "ranks": [rank]}]})
+chaos = lambda: FaultPlan.from_json({"seed": 7, "rules": [
+    {"fault": "delay", "delay_s": 0.05, "prob": 0.5},
+    {"fault": "duplicate", "prob": 0.3}]})
+flat = run_simulated(data, task, cfg, job_id="ci-hier-flat",
+                     sum_assoc="pairwise", adversary_plan=adv(3),
+                     chaos_plan=chaos(), round_timeout_s=15.0)
+tree = run_simulated(data, task, cfg, job_id="ci-hier-tree", edges=E,
+                     adversary_plan=adv(3 + E), chaos_plan=chaos(),
+                     round_timeout_s=15.0)
+for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                  err_msg="tree diverged from flat")
+assert tree.fanin_history == [E] * 3, tree.fanin_history
+led = tree.quarantine.canonical()
+assert led == flat.quarantine.canonical() and led, led
+assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(tree.net))
+print(f"hierarchical smoke ok: tree == flat bitwise over {cfg.comm_round} "
+      f"rounds, fan-in {tree.fanin_history}, ledger {len(led)} entries "
+      f"(NaN adversary quarantined at the edge)")
+PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi

@@ -86,6 +86,15 @@ def render_table(records: list[dict]) -> str:
             # buffered rounds): buffer size folded, staleness quantiles of
             # the folded updates, cumulative shed count, buffer fill time
             # — columns hide on pre-async logs
+            # size-bucketed cohort packing (docs/PERFORMANCE.md §Streaming
+            # & cohort bucketing): dispatched bucket depth vs the cohort's
+            # natural need, and the padded-slot fraction — columns hide on
+            # logs that predate the pack block
+            "bkt_B": (r.get("pack") or {}).get("bucket_B"),
+            "pad_frac": (r.get("pack") or {}).get("pad_frac"),
+            # hierarchical 2-tier runs (docs/ROBUSTNESS.md §Hierarchical
+            # tiers): the root's realized fan-in (== edge count)
+            "fan_in": (r.get("hier") or {}).get("fan_in"),
             "buf_k": (r.get("async") or {}).get("k"),
             "stale_p50": _staleness_quantile(r, 0.5),
             "stale_max": _staleness_quantile(r, 1.0),

@@ -124,6 +124,14 @@ _SMOKE_TESTS = {
     # identity and the deterministic async-beats-the-barrier claim
     "test_async_buffer.py::test_async_k_cohort_bound0_bitwise_equals_sync",
     "test_async_buffer.py::test_async_straggler_beats_sync_barrier_virtual_clock",
+    # round-11 additions: million-client data plane (docs/PERFORMANCE.md
+    # §Streaming & cohort bucketing; docs/ROBUSTNESS.md §Hierarchical
+    # tiers) — streamed ≡ materialized, bucketing on ≡ off, and the
+    # 2-tier tree ≡ flat pairwise identity
+    "test_streaming.py::test_streamed_engine_bitwise_equals_materialized",
+    "test_streaming.py::test_bucketing_on_equals_off_per_round_and_pipelined",
+    "test_hierarchy_tiers.py::test_pairwise_sum_block_composition_property",
+    "test_hierarchy_tiers.py::test_tree_equals_flat_loopback_bitwise",
 }
 
 

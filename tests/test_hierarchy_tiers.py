@@ -1,0 +1,228 @@
+"""Hierarchical edge-aggregation tiers (distributed/fedavg/hierarchy.py +
+robust_agg's canonical pairwise association — docs/ROBUSTNESS.md
+§Hierarchical tiers).
+
+The exactness claim is layered and every layer is asserted:
+
+- **fold composition**: pairwise_sum over contiguous power-of-two blocks,
+  then over the block partials, is bitwise the flat fold (the algebraic
+  fact the whole tier rests on — property-tested over sizes);
+- **function-level tree ≡ flat**: edge_partial + combine_edge_partials ≡
+  gated_aggregate(pairwise=True), values AND per-slot reason codes;
+- **runtime tree ≡ flat**: a 2-tier loopback run (1 root + E edges + W
+  workers) reproduces the flat pairwise run's model bits and quarantine
+  ledger entry-for-entry, under chaos and a NaN adversary, with root
+  fan-in == E every round;
+- **topology validation** + the HierarchicalFLAPI mesh satellite (a bad
+  mesh is refused up front, never silently discarded).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan, FaultPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.robust_agg import (
+    combine_edge_partials,
+    edge_partial,
+    gated_aggregate,
+    pairwise_sum,
+    pairwise_weighted_stats,
+)
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.distributed.fedavg.hierarchy import EdgeTopology
+from fedml_tpu.models.linear import LogisticRegression
+
+
+# ------------------------------------------------------- fold composition
+def test_pairwise_sum_block_composition_property():
+    rs = np.random.RandomState(0)
+    for K in (1, 2, 3, 5, 6, 8, 11, 16, 23):
+        x = jnp.asarray(rs.randn(K, 5).astype(np.float32) * 1e3)
+        flat = np.asarray(pairwise_sum(x))
+        for C in (1, 2, 4, 8):
+            parts = [pairwise_sum(x[s:s + C]) for s in range(0, K, C)]
+            tree = np.asarray(pairwise_sum(jnp.stack(parts)))
+            np.testing.assert_array_equal(flat, tree,
+                                          err_msg=f"K={K} C={C}")
+
+
+def test_pairwise_weighted_stats_zero_weight_slots_are_exact_zero_terms():
+    rs = np.random.RandomState(1)
+    x = [jnp.asarray(rs.randn(4, 3).astype(np.float32))]
+    w = jnp.asarray([2.0, 0.0, 1.0, 0.0])
+    wsum, total = pairwise_weighted_stats(x, w)
+    oracle = 2.0 * np.asarray(x[0][0]) + 1.0 * np.asarray(x[0][2])
+    np.testing.assert_allclose(np.asarray(wsum[0]), oracle, rtol=1e-6)
+    assert float(total) == 3.0
+
+
+def test_edge_partials_equal_flat_gated_pairwise():
+    rs = np.random.RandomState(2)
+    K, C = 8, 2
+    stacked = [rs.randn(K, 6, 2).astype(np.float32),
+               rs.randn(K, 3).astype(np.float32)]
+    stacked[1][5] = np.inf  # poisoned slot -> nonfinite verdict
+    glob = [rs.randn(6, 2).astype(np.float32),
+            rs.randn(3).astype(np.float32)]
+    w = np.abs(rs.randn(K).astype(np.float32)) * 7
+    flat_avg, _, flat_r = gated_aggregate(
+        [jnp.asarray(v) for v in stacked], [jnp.asarray(v) for v in glob],
+        jnp.asarray(w), norm_mult=float("inf"), pairwise=True)
+    partials, totals, reasons = [], [], []
+    for s in range(0, K, C):
+        ws, tot, r = edge_partial(
+            [jnp.asarray(v[s:s + C]) for v in stacked],
+            [jnp.asarray(v) for v in glob], jnp.asarray(w[s:s + C]))
+        partials.append(ws)
+        totals.append(tot)
+        reasons.append(np.asarray(r))
+    stackp = [jnp.stack([p[i] for p in partials]) for i in range(2)]
+    tree_avg, _ = combine_edge_partials(
+        stackp, jnp.asarray(totals), [jnp.asarray(v) for v in glob])
+    for a, b in zip(flat_avg, tree_avg):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(flat_r),
+                                  np.concatenate(reasons))
+
+
+def test_pairwise_refuses_robust_estimators():
+    with pytest.raises(ValueError, match="pairwise"):
+        gated_aggregate([jnp.zeros((2, 3))], [jnp.zeros((3,))],
+                        jnp.ones((2,)), robust_fn=lambda s, w: (s, {}),
+                        pairwise=True)
+
+
+# ----------------------------------------------------- topology validation
+def test_edge_topology_validation():
+    t = EdgeTopology(edges=2, workers=8)
+    assert t.block == 4 and t.world_size == 11
+    assert t.edge_rank(1) == 2
+    assert t.worker_rank(0) == 3 and t.slot_of(10) == 7
+    assert t.edge_of_slot(3) == 0 and t.edge_of_slot(4) == 1
+    assert list(t.slots_of_edge(1)) == [4, 5, 6, 7]
+    with pytest.raises(ValueError, match="divisible"):
+        EdgeTopology(edges=3, workers=8)
+    with pytest.raises(ValueError, match="power of two"):
+        EdgeTopology(edges=2, workers=6)  # block 3
+    with pytest.raises(ValueError, match=">= 1"):
+        EdgeTopology(edges=0, workers=4)
+
+
+# --------------------------------------------------------- runtime parity
+@pytest.fixture(scope="module")
+def data():
+    return synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=24, seed=0)
+
+
+@pytest.fixture(scope="module")
+def task():
+    return classification_task(LogisticRegression(num_classes=3))
+
+
+def _cfg(rounds=3):
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=8, batch_size=6, lr=0.1,
+                        frequency_of_the_test=1)
+
+
+def test_tree_equals_flat_loopback_bitwise(data, task):
+    flat = run_simulated(data, task, _cfg(), job_id="hier-flat-t",
+                         sum_assoc="pairwise")
+    tree = run_simulated(data, task, _cfg(), job_id="hier-tree-t", edges=2)
+    for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg="tree != flat")
+    assert tree.fanin_history == [2, 2, 2]
+    assert flat.quarantine.canonical() == tree.quarantine.canonical()
+    assert tree.history and tree.history[-1]["round"] == 2
+
+
+def test_tree_chaos_adversary_ledger_parity(data, task):
+    """Seeded delay+duplicate chaos on every link and a NaN adversary on
+    cohort slot 2: tree and flat (pairwise) agree on model bits AND the
+    quarantine ledger — and the model stays finite (the edge gate killed
+    the NaN before it ever reached the root)."""
+    E = 2
+    adv = lambda rank: AdversaryPlan.from_json(
+        {"seed": 1, "rules": [{"attack": "nan", "ranks": [rank]}]})
+    chaos = lambda: FaultPlan.from_json({"seed": 7, "rules": [
+        {"fault": "delay", "delay_s": 0.05, "prob": 0.5},
+        {"fault": "duplicate", "prob": 0.3}]})
+    flat = run_simulated(data, task, _cfg(), job_id="hier-flat-c",
+                         sum_assoc="pairwise", adversary_plan=adv(3),
+                         chaos_plan=chaos(), round_timeout_s=15.0)
+    tree = run_simulated(data, task, _cfg(), job_id="hier-tree-c",
+                         edges=E, adversary_plan=adv(3 + E),
+                         chaos_plan=chaos(), round_timeout_s=15.0)
+    for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    led = tree.quarantine.canonical()
+    assert led == flat.quarantine.canonical()
+    assert led and all(e[1] == 3 and e[2] == "nonfinite" for e in led)
+    assert all(np.isfinite(np.asarray(v)).all()
+               for v in pack_pytree(tree.net))
+
+
+def test_tree_telemetry_hier_block_and_header(data, task):
+    from fedml_tpu.obs import Telemetry
+
+    tel = Telemetry()
+    run_simulated(data, task, _cfg(2), job_id="hier-tel", edges=4,
+                  telemetry=tel)
+    recs = tel.events.sink.records
+    hdr = [r for r in recs if r.get("kind") == "run"][0]
+    assert hdr["world_size"] == 1 + 4 + 8
+    rounds = [r for r in recs if r.get("kind") == "round"]
+    assert rounds and all(r["hier"] == {"edges": 4, "block": 2,
+                                        "fan_in": 4} for r in rounds)
+    # num_samples survives the tier (sample-weight exactness at the root)
+    assert all(r["metrics"]["num_samples"] > 0 for r in rounds)
+
+
+def test_hier_refuses_unsupported_modes(data, task):
+    with pytest.raises(ValueError, match="does not compose"):
+        run_simulated(data, task, _cfg(), edges=2, aggregator="median")
+    with pytest.raises(ValueError, match="does not compose"):
+        run_simulated(data, task, _cfg(), edges=2,
+                      update_codec="delta-int8")
+    with pytest.raises(ValueError, match="does not compose"):
+        run_simulated(data, task, _cfg(), edges=2, async_buffer_k=2)
+
+
+def test_flat_pairwise_refuses_sharded_and_robust(data, task):
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+
+    with pytest.raises(ValueError, match="weighted-mean"):
+        FedAvgAggregator(data, task, _cfg(), worker_num=8,
+                         aggregator="median", sum_assoc="pairwise")
+    with pytest.raises(ValueError, match="sum_assoc"):
+        FedAvgAggregator(data, task, _cfg(), worker_num=8,
+                         sum_assoc="bogus")
+
+
+# ----------------------------------------------- mesh satellite (standalone)
+def test_hierarchical_mesh_refused_up_front(data, task):
+    """The satellite fix: a mesh without ('groups','clients') axes — or an
+    indivisible group count — raises IMMEDIATELY, before the parent engine
+    build, instead of being silently discarded."""
+    from jax.sharding import Mesh
+
+    from fedml_tpu.algorithms.hierarchical import HierarchicalFLAPI
+
+    cfg = FedAvgConfig(comm_round=1, client_num_in_total=8,
+                       client_num_per_round=4, batch_size=6)
+    flat_mesh = Mesh(np.array(jax.devices()[:2]), ("clients",))
+    with pytest.raises(ValueError, match="groups"):
+        HierarchicalFLAPI(data, task, cfg, group_num=2, mesh=flat_mesh)
+    grid = Mesh(np.array(jax.devices()[:4]).reshape(2, 2),
+                ("groups", "clients"))
+    with pytest.raises(ValueError, match="divisible"):
+        HierarchicalFLAPI(data, task, cfg, group_num=3, mesh=grid)

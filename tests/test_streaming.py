@@ -1,0 +1,327 @@
+"""Streamed client state + size-bucketed cohort packing oracles
+(core/client_source.py, docs/PERFORMANCE.md §Streaming & cohort bucketing).
+
+Contracts asserted here:
+
+- **pack parity**: every source (in-memory wrapper, packed-npy, LEAF-json)
+  packs BIT-IDENTICALLY to ``pack_clients`` over equivalent data — same
+  (seed, round, CLIENT-ID) splitmix shuffle, same layout;
+- **engine identity**: a FedAvgAPI over a streamed source reproduces the
+  materialized engine's model bits, per-round and pipelined;
+- **bucketing identity**: ``bucket_batches`` on ≡ off, bit for bit —
+  per-round, scan-block, and ±prefetch (trailing all-masked batch slots
+  are exact no-ops), plus the numpy oracle for bucket assignment and
+  padding accounting;
+- **honest provenance**: the telemetry run header carries
+  ``dataset_source`` and round records carry the ``pack`` block.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.client_data import pack_clients
+from fedml_tpu.core.client_source import (
+    InMemorySource,
+    LeafJsonSource,
+    PackedNpySource,
+    as_source,
+    open_source,
+    pack_clients_source,
+    write_packed_npy,
+)
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images, synthetic_lr
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+
+@pytest.fixture(scope="module")
+def fd():
+    # natural partition with RAGGED client sizes (synthetic_lr draws
+    # lognormal sizes) — the shape skew bucketing exists for
+    return synthetic_lr(num_clients=16, dim=12, num_classes=4, seed=3)
+
+
+@pytest.fixture(scope="module")
+def task():
+    return classification_task(LogisticRegression(num_classes=4))
+
+
+def cfg(**kw):
+    base = dict(comm_round=3, client_num_in_total=16,
+                client_num_per_round=4, batch_size=16, lr=0.1,
+                frequency_of_the_test=100)
+    base.update(kw)
+    return FedAvgConfig(**base)
+
+
+def _params(api):
+    return [np.asarray(v) for v in jax.tree.leaves(api.net.params)]
+
+
+def assert_trees_equal(a, b, msg=""):
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y, err_msg=msg)
+
+
+# ------------------------------------------------------------ pack parity
+def test_inmemory_source_pack_bitwise(fd):
+    src = InMemorySource(fd)
+    ids = np.array([5, 2, 11, 7])
+    a = pack_clients(fd, ids, 8, max_batches=6, seed=4, round_idx=9)
+    b = pack_clients_source(src, ids, 8, max_batches=6, seed=4, round_idx=9)
+    for name in ("x", "y", "mask", "num_samples"):
+        np.testing.assert_array_equal(getattr(a, name), getattr(b, name),
+                                      err_msg=name)
+
+
+def test_packed_npy_roundtrip_and_pack_parity(fd, tmp_path):
+    d = write_packed_npy(fd, str(tmp_path / "packed"), chunk_clients=5)
+    src = PackedNpySource(d)
+    np.testing.assert_array_equal(src.client_sizes,
+                                  InMemorySource(fd).client_sizes)
+    np.testing.assert_array_equal(src.test_x, fd.test_x)
+    np.testing.assert_array_equal(src.test_y, fd.test_y)
+    for cid in (0, 7, 15):
+        ax, ay = InMemorySource(fd).client_rows(cid)
+        bx, by = src.client_rows(cid)
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+    ids = np.array([1, 14, 3])
+    a = pack_clients(fd, ids, 8, max_batches=4, seed=0, round_idx=2)
+    b = pack_clients_source(src, ids, 8, max_batches=4, seed=0, round_idx=2)
+    for name in ("x", "y", "mask", "num_samples"):
+        np.testing.assert_array_equal(getattr(a, name), getattr(b, name))
+    # open_source sniffs the layout
+    assert isinstance(open_source(d), PackedNpySource)
+    src.close()
+
+
+def test_leaf_json_source_lazy(tmp_path):
+    # two shard files, ragged users — the LEAF layout files.py documents
+    rs = np.random.RandomState(0)
+    os.makedirs(tmp_path / "train")
+    os.makedirs(tmp_path / "test")
+    users, sizes = ["u0", "u1", "u2"], [7, 3, 5]
+    for fname, sel in (("a.json", [0, 1]), ("b.json", [2])):
+        blob = {"users": [users[i] for i in sel], "user_data": {}}
+        for i in sel:
+            blob["user_data"][users[i]] = {
+                "x": rs.randn(sizes[i], 6).round(3).tolist(),
+                "y": rs.randint(0, 3, sizes[i]).tolist()}
+        with open(tmp_path / "train" / fname, "w") as f:
+            json.dump(blob, f)
+    with open(tmp_path / "test" / "t.json", "w") as f:
+        json.dump({"users": ["u0"], "user_data": {
+            "u0": {"x": rs.randn(4, 6).round(3).tolist(),
+                   "y": rs.randint(0, 3, 4).tolist()}}}, f)
+    src = LeafJsonSource(str(tmp_path), (6,), 3)
+    np.testing.assert_array_equal(src.client_sizes, sizes)
+    x, y = src.client_rows(2)
+    assert x.shape == (5, 6) and y.shape == (5,)
+    assert src.test_x.shape == (4, 6)
+    assert isinstance(open_source(str(tmp_path), input_shape=(6,),
+                                  class_num=3), LeafJsonSource)
+
+
+def test_as_source_rejects_garbage():
+    with pytest.raises(TypeError):
+        as_source([1, 2, 3])
+
+
+# --------------------------------------------------------- engine identity
+def test_streamed_engine_bitwise_equals_materialized(fd, task, tmp_path):
+    c = cfg()
+    a = FedAvgAPI(fd, task, c)
+    for r in range(3):
+        a.run_round(r)
+    d = write_packed_npy(fd, str(tmp_path / "p"))
+    src = PackedNpySource(d)
+    b = FedAvgAPI(src, task, c)
+    for r in range(3):
+        b.run_round(r)
+    assert_trees_equal(_params(a), _params(b), "streamed != materialized")
+    # pipelined driver over the streamed source (prefetch thread reads
+    # through the source's lock) — still bitwise
+    p = FedAvgAPI(src, task, c, prefetch=2)
+    p.run_pipelined(0, 3)
+    assert_trees_equal(_params(a), _params(p), "streamed pipelined")
+    # eval runs off the materialized test split
+    ev = b.evaluate()
+    assert np.isfinite(float(ev["loss"]))
+    src.close()
+
+
+def test_streamed_refuses_device_planes(fd, task, tmp_path):
+    src = PackedNpySource(write_packed_npy(fd, str(tmp_path / "q")))
+    with pytest.raises(ValueError, match="streamed"):
+        FedAvgAPI(src, task, cfg(), device_data=True)
+    with pytest.raises(ValueError, match="streamed"):
+        FedAvgAPI(src, task, cfg(local_test_on_all_clients="on"))
+    api = FedAvgAPI(src, task, cfg())
+    with pytest.raises(ValueError, match="async"):
+        api.run_async(2, buffer_k=2)
+    src.close()
+
+
+def test_packed_npy_n_clients_cap(fd, tmp_path):
+    d = write_packed_npy(fd, str(tmp_path / "cap"))
+    src = PackedNpySource(d, n_clients=5)
+    assert src.num_clients == 5
+    full = PackedNpySource(d)
+    np.testing.assert_array_equal(src.client_sizes, full.client_sizes[:5])
+    ax, _ = src.client_rows(4)
+    bx, _ = full.client_rows(4)
+    np.testing.assert_array_equal(ax, bx)
+    assert isinstance(open_source(d, n_clients=5), PackedNpySource)
+    assert open_source(d, n_clients=5).num_clients == 5
+    src.close()
+    full.close()
+
+
+def test_synthetic_packed_population_fixture(tmp_path):
+    """The shared bench/ci fixture writer: labels must correlate with the
+    rows actually written (the planted linear map is recoverable)."""
+    from fedml_tpu.data.synthetic import synthetic_packed_population
+
+    d = synthetic_packed_population(str(tmp_path / "pop"), 300, dim=8,
+                                    num_classes=4, seed=0, test_rows=64)
+    src = PackedNpySource(d)
+    assert src.num_clients == 300 and src.source == "synthetic"
+    assert int(src.client_sizes.max()) == 96  # heavy tail present
+    # a least-squares readout of the planted map beats chance by a lot
+    xs, ys = [], []
+    for c in range(40):
+        x, y = src.client_rows(c)
+        xs.append(x)
+        ys.append(y)
+    X, Y = np.concatenate(xs), np.concatenate(ys)
+    onehot = np.eye(4)[Y]
+    W, *_ = np.linalg.lstsq(X, onehot, rcond=None)
+    acc = float((np.argmax(X @ W, 1) == Y).mean())
+    assert acc > 0.6, f"labels uncorrelated with stored rows (acc {acc})"
+    src.close()
+
+
+def test_size_weighted_sampling_uses_source_metadata(fd, task, tmp_path):
+    c = cfg(sampling="size_weighted")
+    a = FedAvgAPI(fd, task, c)
+    src = PackedNpySource(write_packed_npy(fd, str(tmp_path / "s")))
+    b = FedAvgAPI(src, task, c)
+    for r in range(2):
+        a.run_round(r)
+        b.run_round(r)
+    assert_trees_equal(_params(a), _params(b), "size_weighted streamed")
+    src.close()
+
+
+# ------------------------------------------------------ bucketing identity
+def test_bucket_assignment_oracle(fd, task):
+    api = FedAvgAPI(fd, task, cfg(), bucket_batches=True)
+    ladder = api._b_ladder
+    assert ladder == sorted(set(ladder)) and ladder[-1] == api.num_batches
+    assert len(ladder) <= 4
+    # oracle: smallest ladder rung >= need, never above the static budget
+    for need in range(0, api.num_batches + 1):
+        got = api._bucketed_B(need)
+        expect = min((b for b in ladder if b >= need),
+                     default=api.num_batches)
+        assert got == expect, (need, got, expect)
+    # padding accounting: a packed round's bucket covers the cohort's
+    # natural depth, and the pad fraction matches the numpy oracle
+    ids = api._sampled_ids(0)
+    cb = pack_clients(fd, ids, api.cfg.batch_size,
+                      max_batches=api.num_batches, seed=api.cfg.seed,
+                      round_idx=0)
+    b_needed = cb.num_batches
+    B = api._bucketed_B(b_needed)
+    assert B >= b_needed
+    used = np.ceil(cb.num_samples / api.cfg.batch_size).sum()
+    pad_frac = 1.0 - used / (len(ids) * B)
+    assert 0.0 <= pad_frac < 1.0
+
+
+def test_bucketing_on_equals_off_per_round_and_pipelined(fd, task):
+    c = cfg()
+    a = FedAvgAPI(fd, task, c)
+    for r in range(3):
+        a.run_round(r)
+    b = FedAvgAPI(fd, task, c, bucket_batches=True)
+    for r in range(3):
+        b.run_round(r)
+    assert_trees_equal(_params(a), _params(b), "bucketing per-round")
+    p = FedAvgAPI(fd, task, c, bucket_batches=True, prefetch=2)
+    p.run_pipelined(0, 3)
+    assert_trees_equal(_params(a), _params(p), "bucketing pipelined")
+
+
+def test_bucketing_on_equals_off_scan_block(fd, task):
+    c = cfg()
+    a = FedAvgAPI(fd, task, c, device_data=True)
+    a.run_rounds(0, 4)
+    b = FedAvgAPI(fd, task, c, device_data=True, bucket_batches=True)
+    b.run_rounds(0, 4)
+    assert_trees_equal(_params(a), _params(b), "bucketing scan-block")
+
+
+def test_bucketed_per_client_local_fit_bitwise(fd, task):
+    """The per-client half of the identity: the local-fit outputs for a
+    REAL client are bitwise the same whether its cohort was padded to the
+    bucket or to the global max (trailing masked batches are state
+    no-ops)."""
+    c = cfg()
+    api = FedAvgAPI(fd, task, c)
+    ids = api._sampled_ids(1)
+    cb_full = pack_clients(fd, ids, c.batch_size,
+                           max_batches=api.num_batches, seed=c.seed,
+                           round_idx=1)
+    from fedml_tpu.core.client_data import pad_batches
+
+    full = pad_batches(cb_full, api.num_batches)
+    bucket = pad_batches(cb_full, api._bucketed_B(cb_full.num_batches))
+    rng = jax.random.PRNGKey(7)
+    for k in range(len(ids)):
+        na, _ = api.local_update(rng, api.net, full.x[k], full.y[k],
+                                 full.mask[k])
+        nb, _ = api.local_update(rng, api.net, bucket.x[k], bucket.y[k],
+                                 bucket.mask[k])
+        assert_trees_equal([np.asarray(v) for v in jax.tree.leaves(na)],
+                           [np.asarray(v) for v in jax.tree.leaves(nb)],
+                           f"client slot {k}")
+
+
+# -------------------------------------------------------------- telemetry
+def test_pack_stats_and_dataset_source_ride_telemetry(fd, task, tmp_path):
+    tel = Telemetry()
+    src = PackedNpySource(write_packed_npy(fd, str(tmp_path / "t")))
+    api = FedAvgAPI(src, task, cfg(), bucket_batches=True, telemetry=tel)
+    api.train(2)
+    recs = tel.events.sink.records
+    hdr = [r for r in recs if r.get("kind") == "run"][0]
+    assert hdr["dataset_source"] == "synthetic"
+    rounds = [r for r in recs if r.get("kind") == "round"]
+    assert rounds
+    for r in rounds:
+        pk = r["pack"]
+        assert pk["bucket_B"] >= pk["b_needed"]
+        assert pk["bucket_B"] <= pk["budget_B"]
+        assert 0.0 <= pk["pad_frac"] < 1.0
+        assert pk["bytes"] > 0
+    src.close()
+
+
+def test_dataset_source_helper_verdicts(fd):
+    from fedml_tpu.data import dataset_source
+
+    assert dataset_source(fd) == "synthetic"  # synthetic_lr stand-in
+    real_like = synthetic_images(num_clients=2, image_shape=(4, 4, 1),
+                                 num_classes=2, samples_per_client=4,
+                                 test_samples=4, seed=0)
+    real_like.synthetic_fallback = False
+    assert dataset_source(real_like) == "real"
+    assert dataset_source(InMemorySource(fd)) == "synthetic"
