@@ -1,0 +1,38 @@
+"""fedlint — AST-based invariant checker for the jit/thread/wire discipline.
+
+Every scale PR in this repo shipped review fixes for the same recurring
+bug classes: unlocked shared state touched by background threads, wall
+clock or unseeded randomness leaking into replay-deterministic paths,
+host syncs and Python side effects inside jitted round programs, and
+ad-hoc metric/message-key strings drifting from their registries. FedJAX
+(arXiv:2108.02117) gets its simulation speed precisely from keeping
+per-client training a pure traced program, and the reference FedML paper
+(arXiv:2007.13518) ties reproducibility to a disciplined message/metric
+protocol layer. This package machine-checks those invariants so each new
+driver does not re-risk them by hand.
+
+Entry points:
+
+- ``scripts/fedlint.py`` — the CLI (text + ``--json`` blob, ``--baseline``,
+  bench_gate-style exit codes);
+- :func:`fedml_tpu.analysis.engine.run` — the library API tests drive;
+- ``fedml_tpu/analysis/rules.py`` — the rule catalogue (documented rule by
+  rule in docs/ANALYSIS.md).
+
+Suppression: ``# fedlint: disable=<rule>[,<rule>...] — <rationale>`` as a
+trailing comment silences that line; on a line of its own it silences the
+whole file. Grandfathered findings live in ``scripts/fedlint_baseline.json``
+(annotated; kept minimal).
+"""
+
+from fedml_tpu.analysis.engine import (  # noqa: F401
+    Finding,
+    RULES,
+    apply_baseline,
+    load_baseline,
+    make_baseline,
+    run,
+)
+
+# importing the catalogue registers every rule into RULES
+from fedml_tpu.analysis import rules as _rules  # noqa: F401  (registration)

@@ -1,0 +1,269 @@
+"""fedlint engine — module loading, rule registry, suppressions, baseline.
+
+Deliberately stdlib-only (ast/json/re/pathlib): the CLI must run in a bare
+interpreter as fast as pyflakes would, and the engine itself must never
+import the code it scans (a broken module must still be LINTABLE — the
+import gate is test_lint.py's job, not ours).
+
+The moving parts:
+
+- :class:`Module` — one parsed source file plus its suppression table;
+- :class:`Rule` — subclass, set ``name``/``description``, implement
+  ``check(module)`` yielding :class:`Finding`; register with ``@register``;
+- :func:`run` — scan paths, run rules, drop suppressed findings;
+- baseline — ``scripts/fedlint_baseline.json`` entries grandfather known
+  findings by (rule, path, message-substring), never by line number (lines
+  drift on every edit; messages only when the code actually changes). Each
+  entry must carry a ``why`` — an unannotated grandfather is a shape error.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Callable, Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    path: str  # posix path relative to the scan root
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+
+# a suppression directive: "fedlint: disable=rule-a,rule-b" followed by an
+# optional rationale (required by review convention; docs/ANALYSIS.md). The
+# directive must LEAD the comment — prose or doc examples that merely
+# mention the syntax mid-sentence must not suppress anything — and it is
+# matched against real COMMENT tokens, never raw source lines, so a string
+# literal containing the text (a fixture, a docstring example) is inert.
+_SUPPRESS_RE = re.compile(r"#+\s*fedlint:\s*disable=([A-Za-z0-9_,-]+)")
+
+
+class Module:
+    """One parsed source file handed to every rule.
+
+    ``path`` is posix-relative to the scan root, so path-scoped rules can
+    test directory membership (``module.in_dirs("core", "comm")``) the same
+    way against the live tree and against test fixtures.
+    """
+
+    def __init__(self, path: str, source: str, tree: ast.AST):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        # file-wide and per-line suppression tables, parsed once from real
+        # comment tokens (tokenize can reject what ast accepted only in
+        # exotic encodings — treat that as "no suppressions", never a crash)
+        self.file_suppressions: set[str] = set()
+        self.line_suppressions: dict[int, set[str]] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, SyntaxError, IndentationError):
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.match(tok.string)
+            if not m:
+                continue
+            rules = {r for r in m.group(1).split(",") if r}
+            row, col = tok.start
+            if self.lines[row - 1][:col].strip() == "":
+                # a comment line of its own suppresses the whole file
+                self.file_suppressions |= rules
+            else:  # trailing a statement: that line only
+                self.line_suppressions.setdefault(row, set()).update(rules)
+
+    @property
+    def parts(self) -> tuple[str, ...]:
+        return Path(self.path).parts
+
+    def in_dirs(self, *names: str) -> bool:
+        """True when any path segment (not the filename) matches a name."""
+        return bool(set(self.parts[:-1]) & set(names))
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        at = self.line_suppressions.get(line, ())
+        return rule in at or "all" in at
+
+    def finding(self, rule: "Rule | str", node: ast.AST | int,
+                message: str) -> Finding:
+        line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        name = rule if isinstance(rule, str) else rule.name
+        return Finding(path=self.path, line=line, rule=name, message=message)
+
+
+class Rule:
+    """Base class: subclasses set ``name``/``description`` and implement
+    ``check``. Rules are stateless — one instance serves every module."""
+
+    name: str = ""
+    description: str = ""
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    """Class decorator adding one instance to the process-wide registry."""
+    if not cls.name:
+        raise ValueError(f"rule {cls.__name__} has no name")
+    if cls.name in RULES and type(RULES[cls.name]) is not cls:
+        raise ValueError(f"duplicate rule name {cls.name!r}")
+    RULES[cls.name] = cls()
+    return cls
+
+
+# ---------------------------------------------------------------- scanning
+def iter_sources(paths: Iterable[Path], root: Path) -> Iterator[tuple[str, Path]]:
+    """(relative posix path, absolute path) for every .py under ``paths``.
+
+    Sorted for stable output. __pycache__ and hidden dirs are skipped —
+    judged on components BELOW each scan path only, so a repo cloned under
+    a dotted ancestor (~/.local/src/...) still scans (an ancestor the
+    caller explicitly pointed at is not ours to veto)."""
+    seen = set()
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            files = [(p, p.name)] if p.suffix == ".py" else []
+        else:
+            files = [(f, f.relative_to(p).as_posix())
+                     for f in sorted(p.rglob("*.py"))]
+        for f, below in files:
+            if any(part == "__pycache__" or part.startswith(".")
+                   for part in Path(below).parts):
+                continue
+            try:
+                rel = f.resolve().relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            if rel in seen:
+                continue
+            seen.add(rel)
+            yield rel, f
+
+
+def load_module(rel: str, abspath: Path) -> Module:
+    source = abspath.read_text(encoding="utf-8")
+    tree = ast.parse(source, filename=str(abspath))
+    return Module(rel, source, tree)
+
+
+def run(paths: Iterable[str | Path], root: str | Path | None = None,
+        rules: Iterable[str] | None = None,
+        on_error: Callable[[str, Exception], None] | None = None,
+        stats: dict | None = None) -> list[Finding]:
+    """Scan ``paths`` (files or directories) with ``rules`` (default: all
+    registered), returning unsuppressed findings sorted by location.
+
+    ``root`` anchors the relative paths findings and baselines use; it
+    defaults to the repo root guess (parent of the fedml_tpu package) so
+    baseline paths read ``fedml_tpu/comm/base.py``. A file that fails to
+    PARSE becomes a ``parse-error`` finding — an unparseable module must
+    fail the gate, not silently drop out of it. Pass ``stats={}`` to get
+    ``stats['files']`` — the count of files this very scan visited (the
+    CLI reports it; a second walk could disagree with what was linted)."""
+    root = Path(root) if root is not None else Path(__file__).parents[2]
+    active = [RULES[name] for name in rules] if rules is not None \
+        else list(RULES.values())
+    findings: list[Finding] = []
+    if stats is not None:
+        stats["files"] = 0
+    for rel, abspath in iter_sources([Path(p) for p in paths], root):
+        if stats is not None:
+            stats["files"] += 1
+        try:
+            module = load_module(rel, abspath)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            if on_error is not None:
+                on_error(rel, e)
+            findings.append(Finding(path=rel,
+                                    line=getattr(e, "lineno", 1) or 1,
+                                    rule="parse-error", message=str(e)))
+            continue
+        for rule in active:
+            for f in rule.check(module):
+                if not module.suppressed(f.rule, f.line):
+                    findings.append(f)
+    return sorted(findings)
+
+
+# ---------------------------------------------------------------- baseline
+def load_baseline(path: str | Path) -> list[dict]:
+    """Parse + validate a baseline file. Schema::
+
+        {"findings": [{"rule": ..., "path": ..., "contains": ...,
+                       "why": "<mandatory one-line rationale>"}, ...]}
+
+    ``contains`` is a substring of the finding message (line numbers are
+    deliberately not part of the key). A missing ``why`` is a ValueError:
+    the committed baseline stays annotated or it does not parse."""
+    with open(path) as f:
+        doc = json.load(f)
+    entries = doc.get("findings")
+    if not isinstance(entries, list):
+        raise ValueError(f"{path}: expected a 'findings' list")
+    for i, e in enumerate(entries):
+        for key in ("rule", "path", "contains", "why"):
+            if not isinstance(e.get(key), str) or not e[key].strip():
+                raise ValueError(
+                    f"{path}: findings[{i}] needs a non-empty {key!r} "
+                    "(every grandfathered entry must name its rule, path, "
+                    "a message substring, and why it is grandfathered)")
+    return entries
+
+
+def make_baseline(findings: Iterable[Finding],
+                  why: str = "TODO: annotate") -> dict:
+    """A baseline document grandfathering ``findings`` — the --write-baseline
+    starting point; each entry's ``why`` still needs a human sentence."""
+    return {"findings": [
+        {"rule": f.rule, "path": f.path, "contains": f.message, "why": why}
+        for f in sorted(set(findings))]}
+
+
+def apply_baseline(findings: list[Finding], entries: list[dict],
+                   ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """-> (new findings, grandfathered findings, stale entries).
+
+    A stale entry matches nothing — the debt it recorded was paid (or the
+    message changed, which means the code changed and deserves a fresh
+    look); the CLI reports staleness so the baseline shrinks over time
+    instead of accreting."""
+    new: list[Finding] = []
+    old: list[Finding] = []
+    used = [False] * len(entries)
+    for f in findings:
+        hit = False
+        for i, e in enumerate(entries):
+            if (e["rule"] == f.rule and e["path"] == f.path
+                    and e["contains"] in f.message):
+                used[i] = True
+                hit = True
+        (old if hit else new).append(f)
+    stale = [e for i, e in enumerate(entries) if not used[i]]
+    return new, old, stale

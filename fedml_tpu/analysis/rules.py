@@ -1,0 +1,617 @@
+"""The fedlint rule catalogue — one rule per recurring review-fix class.
+
+Each rule names the historical bug class that motivated it (full writeups
+in docs/ANALYSIS.md). Rules are AST-only and over-approximate on purpose:
+a linter that misses the next `_undeliverable` race is worthless, and the
+escape hatch for a justified exception is a one-line suppression comment
+with a rationale, not a looser rule.
+
+Shared machinery first (dotted-name resolution, jit-seam discovery), then
+the rules in the order docs/ANALYSIS.md documents them.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from fedml_tpu.analysis.engine import Finding, Module, Rule, register
+
+
+# --------------------------------------------------------------- ast helpers
+def dotted(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _call_names(tree: ast.AST) -> Iterator[tuple[str, ast.Call]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            if name is not None:
+                yield name, node
+
+
+def module_imports(module: Module) -> set[str]:
+    """Top-level module names imported anywhere in the file (``import x``,
+    ``import x.y``, ``from x.y import z`` all contribute 'x')."""
+    roots: set[str] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                roots.add(alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            roots.add(node.module.split(".")[0])
+    return roots
+
+
+# ------------------------------------------------------------ jit seam index
+# dotted names that turn their function argument (or decorated function)
+# into a traced program: Python side effects inside run at TRACE time only,
+# and host syncs inside force a device round-trip per call
+_JIT_WRAPPERS = frozenset({
+    "jit", "jax.jit", "pjit", "jax.pjit",
+})
+_TRACE_CALLS = frozenset(_JIT_WRAPPERS | {
+    "lax.scan", "jax.lax.scan", "scan",
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "pmap", "jax.pmap", "vmap", "jax.vmap",
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.while_loop", "jax.lax.while_loop",
+    "checkpoint", "jax.checkpoint", "jax.remat",
+})
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True when ``node`` evaluates to a tracing transform: ``jax.jit``,
+    ``partial(jax.jit, ...)``, or a call of either (decorator factories)."""
+    name = dotted(node)
+    if name in _JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted(node.func)
+        if fname in _JIT_WRAPPERS:
+            return True
+        if fname in ("partial", "functools.partial"):
+            return any(_is_jit_expr(a) for a in node.args)
+    return False
+
+
+def traced_functions(module: Module) -> list[ast.FunctionDef]:
+    """Function defs that become traced programs: decorated with (a partial
+    of) ``jax.jit``, or passed by name into a ``_TRACE_CALLS`` seam
+    (``jax.jit(step)``, ``lax.scan(body, ...)``, ``shard_map(f, ...)``).
+    Memoized per module — jit-purity and host-sync share the index."""
+    cached = getattr(module, "_traced_fns", None)
+    if cached is not None:
+        return cached
+    defs_by_name: dict[str, list[ast.FunctionDef]] = {}
+    traced: list[ast.FunctionDef] = []
+    seen: set[ast.FunctionDef] = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                if node not in seen:
+                    seen.add(node)
+                    traced.append(node)
+    for name, call in _call_names(module.tree):
+        if name in _TRACE_CALLS and call.args \
+                and isinstance(call.args[0], ast.Name):
+            for fn in defs_by_name.get(call.args[0].id, ()):
+                if fn not in seen:
+                    seen.add(fn)
+                    traced.append(fn)
+    module._traced_fns = traced  # type: ignore[attr-defined]
+    return traced
+
+
+# wall-clock reads (value depends on when, not what) and unseeded RNG draws
+_WALL_CLOCK = frozenset({
+    "time.time", "time.time_ns", "time.strftime", "time.ctime",
+    "time.localtime", "time.gmtime",
+})
+_DATETIME_READS = frozenset({"now", "utcnow", "today"})
+# np.random module-level callables that are SEEDED constructors, not draws
+# from the hidden global stream
+_NP_RANDOM_OK = frozenset({"RandomState", "Generator", "SeedSequence",
+                           "PCG64", "Philox", "MT19937", "BitGenerator"})
+_STDLIB_RANDOM_OK = frozenset({"Random", "SystemRandom"})
+
+
+def _clock_or_rng_violation(name: str, call: ast.Call,
+                            has_random_import: bool) -> str | None:
+    """Why ``name(...)`` breaks replay determinism, or None if it doesn't."""
+    parts = name.split(".")
+    if name in _WALL_CLOCK:
+        return f"wall-clock read {name}()"
+    if "datetime" in parts[:-1] and parts[-1] in _DATETIME_READS:
+        return f"wall-clock read {name}()"
+    if parts[:2] in (["np", "random"], ["numpy", "random"]) and len(parts) == 3:
+        if parts[2] == "default_rng":
+            seeded = bool(call.args) or any(kw.arg == "seed"
+                                            for kw in call.keywords)
+            return None if seeded else \
+                f"unseeded {name}() (pass an explicit seed)"
+        if parts[2] not in _NP_RANDOM_OK:
+            return (f"{name}() draws from numpy's hidden global stream "
+                    "(use a seeded RandomState/fold_in chain)")
+    if parts[0] == "random" and len(parts) == 2 and has_random_import \
+            and parts[1] not in _STDLIB_RANDOM_OK:
+        return (f"{name}() draws from the random module's hidden global "
+                "stream (use a seeded generator)")
+    return None
+
+
+# ===================================================================== rules
+@register
+class JitPurity(Rule):
+    """No Python side effects inside traced programs.
+
+    A ``self.X = ...`` or ``time.time()`` inside a jitted function runs
+    once at trace time and never again — the classic silently-wrong round
+    program (the PR-6 scan-block driver and every ``_dispatch_round`` seam
+    re-risk this on each refactor)."""
+
+    name = "jit-purity"
+    description = ("no self/global mutation or wall-clock/global-RNG reads "
+                   "inside functions handed to jax.jit / shard_map / "
+                   "lax.scan")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        has_random = "random" in module_imports(module)
+        for fn in traced_functions(module):
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for leaf in ast.walk(t):
+                            if isinstance(leaf, ast.Attribute) \
+                                    and isinstance(leaf.value, ast.Name) \
+                                    and leaf.value.id == "self" \
+                                    and isinstance(leaf.ctx, ast.Store):
+                                yield module.finding(self, node, (
+                                    f"traced function {fn.name!r} mutates "
+                                    f"self.{leaf.attr} — runs once at trace "
+                                    "time, then never again"))
+                elif isinstance(node, ast.Global):
+                    yield module.finding(self, node, (
+                        f"traced function {fn.name!r} declares "
+                        f"global {', '.join(node.names)} — trace-time "
+                        "side effect"))
+                elif isinstance(node, ast.Call):
+                    name = dotted(node.func)
+                    if name is None:
+                        continue
+                    why = _clock_or_rng_violation(name, node, has_random)
+                    if why is not None:
+                        yield module.finding(self, node, (
+                            f"traced function {fn.name!r}: {why} — value "
+                            "freezes at trace time"))
+
+
+@register
+class HostSync(Rule):
+    """No host syncs on traced values in hot-path modules.
+
+    ``float(x)`` / ``x.item()`` / ``np.asarray(x)`` inside a jitted
+    function blocks on the device per call — the dispatch-pipeline killer
+    the PR-6/PR-7 drivers kept out of the round program by review."""
+
+    name = "host-sync"
+    description = ("no float()/int()/bool()/.item()/np.asarray on traced "
+                   "values inside jitted code in core/, algorithms/, "
+                   "distributed/")
+
+    _CASTS = frozenset({"float", "int", "bool"})
+    _MATERIALIZE = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                              "numpy.array", "jax.device_get",
+                              "onp.asarray", "onp.array"})
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.in_dirs("core", "algorithms", "distributed"):
+            return
+        for fn in traced_functions(module):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                if name in self._CASTS and len(node.args) == 1 \
+                        and not isinstance(node.args[0], ast.Constant):
+                    yield module.finding(self, node, (
+                        f"traced function {fn.name!r} host-syncs via "
+                        f"{name}(...) — forces a device round-trip per "
+                        "call (keep it in jnp, or sync outside the jit)"))
+                elif name in self._MATERIALIZE:
+                    yield module.finding(self, node, (
+                        f"traced function {fn.name!r} materializes a "
+                        f"device value via {name}(...) — host transfer "
+                        "inside the traced program"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" and not node.args:
+                    yield module.finding(self, node, (
+                        f"traced function {fn.name!r} host-syncs via "
+                        ".item() — forces a device round-trip per call"))
+
+
+# ---------------------------------------------------------- lock discipline
+_LOCKISH = frozenset({"lock", "rlock", "mutex", "cond", "condition", "cv",
+                      "sem", "semaphore"})
+
+
+def _is_lock_ctx(expr: ast.AST) -> bool:
+    """Whole-word match on the dotted name's snake/dot segments: _rx_lock,
+    round_lock, Lock, _cv, _cond all qualify; recv_stream must not (``cv``
+    inside ``recv``) and block_ctx must not (``lock`` inside ``block``)."""
+    name = dotted(expr.func if isinstance(expr, ast.Call) else expr)
+    if name is None:
+        return False
+    segments = name.lower().replace(".", "_").split("_")
+    return bool(_LOCKISH & set(segments))
+
+
+class _MethodFacts(ast.NodeVisitor):
+    """Per-method: self-attr writes (with guarded flag), self-method calls
+    (with guarded flag). 'Guarded' = lexically inside ``with self._lock:``
+    (any context-manager whose dotted name mentions lock/mutex/cond)."""
+
+    def __init__(self) -> None:
+        self.writes: list[tuple[str, int, bool]] = []
+        self.calls: list[tuple[str, bool]] = []
+        self._depth = 0
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_is_lock_ctx(item.context_expr) for item in node.items)
+        self._depth += locked
+        self.generic_visit(node)
+        self._depth -= locked
+
+    def _record_target(self, t: ast.AST, lineno: int) -> None:
+        for leaf in ast.walk(t):
+            if isinstance(leaf, ast.Attribute) \
+                    and isinstance(leaf.value, ast.Name) \
+                    and leaf.value.id == "self" \
+                    and isinstance(leaf.ctx, ast.Store):
+                self.writes.append((leaf.attr, lineno, self._depth > 0))
+            elif isinstance(leaf, ast.Subscript):
+                base = dotted(leaf.value)
+                if base is not None and base.startswith("self."):
+                    self.writes.append((base.split(".")[1], lineno,
+                                        self._depth > 0))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_target(t, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name is not None and name.startswith("self.") \
+                and name.count(".") == 1:
+            self.calls.append((name.split(".")[1], self._depth > 0))
+        self.generic_visit(node)
+
+
+@register
+class LockDiscipline(Rule):
+    """Shared attributes touched by a background thread must be written
+    under a lock.
+
+    The `_undeliverable` race, the gRPC channel-cache reconnect race, and
+    the MemorySink append race were all this shape: a class starts a
+    ``threading.Thread`` on one of its methods and some OTHER method
+    mutates the same attribute with no ``with self._lock:`` around either
+    side."""
+
+    name = "lock-discipline"
+    description = ("attributes written both by a thread-target method and "
+                   "elsewhere in the class must be written under "
+                   "'with self.<lock>:'")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                yield from self._check_class(module, cls)
+
+    def _check_class(self, module: Module,
+                     cls: ast.ClassDef) -> Iterator[Finding]:
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        targets: set[str] = set()
+        for name, call in _call_names(cls):
+            if name.split(".")[-1] != "Thread":
+                continue
+            for kw in call.keywords:
+                if kw.arg == "target" and isinstance(kw.value, ast.Attribute) \
+                        and isinstance(kw.value.value, ast.Name) \
+                        and kw.value.value.id == "self":
+                    targets.add(kw.value.attr)
+        targets &= set(methods)
+        if not targets:
+            return
+
+        facts = {}
+        for name, fn in methods.items():
+            v = _MethodFacts()
+            v.visit(fn)
+            facts[name] = v
+
+        def closure(entries: set[str]) -> set[str]:
+            out, frontier = set(entries), list(entries)
+            while frontier:
+                for callee, _ in facts[frontier.pop()].calls:
+                    if callee in methods and callee not in out:
+                        out.add(callee)
+                        frontier.append(callee)
+            return out
+
+        # thread side: the targets plus every self-method reachable from
+        # them; main side: everything reachable from the non-thread entry
+        # points. A shared helper (reachable from BOTH) counts its writes
+        # on both sides — that is exactly how the `_undeliverable`-shape
+        # race hides behind an innocent-looking helper.
+        thread_set = closure(targets)
+        main_set = closure(set(methods) - thread_set - {"__init__"})
+
+        # a method is lock-held when EVERY call site already holds the lock
+        # (the 'caller holds self._lock' docstring convention) — its writes
+        # then count as guarded. any() would let one guarded call site
+        # whitelist the helper's writes at an unguarded one, which is
+        # exactly the race shape this rule exists to catch.
+        sites: dict[str, list[bool]] = {}
+        for f in facts.values():
+            for callee, g in f.calls:
+                sites.setdefault(callee, []).append(g)
+        lock_held = {m for m in methods if sites.get(m) and all(sites[m])}
+
+        def write_sites(names: set[str]) -> dict[str, list[tuple[int, bool]]]:
+            out: dict[str, list[tuple[int, bool]]] = {}
+            for m in names:
+                if m == "__init__":
+                    continue  # pre-thread construction is single-threaded
+                for attr, line, guarded in facts[m].writes:
+                    out.setdefault(attr, []).append(
+                        (line, guarded or m in lock_held))
+            return out
+
+        by_thread = write_sites(thread_set)
+        by_main = write_sites(main_set)
+        shared = set(by_thread) & set(by_main)
+        for attr in sorted(shared):
+            # a shared helper contributes the same site to both maps: dedup
+            for line, guarded in sorted(set(by_thread[attr] + by_main[attr])):
+                if not guarded:
+                    yield module.finding(self, line, (
+                        f"class {cls.name}: self.{attr} is written by "
+                        f"thread target(s) {sorted(targets)} AND other "
+                        "methods, but this write holds no lock (wrap in "
+                        "'with self._lock:')"))
+
+
+@register
+class Determinism(Rule):
+    """Replay-deterministic paths take no wall-clock or hidden-RNG input.
+
+    The PR-2 replay contract: every chaos/comm/core decision derives from
+    seeds via sha256/fold_in chains (monotonic DURATION reads,
+    time.perf_counter/monotonic, are fine — they never steer replayed
+    decisions)."""
+
+    name = "determinism"
+    description = ("no wall-clock reads or unseeded np.random/random calls "
+                   "in core/, chaos/, comm/")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.in_dirs("core", "chaos", "comm"):
+            return
+        has_random = "random" in module_imports(module)
+        for name, call in _call_names(module.tree):
+            why = _clock_or_rng_violation(name, call, has_random)
+            if why is not None:
+                yield module.finding(self, call, (
+                    f"{why} in a replay-deterministic module (derive from "
+                    "the seed via sha256/fold_in, or take a clock "
+                    "parameter)"))
+
+
+@register
+class MetricDiscipline(Rule):
+    """Metric family names are literal and namespaced.
+
+    An f-string family name silently forks a new time series per format
+    value (unbounded cardinality) and drifts from the exporters' expected
+    vocabulary; names outside fed_/comm_ vanish from the dashboards and
+    the bench-gate blobs (the PR-8/PR-10 review rule)."""
+
+    name = "metric-discipline"
+    description = ("registry.counter/gauge/histogram family names must be "
+                   "string literals with a fed_/comm_ prefix")
+
+    _KINDS = frozenset({"counter", "gauge", "histogram"})
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._KINDS):
+                continue
+            recv = dotted(node.func.value)
+            if recv is None \
+                    or recv.split(".")[-1].lstrip("_").lower() != "registry":
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.JoinedStr):
+                yield module.finding(self, node, (
+                    "f-string metric family name — unbounded label-free "
+                    "cardinality; make the family a fed_/comm_ literal and "
+                    "put the variable part in a label"))
+            elif not isinstance(arg, ast.Constant):
+                yield module.finding(self, node, (
+                    "non-literal metric family name — exporters and the "
+                    "bench gate can only track literal fed_/comm_ "
+                    "families"))
+            elif not (isinstance(arg.value, str)
+                      and arg.value.startswith(("fed_", "comm_"))):
+                yield module.finding(self, node, (
+                    f"metric family {arg.value!r} lacks the fed_/comm_ "
+                    "namespace prefix"))
+
+
+@register
+class WireKeys(Rule):
+    """Message param keys come from the message_define vocabulary.
+
+    A literal key on ``add_params`` drifts from the registered handler
+    vocabulary the moment one side is renamed — the cross-protocol decode
+    table and the LOSSY_EXEMPT contract only protect keys they know
+    about."""
+
+    name = "wire-keys"
+    description = ("Message.add_params keys must be message_define "
+                   "MSG_ARG_KEY_* constants; LOSSY_EXEMPT keys must stay "
+                   "in the _KNOWN_ARRAY_KEYS decode table")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "add_params" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str) \
+                    and not node.args[0].value.startswith("__"):
+                yield module.finding(self, node, (
+                    f"literal wire key {node.args[0].value!r} on "
+                    "add_params — use the message_define MSG_ARG_KEY_* "
+                    "constant so handlers, the decode table, and "
+                    "LOSSY_EXEMPT stay one vocabulary"))
+        yield from self._check_lossy_table(module)
+
+    def _check_lossy_table(self, module: Module) -> Iterator[Finding]:
+        """Inside the file that defines both: every LOSSY_EXEMPT key must
+        appear in the _KNOWN_ARRAY_KEYS decode table, so a key exempted
+        from lossy re-encoding is also decodable from interop frames."""
+        exempt: tuple[ast.AST, set[str]] | None = None
+        known: set[str] | None = None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            tname = t.id if isinstance(t, ast.Name) else \
+                t.attr if isinstance(t, ast.Attribute) else None
+            if tname == "LOSSY_EXEMPT":
+                keys = {e.value for e in ast.walk(node.value)
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+                exempt = (node, keys)
+            elif tname == "_KNOWN_ARRAY_KEYS" \
+                    and isinstance(node.value, ast.Dict):
+                known = {k.value for k in node.value.keys
+                         if isinstance(k, ast.Constant)
+                         and isinstance(k.value, str)}
+        if exempt is not None and known is not None:
+            node, keys = exempt
+            for key in sorted(keys - known):
+                yield module.finding(self, node, (
+                    f"LOSSY_EXEMPT key {key!r} is missing from the "
+                    "_KNOWN_ARRAY_KEYS decode table — interop json frames "
+                    "would hand handlers nested lists for it"))
+
+
+@register
+class ExceptSwallow(Rule):
+    """Comm dispatch, chaos injection, and obs sink failures are counted
+    or logged, never silently dropped.
+
+    A swallowed handler error turns protocol bugs into eternal hangs (the
+    ``_notify`` re-raise rationale); a swallowed sink error silently
+    stops telemetry. Bare ``except:`` additionally eats KeyboardInterrupt
+    and SystemExit."""
+
+    name = "except-swallow"
+    description = ("no bare except, and no 'except Exception' that neither "
+                   "logs nor counts, in comm/, chaos/, obs/")
+
+    _EVIDENCE = ("log", "warn", "error", "exception", "debug", "info",
+                 "record", "inc", "observe", "emit", "count", "print",
+                 "fail")
+    _BROAD = frozenset({"Exception", "BaseException"})
+
+    def _is_broad(self, type_node: ast.AST | None) -> bool:
+        if type_node is None:
+            return True
+        names = [dotted(type_node)] if not isinstance(type_node, ast.Tuple) \
+            else [dotted(e) for e in type_node.elts]
+        return any(n is not None and n.split(".")[-1] in self._BROAD
+                   for n in names)
+
+    def _handles(self, handler: ast.ExceptHandler) -> bool:
+        """True when the handler visibly does something with the failure:
+        re-raises, or calls anything that looks like logging/metrics."""
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name is not None and any(tok in name.lower()
+                                            for tok in self._EVIDENCE):
+                    return True
+        return False
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not module.in_dirs("comm", "chaos", "obs"):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield module.finding(self, node, (
+                    "bare 'except:' — eats KeyboardInterrupt/SystemExit; "
+                    "catch a concrete type (and log or count the drop)"))
+            elif self._is_broad(node.type) and not self._handles(node):
+                yield module.finding(self, node, (
+                    "'except Exception' swallows the failure silently — "
+                    "dispatch/chaos/sink paths must log or count every "
+                    "absorbed error (docs/ANALYSIS.md §except-swallow)"))
+
+
+@register
+class NoBarePrint(Rule):
+    """Library code routes output through logging or the obs EventLog.
+
+    Telemetry must be structured and capturable, not interleaved with
+    stdout; the only legitimate bare prints are CLI entry points whose
+    stdout IS their interface, which suppress file-wide with a rationale
+    (migrated from tests/test_lint.py's walker)."""
+
+    name = "no-bare-print"
+    description = ("no bare print() in library code — use logging or the "
+                   "obs EventLog (CLIs suppress file-wide)")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                yield module.finding(self, node, (
+                    "bare print() in library code (route telemetry "
+                    "through fedml_tpu.obs.EventLog or logging, or "
+                    "suppress file-wide for a stdout-interface CLI)"))

@@ -328,7 +328,12 @@ class ChaosCommManager:
         try:
             msg = Message.from_bytes(data)
             return int(msg.get_sender_id()), msg.get_params()
-        except Exception:
+        except Exception:  # noqa: BLE001 — expected under sender-side chaos
+            # quiet by design (the integrity check downstream counts the
+            # frame), but never invisible: a peek failing for a NON-chaos
+            # reason (protocol drift, framing bug) must be diagnosable
+            log.debug("chaos: peek failed on a %d-byte frame (proceeding "
+                      "to the integrity check)", len(data), exc_info=True)
             return None, None
 
     def _recv_hook(self, data: bytes) -> None:

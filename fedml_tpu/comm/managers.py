@@ -81,6 +81,11 @@ class DistributedManager(Observer):
         self.com_manager.add_observer(self)
         self._handlers: dict[str, Callable] = {}
         self.timeout_s = timeout_s
+        # written by the dispatch thread (receive_message) AND the watchdog
+        # thread (_watch's rate-limit reset) — both sides go through
+        # _rx_lock so an idle-age read can never interleave with a refresh
+        # (the fedlint lock-discipline rule pins this)
+        self._rx_lock = threading.Lock()
         self._last_rx = time.monotonic()
         self._finished = threading.Event()
         self.register_message_receive_handlers()
@@ -93,7 +98,8 @@ class DistributedManager(Observer):
         self._handlers[msg_type] = handler
 
     def receive_message(self, msg_type: str, msg_params) -> None:
-        self._last_rx = time.monotonic()
+        with self._rx_lock:
+            self._last_rx = time.monotonic()
         handler = self._handlers.get(msg_type)
         if handler is None:
             log.warning("rank %d: no handler for msg_type=%s", self.rank, msg_type)
@@ -119,10 +125,15 @@ class DistributedManager(Observer):
             # periodic liveness refresh: heartbeat-age gauges keep growing
             # while the link is silent — exactly when the watchdog watches
             _obs.refresh_liveness()
-            idle = time.monotonic() - self._last_rx
-            if idle > self.timeout_s:
-                self._last_rx = time.monotonic()  # rate-limit the callback
-                self.on_timeout(idle)
+            with self._rx_lock:
+                idle = time.monotonic() - self._last_rx
+                if idle > self.timeout_s:
+                    self._last_rx = time.monotonic()  # rate-limit the callback
+                else:
+                    idle = None
+            if idle is not None:  # callback outside the lock: a handler
+                self.on_timeout(idle)  # calling receive_message must not
+                # deadlock against its own watchdog
 
     def send_message(self, message: Message) -> None:
         self.com_manager.send_message(message)

@@ -611,7 +611,10 @@ def main(argv=None):
                 "chaos: %d faults injected %s", len(plan.ledger),
                 plan.ledger.counts())
     if args.rank == 0:
-        print(json.dumps(mgr.aggregator.history, default=float))
+        # stdout IS this CLI's interface: the launching script parses the
+        # final eval-history JSON from it (the one legitimate bare print
+        # in the package — everything else routes through logging/EventLog)
+        print(json.dumps(mgr.aggregator.history, default=float))  # fedlint: disable=no-bare-print
 
 
 if __name__ == "__main__":

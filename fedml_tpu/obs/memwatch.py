@@ -61,12 +61,15 @@ def device_memory_stats() -> dict[str, dict]:
 
         devices = jax.local_devices()
     except Exception:  # noqa: BLE001 — no jax / backend not up: no stats
+        log.debug("device memory stats unavailable (no jax backend)",
+                  exc_info=True)
         return {}
     out: dict[str, dict] = {}
     for d in devices:
         try:
             stats = d.memory_stats()
         except Exception:  # noqa: BLE001 — per-device probe is best-effort
+            log.debug("memory_stats probe failed on %s", d, exc_info=True)
             stats = None
         if not stats:
             continue

@@ -25,6 +25,13 @@ export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 
 if [ "$TIER" = "smoke" ]; then
+  echo "== fedlint static gate (AST invariants: jit/thread/wire discipline, docs/ANALYSIS.md) =="
+  # fails the build on any NEW finding (committed grandfathered debt lives
+  # annotated in scripts/fedlint_baseline.json); the --json blob is the
+  # bench_gate-compatible artifact future CI can diff across commits
+  mkdir -p ./tmp
+  python scripts/fedlint.py --baseline scripts/fedlint_baseline.json \
+    --json ./tmp/ci_fedlint_blob.json
   echo "== smoke tier (every engine oracle, minimal shapes) =="
   python -m pytest tests/ -q -m smoke
   echo "== tracing + live-health smoke (2-round loopback sim; mid-run /metrics + /healthz scrape; span-schema + Chrome-trace validation) =="

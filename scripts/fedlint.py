@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""fedlint — project-specific AST invariant checker (CI static gate).
+
+Checks the jit/thread/wire discipline the scale PRs enforced by hand:
+jit-purity, host-sync, lock-discipline, determinism, metric-discipline,
+wire-keys, except-swallow, no-bare-print (rule writeups with the
+historical bug behind each: docs/ANALYSIS.md).
+
+    python scripts/fedlint.py                      # scan fedml_tpu/
+    python scripts/fedlint.py --baseline scripts/fedlint_baseline.json
+    python scripts/fedlint.py --json fedlint.json  # bench_gate-style blob
+    python scripts/fedlint.py --select determinism,wire-keys fedml_tpu/comm
+
+Exit 0 = clean (modulo baseline); exit 1 = new findings; exit 2 =
+usage/shape error — the same contract as scripts/bench_gate.py, so CI
+treats both gates identically. The --json blob carries a
+``metric``/``value`` headline (``fedlint_new_findings``), so bench_gate.py
+can diff finding counts across commits:
+
+    python scripts/fedlint.py --json fedlint.json || true
+    python scripts/bench_gate.py fedlint.json --gate my_gate.json
+
+Suppress a single line with ``# fedlint: disable=<rule> — <why>``; a
+comment on its own line suppresses the file. Grandfathered findings live
+in scripts/fedlint_baseline.json (annotated ``why`` per entry; stale
+entries are reported so the baseline shrinks, never accretes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from fedml_tpu.analysis import (  # noqa: E402
+    RULES, apply_baseline, load_baseline, make_baseline, run)
+
+
+def blob(new, old, stale, files_scanned: int) -> dict:
+    """bench_gate-compatible JSON: metric/value headline + side fields."""
+    per_rule: dict[str, int] = {}
+    for f in new:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return {
+        "metric": "fedlint_new_findings",
+        "value": len(new),
+        "unit": "findings",
+        "fedlint_total_findings": len(new) + len(old),
+        "fedlint_baselined": len(old),
+        "fedlint_stale_baseline_entries": len(stale),
+        "files_scanned": files_scanned,
+        "per_rule": dict(sorted(per_rule.items())),
+        "findings": [f.to_json() for f in new],
+        "baselined": [f.to_json() for f in old],
+        "stale_baseline": stale,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        "fedlint", description="AST invariant checker (docs/ANALYSIS.md)")
+    p.add_argument("paths", nargs="*",
+                   default=[os.path.join(REPO, "fedml_tpu")],
+                   help="files/dirs to scan (default: the fedml_tpu "
+                        "package)")
+    p.add_argument("--baseline", metavar="PATH",
+                   help="grandfather findings listed in this annotated "
+                        "JSON file (scripts/fedlint_baseline.json in CI)")
+    p.add_argument("--json", metavar="PATH", dest="json_out",
+                   help="write a bench_gate-style JSON blob ('-' = stdout)")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule subset (see --list-rules)")
+    p.add_argument("--write-baseline", metavar="PATH",
+                   help="write the current findings as a baseline skeleton "
+                        "(each entry's 'why' still needs a human sentence) "
+                        "and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalogue and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress per-finding lines (summary + exit code "
+                        "only)")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for name in sorted(RULES):
+            print(f"{name:20s} {RULES[name].description}")
+        return 0
+
+    rules = None
+    if args.select:
+        rules = [r for r in args.select.split(",") if r]
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"fedlint: unknown rule(s) {unknown} "
+                  f"(known: {sorted(RULES)})", file=sys.stderr)
+            return 2
+
+    stats: dict = {}
+    try:
+        findings = run(args.paths, root=REPO, rules=rules, stats=stats)
+        entries = load_baseline(args.baseline) if args.baseline else []
+    except (OSError, ValueError, json.JSONDecodeError, KeyError) as e:
+        print(f"fedlint: {e}", file=sys.stderr)
+        return 2
+    files_scanned = stats["files"]
+
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump(make_baseline(findings), f, indent=2)
+            f.write("\n")
+        print(f"fedlint: wrote {len(findings)} baseline entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to "
+              f"{args.write_baseline} (annotate each 'why' before "
+              "committing)")
+        return 0
+
+    new, old, stale = apply_baseline(findings, entries)
+
+    if args.json_out:
+        doc = blob(new, old, stale, files_scanned)
+        if args.json_out == "-":
+            json.dump(doc, sys.stdout, indent=2)
+            print()
+        else:
+            with open(args.json_out, "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"stale baseline entry (fix shipped? message drifted?): "
+                  f"[{e['rule']}] {e['path']}: {e['contains']!r}")
+    summary = (f"fedlint: {len(new)} new finding"
+               f"{'' if len(new) == 1 else 's'} "
+               f"({len(old)} baselined, {len(stale)} stale baseline "
+               f"entr{'y' if len(stale) == 1 else 'ies'}, "
+               f"{files_scanned} files)")
+    if new:
+        print(summary, file=sys.stderr)
+        return 1
+    print(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -132,6 +132,9 @@ _SMOKE_TESTS = {
     "test_streaming.py::test_bucketing_on_equals_off_per_round_and_pipelined",
     "test_hierarchy_tiers.py::test_pairwise_sum_block_composition_property",
     "test_hierarchy_tiers.py::test_tree_equals_flat_loopback_bitwise",
+    # round-12 addition: the fedlint static gate (docs/ANALYSIS.md) — the
+    # live tree stays clean modulo the committed annotated baseline
+    "test_fedlint.py::test_live_tree_clean_modulo_baseline",
 }
 
 

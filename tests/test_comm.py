@@ -338,6 +338,35 @@ def test_manager_watchdog_fires():
     t.join(timeout=5)
 
 
+def test_manager_watchdog_quiet_under_concurrent_traffic():
+    """Regression for the `_last_rx` watchdog race (fedlint lock-discipline
+    finding, PR 12): the dispatch-side refresh and the watchdog's
+    read-then-reset now interleave through `_rx_lock`, so inbound traffic
+    faster than timeout_s keeps on_timeout quiet — and neither thread
+    deadlocks against the other."""
+    fired = threading.Event()
+
+    class Watched(ServerManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler("tick", lambda params: None)
+
+        def on_timeout(self, idle_s):
+            fired.set()
+
+    mgr = Watched(rank=0, size=1, backend="LOOPBACK", timeout_s=0.4,
+                  job_id="t-watch-quiet")
+    t = threading.Thread(target=mgr.run, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 1.5
+    while time.monotonic() < deadline:  # ~4 timeout windows of traffic
+        mgr.receive_message("tick", {})  # the dispatch-thread entry point
+        time.sleep(0.05)
+    assert not fired.is_set(), \
+        "watchdog fired despite traffic faster than timeout_s"
+    mgr.finish()
+    t.join(timeout=5)
+
+
 # --------------------------------------------- distributed == standalone
 @pytest.fixture(scope="module")
 def lr_setup():
