@@ -61,8 +61,12 @@ def _attack_seed(seed: int, rule_idx: int, rank: int, round_idx: int) -> int:
 @dataclass
 class AdversaryRule:
     """One (attack, round-window, rank set) schedule entry. ``ranks`` are
-    1-based worker ranks (standalone slot = rank - 1); ``rounds`` is a
-    half-open ``[lo, hi)`` window (None = every round). ``factor``
+    1-based COHORT ranks (standalone slot = rank - 1, which in the flat
+    cross-process topology is also the transport rank; in a 2-tier
+    ``--edges`` topology each worker matches by its cohort slot + 1 — the
+    client manager's ``adversary_rank`` — so ONE plan drives flat and
+    tree runs identically, quarantine-ledger parity included); ``rounds``
+    is a half-open ``[lo, hi)`` window (None = every round). ``factor``
     parameterizes sign_flip/scale, ``sigma`` gaussian, ``z`` shift."""
 
     attack: str

@@ -91,11 +91,17 @@ class BaseCommManager(abc.ABC):
         eff = (frame_codec if upd is None
                else str(upd) if frame_codec == "none"
                else f"{upd}+{frame_codec}")
-        try:
-            direction = ("uplink" if int(msg.get_receiver_id()) == 0
-                         else "downlink")
-        except (TypeError, ValueError, KeyError):
-            direction = "downlink"  # interop peers with exotic ids
+        # protocol frames with a registered override (e2s_evidence /
+        # s2e_verdict — the cross-tier robust control plane) are accounted
+        # under their own direction label so their byte budget is
+        # separable from the update-frame traffic they exist to bound
+        direction = _obs.direction_override(msg.get_type())
+        if direction is None:
+            try:
+                direction = ("uplink" if int(msg.get_receiver_id()) == 0
+                             else "downlink")
+            except (TypeError, ValueError, KeyError):
+                direction = "downlink"  # interop peers with exotic ids
         _obs.record_wire_bytes(eff, direction, len(frame))
         return frame
 

@@ -65,8 +65,13 @@ from fedml_tpu.utils.tree import tree_weighted_mean
 # when an encoded uplink's payload is structurally garbage — a chaos
 # bit-flip that survived CRC, a truncated deflate stream — and the upload
 # never reaches the stacked aggregate at all (docs/PERFORMANCE.md §Wire
-# efficiency). Appended AFTER the in-graph codes so 0..3 stay stable.
-REASONS = ("ok", "nonfinite", "norm_outlier", "suspected", "undecodable")
+# efficiency). 'edge_lost' is ledger-only too: the hierarchical root
+# records it for every cohort slot of an edge block whose partial never
+# arrived (crashed/partitioned edge rank — the round degrades to an
+# elastic zero-term partial, docs/ROBUSTNESS.md §Cross-tier robust
+# gating). Appended AFTER the in-graph codes so 0..3 stay stable.
+REASONS = ("ok", "nonfinite", "norm_outlier", "suspected", "undecodable",
+           "edge_lost")
 REASON_OK, REASON_NONFINITE, REASON_NORM_OUTLIER, REASON_SUSPECTED = range(4)
 
 # sanitation default: reject ||update|| > 4x the weighted-median norm.
@@ -175,6 +180,21 @@ def krum_scores(stacked, weights, f: int):
     return jnp.where(valid, score, jnp.inf)
 
 
+def _krum_suspected(score, valid, f: int):
+    """The ``f`` worst-scoring VALID slots (ties broken by slot order) —
+    the aggregator-level attribution the quarantine ledger records.
+    Invalid slots sort LAST in the from-worst order (+inf) so a
+    gate-rejected slot is never re-reported as krum-suspected. Shared by
+    the stacked estimator and the evidence-phase verdict estimator so the
+    two ledgers cannot drift."""
+    if f <= 0:
+        return jnp.zeros(score.shape, bool)
+    rank_from_worst = jnp.argsort(jnp.argsort(
+        jnp.where(valid, -score, jnp.inf)))
+    return valid & (rank_from_worst < jnp.minimum(
+        f, jnp.sum(valid.astype(jnp.int32))))
+
+
 def krum(stacked, weights, f: int, m: int = 1):
     """(Multi-)Krum: ``m=1`` returns the single client minimizing the Krum
     score; ``m>1`` sample-weight-averages the ``m`` best-scoring clients.
@@ -195,18 +215,7 @@ def krum(stacked, weights, f: int, m: int = 1):
         sel_w = jnp.where(jnp.isfinite(score[sel]), w[sel], 0.0)
         sel_tree = jax.tree.map(lambda x: jnp.take(x, sel, axis=0), stacked)
         agg = tree_weighted_mean(sel_tree, sel_w)
-    # suspected = the f highest finite scores (ties broken by slot order);
-    # with no f budget nothing is suspected. Invalid slots sort LAST in
-    # the from-worst order (+inf) so a gate-rejected slot is never
-    # re-reported as krum-suspected.
-    if f > 0:
-        rank_from_worst = jnp.argsort(jnp.argsort(
-            jnp.where(valid, -score, jnp.inf)))
-        suspected = valid & (rank_from_worst < jnp.minimum(
-            f, jnp.sum(valid.astype(jnp.int32))))
-    else:
-        suspected = jnp.zeros((k,), bool)
-    return agg, {"suspected": suspected}
+    return agg, {"suspected": _krum_suspected(score, valid, f)}
 
 
 def geometric_median(stacked, weights, iters: int = 8, eps: float = 1e-8):
@@ -327,9 +336,11 @@ def nonfinite_gate(stacked, global_tree, weights):
     """The per-slot half of :func:`sanitize_updates` — non-finite
     rejection only. Verdicts depend on nothing but the slot itself, so an
     edge aggregator gating its OWN children reaches exactly the verdicts
-    a flat server would for those slots (the norm-outlier rule is a
-    cohort statistic and is deliberately NOT available across tiers —
-    docs/ROBUSTNESS.md §Hierarchical tiers)."""
+    a flat server would for those slots. This is the SINGLE-PHASE tree
+    mode's whole defense; the cohort statistics (norm-outlier rule,
+    robust estimators) compose across tiers via the two-phase
+    evidence/verdict protocol instead (docs/ROBUSTNESS.md §Cross-tier
+    robust gating)."""
     w = jnp.asarray(weights, jnp.float32)
     k = w.shape[0]
     finite = jnp.ones((k,), bool)
@@ -368,7 +379,298 @@ def combine_edge_partials(partial_stack, totals, global_tree):
     return pairwise_finalize(wsum, total, global_tree), total
 
 
+# ----------------------------------------- two-phase robust (evidence/verdict)
+# The cross-tier protocol (docs/ROBUSTNESS.md §Cross-tier robust gating):
+# once aggregation is distributed over edge tiers to keep root fan-in
+# bounded, any defense that needs the full stacked cohort at one rank
+# re-creates the very bottleneck the tree removed (the Smart-NIC lesson,
+# arXiv:2307.06561). The split below keeps the DATA at the edges and
+# moves only VERDICT-SUFFICIENT evidence to the root:
+#
+#   phase 1  update_evidence   per-slot sanitation evidence — update norm,
+#            (edge-local)      non-finite flag, and a fixed-size chunked-
+#                              Rademacher sketch of the flattened update
+#                              (sign-masked bucket sums: a count-sketch
+#                              whose pairwise distances estimate the full-
+#                              vector ones). Every operation is a per-row
+#                              reduction, so edge-computed evidence is
+#                              bitwise what a flat server would compute
+#                              for the same slots.
+#   phase 2  evidence_verdicts cohort-global math at ONE rank (the root,
+#            (root)            or a flat server): the sanitation gate's
+#                              norm-median rule (gate_verdicts — the SAME
+#                              scalar half sanitize_updates runs) plus an
+#                              estimator-selection pass over the sketches,
+#                              emitting per-slot VERDICT WEIGHTS + reason
+#                              codes.
+#   phase 3  apply_verdicts    survivor fold: rejected/unselected slots
+#            (edge-local)      are replaced by the global model and carry
+#                              zero weight (the PR-4 survivor-reweighting
+#                              rule), survivors fold with the canonical
+#                              pairwise association — so an edge tier's
+#                              block partials combine to the flat result
+#                              bit for bit (pairwise_sum composition).
+#
+# Estimator selection (make_verdict_estimator) recasts each PR-4
+# aggregator as a per-slot weighting over the evidence — the tiered form:
+#   mean            gate-surviving sample weights (the weighted mean);
+#   krum            the slot minimizing the Krum score over SKETCH
+#                   distances, verdict weight 1.0 (x * 1.0 / 1.0 is
+#                   exact, so the winner's update survives bitwise);
+#   multi_krum      sample weights on the m best-scoring slots;
+#   median          the weighted MEDOID over sketches — the slot
+#                   minimizing the weighted sum of distances to the
+#                   others (the selection form of the median; an exact
+#                   coordinate-wise median needs the full cohort at one
+#                   rank, which is the bottleneck this protocol exists to
+#                   avoid);
+#   trimmed_mean    winsorized interval weights over the DISTANCE-TO-
+#                   CENTER order (the farthest 2*trim of total weight is
+#                   trimmed — both coordinate "ends" collapse to large
+#                   distance in update space);
+#   geometric_median  a fixed-iteration Weiszfeld loop in sketch space;
+#                   the verdict weights are the final iteration's
+#                   ``w_k / max(d_k, eps)`` reweighting, so the full-
+#                   space fold IS the smoothed-L1 estimate driven by
+#                   sketch distances.
+#
+# A flat run opts into the identical composition via
+# ``gated_aggregate(verdict_fn=...)`` (the cross-process aggregator's
+# ``sum_assoc='pairwise'`` + ``aggregator=``), which is what makes
+# tree ≡ flat bitwise — model bits AND ledger — for every estimator.
+
+EVIDENCE_SKETCH_DIM = 64  # f32 scalars per client the sketch budget ships
+_SKETCH_SEED = 0x5EDC0FFE  # fixed: both runtimes must draw the same signs
+
+
+def update_sketch(stacked, global_tree, sketch_dim: int = EVIDENCE_SKETCH_DIM):
+    """``[K, sketch_dim]`` chunked-Rademacher sketch of the flattened
+    updates ``u_k = s_k - g``: coordinates are sign-flipped by a fixed
+    seeded ±1 pattern and summed in ``sketch_dim`` contiguous buckets.
+    Distance-preserving in expectation (the one-hash count-sketch), and —
+    unlike a dense Gaussian projection — computed with per-row elementwise
+    ops and trailing-axis reductions only, so an edge's block sketch is
+    bitwise the flat cohort's rows. Non-finite entries are masked to zero
+    (those slots are already dead at the gate)."""
+    if sketch_dim <= 0:
+        # sketchless mode (the mean/sanitize-only verdict estimator reads
+        # no distances): ship zero evidence bytes instead of dead payload
+        k = jax.tree.leaves(stacked)[0].shape[0]
+        return jnp.zeros((k, 0), jnp.float32)
+    rows = []
+    for s, g in zip(jax.tree.leaves(stacked), jax.tree.leaves(global_tree)):
+        d = s.astype(jnp.float32) - g.astype(jnp.float32)[None]
+        d = jnp.where(jnp.isfinite(d), d, 0.0)
+        rows.append(d.reshape(d.shape[0], -1))
+    flat = jnp.concatenate(rows, axis=1)
+    k, dsz = flat.shape
+    chunk = -(-dsz // sketch_dim)  # ceil: bucket width
+    pad = sketch_dim * chunk - dsz
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.zeros((k, pad), jnp.float32)], axis=1)
+    signs = jax.random.rademacher(
+        jax.random.PRNGKey(_SKETCH_SEED), (sketch_dim * chunk,),
+        jnp.float32)
+    return (flat * signs[None, :]).reshape(k, sketch_dim, chunk).sum(axis=-1)
+
+
+def update_evidence(stacked, global_tree, weights,
+                    sketch_dim: int = EVIDENCE_SKETCH_DIM):
+    """Phase 1: the per-slot evidence dict an edge forwards in ONE compact
+    ``e2s_evidence`` frame — ``sketch_dim + 3`` scalars per client
+    (norm, finite, weight, sketch row), never the updates themselves."""
+    finite, norm = _slot_evidence(stacked, global_tree)
+    return {"norm": norm, "finite": finite,
+            "sketch": update_sketch(stacked, global_tree, sketch_dim),
+            "weight": jnp.asarray(weights, jnp.float32)}
+
+
+def make_verdict_estimator(name: str, n: int, f: int | None = None,
+                           trim: float | None = None, m: int | None = None,
+                           iters: int = 8):
+    """Build the evidence-phase estimator ``fn(sketch, gate_w) ->
+    (verdict_weights, suspected)`` for aggregator ``name`` over ``n``
+    cohort slots — the tiered form of :func:`make_robust_aggregator`
+    (same budget defaults and validation: ``f`` defaults to ``(n-3)//2``,
+    krum needs ``n >= 2f+3``, ``trim`` defaults to ``max(f/n, 0.1)``)."""
+    if name not in AGGREGATORS:
+        raise ValueError(f"unknown aggregator {name!r} (one of {AGGREGATORS})")
+    if f is None:
+        f = max((n - 3) // 2, 0)
+    if not 0 <= f < n:
+        raise ValueError(f"f={f} must be in [0, {n})")
+
+    if name == "mean":
+        return lambda sk, w: (w, None)
+
+    if name in ("krum", "multi_krum"):
+        if n < 2 * f + 3:
+            raise ValueError(f"krum needs n >= 2f+3 (n={n}, f={f})")
+        mm = 1 if name == "krum" else (max(n - f - 2, 1) if m is None
+                                       else int(m))
+
+        def krum_verdicts(sk, w):
+            score = krum_scores([sk], w, f)
+            valid = jnp.isfinite(score)
+            if mm <= 1:
+                # weight EXACTLY 1.0 on the winner: x * 1.0 / 1.0 is
+                # bitwise x, so single-krum's take-the-winner semantics
+                # survive the weighted fold; an all-invalid cohort keeps
+                # zero weight everywhere (the global-model fallback)
+                vw = jnp.zeros_like(w).at[jnp.argmin(score)].set(1.0)
+                vw = jnp.where(jnp.any(valid), vw, 0.0)
+            else:
+                # bound by the REALIZED slot count, not the construction-n:
+                # a flat elastic round stacks only the arrived uploads and
+                # top_k refuses k > minor dim
+                _, sel = jax.lax.top_k(-score, min(mm, score.shape[0]))
+                selected = jnp.zeros((score.shape[0],), bool).at[sel].set(True)
+                vw = jnp.where(selected & valid, w, 0.0)
+            return vw, _krum_suspected(score, valid, f)
+
+        return krum_verdicts
+
+    if name == "median":
+        def medoid_verdicts(sk, w):
+            # the weighted MEDOID: argmin_i sum_j w_j ||sk_i - sk_j||
+            valid = w > 0
+            sq = jnp.sum(sk * sk, axis=1)
+            d2 = jnp.maximum(sq[:, None] + sq[None, :] - 2.0 * (sk @ sk.T),
+                             0.0)
+            cost = jnp.sqrt(d2) @ jnp.where(valid, w, 0.0)
+            cost = jnp.where(valid, cost, jnp.inf)
+            vw = jnp.zeros_like(w).at[jnp.argmin(cost)].set(1.0)
+            return jnp.where(jnp.any(valid), vw, 0.0), None
+
+        return medoid_verdicts
+
+    if name == "trimmed_mean":
+        t = max(f / n, 0.1) if trim is None else trim
+        if not 0.0 <= t < 0.5:
+            raise ValueError(f"trim must be in [0, 0.5), got {t}")
+
+        def trimmed_verdicts(sk, w):
+            # winsorized interval weights over the distance-to-center
+            # order: the farthest 2*trim of total weight is trimmed (both
+            # per-coordinate "ends" collapse to large update-space
+            # distance); boundary slots keep their fractional width, the
+            # same clipped-interval rule weighted_trimmed_mean applies
+            total = jnp.sum(w)
+            center = (w @ sk) / jnp.maximum(total, 1e-12)
+            dist = jnp.sqrt(jnp.sum((sk - center[None, :]) ** 2, axis=1))
+            dist = jnp.where(w > 0, dist, jnp.inf)
+            order = jnp.argsort(dist)
+            ws = w[order]
+            cum = jnp.cumsum(ws)
+            hi = (1.0 - 2.0 * t) * total
+            eff = jnp.clip(jnp.minimum(cum, hi) - (cum - ws), 0.0, None)
+            return jnp.zeros_like(w).at[order].set(eff), None
+
+        return trimmed_verdicts
+
+    def weiszfeld_verdicts(sk, w):
+        z0 = (w @ sk) / jnp.maximum(jnp.sum(w), 1e-12)
+
+        def step(_, z):
+            d = jnp.sqrt(jnp.sum((sk - z[None, :]) ** 2, axis=1))
+            beta = w / jnp.maximum(d, 1e-8)
+            return (beta @ sk) / jnp.maximum(jnp.sum(beta), 1e-12)
+
+        # iters-1 refinement steps, then the final reweighting BECOMES the
+        # verdict: the fold sum(beta_k u_k)/sum(beta_k) is exactly the
+        # last Weiszfeld iterate, lifted to full update space
+        z = jax.lax.fori_loop(0, max(iters - 1, 0), step, z0)
+        d = jnp.sqrt(jnp.sum((sk - z[None, :]) ** 2, axis=1))
+        return w / jnp.maximum(d, 1e-8), None
+
+    return weiszfeld_verdicts
+
+
+def evidence_verdicts(evidence, verdict_fn, norm_mult: float | None = None):
+    """Phase 2 — the ONE cohort-global verdict composition (the root runs
+    it over gathered edge evidence, a flat server over its own): gate
+    (``gate_verdicts`` — the exact scalar half of ``sanitize_updates``,
+    so the ledgers agree by construction) -> estimator selection ->
+    merge ``suspected`` into the gate's reasons (gate reasons win).
+    Returns ``(verdict_weights, reasons)``, both ``[K]``."""
+    w = jnp.asarray(evidence["weight"], jnp.float32)
+    mult = float("inf") if norm_mult is None else norm_mult
+    _, gate_w, reasons = gate_verdicts(
+        jnp.asarray(evidence["norm"], jnp.float32),
+        jnp.asarray(evidence["finite"], bool), w, mult)
+    vw, suspected = verdict_fn(
+        jnp.asarray(evidence["sketch"], jnp.float32), gate_w)
+    if suspected is not None:
+        reasons = jnp.where((reasons == REASON_OK) & suspected,
+                            REASON_SUSPECTED, reasons)
+    return vw, reasons
+
+
+def apply_verdicts(stacked, global_tree, vweights):
+    """Phase 3 — the survivor fold an edge runs over its block (and a flat
+    server over the whole cohort): zero-verdict slots are REPLACED by the
+    global model (a NaN under a zero weight would still poison ``0 * nan``)
+    and fold as exact zero terms; survivors fold with the canonical
+    pairwise association. Returns ``(wsum_tree, total_weight)`` — the same
+    partial shape the single-phase ``edge_partial`` ships, so the root's
+    ``combine_edge_partials`` serves both protocols."""
+    vw = jnp.asarray(vweights, jnp.float32)
+    keep = vw > 0
+    clean = jax.tree.map(
+        lambda s, g: jnp.where(
+            keep.reshape((keep.shape[0],) + (1,) * (s.ndim - 1)),
+            s, jnp.broadcast_to(g[None], s.shape).astype(s.dtype)),
+        stacked, global_tree)
+    return pairwise_weighted_stats(clean, vw)
+
+
 # ------------------------------------------------------------------ gate
+def _slot_evidence(stacked, global_tree):
+    """Per-slot sanitation evidence over the full tree: ``(finite, norm)``
+    where ``finite[k]`` is the all-leaves-finite flag and ``norm[k]`` is
+    ``||u_k - g||`` with non-finite entries masked out of the sum. Every
+    operation is a PER-ROW reduction (trailing axes only), so the values
+    are bitwise independent of how many slots share the leading axis —
+    which is what lets an edge aggregator compute its block's evidence
+    locally and a flat server compute the whole cohort's, and the two
+    agree slot-for-slot (docs/ROBUSTNESS.md §Cross-tier robust gating)."""
+    k = jax.tree.leaves(stacked)[0].shape[0]
+    finite = jnp.ones((k,), bool)
+    norm_sq = jnp.zeros((k,), jnp.float32)
+    for s, g in zip(jax.tree.leaves(stacked), jax.tree.leaves(global_tree)):
+        axes = tuple(range(1, s.ndim))
+        finite &= jnp.all(jnp.isfinite(s), axis=axes)
+        d = (s.astype(jnp.float32)
+             - g.astype(jnp.float32)[None])
+        # non-finite entries would NaN the norm; they are already
+        # rejected by the finite flag, so mask them out of the sum
+        norm_sq += jnp.sum(jnp.where(jnp.isfinite(d), d, 0.0) ** 2,
+                           axis=axes)
+    return finite, jnp.sqrt(norm_sq)
+
+
+def gate_verdicts(norm, finite, weights, norm_mult: float):
+    """The cohort-global scalar half of :func:`sanitize_updates`: given
+    per-slot evidence (``norm``, ``finite``) and weights, decide
+    ``(replace, new_weights, reasons)``. Factored out so the hierarchical
+    root can run EXACTLY the flat gate's math over evidence gathered from
+    edges — the two ledgers agree by construction, not by parallel
+    implementations."""
+    w = jnp.asarray(weights, jnp.float32)
+    # unweighted median of the finite, participating slots' norms (one
+    # vote per client — see the sanitize_updates docstring)
+    med_w = (finite & (w > 0)).astype(jnp.float32)
+    med = weighted_median(norm, med_w)
+    outlier = finite & (w > 0) & (norm > norm_mult * jnp.maximum(med, 1e-12))
+    replace = ~finite | outlier
+    reasons = jnp.where(~finite, REASON_NONFINITE,
+                        jnp.where(outlier, REASON_NORM_OUTLIER, REASON_OK))
+    reasons = jnp.where(w > 0, reasons, REASON_OK).astype(jnp.int32)
+    new_w = jnp.where(replace, 0.0, w)
+    return replace, new_w, reasons
+
+
 def sanitize_updates(stacked, global_tree, weights,
                      norm_mult: float = DEFAULT_NORM_MULT):
     """The sanitation gate, in-graph: per slot decide ok / nonfinite /
@@ -397,36 +699,13 @@ def sanitize_updates(stacked, global_tree, weights,
     ``norm_mult=inf`` disables the norm rule but keeps the non-finite one.
     """
     w = jnp.asarray(weights, jnp.float32)
-    k = w.shape[0]
-
-    finite = jnp.ones((k,), bool)
-    norm_sq = jnp.zeros((k,), jnp.float32)
-    for s, g in zip(jax.tree.leaves(stacked), jax.tree.leaves(global_tree)):
-        axes = tuple(range(1, s.ndim))
-        finite &= jnp.all(jnp.isfinite(s), axis=axes)
-        d = (s.astype(jnp.float32)
-             - g.astype(jnp.float32)[None])
-        # non-finite entries would NaN the norm; they are already
-        # rejected by the finite flag, so mask them out of the sum
-        norm_sq += jnp.sum(jnp.where(jnp.isfinite(d), d, 0.0) ** 2,
-                           axis=axes)
-    norm = jnp.sqrt(norm_sq)
-
-    # unweighted median of the finite, participating slots' norms (one
-    # vote per client — see the docstring's breakdown note)
-    med_w = (finite & (w > 0)).astype(jnp.float32)
-    med = weighted_median(norm, med_w)
-    outlier = finite & (w > 0) & (norm > norm_mult * jnp.maximum(med, 1e-12))
+    finite, norm = _slot_evidence(stacked, global_tree)
 
     # value replacement covers EVERY non-finite/outlier slot (even
     # zero-weight padding — a stray NaN there would still poison sorts and
     # pairwise distances); the REPORTED reasons cover only participating
     # (w > 0) slots, so padding never shows up in the ledger.
-    replace = ~finite | outlier
-    reasons = jnp.where(~finite, REASON_NONFINITE,
-                        jnp.where(outlier, REASON_NORM_OUTLIER, REASON_OK))
-    reasons = jnp.where(w > 0, reasons, REASON_OK).astype(jnp.int32)
-    new_w = jnp.where(replace, 0.0, w)
+    replace, new_w, reasons = gate_verdicts(norm, finite, w, norm_mult)
     clean = jax.tree.map(
         lambda s, g: jnp.where(_wshape(replace, s),
                                jnp.broadcast_to(g[None], s.shape)
@@ -437,7 +716,8 @@ def sanitize_updates(stacked, global_tree, weights,
 
 def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
                     norm_mult: float | None = None, reshard_fn=None,
-                    pairwise: bool = False):
+                    pairwise: bool = False, verdict_fn=None,
+                    sketch_dim: int = EVIDENCE_SKETCH_DIM):
     """The full verdict composition, jittable, defined ONCE for both
     runtimes (their quarantine ledgers must agree entry-for-entry, so the
     composition rule must not exist in two dialects):
@@ -460,16 +740,34 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
     ``pairwise`` replaces the weighted-mean estimator's tensordot with
     the canonical balanced-binary association (see :func:`pairwise_sum`)
     — the flat twin of a hierarchical edge tier, bitwise-comparable to
-    any 2-tier topology over the same cohort. Mean only: robust
-    estimators need the full stack and have no tiered form.
+    any 2-tier topology over the same cohort. Mean only; ROBUST
+    estimators get their tiered form via ``verdict_fn`` instead.
+
+    ``verdict_fn`` (from :func:`make_verdict_estimator`) switches to the
+    two-phase composition — update_evidence -> evidence_verdicts ->
+    apply_verdicts -> pairwise_finalize — the flat twin of the cross-tier
+    robust protocol (docs/ROBUSTNESS.md §Cross-tier robust gating): a
+    flat run with ``verdict_fn`` is bitwise a 2-tier robust run over the
+    same cohort, model bits AND reason codes. The gate arms through the
+    same ``norm_mult``; ``robust_fn``/``pairwise`` must stay unset (one
+    composition per call).
 
     Returns ``(avg_tree, surviving_weights, reasons)``; ``reasons`` is
     None only when the gate is off AND the estimator reported nothing.
     """
     if pairwise and robust_fn is not None:
         raise ValueError("pairwise association is the weighted-mean "
-                         "contract — robust estimators need the full "
-                         "stacked cohort (no tiered form)")
+                         "contract — robust estimators' tiered form is "
+                         "verdict_fn (make_verdict_estimator)")
+    if verdict_fn is not None:
+        if robust_fn is not None or pairwise:
+            raise ValueError("verdict_fn IS the two-phase composition — "
+                             "it does not stack with robust_fn/pairwise")
+        ev = update_evidence(stacked, global_tree, weights,
+                             sketch_dim=sketch_dim)
+        vw, reasons = evidence_verdicts(ev, verdict_fn, norm_mult=norm_mult)
+        wsum, total = apply_verdicts(stacked, global_tree, vw)
+        return pairwise_finalize(wsum, total, global_tree), vw, reasons
     w = jnp.asarray(weights, jnp.float32)
     reasons = None
     agg_in = stacked

@@ -144,16 +144,27 @@ class FedAvgAggregator:
         # edge topology over the same cohort (docs/ROBUSTNESS.md
         # §Hierarchical tiers). 'auto' (default) keeps the historical
         # association, so every existing bitwise contract is untouched.
+        # pairwise + a robust estimator = the TWO-PHASE composition
+        # (evidence -> verdicts -> survivor fold, robust_agg.make_verdict_
+        # estimator): the flat twin of cross-tier robust gating, bitwise-
+        # comparable with a 2-tier robust run over the same cohort
+        # (docs/ROBUSTNESS.md §Cross-tier robust gating). The 'auto'
+        # robust path keeps the full-stack estimators untouched.
         if sum_assoc not in ("auto", "pairwise"):
             raise ValueError(f"sum_assoc={sum_assoc!r} "
                              "(expected 'auto' or 'pairwise')")
         self.sum_assoc = sum_assoc
-        if sum_assoc == "pairwise" and robust is not None:
-            raise ValueError("sum_assoc='pairwise' is the weighted-mean "
-                             "contract; robust estimators keep 'auto'")
-        self._gagg = jax.jit(partial(gated_aggregate, robust_fn=robust,
-                                     norm_mult=mult,
-                                     pairwise=sum_assoc == "pairwise"))
+        verdict_fn = None
+        if sum_assoc == "pairwise" and aggregator is not None:
+            from fedml_tpu.core.robust_agg import make_verdict_estimator
+
+            verdict_fn = make_verdict_estimator(
+                aggregator, n=worker_num, **(aggregator_params or {}))
+            robust = None
+        self._gagg = jax.jit(partial(
+            gated_aggregate, robust_fn=robust, norm_mult=mult,
+            verdict_fn=verdict_fn,
+            pairwise=sum_assoc == "pairwise" and verdict_fn is None))
         self.quarantine = QuarantineLedger()
         # Mesh-sharded server state on the cross-process server (the
         # standalone engine's shard_server_state, wired to the wire path):

@@ -137,13 +137,15 @@ def run_simulated(
     if edges:
         # hierarchical 2-tier topology (distributed/fedavg/hierarchy.py,
         # docs/ROBUSTNESS.md §Hierarchical tiers): 1 root + E edge
-        # aggregator ranks + W workers; root fan-in is O(edges). The
-        # modes below are not wired through the edge tier — the dense
-        # synchronous protocol is the tree contract.
+        # aggregator ranks + W workers; root fan-in is O(edges).
+        # ``aggregator=``/``sanitize=`` arm the two-phase cross-tier
+        # robust protocol (§Cross-tier robust gating) — every PR-4
+        # defense composes with the tree. The modes below are not wired
+        # through the edge tier — the dense synchronous protocol is the
+        # tree contract.
         unsupported = {
             "sparsify_ratio": sparsify_ratio, "update_codec": update_codec,
             "delta_broadcast": delta_broadcast or None,
-            "aggregator": aggregator, "sanitize": sanitize or None,
             "async_buffer_k": async_buffer_k,
             "shard_server_state": shard_server_state or None,
             "heartbeat_max_age_s": heartbeat_max_age_s,
@@ -165,7 +167,8 @@ def run_simulated(
             broker_port=broker_port, ckpt_dir=ckpt_dir,
             telemetry=telemetry, chaos_plan=chaos_plan,
             round_timeout_s=round_timeout_s, adversary_plan=adversary_plan,
-            warmup=warmup)
+            warmup=warmup, aggregator=aggregator,
+            aggregator_params=aggregator_params, sanitize=sanitize)
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos

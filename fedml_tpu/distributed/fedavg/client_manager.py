@@ -28,7 +28,8 @@ class FedAvgClientManager(ClientManager):
                  backend="LOOPBACK", sparsify_ratio: float | None = None,
                  adversary_plan=None, async_uplink: bool = True,
                  update_codec: str | None = None,
-                 error_feedback: bool = True, server_rank: int = 0, **kw):
+                 error_feedback: bool = True, server_rank: int = 0,
+                 adversary_rank: int | None = None, **kw):
         self.trainer = trainer
         self.round_idx = 0
         # where uploads go: rank 0 (the flat root) by default; in a 2-tier
@@ -51,8 +52,15 @@ class FedAvgClientManager(ClientManager):
         # local fit and BEFORE packing/sparsification — the Byzantine
         # client lies on the wire, so every server-side defense (clip,
         # sanitation gate, robust aggregator) sees exactly what a real
-        # attacker would send
+        # attacker would send. ``adversary_rank`` is the 1-based COHORT
+        # rank the plan's schedule matches (default: this transport rank
+        # — the flat topology's identity); in a 2-tier topology workers
+        # sit at transport ranks E+1..E+W but play cohort slots 0..W-1,
+        # so the hierarchy launcher passes slot + 1 and ONE plan drives a
+        # flat and a tree run identically (ledger parity included)
         self.adversary_plan = adversary_plan
+        self.adversary_rank = int(adversary_rank) if adversary_rank \
+            is not None else int(rank)
         # top-k sparsified uplinks with per-rank error feedback
         # (comm/sparse.py); None = dense protocol. Validate HERE so a bad
         # ratio fails at launch, not inside the receive-loop handler after
@@ -174,7 +182,7 @@ class FedAvgClientManager(ClientManager):
 
             wire_leaves = perturb_leaves(
                 self.adversary_plan, wire_leaves, global_leaves,
-                self.rank, self.round_idx)
+                self.adversary_rank, self.round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank,
                       self.server_rank)
         with span("pack"):

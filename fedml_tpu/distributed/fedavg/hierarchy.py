@@ -30,9 +30,27 @@ pairwise aggregate over the same cohort — model bits AND quarantine
 ledger (a flat run opts into the same association with
 ``sum_assoc='pairwise'``; test- and ci.sh-enforced). Sample weights ride
 the partials unscaled, so elastic partial rounds stay sample-weight
-exact. The norm-outlier gate and robust estimators need the full stacked
-cohort and are refused in tree mode (docs/ROBUSTNESS.md §Hierarchical
-tiers).
+exact.
+
+**Two-phase cross-tier robust gating** (docs/ROBUSTNESS.md §Cross-tier
+robust gating): with ``aggregator=``/``sanitize=`` armed, every PR-4
+defense composes with the tree. The edge computes per-client sanitation
+EVIDENCE locally (update norms, non-finite flags, a fixed-size
+count-sketch of the flattened update — ``robust_agg.update_evidence``)
+and forwards one compact ``e2s_evidence`` frame while HOLDING the
+staged, still-unaggregated uploads; the root runs the cohort-global
+gate + estimator selection over the gathered evidence
+(``evidence_verdicts`` — the same math a flat two-phase server runs,
+which is what makes ledger parity exact) and answers each edge with a
+per-slot ``s2e_verdict`` frame; the edge then pairwise-sums ONLY the
+survivors (zero-weight replaced-by-global slots — the PR-4 survivor-
+reweighting rule) and forwards one ordinary partial. Steady root
+ingress stays O(edges) update frames; only O(cohort) scalar evidence
+ever reaches the root (measured: ``comm_bytes_total{direction=
+evidence|verdict}``). A crashed/partitioned edge inside
+``round_timeout_s`` degrades to an elastic zero-term partial with its
+whole block ledgered ``edge_lost``; verdict frames are retried/deduped
+under chaos like any FMT2 frame.
 
 Chaos (comm-manager wrap), telemetry (comm counters per link) and
 tracing (root round traces cover the edge tier — its direct children)
@@ -52,13 +70,31 @@ import numpy as np
 
 from fedml_tpu.comm.managers import DistributedManager
 from fedml_tpu.comm.message import Message
-from fedml_tpu.core.robust_agg import combine_edge_partials, edge_partial
+from fedml_tpu.core.robust_agg import (
+    EVIDENCE_SKETCH_DIM,
+    apply_verdicts,
+    combine_edge_partials,
+    edge_partial,
+    evidence_verdicts,
+    make_verdict_estimator,
+    update_evidence,
+)
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
+from fedml_tpu.obs import comm_instrument as _obs
 from fedml_tpu.obs import perf_instrument as _perf
 
 log = logging.getLogger("fedml_tpu.distributed.hierarchy")
+
+# the cross-tier control plane's bytes are separable from the update
+# traffic they exist to bound: comm_bytes_total{direction=evidence} must
+# stay within the documented per-client scalar budget (the sketch row +
+# norm/finite/weight), and {direction=verdict} within per-slot f32+i32
+_obs.register_direction_override(
+    MyMessage.MSG_TYPE_E2S_SEND_EVIDENCE_TO_SERVER, "evidence")
+_obs.register_direction_override(
+    MyMessage.MSG_TYPE_S2E_SEND_VERDICT_TO_EDGE, "verdict")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,9 +152,19 @@ class HierFedAvgAggregator(FedAvgAggregator):
     """Root-side aggregator over EDGE partials: slots are edges, not
     workers; ``aggregate()`` pairwise-folds the staged (wsum, weight)
     pairs and divides once. Quarantine verdicts arrive pre-attributed by
-    cohort slot, so the ledger matches a flat run entry-for-entry."""
+    cohort slot, so the ledger matches a flat run entry-for-entry.
 
-    def __init__(self, dataset, task, cfg, topology: EdgeTopology):
+    ``aggregator=``/``sanitize=`` arm the two-phase cross-tier robust
+    protocol (module docstring): this class then owns the phase-2 verdict
+    computation — the jitted ``evidence_verdicts`` over the cohort
+    evidence the server manager gathers — with the SAME estimator budget
+    defaults as the flat ``FedAvgAggregator``."""
+
+    def __init__(self, dataset, task, cfg, topology: EdgeTopology,
+                 aggregator: str | None = None,
+                 aggregator_params: dict | None = None,
+                 sanitize: bool | float | None = None,
+                 sketch_dim: int = EVIDENCE_SKETCH_DIM):
         if cfg.client_num_per_round != topology.workers:
             raise ValueError(
                 f"client_num_per_round={cfg.client_num_per_round} != "
@@ -130,12 +176,40 @@ class HierFedAvgAggregator(FedAvgAggregator):
         self._edge_meta: dict[int, tuple] = {}
         self.fanin_history: list[int] = []
         self._combine = jax.jit(combine_edge_partials)
+        # two-phase robust gating: same sanitize semantics as the flat
+        # aggregator (None = armed iff a robust estimator is; the
+        # non-finite rejection is unconditional either way — in plain
+        # tree mode it runs at the edges, in robust mode at the gate)
+        if sanitize is None:
+            sanitize = aggregator is not None
+        self.robust_mode = bool(aggregator is not None or sanitize)
+        # the mean/sanitize-only verdict estimator reads no distances —
+        # edges ship zero sketch bytes (norm/finite/weight only)
+        self.sketch_dim = int(sketch_dim) if aggregator is not None else 0
+        self._verdict_jit = None
+        self.last_round_rejected: list[int] | None = None
+        if self.robust_mode:
+            from fedml_tpu.core.robust_agg import DEFAULT_NORM_MULT
+
+            mult = (float("inf") if sanitize is False
+                    else DEFAULT_NORM_MULT if sanitize is True
+                    else float(sanitize))
+            est = make_verdict_estimator(
+                aggregator or "mean", n=topology.workers,
+                **(aggregator_params or {}))
+            self._verdict_jit = jax.jit(partial(
+                evidence_verdicts, verdict_fn=est, norm_mult=mult))
 
     def add_edge_result(self, edge_idx: int, wsum_leaves, wtotal: float,
                         reasons, slots, clients,
-                        round_idx: int | None = None) -> None:
+                        round_idx: int | None = None,
+                        samples: float | None = None) -> None:
         """Slot one edge's pre-aggregated uplink (the e2s_agg frame).
-        Same stale/unknown rejection semantics as the per-worker path."""
+        Same stale/unknown rejection semantics as the per-worker path.
+        ``wtotal`` is the FOLD total (the division's denominator half —
+        verdict-weight mass under two-phase gating); ``samples`` the raw
+        client-reported mass for telemetry (defaults to ``wtotal`` for
+        frames from pre-cross-tier edges)."""
         if edge_idx not in self.flag_client_model_uploaded:
             from fedml_tpu.obs import comm_instrument as _obs
 
@@ -152,9 +226,10 @@ class HierFedAvgAggregator(FedAvgAggregator):
                         edge_idx, round_idx, self.current_round)
             return
         self.model_dict[edge_idx] = self._stage_upload(list(wsum_leaves))
-        self.sample_num_dict[edge_idx] = float(wtotal)
+        self.sample_num_dict[edge_idx] = float(
+            wtotal if samples is None else samples)
         self._edge_meta[edge_idx] = (
-            np.asarray(reasons, np.int32),
+            float(wtotal), np.asarray(reasons, np.int32),
             [int(s) for s in slots], [int(c) for c in clients])
         self.flag_client_model_uploaded[edge_idx] = True
 
@@ -169,11 +244,39 @@ class HierFedAvgAggregator(FedAvgAggregator):
             log.warning("round %d: no edge partials — keeping the "
                         "current global model", self.current_round)
             return
+        # edge-failure elasticity: a block whose partial never arrived
+        # (crashed/partitioned edge rank — the round already degraded to
+        # an elastic zero-term partial) is ledgered slot-by-slot as
+        # 'edge_lost' with the clients that block would have trained, so
+        # the loss is attributable and counted
+        # (fed_updates_rejected_total{reason=edge_lost})
+        missing = [e for e in range(self.topology.edges)
+                   if e not in self.model_dict]
+        if missing:
+            ids = self.client_sampling(self.current_round)
+            for e in missing:
+                for s in self.topology.slots_of_edge(e):
+                    self.quarantine.record(self.current_round, s + 1,
+                                           "edge_lost", client=int(ids[s]))
+                    _obs.record_update_rejected("edge_lost")
+            log.warning("round %d: edge partial(s) %s lost — their blocks "
+                        "fold as zero terms (ledgered edge_lost)",
+                        self.current_round, missing)
+        # per-edge rejection counts for the round record's hier block: a
+        # reporting edge contributes its verdict rejects, a lost edge its
+        # whole block
+        self.last_round_rejected = [
+            int(np.count_nonzero(self._edge_meta[e][1]))
+            if e in self._edge_meta else self.topology.block
+            for e in range(self.topology.edges)]
         stacked = [
             jnp.stack([jnp.asarray(self.model_dict[e][i]) for e in edges])
             for i in range(len(self.model_dict[edges[0]]))
         ]
-        totals = jnp.asarray([self.sample_num_dict[e] for e in edges],
+        # the combine's denominator is the FOLD total each edge shipped
+        # (verdict-weight mass under two-phase gating) — sample_num_dict
+        # holds the raw telemetry mass and must never steer the division
+        totals = jnp.asarray([self._edge_meta[e][0] for e in edges],
                              jnp.float32)
         global_leaves = [jnp.asarray(v) for v in pack_pytree(self.net)]
         avg_leaves, total_w = self._combine(stacked, totals, global_leaves)
@@ -184,13 +287,13 @@ class HierFedAvgAggregator(FedAvgAggregator):
         # the COHORT-SLOT rank (slot + 1) — the same attribution the flat
         # aggregator records, so tree and flat ledgers compare equal
         for e in edges:
-            reasons, slots, clients = self._edge_meta[e]
+            _, reasons, slots, clients = self._edge_meta[e]
             if reasons.any():
                 self.quarantine.record_codes(
                     self.current_round, reasons,
                     clients=clients, ranks=[s + 1 for s in slots])
         if float(total_w) == 0.0 and any(
-                self._edge_meta[e][0].any() for e in edges):
+                self._edge_meta[e][1].any() for e in edges):
             log.warning("round %d: every child quarantined — keeping the "
                         "current global model", self.current_round)
         self.net = unpack_pytree(self.net, avg_leaves)
@@ -214,7 +317,9 @@ class FedAvgEdgeManager(DistributedManager):
 
     def __init__(self, rank: int, topology: EdgeTopology,
                  backend: str = "LOOPBACK",
-                 round_timeout_s: float | None = None, **kw):
+                 round_timeout_s: float | None = None,
+                 robust: bool = False,
+                 sketch_dim: int = EVIDENCE_SKETCH_DIM, **kw):
         self.topology = topology
         self.edge_idx = rank - 1
         if not 0 <= self.edge_idx < topology.edges:
@@ -228,10 +333,37 @@ class FedAvgEdgeManager(DistributedManager):
         self._forwarded = False
         self._lock = threading.Lock()
         self._partial = jax.jit(edge_partial)
+        # two-phase robust gating (module docstring): this edge forwards
+        # EVIDENCE first, holds the staged uploads, and folds only the
+        # survivors the root's verdict frame names
+        self.robust = bool(robust)
+        self._evidence_jit = jax.jit(partial(update_evidence,
+                                             sketch_dim=int(sketch_dim)))
+        self._apply_jit = jax.jit(apply_verdicts)
+        self._evidence_sent = False
+        self._staged: tuple | None = None  # (stacked, global) held for phase 3
+        self._last_partial: tuple | None = None  # retransmit cache
         ts = kw.pop("timeout_s", None)
         self.round_timeout_s = round_timeout_s
         super().__init__(rank, topology.world_size, backend,
                          timeout_s=round_timeout_s or ts, **kw)
+
+    def send_message(self, msg) -> None:
+        """Elastic sends on the edge tier: with ``round_timeout_s`` armed,
+        an unreachable CHILD (crashed worker — chaos raises
+        ConnectionError) just misses this round's fan-out and the elastic
+        block partial covers it; an unreachable ROOT drops this uplink and
+        the root watchdog's re-broadcast owns recovery. Without a round
+        deadline, delivery failures stay fatal (same policy as the flat
+        server manager)."""
+        try:
+            super().send_message(msg)
+        except Exception as e:
+            if self.round_timeout_s is None or \
+                    not FedAvgServerManager._is_transport_error(e):
+                raise
+            log.warning("edge %d: dropping undeliverable send to rank %s",
+                        self.edge_idx, msg.get_receiver_id(), exc_info=True)
 
     # ------------------------------------------------------------ handlers
     def register_message_receive_handlers(self):
@@ -247,6 +379,9 @@ class FedAvgEdgeManager(DistributedManager):
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self._handle_child_upload)
         self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2E_SEND_VERDICT_TO_EDGE,
+            self._handle_verdict)
+        self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
 
     def _handle_downlink(self, msg_type: str, msg_params) -> None:
@@ -261,6 +396,9 @@ class FedAvgEdgeManager(DistributedManager):
                 msg_params[MyMessage.MSG_ARG_KEY_CHILD_CLIENTS]]
             self._uploads = {}
             self._forwarded = False
+            self._evidence_sent = False
+            self._staged = None
+            self._last_partial = None
         for i, slot in enumerate(self._slots):
             msg = Message(msg_type, self.rank,
                           self.topology.worker_rank(slot))
@@ -296,6 +434,16 @@ class FedAvgEdgeManager(DistributedManager):
                 return
             if local in self._uploads or self._forwarded:
                 return  # chaos-duplicated upload: exactly-once folding
+            if self._evidence_sent:
+                # the evidence cut already happened: the root's verdicts
+                # were computed over a snapshot that scored this slot
+                # absent (weight 0) — folding it now would desync the
+                # partial from the verdict frame
+                _obs.record_stale_upload("stale")
+                log.warning("edge %d: drop upload from rank %d — arrived "
+                            "after the round %s evidence cut", self.edge_idx,
+                            sender, self._round)
+                return
             if (MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
                     or MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params):
                 raise RuntimeError(
@@ -306,12 +454,15 @@ class FedAvgEdgeManager(DistributedManager):
                 list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]),
                 float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]))
             if len(self._uploads) == len(self._slots):
-                self._forward_partial()
+                if self.robust:
+                    self._forward_evidence()
+                else:
+                    self._forward_partial()
 
-    def _forward_partial(self) -> None:
-        """Gate + canonical pairwise partial over this block, one frame to
-        the root. Caller holds _lock. Missing children (elastic timeout)
-        carry zero weight and the global value — exact zero terms."""
+    def _stack_block(self):
+        """(stacked, global, weights) over this block's slots — missing
+        children (elastic timeout) carry zero weight and the global value,
+        exact zero terms in any downstream fold. Caller holds _lock."""
         C = len(self._slots)
         stacked = []
         for i, g in enumerate(self._global):
@@ -323,13 +474,26 @@ class FedAvgEdgeManager(DistributedManager):
         weights = jnp.asarray(
             [self._uploads[local][1] if local in self._uploads else 0.0
              for local in range(C)], jnp.float32)
-        glob = [jnp.asarray(g) for g in self._global]
-        wsum, total, reasons = self._partial(stacked, glob, weights)
+        return stacked, [jnp.asarray(g) for g in self._global], weights
+
+    def _send_partial_frame(self, wsum, total, reasons) -> None:
+        """One e2s_agg frame to the root — the same shape whether the
+        verdicts came from the local non-finite gate (single-phase) or the
+        root's cross-tier verdict frame (two-phase). The payload is cached
+        so a verdict retry can retransmit it bit-identically (a dropped
+        PARTIAL heals through the same retry that heals a dropped
+        verdict). Caller holds _lock."""
+        self._last_partial = (wsum, total, reasons)
         msg = Message(MyMessage.MSG_TYPE_E2S_SEND_AGG_TO_SERVER,
                       self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_WSUM,
                        [np.asarray(v) for v in wsum])
         msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_WEIGHT, float(total))
+        # telemetry: the raw sample mass that ARRIVED (pre-gate/verdict),
+        # so the root's round record reads client-reported samples like a
+        # flat run's, whatever the verdict weights folded to
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SAMPLES,
+                       float(sum(u[1] for u in self._uploads.values())))
         msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_REASONS,
                        np.asarray(reasons, np.int32))
         msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SLOTS,
@@ -340,12 +504,95 @@ class FedAvgEdgeManager(DistributedManager):
         self._forwarded = True
         self.send_message(msg)
 
+    def _forward_partial(self) -> None:
+        """Single-phase (no robust gating): local non-finite gate + the
+        canonical pairwise partial over this block. Caller holds _lock."""
+        stacked, glob, weights = self._stack_block()
+        wsum, total, reasons = self._partial(stacked, glob, weights)
+        self._send_partial_frame(wsum, total, reasons)
+
+    def _forward_evidence(self) -> None:
+        """Phase 1 of the two-phase protocol: per-slot sanitation evidence
+        to the root; the staged uploads stay HERE until the verdict frame
+        names the survivors. Caller holds _lock."""
+        stacked, glob, weights = self._stack_block()
+        self._staged = (stacked, glob)
+        ev = self._evidence_jit(stacked, glob, weights)
+        msg = Message(MyMessage.MSG_TYPE_E2S_SEND_EVIDENCE_TO_SERVER,
+                      self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_EVIDENCE_NORM,
+                       np.asarray(ev["norm"], np.float32))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EVIDENCE_FINITE,
+                       np.asarray(ev["finite"], np.int32))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EVIDENCE_SKETCH,
+                       np.asarray(ev["sketch"], np.float32))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EVIDENCE_WEIGHT,
+                       np.asarray(ev["weight"], np.float32))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SLOTS,
+                       [int(s) for s in self._slots])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_CLIENTS,
+                       list(self._clients))
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+        self._evidence_sent = True
+        self.send_message(msg)
+
+    def _handle_verdict(self, msg_params) -> None:
+        """Phase 3: fold ONLY the survivors the root's verdict names
+        (zero-weight slots replaced by the held global — the PR-4
+        survivor-reweighting rule) and forward the ordinary partial.
+        Stale verdicts are dropped by the round tag; a RETRIED verdict
+        for a round this edge already folded retransmits the cached
+        partial verbatim instead — the root's retry cannot tell a
+        dropped verdict from a dropped partial, and the fold must stay
+        exactly-once either way (add_edge_result re-slots the identical
+        bits; a superseded round's copy dies at the root's round gate)."""
+        with self._lock:
+            if self._round is None:
+                return
+            tag = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            if int(tag) != self._round:
+                _obs.record_stale_upload("stale")
+                log.warning("edge %d: drop stale verdict (round %s, now "
+                            "%d)", self.edge_idx, tag, self._round)
+                return
+            if self._forwarded:
+                if self._last_partial is not None:
+                    log.warning("edge %d: verdict retry for round %d — "
+                                "retransmitting the cached partial",
+                                self.edge_idx, self._round)
+                    self._send_partial_frame(*self._last_partial)
+                return
+            if not self._evidence_sent or self._staged is None:
+                log.warning("edge %d: verdict for round %d before this "
+                            "edge sent evidence — dropped (root retry "
+                            "covers it)", self.edge_idx, self._round)
+                return
+            vw = np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_VERDICT_WEIGHTS],
+                np.float32)
+            reasons = np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_VERDICT_REASONS], np.int32)
+            stacked, glob = self._staged
+            wsum, total = self._apply_jit(stacked, glob, jnp.asarray(vw))
+            self._staged = None
+            self._send_partial_frame(wsum, total, reasons)
+
     def on_timeout(self, idle_s: float) -> None:
         """Elastic edge tier: a block stalled past round_timeout_s
-        forwards the partial over the children that DID report."""
+        forwards the partial (or, in two-phase mode, its EVIDENCE — the
+        missing children score absent and the verdict round proceeds)
+        over the children that DID report."""
         with self._lock:
             if (self._round is None or self._forwarded
                     or self.round_timeout_s is None):
+                return
+            if self.robust and self._evidence_sent:
+                # phase 2 wait: the verdict frame is the root's to retry
+                # (its watchdog re-sends to edges whose partial is missing)
+                log.warning("edge %d: round %d evidence sent %.1fs ago, "
+                            "no verdict yet — waiting (root watchdog owns "
+                            "the retry)", self.edge_idx, self._round,
+                            idle_s)
                 return
             if not self._uploads:
                 log.error("edge %d: round %d stalled %.1fs with no child "
@@ -354,11 +601,15 @@ class FedAvgEdgeManager(DistributedManager):
                 return
             missing = [self._slots[0] + i for i in range(len(self._slots))
                        if i not in self._uploads]
-            log.warning("edge %d: elastic partial over %d/%d children "
+            log.warning("edge %d: elastic %s over %d/%d children "
                         "(missing slots %s after %.1fs)", self.edge_idx,
+                        "evidence" if self.robust else "partial",
                         len(self._uploads), len(self._slots), missing,
                         idle_s)
-            self._forward_partial()
+            if self.robust:
+                self._forward_evidence()
+            else:
+                self._forward_partial()
 
     def _handle_finish(self, _msg) -> None:
         self.finish()
@@ -384,6 +635,17 @@ class HierFedAvgServerManager(FedAvgServerManager):
                 raise ValueError(
                     f"{name} is not wired through edge aggregators — run "
                     "the flat topology for that mode")
+        # two-phase robust gating state (all touched under _round_lock):
+        # per-edge staged evidence, whether this round's verdicts went
+        # out (and when — the hier record's verdict round-trip latency),
+        # and the one-retry latch for chaos-dropped verdict frames
+        self._robust = aggregator.robust_mode
+        self._edge_evidence: dict[int, dict] = {}
+        self._verdict_pack = None       # (vweights [K], reasons [K])
+        self._verdict_sent = False
+        self._verdict_retried = False
+        self._verdict_t: float | None = None
+        self._last_verdict_rtt: float | None = None
         super().__init__(aggregator, **kw)
 
     def _validate_world_size(self, size: int) -> None:
@@ -397,12 +659,24 @@ class HierFedAvgServerManager(FedAvgServerManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_E2S_SEND_AGG_TO_SERVER,
             self.handle_message_edge_partial)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2S_SEND_EVIDENCE_TO_SERVER,
+            self.handle_message_edge_evidence)
 
     def _round_record_extra(self) -> dict:
         hist = self.aggregator.fanin_history
-        return {"hier": {"edges": self.topology.edges,
-                         "block": self.topology.block,
-                         "fan_in": hist[-1] if hist else 0}}
+        hier = {"edges": self.topology.edges,
+                "block": self.topology.block,
+                "fan_in": hist[-1] if hist else 0}
+        # per-edge rejection counts (verdict rejects; a lost edge counts
+        # its whole block) and the verdict round-trip latency — absent on
+        # pre-cross-tier logs, and report.py hides the columns then
+        rej = self.aggregator.last_round_rejected
+        if rej is not None:
+            hier["rejected"] = list(rej)
+        if self._robust and self._last_verdict_rtt is not None:
+            hier["verdict_rtt_s"] = round(self._last_verdict_rtt, 6)
+        return {"hier": hier}
 
     def _broadcast_model(self, msg_type: str, global_params) -> None:
         """One frame per EDGE (fan-out O(edges)): the model + that edge
@@ -414,6 +688,13 @@ class HierFedAvgServerManager(FedAvgServerManager):
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         self._round_ids = [int(c) for c in client_indexes]
         self.aggregator.begin_round(self.round_idx)
+        # fresh verdict phase: a re-broadcast of a stalled round restarts
+        # the evidence gathering from scratch (edges reset on downlink)
+        self._edge_evidence = {}
+        self._verdict_pack = None
+        self._verdict_sent = False
+        self._verdict_retried = False
+        self._verdict_t = None
         # stash AS CLIENTS SEE IT, like the flat path (frame codec round
         # trip) — tree mode refuses encoded uplinks, but the stash keeps
         # the versioned-base bookkeeping uniform
@@ -437,6 +718,97 @@ class HierFedAvgServerManager(FedAvgServerManager):
         if tr is not None:
             tr.end_broadcast()
 
+    def handle_message_edge_evidence(self, msg_params) -> None:
+        """Phase 2 intake: stage one edge's per-slot evidence; once every
+        edge reported (the elastic watchdog covers the rest), run the
+        cohort-global verdict computation and answer each reporting edge
+        with its block's verdict frame."""
+        with self._round_lock:
+            sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+            edge_idx = sender - 1
+            msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                       self.round_idx)
+            if int(msg_round) != self.round_idx:
+                _obs.record_stale_upload("stale")
+                log.warning("drop stale edge evidence from rank %d "
+                            "(round %s, now %d)", sender, msg_round,
+                            self.round_idx)
+                return
+            if not 0 <= edge_idx < self.topology.edges:
+                _obs.record_stale_upload("unknown_rank")
+                log.warning("drop evidence from non-edge rank %d", sender)
+                return
+            if self._verdict_sent or edge_idx in self._edge_evidence:
+                # chaos duplicate, or evidence limping in after an elastic
+                # partial-evidence verdict round — exactly-once staging
+                _obs.record_stale_upload("stale")
+                log.warning("drop late/duplicate evidence from edge %d "
+                            "(round %d)", edge_idx, self.round_idx)
+                return
+            self._edge_evidence[edge_idx] = {
+                "norm": np.asarray(
+                    msg_params[MyMessage.MSG_ARG_KEY_EVIDENCE_NORM],
+                    np.float32),
+                "finite": np.asarray(
+                    msg_params[MyMessage.MSG_ARG_KEY_EVIDENCE_FINITE],
+                    np.int32),
+                "sketch": np.asarray(
+                    msg_params[MyMessage.MSG_ARG_KEY_EVIDENCE_SKETCH],
+                    np.float32),
+                "weight": np.asarray(
+                    msg_params[MyMessage.MSG_ARG_KEY_EVIDENCE_WEIGHT],
+                    np.float32),
+            }
+            if len(self._edge_evidence) == self.topology.edges:
+                self._send_verdicts()
+
+    def _send_verdicts(self) -> None:
+        """Run ``evidence_verdicts`` over the gathered cohort evidence —
+        the SAME jitted math a flat two-phase server runs, over the same
+        [K]-shaped inputs, which is the bitwise half of the tree ≡ flat
+        ledger contract — and fan one verdict frame out per reporting
+        edge. Blocks with no evidence (crashed edge) score absent: zero
+        weight, reasons OK here, ledgered edge_lost at aggregate time.
+        Caller holds _round_lock."""
+        import time as _time
+
+        topo = self.topology
+        K = topo.workers
+        some = next(iter(self._edge_evidence.values()))
+        norm = np.zeros((K,), np.float32)
+        finite = np.ones((K,), bool)
+        sketch = np.zeros((K, some["sketch"].shape[1]), np.float32)
+        weight = np.zeros((K,), np.float32)
+        for e, ev in self._edge_evidence.items():
+            sl = slice(e * topo.block, (e + 1) * topo.block)
+            norm[sl] = ev["norm"]
+            finite[sl] = ev["finite"] != 0
+            sketch[sl] = ev["sketch"]
+            weight[sl] = ev["weight"]
+        vw, reasons = self.aggregator._verdict_jit(
+            {"norm": jnp.asarray(norm), "finite": jnp.asarray(finite),
+             "sketch": jnp.asarray(sketch), "weight": jnp.asarray(weight)})
+        self._verdict_pack = (np.asarray(vw, np.float32),
+                              np.asarray(reasons, np.int32))
+        for e in sorted(self._edge_evidence):
+            self._send_verdict_frame(e)
+        self._verdict_sent = True
+        self._verdict_t = _time.monotonic()
+
+    def _send_verdict_frame(self, edge_idx: int) -> None:
+        """One s2e_verdict frame: that block's per-slot survivor weights +
+        reason codes. Re-sent verbatim by the watchdog retry (the edge's
+        _forwarded flag dedups). Caller holds _round_lock."""
+        vw, reasons = self._verdict_pack
+        topo = self.topology
+        sl = slice(edge_idx * topo.block, (edge_idx + 1) * topo.block)
+        msg = Message(MyMessage.MSG_TYPE_S2E_SEND_VERDICT_TO_EDGE,
+                      self.rank, topo.edge_rank(edge_idx))
+        msg.add_params(MyMessage.MSG_ARG_KEY_VERDICT_WEIGHTS, vw[sl])
+        msg.add_params(MyMessage.MSG_ARG_KEY_VERDICT_REASONS, reasons[sl])
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        self.send_message(msg)
+
     def handle_message_edge_partial(self, msg_params) -> None:
         from fedml_tpu.obs.tracing import TRACE_KEY
 
@@ -445,8 +817,6 @@ class HierFedAvgServerManager(FedAvgServerManager):
             msg_round = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
                                        self.round_idx)
             if int(msg_round) != self.round_idx:
-                from fedml_tpu.obs import comm_instrument as _obs
-
                 _obs.record_stale_upload("stale")
                 log.warning("drop stale edge partial from rank %d "
                             "(round %s, now %d)", sender, msg_round,
@@ -455,6 +825,7 @@ class HierFedAvgServerManager(FedAvgServerManager):
             if self._dtracer is not None:
                 self._dtracer.on_upload(sender,
                                         msg_params.get(TRACE_KEY))
+            samples = msg_params.get(MyMessage.MSG_ARG_KEY_EDGE_SAMPLES)
             self.aggregator.add_edge_result(
                 sender - 1,
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_WSUM],
@@ -462,10 +833,55 @@ class HierFedAvgServerManager(FedAvgServerManager):
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_REASONS],
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_SLOTS],
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_CLIENTS],
-                round_idx=int(msg_round))
+                round_idx=int(msg_round),
+                samples=None if samples is None else float(samples))
+            if self._robust and self._verdict_t is not None:
+                import time as _time
+
+                # verdict round-trip latency: verdict fan-out -> the last
+                # partial's arrival (the slowest edge's turn-around)
+                self._last_verdict_rtt = _time.monotonic() - self._verdict_t
             if not self.aggregator.check_whether_all_receive():
                 return
             self._advance_round()
+
+    def on_timeout(self, idle_s: float):
+        """Two-phase elastic recovery on top of the stock watchdog: a
+        round stalled in phase 1 computes verdicts over the PARTIAL
+        evidence (missing blocks score absent — the elastic zero-term
+        partial); one stalled in phase 2 re-sends the verdict frames once
+        (chaos may have dropped them — the edge dedups). Only then does
+        the stock elastic machinery take over (partial aggregate over the
+        partials that DID land, or the no-uploads re-broadcast)."""
+        if self._robust:
+            with self._round_lock:
+                if (self.round_timeout_s is not None
+                        and not self._finished.is_set()
+                        and self.round_idx < self.round_num):
+                    if self._edge_evidence and not self._verdict_sent:
+                        missing = [e for e in range(self.topology.edges)
+                                   if e not in self._edge_evidence]
+                        log.warning(
+                            "round %d: elastic verdicts over %d/%d edges' "
+                            "evidence (missing edges %s after %.1fs)",
+                            self.round_idx, len(self._edge_evidence),
+                            self.topology.edges, missing, idle_s)
+                        self._send_verdicts()
+                        return
+                    if self._verdict_sent and not self._verdict_retried:
+                        waiting = [e for e in sorted(self._edge_evidence)
+                                   if e not in self.aggregator.model_dict]
+                        if waiting:
+                            log.warning(
+                                "round %d: verdict sent %.1fs ago, no "
+                                "partial from edges %s — re-sending "
+                                "verdict frames once", self.round_idx,
+                                idle_s, waiting)
+                            self._verdict_retried = True
+                            for e in waiting:
+                                self._send_verdict_frame(e)
+                            return
+        super().on_timeout(idle_s)
 
 
 def run_simulated_hierarchical(
@@ -474,13 +890,21 @@ def run_simulated_hierarchical(
     broker_host: str = "127.0.0.1", broker_port: int = 1883,
     ckpt_dir: str | None = None, telemetry=None, chaos_plan=None,
     round_timeout_s: float | None = None, adversary_plan=None,
-    warmup: bool = False,
+    warmup: bool = False, aggregator: str | None = None,
+    aggregator_params: dict | None = None,
+    sanitize: bool | float | None = None,
 ) -> HierFedAvgAggregator:
     """The 2-tier analogue of ``run_simulated``: 1 root + E edges + W
     workers as threads over the loopback (or localhost-gRPC) backend.
     ``cfg.client_num_per_round`` is W; worker slot s trains
     ``client_sampling(round)[s]`` exactly like the flat runtime, so the
-    tree and flat cohorts coincide round-for-round."""
+    tree and flat cohorts coincide round-for-round.
+
+    ``aggregator=``/``sanitize=`` arm the two-phase cross-tier robust
+    protocol (module docstring) with the same semantics as the flat
+    ``run_simulated`` — and an ``adversary_plan``'s 1-based ranks match
+    workers by COHORT SLOT (slot + 1), not transport rank, so ONE plan
+    drives a flat and a tree run identically."""
     from fedml_tpu import chaos as _chaos
     from fedml_tpu.distributed.fedavg.client_manager import (
         FedAvgClientManager,
@@ -494,14 +918,25 @@ def run_simulated_hierarchical(
     if chaos_plan is not None:
         _chaos.install_plan(chaos_plan)
     try:
-        aggregator = HierFedAvgAggregator(dataset, task, cfg, topo)
+        root_agg = HierFedAvgAggregator(
+            dataset, task, cfg, topo, aggregator=aggregator,
+            aggregator_params=aggregator_params, sanitize=sanitize)
         server = HierFedAvgServerManager(
-            aggregator, rank=0, size=topo.world_size, backend=backend,
+            root_agg, rank=0, size=topo.world_size, backend=backend,
             ckpt_dir=ckpt_dir, round_timeout_s=round_timeout_s,
             telemetry=telemetry, **kw)
+        # the edge tier arms its elastic watchdog at HALF the root
+        # deadline: tier-2 elasticity (a stalled block's evidence/partial)
+        # resolves strictly before the root's own timeout acts, so the
+        # chaos replay contract stays a property of the SEEDED schedule,
+        # never of which watchdog thread happened to fire first
+        edge_timeout = (round_timeout_s / 2.0
+                        if round_timeout_s is not None else None)
         edge_mgrs = [
             FedAvgEdgeManager(topo.edge_rank(e), topo, backend=backend,
-                              round_timeout_s=round_timeout_s, **kw)
+                              round_timeout_s=edge_timeout,
+                              robust=root_agg.robust_mode,
+                              sketch_dim=root_agg.sketch_dim, **kw)
             for e in range(topo.edges)
         ]
         clients = []
@@ -511,7 +946,8 @@ def run_simulated_hierarchical(
             clients.append(FedAvgClientManager(
                 trainer, rank=rank, size=topo.world_size, backend=backend,
                 server_rank=topo.edge_rank(topo.edge_of_slot(slot)),
-                adversary_plan=adversary_plan, **kw))
+                adversary_plan=adversary_plan,
+                adversary_rank=slot + 1, **kw))
         if warmup and clients:
             from fedml_tpu.utils.metrics import enable_compile_cache
 
@@ -522,4 +958,4 @@ def run_simulated_hierarchical(
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)
-    return aggregator
+    return root_agg

@@ -58,6 +58,37 @@ class MyMessage:
     MSG_ARG_KEY_EDGE_REASONS = "edge_reasons"
     MSG_ARG_KEY_EDGE_SLOTS = "edge_slots"
     MSG_ARG_KEY_EDGE_CLIENTS = "edge_clients"
+    # raw client-reported sample mass of the uploads that ARRIVED at the
+    # edge (pre-gate, pre-verdict) — telemetry only, never the division:
+    # under two-phase robust gating EDGE_WEIGHT is the fold total of the
+    # VERDICT weights (krum's winner folds at weight exactly 1.0), so the
+    # round record's num_samples would otherwise read verdict mass, not
+    # sample mass, and diverge from the flat twin's
+    MSG_ARG_KEY_EDGE_SAMPLES = "edge_samples"
+    # two-phase cross-tier robust gating (docs/ROBUSTNESS.md §Cross-tier
+    # robust gating): with a robust aggregator / sanitation gate armed in
+    # tree mode, the edge HOLDS its block's staged uploads and first
+    # forwards ONE e2s_evidence frame — per-slot sanitation evidence
+    # (EVIDENCE_NORM update norms, EVIDENCE_FINITE flags, the [C, S]
+    # EVIDENCE_SKETCH count-sketch of the flattened updates, and the raw
+    # EVIDENCE_WEIGHT sample counts), sketch_dim + 3 scalars per client.
+    # The root runs the cohort-global gate + estimator selection over the
+    # gathered evidence and answers each edge with ONE s2e_verdict frame
+    # (VERDICT_WEIGHTS: per-slot survivor weights, zero = rejected or
+    # unselected; VERDICT_REASONS: the ledger's reason codes). The edge
+    # then folds ONLY the survivors (zero-weight slots replaced by the
+    # held global — exact zero terms) and forwards the ordinary e2s_agg
+    # partial, so steady root ingress stays O(edges) update frames and
+    # only O(cohort) scalar evidence ever reaches the root. Both frame
+    # types are round-tagged and deduped like any FMT2 frame.
+    MSG_TYPE_E2S_SEND_EVIDENCE_TO_SERVER = "e2s_evidence"
+    MSG_TYPE_S2E_SEND_VERDICT_TO_EDGE = "s2e_verdict"
+    MSG_ARG_KEY_EVIDENCE_NORM = "ev_norm"
+    MSG_ARG_KEY_EVIDENCE_FINITE = "ev_finite"
+    MSG_ARG_KEY_EVIDENCE_SKETCH = "ev_sketch"
+    MSG_ARG_KEY_EVIDENCE_WEIGHT = "ev_weight"
+    MSG_ARG_KEY_VERDICT_WEIGHTS = "verdict_w"
+    MSG_ARG_KEY_VERDICT_REASONS = "verdict_reasons"
     # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
     # MODEL_PARAMS and BASE_VERSION names the global version the delta was
     # computed against — the client must hold exactly that version (the

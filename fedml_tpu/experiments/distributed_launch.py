@@ -52,10 +52,14 @@ def add_args(p: argparse.ArgumentParser):
                         "block's sanitized uplinks and forward ONE "
                         "pre-aggregated update each — root fan-in is "
                         "O(edges), and tree == flat stays bitwise under "
-                        "--sum_assoc pairwise. Workers are ranks "
-                        "E+1..world_size-1; the per-edge block size "
-                        "(workers/edges) must be a power of two. 0 = "
-                        "flat (default)")
+                        "--sum_assoc pairwise. Pair with --aggregator to "
+                        "arm two-phase cross-tier robust gating (edges "
+                        "forward per-client evidence, the root returns "
+                        "verdict frames, edges fold only survivors — "
+                        "docs/ROBUSTNESS.md §Cross-tier robust gating). "
+                        "Workers are ranks E+1..world_size-1; the "
+                        "per-edge block size (workers/edges) must be a "
+                        "power of two. 0 = flat (default)")
     p.add_argument("--sum_assoc", "--sum-assoc", dest="sum_assoc",
                    type=str, default="auto", choices=["auto", "pairwise"],
                    help="rank 0: weighted-mean summation association. "
@@ -296,12 +300,13 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     edges = int(getattr(args, "edges", 0) or 0)
     if edges:
         # hierarchical 2-tier topology: rank 0 root, 1..E edges, rest
-        # workers. Dense synchronous protocol only (the tree contract).
+        # workers. Dense synchronous protocol; --aggregator (+ the
+        # implied sanitation gate) arms the two-phase cross-tier robust
+        # protocol (docs/ROBUSTNESS.md §Cross-tier robust gating).
         if args.algo not in ("fedavg", "fedprox"):
             raise ValueError(f"--edges is wired for fedavg/fedprox only "
                              f"(got --algo {args.algo})")
         incompatible = [name for name, v in (
-            ("--aggregator", getattr(args, "aggregator", None)),
             ("--async_buffer_k", getattr(args, "async_buffer_k", None)),
             ("--sparsify_ratio", getattr(args, "sparsify_ratio", None)),
             ("--update_codec", getattr(args, "update_codec", None)),
@@ -326,17 +331,30 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
 
         topo = EdgeTopology(edges=edges,
                             workers=args.world_size - 1 - edges)
+        robust_agg_name = getattr(args, "aggregator", None)
         if args.rank == 0:
-            agg = HierFedAvgAggregator(data, task, cfg, topo)
+            hier_params = None
+            if robust_agg_name and getattr(args, "byzantine_f",
+                                           None) is not None:
+                hier_params = {"f": args.byzantine_f}
+            agg = HierFedAvgAggregator(data, task, cfg, topo,
+                                       aggregator=robust_agg_name,
+                                       aggregator_params=hier_params)
             return HierFedAvgServerManager(
                 agg, rank=0, size=args.world_size, backend=backend,
                 ckpt_dir=args.ckpt_dir,
                 round_timeout_s=args.round_timeout_s,
                 telemetry=telemetry, **backend_kw)
         if args.rank <= edges:
+            # every rank shares argv, so the edge derives the two-phase
+            # mode from the same --aggregator flag the root arms; the
+            # edge watchdog runs at HALF the root deadline so tier-2
+            # elasticity resolves before the root's (replay determinism)
             return FedAvgEdgeManager(
                 args.rank, topo, backend=backend,
-                round_timeout_s=args.round_timeout_s, **backend_kw)
+                round_timeout_s=(args.round_timeout_s / 2.0
+                                 if args.round_timeout_s else None),
+                robust=bool(robust_agg_name), **backend_kw)
         local_spec = None
         if args.algo == "fedprox":
             from fedml_tpu.distributed.fedprox import prox_spec
@@ -348,6 +366,9 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
             local_spec=local_spec, adversary_plan=adv,
             server_rank=topo.edge_rank(
                 topo.edge_of_slot(topo.slot_of(args.rank))),
+            # adversary plans name 1-based COHORT ranks: tree workers
+            # match by slot + 1, so one plan drives flat and tree alike
+            adversary_rank=topo.slot_of(args.rank) + 1,
             **backend_kw)
     # robust aggregation (--aggregator): kwargs shared by every aggregator
     # that inherits the FedAvgAggregator gate (turboaggregate excluded —

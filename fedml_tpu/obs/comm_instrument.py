@@ -85,6 +85,33 @@ def record_wire_bytes(codec: str, direction: str, nbytes: int) -> None:
     _bytes_total(codec, direction).inc(nbytes)
 
 
+# message types whose wire bytes are accounted under their OWN direction
+# label instead of the receiver-derived uplink/downlink split. Registered
+# by the protocol module that owns the frame type (the hierarchical tier
+# registers e2s_evidence -> 'evidence' and s2e_verdict -> 'verdict', so
+# the cross-tier robust protocol's control-plane bytes are separable from
+# the update-frame budget in comm_bytes_total — the measured half of the
+# O(cohort)-evidence / O(edges)-traffic claim). directional_bytes() sums
+# uplink/downlink only, so overridden directions never pollute the
+# per-round uplink/downlink record fields.
+_DIRECTION_OVERRIDES: dict[str, str] = {}
+
+
+def register_direction_override(msg_type: str, direction: str) -> None:
+    """Account ``msg_type`` frames under ``comm_bytes_total{direction=}``
+    with the given label (idempotent; conflicting re-registration is a
+    programming error and raises)."""
+    prev = _DIRECTION_OVERRIDES.get(str(msg_type))
+    if prev is not None and prev != direction:
+        raise ValueError(f"direction override for {msg_type!r} already "
+                         f"registered as {prev!r} (got {direction!r})")
+    _DIRECTION_OVERRIDES[str(msg_type)] = str(direction)
+
+
+def direction_override(msg_type) -> str | None:
+    return _DIRECTION_OVERRIDES.get(str(msg_type))
+
+
 def directional_bytes(registry: MetricsRegistry | None = None) -> dict:
     """{'uplink': bytes, 'downlink': bytes} summed over codecs (0.0 for a
     direction with no traffic / pre-PR-9 processes)."""

@@ -75,7 +75,9 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              aggregator: str | None = None,
              async_buffer_k: int | None = None,
              update_codec: str | None = None,
-             sparsify_ratio: float | None = None) -> dict:
+             sparsify_ratio: float | None = None,
+             edges: int | None = None,
+             sum_assoc: str = "auto") -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
@@ -91,12 +93,20 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     thread-scheduled, so async replays assert liveness (every global
     update completes under the seeded fault pressure), not ledger/model
     equality (the bit-for-bit async replay lives in the virtual-clock
-    simulator, tests/test_async_buffer.py)."""
+    simulator, tests/test_async_buffer.py).
+
+    ``edges`` runs the trial on the 2-tier tree topology (ranks 1..E are
+    edge aggregators, the rest workers; docs/ROBUSTNESS.md §Cross-tier
+    robust gating) — chaos then lands on BOTH tiers, a crashed edge rank
+    exercises the edge_lost elastic path, and the record gains per-tier
+    fan-in stats."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.distributed.fedavg import run_simulated
     from fedml_tpu.obs import Telemetry
 
     per_round = (world_size - 1) if world_size else 3
+    if edges:
+        per_round = (world_size - 1 - edges) if world_size else 4
     cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=data.num_clients,
                        client_num_per_round=per_round, epochs=1, batch_size=8,
                        lr=0.1, frequency_of_the_test=1, seed=0)
@@ -127,6 +137,7 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
                             aggregator_params=agg_params,
                             update_codec=update_codec,
                             sparsify_ratio=sparsify_ratio,
+                            edges=edges, sum_assoc=sum_assoc,
                             telemetry=tel, **async_kw)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
@@ -149,7 +160,13 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     quorum_err = None
     crash_rounds = [r.rounds[0] for r in plan.rules
                     if r.fault == "crash" and r.rounds
-                    and r.rounds[0] < rounds]  # a post-run window never fires
+                    and r.rounds[0] < rounds  # a post-run window never fires
+                    # tree mode: only a crash on a rank the ROOT talks to
+                    # (an edge, ranks 1..E) marks it undeliverable and
+                    # moves fed_ranks_alive; a crashed WORKER is absorbed
+                    # by its edge's elastic block partial
+                    and (not edges or any(rk <= edges
+                                          for rk in (r.ranks or ())))]
     if err is None and completed and not async_buffer_k:
         fired = sum(1 for a in alerts
                     if a["rule"] == "quorum" and a["state"] == "fired")
@@ -163,12 +180,19 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
             quorum_err = (f"quorum alerts: fired {fired} (want {want_fired}),"
                           f" resolved {resolved} (want >= {want_resolved})"
                           f" for crash windows at {crash_rounds}")
+    fan_in = None
+    if edges and agg is not None and getattr(agg, "fanin_history", None):
+        hist = agg.fanin_history
+        fan_in = {"edges": int(edges), "block": per_round // int(edges),
+                  "min": int(min(hist)), "max": int(max(hist)),
+                  "mean": round(sum(hist) / len(hist), 3)}
     return {
         "seed": plan.seed,
         "ok": err is None and completed and quorum_err is None,
         "error": err or quorum_err,
         "alerts": alerts,
         "crash_windows": crash_rounds,
+        **({"fan_in": fan_in} if fan_in else {}),
         "completed_rounds": (agg.history[-1]["round"] + 1
                              if agg and agg.history else 0),
         "faults": plan.ledger.counts(),
@@ -268,8 +292,31 @@ def main(argv=None) -> int:
                          "'topk:R' (top-k with ratio R). Replays must "
                          "still reproduce ledger + model bits — the "
                          "codec layer is deterministic")
+    ap.add_argument("--edges", type=int, default=None,
+                    help="run every trial on the 2-tier tree topology "
+                         "with this many edge-aggregator ranks (ranks "
+                         "1..E; workers are the rest of --world_size). "
+                         "Chaos lands on both tiers — a crashed edge "
+                         "rank exercises the edge_lost elastic path — "
+                         "and with --adversary-plan the trials run the "
+                         "two-phase cross-tier robust protocol "
+                         "(docs/ROBUSTNESS.md §Cross-tier robust "
+                         "gating). Replay spot-checks additionally "
+                         "compare a chaos-free tree run's quarantine "
+                         "ledger + model bits against its flat pairwise "
+                         "twin; the summary gains per-tier fan-in stats")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
+    if args.edges:
+        if args.async_buffer_k:
+            ap.error("--edges does not compose with --async-buffer-k "
+                     "(the tree protocol is synchronous)")
+        if args.compression and (
+                args.compression.startswith("topk:")
+                or args.compression in ("delta", "delta-int8",
+                                        "delta-sign1")):
+            ap.error("--edges does not compose with encoded-uplink "
+                     "--compression tiers (frame codecs are fine)")
 
     from fedml_tpu.core.tasks import classification_task
     from fedml_tpu.data.synthetic import synthetic_images
@@ -319,7 +366,7 @@ def main(argv=None) -> int:
         plan = random_plan(seed, args.world_size)
         rec = run_plan(data, task, plan, rounds=args.rounds,
                        world_size=args.world_size, adversary_plan=adv(),
-                       aggregator=aggregator,
+                       aggregator=aggregator, edges=args.edges,
                        async_buffer_k=args.async_buffer_k, **codec_kw)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
@@ -329,15 +376,26 @@ def main(argv=None) -> int:
             rec2 = run_plan(data, task, random_plan(seed, args.world_size),
                             rounds=args.rounds, world_size=args.world_size,
                             adversary_plan=adv(), aggregator=aggregator,
+                            edges=args.edges,
                             async_buffer_k=args.async_buffer_k, **codec_kw)
-            if args.async_buffer_k:
+            if args.async_buffer_k or args.edges:
                 # async dispatch counts and arrival order are
                 # thread-scheduled, so even per-link fault draws shift
                 # between runs: the replay invariant is LIVENESS — the
                 # replayed job completes every global update under the
                 # same seeded fault pressure — not ledger/model equality
                 # (the bit-for-bit async replay is the virtual-clock
-                # simulator's, tests/test_async_buffer.py)
+                # simulator's, tests/test_async_buffer.py). Tree trials
+                # share the caveat for a different reason: the two-phase
+                # protocol stacks three frame trips per round against
+                # one elastic deadline, so a multi-fault plan's timeout
+                # cascades retransmit — and which WATCHDOG TICK races
+                # which in-flight frame is wall-clock, not seeded. The
+                # bit-for-bit tree replay contract lives in tier-1
+                # (tests/test_hierarchy_robust.py, single-fault plans
+                # with wide margins); HERE the tree's determinism
+                # evidence is the chaos-free tree-vs-flat bitwise spot
+                # check below.
                 replay_ok = (rec2["completed_rounds"]
                              == rec["completed_rounds"] == args.rounds)
             else:
@@ -351,6 +409,36 @@ def main(argv=None) -> int:
                 rec["ok"] = False
                 rec["error"] = "replay diverged (ledger, quarantine, or " \
                                "final model)"
+            if replay_ok and args.edges:
+                # tree-vs-flat spot check (chaos-free, adversary only —
+                # wire faults draw per-link and the two topologies have
+                # different links): the 2-tier run's quarantine ledger
+                # AND model bits must match the flat two-phase twin's
+                # (docs/ROBUSTNESS.md §Cross-tier robust gating)
+                from fedml_tpu.chaos import FaultPlan
+
+                empty = lambda: FaultPlan.from_json(  # noqa: E731
+                    {"seed": seed, "rules": []})
+                t_rec = run_plan(data, task, empty(), rounds=args.rounds,
+                                 world_size=args.world_size,
+                                 adversary_plan=adv(),
+                                 aggregator=aggregator, edges=args.edges,
+                                 **codec_kw)
+                f_rec = run_plan(
+                    data, task, empty(), rounds=args.rounds,
+                    world_size=args.world_size - args.edges,
+                    adversary_plan=adv(), aggregator=aggregator,
+                    sum_assoc="pairwise", **codec_kw)
+                tf_ok = (t_rec["qledger"] == f_rec["qledger"]
+                         and t_rec["net"] is not None and all(
+                             np.array_equal(np.asarray(a), np.asarray(b))
+                             for a, b in zip(pack_pytree(t_rec["net"]),
+                                             pack_pytree(f_rec["net"]))))
+                rec["tree_vs_flat_ledger_ok"] = tf_ok
+                if not tf_ok:
+                    rec["ok"] = False
+                    rec["error"] = ("tree-vs-flat diverged (quarantine "
+                                    "ledger or model bits)")
         rec.pop("net", None)
         rec.pop("ledger", None)
         rec.pop("qledger", None)
@@ -391,6 +479,19 @@ def main(argv=None) -> int:
         summary["async_buffer_k"] = args.async_buffer_k
     if args.compression:
         summary["compression"] = args.compression
+    if args.edges:
+        # per-tier fan-in roll-up: the root must have folded O(edges)
+        # update frames per round on every trial that completed
+        fans = [t["fan_in"] for t in trials if t.get("fan_in")]
+        summary["edges"] = args.edges
+        summary["fan_in"] = {
+            "edges": args.edges,
+            "block": (fans[0]["block"] if fans else None),
+            "min": min((f["min"] for f in fans), default=None),
+            "max": max((f["max"] for f in fans), default=None),
+        }
+        summary["tree_vs_flat_ledger_ok"] = all(
+            t.get("tree_vs_flat_ledger_ok", True) for t in trials)
     if adv_spec is not None:
         summary["adversary_plan"] = json.loads(adv_spec)
         summary["aggregator"] = aggregator

@@ -490,16 +490,18 @@ cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
                    client_num_per_round=8, batch_size=6, lr=0.1,
                    frequency_of_the_test=1)
 E = 2
-adv = lambda rank: AdversaryPlan.from_json(
-    {"seed": 1, "rules": [{"attack": "nan", "ranks": [rank]}]})
+# ONE plan drives both topologies: adversary ranks are cohort ranks
+# (tree workers match by slot + 1)
+adv = lambda: AdversaryPlan.from_json(
+    {"seed": 1, "rules": [{"attack": "nan", "ranks": [3]}]})
 chaos = lambda: FaultPlan.from_json({"seed": 7, "rules": [
     {"fault": "delay", "delay_s": 0.05, "prob": 0.5},
     {"fault": "duplicate", "prob": 0.3}]})
 flat = run_simulated(data, task, cfg, job_id="ci-hier-flat",
-                     sum_assoc="pairwise", adversary_plan=adv(3),
+                     sum_assoc="pairwise", adversary_plan=adv(),
                      chaos_plan=chaos(), round_timeout_s=15.0)
 tree = run_simulated(data, task, cfg, job_id="ci-hier-tree", edges=E,
-                     adversary_plan=adv(3 + E), chaos_plan=chaos(),
+                     adversary_plan=adv(), chaos_plan=chaos(),
                      round_timeout_s=15.0)
 for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
     np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
@@ -511,6 +513,58 @@ assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(tree.net))
 print(f"hierarchical smoke ok: tree == flat bitwise over {cfg.comm_round} "
       f"rounds, fan-in {tree.fanin_history}, ledger {len(led)} entries "
       f"(NaN adversary quarantined at the edge)")
+PY
+  echo "== cross-tier robust gating smoke (2-tier + median vs a 2-of-8 sign-flip; tree == flat bits + ledger; evidence/verdict bytes exported) =="
+  # the two-phase protocol (docs/ROBUSTNESS.md §Cross-tier robust gating):
+  # a robust estimator composes with --edges — the root gates over
+  # edge-forwarded evidence and returns verdicts, so root ingress stays
+  # O(edges) update frames while the ledger matches a flat two-phase run
+  # entry-for-entry; the control plane's bytes are visible (and bounded)
+  # in comm_bytes_total{direction=evidence|verdict}
+  python - <<'PY'
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.robust_agg import EVIDENCE_SKETCH_DIM
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs.metrics import REGISTRY
+
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=8, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+E, W = 2, 8
+adv = lambda: AdversaryPlan.from_json({"seed": 1, "rules": [
+    {"attack": "sign_flip", "ranks": [2, 5], "factor": 10.0}]})
+flat = run_simulated(data, task, cfg, job_id="ci-xtier-flat",
+                     sum_assoc="pairwise", aggregator="median",
+                     adversary_plan=adv())
+tree = run_simulated(data, task, cfg, job_id="ci-xtier-tree", edges=E,
+                     aggregator="median", adversary_plan=adv())
+for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                  err_msg="tree-median diverged from flat")
+led = tree.quarantine.canonical()
+assert led == flat.quarantine.canonical() and led, led
+assert {e[1] for e in led if e[2] == "norm_outlier"} == {2, 5}, led
+assert tree.fanin_history == [E] * cfg.comm_round, tree.fanin_history
+fam = REGISTRY.snapshot().get("comm_bytes_total", {})
+ev_b = sum(v for k, v in fam.items() if "direction=evidence" in k)
+vd_b = sum(v for k, v in fam.items() if "direction=verdict" in k)
+assert ev_b > 0 and vd_b > 0, sorted(fam)
+budget = cfg.comm_round * (W * 4 * (EVIDENCE_SKETCH_DIM + 3) + E * 2048)
+assert ev_b <= budget, (ev_b, budget)
+print(f"cross-tier robust smoke ok: tree-median == flat bitwise, "
+      f"{len(led)} ledger entries (sign-flippers quarantined), fan-in "
+      f"{tree.fanin_history}, evidence {int(ev_b)}B / verdict {int(vd_b)}B "
+      f"over {cfg.comm_round} rounds (budget {budget}B)")
 PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
@@ -606,4 +660,13 @@ python scripts/chaos_soak.py --trials 3 --rounds 3 --async-buffer-k 2 \
 # ledger + final model bits — the codec layer is deterministic
 python scripts/chaos_soak.py --trials 3 --rounds 3 --compression delta-int8 \
   --out ./tmp/chaos_soak_codec.json
+# cross-tier robust tier (docs/ROBUSTNESS.md §Cross-tier robust gating):
+# seeded wire faults over the 2-tier tree topology with a krum-defended
+# sign-flip adversary — chaos lands on both tiers (a crashed edge rank
+# exercises the edge_lost elastic path), replay spot-checks also compare
+# a chaos-free tree run's quarantine ledger + model bits against its
+# flat pairwise twin, and the summary carries per-tier fan-in stats
+python scripts/chaos_soak.py --trials 3 --rounds 3 --world_size 7 --edges 2 \
+  --adversary-plan '{"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [1], "factor": 10.0}]}' \
+  --out ./tmp/chaos_soak_edges.json
 echo "CI GREEN"

@@ -93,8 +93,16 @@ def render_table(records: list[dict]) -> str:
             "bkt_B": (r.get("pack") or {}).get("bucket_B"),
             "pad_frac": (r.get("pack") or {}).get("pad_frac"),
             # hierarchical 2-tier runs (docs/ROBUSTNESS.md §Hierarchical
-            # tiers): the root's realized fan-in (== edge count)
+            # tiers): the root's realized fan-in (== edge count); with
+            # cross-tier robust gating (§Cross-tier robust gating), the
+            # round's total rejected slots over the per-edge counts and
+            # the verdict fan-out -> last-partial round-trip latency —
+            # both hide on pre-cross-tier logs
             "fan_in": (r.get("hier") or {}).get("fan_in"),
+            "rej": (sum((r.get("hier") or {}).get("rejected"))
+                    if (r.get("hier") or {}).get("rejected") is not None
+                    else None),
+            "vrtt_s": (r.get("hier") or {}).get("verdict_rtt_s"),
             "buf_k": (r.get("async") or {}).get("k"),
             "stale_p50": _staleness_quantile(r, 0.5),
             "stale_max": _staleness_quantile(r, 1.0),

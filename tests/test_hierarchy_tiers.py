@@ -149,18 +149,20 @@ def test_tree_chaos_adversary_ledger_parity(data, task):
     """Seeded delay+duplicate chaos on every link and a NaN adversary on
     cohort slot 2: tree and flat (pairwise) agree on model bits AND the
     quarantine ledger — and the model stays finite (the edge gate killed
-    the NaN before it ever reached the root)."""
+    the NaN before it ever reached the root). ONE plan drives both
+    topologies: adversary ranks are cohort ranks, matched by slot + 1 in
+    tree mode (the client manager's adversary_rank)."""
     E = 2
-    adv = lambda rank: AdversaryPlan.from_json(
-        {"seed": 1, "rules": [{"attack": "nan", "ranks": [rank]}]})
+    adv = lambda: AdversaryPlan.from_json(
+        {"seed": 1, "rules": [{"attack": "nan", "ranks": [3]}]})
     chaos = lambda: FaultPlan.from_json({"seed": 7, "rules": [
         {"fault": "delay", "delay_s": 0.05, "prob": 0.5},
         {"fault": "duplicate", "prob": 0.3}]})
     flat = run_simulated(data, task, _cfg(), job_id="hier-flat-c",
-                         sum_assoc="pairwise", adversary_plan=adv(3),
+                         sum_assoc="pairwise", adversary_plan=adv(),
                          chaos_plan=chaos(), round_timeout_s=15.0)
     tree = run_simulated(data, task, _cfg(), job_id="hier-tree-c",
-                         edges=E, adversary_plan=adv(3 + E),
+                         edges=E, adversary_plan=adv(),
                          chaos_plan=chaos(), round_timeout_s=15.0)
     for x, y in zip(pack_pytree(flat.net), pack_pytree(tree.net)):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
@@ -181,31 +183,45 @@ def test_tree_telemetry_hier_block_and_header(data, task):
     hdr = [r for r in recs if r.get("kind") == "run"][0]
     assert hdr["world_size"] == 1 + 4 + 8
     rounds = [r for r in recs if r.get("kind") == "round"]
-    assert rounds and all(r["hier"] == {"edges": 4, "block": 2,
-                                        "fan_in": 4} for r in rounds)
+    assert rounds
+    for r in rounds:
+        hier = r["hier"]
+        assert (hier["edges"], hier["block"], hier["fan_in"]) == (4, 2, 4)
+        # PR-12: per-edge rejection counts ride every tree round record
+        # (all zero on this clean run); verdict_rtt_s is robust-mode only
+        assert hier["rejected"] == [0, 0, 0, 0]
+        assert "verdict_rtt_s" not in hier
     # num_samples survives the tier (sample-weight exactness at the root)
     assert all(r["metrics"]["num_samples"] > 0 for r in rounds)
 
 
 def test_hier_refuses_unsupported_modes(data, task):
-    with pytest.raises(ValueError, match="does not compose"):
-        run_simulated(data, task, _cfg(), edges=2, aggregator="median")
+    # --aggregator/sanitize now COMPOSE with edges (two-phase cross-tier
+    # robust gating, tests/test_hierarchy_robust.py); the wire-codec and
+    # async modes stay refused
     with pytest.raises(ValueError, match="does not compose"):
         run_simulated(data, task, _cfg(), edges=2,
                       update_codec="delta-int8")
     with pytest.raises(ValueError, match="does not compose"):
         run_simulated(data, task, _cfg(), edges=2, async_buffer_k=2)
+    with pytest.raises(ValueError, match="does not compose"):
+        run_simulated(data, task, _cfg(), edges=2, sparsify_ratio=0.5)
 
 
-def test_flat_pairwise_refuses_sharded_and_robust(data, task):
+def test_flat_pairwise_sharded_refused_and_bogus_assoc(data, task):
     from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 
-    with pytest.raises(ValueError, match="weighted-mean"):
-        FedAvgAggregator(data, task, _cfg(), worker_num=8,
-                         aggregator="median", sum_assoc="pairwise")
+    # pairwise + a robust estimator is now the two-phase composition
+    # (verdict_fn), not a refusal — it must BUILD
+    agg = FedAvgAggregator(data, task, _cfg(), worker_num=8,
+                           aggregator="median", sum_assoc="pairwise")
+    assert agg.sum_assoc == "pairwise"
     with pytest.raises(ValueError, match="sum_assoc"):
         FedAvgAggregator(data, task, _cfg(), worker_num=8,
                          sum_assoc="bogus")
+    with pytest.raises(ValueError, match="pairwise"):
+        FedAvgAggregator(data, task, _cfg(), worker_num=8,
+                         sum_assoc="pairwise", shard_server_state=True)
 
 
 # ----------------------------------------------- mesh satellite (standalone)
