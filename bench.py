@@ -203,14 +203,18 @@ def _measure(mode: str) -> None:
         frequency_of_the_test=10_000,  # pure training throughput
         max_batches=max_batches,  # 28 covers ~[22,550]-sample clients at bs=20
     )
-    # FEDML_BENCH_BF16=1: bf16 activations on the MXU (params stay f32) —
-    # the standard TPU mixed-precision recipe; f32 default for exact
-    # reference-comparable numerics
+    # FEDML_BENCH_BF16=1: the full mixed-precision policy (docs/
+    # PERFORMANCE.md §Mixed precision) — bf16 activations on the MXU AND
+    # cfg.precision='bf16' so the vmapped local fits run on bf16 casts of
+    # the f32 masters; f32 default for exact reference-comparable numerics
     dtype = None
     if os.environ.get("FEDML_BENCH_BF16") == "1":
+        import dataclasses as _dc
+
         import jax.numpy as jnp
 
         dtype = jnp.bfloat16
+        cfg = _dc.replace(cfg, precision="bf16")
     task = classification_task(CNNOriginalFedAvg(only_digits=False, dtype=dtype))
     # block mode parks the whole train set in HBM (~330 MB uint8) so a round
     # ships only the shuffled index block (~KBs) and gathers on device.
@@ -664,6 +668,221 @@ def _measure_codec() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _measure_fused_agg() -> None:
+    """FEDML_BENCH_FUSED fused-vs-stacked server flush A/B (docs/
+    PERFORMANCE.md §Fused aggregation): synthesize one cohort of
+    delta-int8 uploads at fan-in FEDML_BENCH_FUSED_FANIN (default 128) and
+    drive the two server ingest+aggregate routes at matched bits — the
+    stacked route host-densifies every upload (zlib + numpy + apply_delta)
+    and stacks the cohort, the fused route inflates to int8 and lets the
+    per-arrival jit decode/gate/fold on device. Two timed phases per
+    round, both synced: INGEST (per-arrival work — overlaps client
+    training in production) and FLUSH (barrier -> new global model, the
+    serialized critical path and the Smart-NIC seconds-per-flush number:
+    stacked pays the [K, ...] stack + gagg jit there, fused only merges
+    O(log K) partials and divides). Also reports the whole-server-round
+    ratio (conservative) and the host-RSS delta across the ingest (the
+    per-client f32 trees are exactly what fused never allocates).
+    Forced-CPU child — the measurement isolates the server's decode→sum
+    chain, not accelerator FLOPs."""
+    t0 = time.perf_counter()
+    import jax
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.comm.delta import (encode_update, inflate_update,
+                                      round_delta, decode_update,
+                                      apply_delta)
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs.memwatch import host_rss_bytes
+
+    fan_in = _env_int("FEDML_BENCH_FUSED_FANIN", 128)
+    rounds = _env_int("FEDML_BENCH_FUSED_ROUNDS", 5)
+    # ~92k params (96x96 image -> 10 classes): big enough that the
+    # per-upload decode/stack cost dominates the fixed jit dispatch
+    data = synthetic_images(num_clients=8, image_shape=(96, 96, 1),
+                            num_classes=10, samples_per_client=4,
+                            test_samples=8, seed=3)
+    task = classification_task(LogisticRegression(num_classes=10))
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=fan_in,
+                       client_num_per_round=fan_in, batch_size=4,
+                       frequency_of_the_test=10_000, seed=0)
+    _mark(t0, f"fused A/B workload built (fan-in {fan_in})")
+
+    def synth_uploads(net_leaves, seed):
+        """One cohort's encoded delta-int8 uploads (client work — never
+        inside the flush timer)."""
+        rs = np.random.RandomState(seed)
+        out = []
+        for _ in range(fan_in):
+            local = [v + rs.randn(*np.shape(v)).astype(np.float32) * 0.01
+                     for v in net_leaves]
+            out.append(encode_update(round_delta(local, net_leaves),
+                                     "delta-int8"))
+        return out
+
+    def leg(fused: bool) -> dict:
+        agg = FedAvgAggregator(data, task, cfg, worker_num=fan_in,
+                               fused_agg=fused,
+                               sum_assoc="auto" if fused else "pairwise")
+        flush_s, ingest_s, rss_deltas = [], [], []
+        for r in range(rounds + 1):  # round 0 = warm (jit compiles)
+            agg.begin_round(r)
+            base = [np.asarray(v) for v in pack_pytree(agg.net)]
+            base_dev = [jax.device_put(v) for v in base] if fused else None
+            uploads = synth_uploads(base, seed=100 + r)
+            rss0 = host_rss_bytes() or 0
+            # INGEST phase: per-arrival work — in production this runs
+            # under the receive path while OTHER clients still train, so
+            # it is off the barrier's critical path at realistic arrival
+            # spreads; timed per cohort (synced) for the A/B anyway
+            tl = time.perf_counter()
+            for rank, (payload, scales) in enumerate(uploads):
+                if fused:
+                    raw, sc = inflate_update(payload, scales, "delta-int8",
+                                             base)
+                    agg.add_fused_result(rank, "delta-int8", raw, sc,
+                                         10, r, base_dev)
+                else:
+                    dec = decode_update(payload, scales, "delta-int8", base)
+                    agg.add_local_trained_result(
+                        rank, apply_delta(base, dec), 10, r)
+            if fused:
+                agg._fused.block_until_ready()
+            else:
+                jax.block_until_ready(
+                    [v for leaves in agg.model_dict.values()
+                     for v in leaves if isinstance(v, jax.Array)])
+            t_ing = time.perf_counter() - tl
+            rss1 = host_rss_bytes() or 0
+            # FLUSH phase: barrier -> new global model. ALWAYS serialized
+            # on the round's critical path — this is the Smart-NIC
+            # seconds-per-flush number. Stacked pays the [K, ...] stack +
+            # gagg here; fused only merges O(log K) partials + divides.
+            tl = time.perf_counter()
+            agg._aggregate_core()
+            jax.block_until_ready(jax.tree.leaves(agg.net))
+            t_fl = time.perf_counter() - tl
+            if r > 0:
+                ingest_s.append(t_ing)
+                flush_s.append(t_fl)
+                rss_deltas.append(rss1 - rss0)
+        return {"seconds_per_flush": round(float(np.mean(flush_s)), 4),
+                "flush_s": [round(float(s), 4) for s in flush_s],
+                "ingest_seconds_per_cohort":
+                    round(float(np.mean(ingest_s)), 4),
+                "server_seconds_per_round": round(
+                    float(np.mean(ingest_s) + np.mean(flush_s)), 4),
+                "ingest_rss_delta_bytes": int(np.max(rss_deltas)),
+                "rss_end_bytes": int(host_rss_bytes() or 0),
+                "stack_bytes": int(agg._last_flush["stack_bytes"]),
+                "fan_in": fan_in}
+
+    stacked = leg(False)
+    _mark(t0, f"stacked leg: {stacked['seconds_per_flush']}s/flush + "
+              f"{stacked['ingest_seconds_per_cohort']}s ingest")
+    fused = leg(True)
+    _mark(t0, f"fused leg: {fused['seconds_per_flush']}s/flush + "
+              f"{fused['ingest_seconds_per_cohort']}s ingest")
+    rec = {
+        "metric": "fedavg_fused_flush_speedup",
+        "value": round(stacked["seconds_per_flush"]
+                       / max(fused["seconds_per_flush"], 1e-9), 2),
+        "unit": "x_stacked_flush_over_fused",
+        "mode": "fused_ab",
+        "fused_ab": {"stacked": stacked, "fused": fused},
+        "fused_flush_speedup": round(
+            stacked["seconds_per_flush"]
+            / max(fused["seconds_per_flush"], 1e-9), 2),
+        # whole-server-round ratio (ingest + flush, both synced): the
+        # conservative number — ingest normally overlaps client training
+        "fused_server_round_speedup": round(
+            stacked["server_seconds_per_round"]
+            / max(fused["server_seconds_per_round"], 1e-9), 2),
+        "fused_ingest_rss_delta_bytes": fused["ingest_rss_delta_bytes"],
+        "stacked_ingest_rss_delta_bytes": stacked["ingest_rss_delta_bytes"],
+        "fused_stack_bytes": fused["stack_bytes"],
+        "stacked_stack_bytes": stacked["stack_bytes"],
+        "fan_in": fan_in,
+        "rounds": rounds,
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+
+
+def _bf16_dataset_dir() -> tuple[str, int]:
+    """Size-skewed packed population for the bf16+bucket A/B: the static
+    batch budget is priced by a 480-row tail client (0.1% of the
+    population) while typical cohorts need a fraction of it — the
+    FEMNIST-lognormal shape the bucket ladder exists for."""
+    from fedml_tpu.core.client_source import PackedNpySource
+    from fedml_tpu.data.synthetic import synthetic_packed_population
+
+    n = _env_int("FEDML_BENCH_BF16_CLIENTS", 100_000)
+    dim = _env_int("FEDML_BENCH_BF16_DIM", 32)
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tmp",
+                     f"bench_bf16_{n}x{dim}")
+    if not os.path.isfile(os.path.join(d, "meta.json")):
+        # 0.1% of clients at 480 rows, the rest 6-25: the static budget is
+        # 60 batches while a typical 16-client cohort needs <= 4 — REAL
+        # natural-partition shape (FEMNIST's lognormal max is ~20x its
+        # p50), and exactly the regime the bucket ladder targets
+        synthetic_packed_population(d, n, dim=dim, tail_size=480,
+                                    tail_every=1000)
+        PackedNpySource(d).close()
+    return d, n
+
+
+def _measure_bf16(leg: str) -> None:
+    """One FEDML_BENCH_FUSED bf16 A/B leg in its own process: ``f32`` is
+    the pre-policy engine (f32 compute, static batch budget every round),
+    ``bf16`` the bf16+bucketed-vmap path (bf16 casts in the vmapped fits,
+    per-cohort ladder depth). Matched rounds/seed/cohort over the same
+    100k-client streamed population; reports rounds/s."""
+    import dataclasses as _dc
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+    from fedml_tpu.core.client_source import PackedNpySource
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.models.linear import LogisticRegression
+
+    t0 = time.perf_counter()
+    d, n = _bf16_dataset_dir()
+    rounds = _env_int("FEDML_BENCH_BF16_ROUNDS", 42)
+    src = PackedNpySource(d)
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=n,
+                       client_num_per_round=16, batch_size=8, lr=0.1,
+                       epochs=_env_int("FEDML_BENCH_BF16_EPOCHS", 6),
+                       frequency_of_the_test=10_000, seed=0)
+    if leg == "bf16":
+        cfg = _dc.replace(cfg, precision="bf16")
+    task = classification_task(LogisticRegression(num_classes=5))
+    api = FedAvgAPI(src, task, cfg, bucket_batches=(leg == "bf16"))
+    api.warmup()
+    api.run_round(0)
+    api.run_round(1)
+    _mark(t0, f"bf16 A/B leg {leg}: warm (2 rounds)")
+    tl = time.perf_counter()
+    for r in range(2, rounds):
+        api.run_round(r)
+    jax.block_until_ready(jax.tree.leaves(api.net.params))
+    dt = time.perf_counter() - tl
+    src.close()
+    rec = {
+        "leg": leg, "clients": n, "rounds": rounds,
+        "bucketed": leg == "bf16",
+        "seconds": round(dt, 3),
+        "rounds_per_sec": round((rounds - 2) / dt, 3),
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def _stream_dataset_dir() -> tuple[str, int]:
     """Deterministic packed-npy population under ./tmp (built once,
     reused by both A/B legs so they read identical bytes) — the ONE
@@ -748,6 +967,41 @@ def _measure_stream(leg: str) -> None:
 
 def main() -> None:
     here = os.path.abspath(__file__)
+    if os.environ.get("FEDML_BENCH_FUSED") is not None or \
+            os.environ.get("FEDML_BENCH_FUSED_AGG") is not None:
+        # fused-aggregation + bf16 A/B pair (docs/PERFORMANCE.md §Fused
+        # aggregation / §Mixed precision) -> the BENCH_FUSED blob. Either
+        # env var (any value) TRIGGERS the full A/B: both halves' legs
+        # always run and ride the blob, and the headline is the fused
+        # flush speedup (a ratio has no single-leg form to pick).
+        # Forced-CPU children: the flush A/B isolates the server's
+        # decode→sum chain, the bf16 A/B runs one child per leg at
+        # matched rounds.
+        rc, out = _run_child([here, "--measure", "fused_agg"],
+                             _cpu_env(os.environ),
+                             _env_int("FEDML_BENCH_FUSED_TIMEOUT", 900))
+        fused_rec = _last_json_line(out)
+        if fused_rec is None:
+            raise RuntimeError(f"bench: fused A/B child failed (rc={rc})")
+        legs = {}
+        for leg in ("f32", "bf16"):
+            rc, out = _run_child([here, "--measure", f"bf16_{leg}"],
+                                 _cpu_env(os.environ),
+                                 _env_int("FEDML_BENCH_BF16_TIMEOUT", 900))
+            rec = _last_json_line(out)
+            if rec is None:
+                raise RuntimeError(
+                    f"bench: bf16 A/B {leg} child failed (rc={rc})")
+            legs[leg] = rec
+        speedup = round(legs["bf16"]["rounds_per_sec"]
+                        / max(legs["f32"]["rounds_per_sec"], 1e-9), 2)
+        fused_rec.update({
+            "bf16_ab": legs,
+            "bf16_rounds_per_sec_speedup": speedup,
+            "bf16_clients": legs["bf16"]["clients"],
+        })
+        _emit(fused_rec)
+        return
     if os.environ.get("FEDML_BENCH_STREAM") is not None:
         # streamed-vs-materialized data-plane A/B (docs/PERFORMANCE.md
         # §Streaming & cohort bucketing) — one forced-CPU child PER LEG
@@ -938,6 +1192,10 @@ if __name__ == "__main__":
             _measure_async()
         elif sys.argv[2] == "codec":
             _measure_codec()
+        elif sys.argv[2] == "fused_agg":
+            _measure_fused_agg()
+        elif sys.argv[2].startswith("bf16_"):
+            _measure_bf16(sys.argv[2][len("bf16_"):])
         elif sys.argv[2].startswith("stream_"):
             _measure_stream(sys.argv[2][len("stream_"):])
         else:

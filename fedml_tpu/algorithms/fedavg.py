@@ -244,6 +244,15 @@ class FedAvgConfig:
     # aggregate (the FedAvg paper's alternative scheme — both are
     # unbiased; size-weighting concentrates rounds on data-rich clients)
     sampling: str = "uniform"
+    # client-compute precision policy (docs/PERFORMANCE.md §Mixed
+    # precision): 'bf16' runs the vmapped local fits on bfloat16 casts of
+    # the f32 master weights (grad-scale-free; aggregation and the server
+    # update stay f32); 'f32' (default) traces no casts — bit-identical
+    # to the pre-policy engine (test-enforced). Applied through the
+    # default LocalSpec in BOTH runtimes (FedAvgAPI and the cross-process
+    # DistributedTrainer), and grafted onto an explicitly-passed
+    # LocalSpec that left compute_dtype at its default.
+    precision: str = "f32"
     # per-client eval inside train() (reference _local_test_on_all_clients,
     # fedavg_api.py:117-180: every eval round the CURRENT global model is
     # scored on EVERY client's own train and test split, aggregated by
@@ -251,6 +260,29 @@ class FedAvgConfig:
     # test splits (natural partitions — where the weighting differs from a
     # shared global test set); 'on'/'off' force it.
     local_test_on_all_clients: str = "auto"
+
+
+def resolve_local_spec(local_spec: LocalSpec | None,
+                       cfg: FedAvgConfig) -> LocalSpec:
+    """The engine's LocalSpec: the default build honors ``cfg.precision``;
+    an explicitly-passed spec (fedprox's prox_spec, engine subclasses)
+    that left ``compute_dtype`` at its default is grafted with it, so
+    ``precision='bf16'`` composes with every engine instead of silently
+    reverting to f32 — a spec that SET its own compute_dtype wins."""
+    from fedml_tpu.core.local import COMPUTE_DTYPES
+
+    prec = getattr(cfg, "precision", "f32")
+    if prec not in COMPUTE_DTYPES:
+        raise ValueError(f"precision={prec!r} (one of "
+                         f"{sorted(COMPUTE_DTYPES)})")
+    if local_spec is None:
+        return LocalSpec(optimizer=make_client_optimizer(cfg),
+                         epochs=cfg.epochs, remat=cfg.remat,
+                         compute_dtype=prec)
+    if COMPUTE_DTYPES[prec] is not None \
+            and local_spec.compute_dtype in ("f32", "float32"):
+        return dataclasses.replace(local_spec, compute_dtype=prec)
+    return local_spec
 
 
 def make_client_optimizer(cfg: FedAvgConfig) -> optax.GradientTransformation:
@@ -459,10 +491,7 @@ class FedAvgAPI:
         ladder = sorted({-(-self.num_batches // d) for d in (8, 4, 2, 1)})
         self._b_ladder = [b for b in ladder if b > 0]
 
-        self.local_spec = local_spec or LocalSpec(
-            optimizer=make_client_optimizer(config), epochs=config.epochs,
-            remat=config.remat,
-        )
+        self.local_spec = resolve_local_spec(local_spec, config)
         self.local_update = make_local_update(task, self.local_spec)
         self.eval_fn = make_eval_fn(task)
 
@@ -574,6 +603,11 @@ class FedAvgAPI:
             "server_state_bytes_per_device": int(per_dev),
             "bytes_per_round": int(self._agg_bytes_round),
         }
+        # mixed-precision runs stamp the policy on every round record
+        # (report.py's `prec` column; absent = f32, so pre-policy logs
+        # render unchanged)
+        if self.local_spec.compute_dtype not in ("f32", "float32"):
+            self._agg_record["prec"] = self.local_spec.compute_dtype
 
     # ------------------------------------------------------------------ round
     def _round_body(self, keys, net, server_opt_state, x, y, mask, nsamp,
@@ -1345,10 +1379,15 @@ class FedAvgAPI:
                    else [self.num_batches])
         rng = jax.random.PRNGKey(0)
         r0, ids = jnp.int32(0), jnp.zeros((K,), jnp.int32)
+        # precision x bucket variant naming: a bf16 engine's warmed
+        # executables are DIFFERENT programs from the f32 engine's, and
+        # the report must say which ladder was precompiled
+        prec = ("" if self.local_spec.compute_dtype in ("f32", "float32")
+                else f"_{self.local_spec.compute_dtype}")
         lowered = {}
         if per_round:
             for B in buckets:
-                lowered[f"round_b{B}"] = self.round_fn.lower(
+                lowered[f"round{prec}_b{B}"] = self.round_fn.lower(
                     rng, self.net, self.server_opt_state,
                     self._warmup_batch(B), r0, ids)
         if block_rounds and self.device_data and not self.block_working_set \
@@ -1367,7 +1406,7 @@ class FedAvgAPI:
                                        P(None, self.mesh.axis_names[0]))
                     blocks = [jax.device_put(b, sh) for b in blocks]
                 blocks = [jnp.asarray(b) for b in blocks]
-                lowered[f"block_r{R}_b{B}"] = self._block_fn.lower(
+                lowered[f"block{prec}_r{R}_b{B}"] = self._block_fn.lower(
                     rng, self.net, self.server_opt_state,
                     self._dev_x, self._dev_y, *blocks,
                     jnp.asarray(np.arange(R, dtype=np.int32)))

@@ -208,17 +208,47 @@ class HostSync(Rule):
 
     name = "host-sync"
     description = ("no float()/int()/bool()/.item()/np.asarray on traced "
-                   "values inside jitted code in core/, algorithms/, "
-                   "distributed/")
+                   "values inside jitted code — and no blocking "
+                   "float(jnp.*(...))-style fetches on host hot paths — "
+                   "in core/, algorithms/, distributed/")
 
     _CASTS = frozenset({"float", "int", "bool"})
     _MATERIALIZE = frozenset({"np.asarray", "np.array", "numpy.asarray",
                               "numpy.array", "jax.device_get",
                               "onp.asarray", "onp.array"})
+    _JNP_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.")
+
+    def _is_device_expr(self, node: ast.AST) -> bool:
+        """A call that transparently produces a device value: jnp.sum(x),
+        jax.lax.*, jnp.linalg.norm(...) — the argument shape of the
+        blocking-fetch pattern."""
+        if not isinstance(node, ast.Call):
+            return False
+        name = dotted(node.func)
+        return bool(name) and name.startswith(self._JNP_PREFIXES)
 
     def check(self, module: Module) -> Iterator[Finding]:
         if not module.in_dirs("core", "algorithms", "distributed"):
             return
+        # blocking device fetches fused into HOST expressions:
+        # float(jnp.sum(x)) on a round path blocks the dispatch pipeline
+        # per call (the FedAvgAggregator all-quarantined check shipped
+        # exactly this) — flag the cast-of-a-jnp-call pattern module-wide;
+        # traced functions are covered by the generic cast walk below
+        traced_nodes = {id(n) for fn in traced_functions(module)
+                        for n in ast.walk(fn)}
+        for node in ast.walk(module.tree):
+            if id(node) in traced_nodes or not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name in self._CASTS and len(node.args) == 1 \
+                    and self._is_device_expr(node.args[0]):
+                inner = dotted(node.args[0].func)
+                yield module.finding(self, node, (
+                    f"blocking device fetch {name}({inner}(...)) on a "
+                    "host path — the cast synchronizes on the device "
+                    "result; derive the flag from already-fetched host "
+                    "state, or sync once at the drain point"))
         for fn in traced_functions(module):
             for node in ast.walk(fn):
                 if not isinstance(node, ast.Call):

@@ -239,6 +239,71 @@ def decode_update(payload, scales, codec: str, template_leaves) -> list:
     return out
 
 
+def inflate_update(payload, scales, codec: str,
+                   template_leaves) -> tuple[list, np.ndarray]:
+    """Structural half of :func:`decode_update` for the fused on-device
+    server path (docs/PERFORMANCE.md §Fused aggregation): validate the
+    payload's structure and return the RAW quantized per-leaf arrays —
+    inflated flat int8 for ``delta-int8`` (zlib cannot run in a jit, and
+    int8 is 4x smaller than the f32 tree the stacked path materializes),
+    packed sign BYTES for ``delta-sign1``, flat f32 deltas for ``delta``,
+    dense non-float leaves verbatim — ready for the on-device densify in
+    ``core/fused_agg.py``. VALUE garbage still flows through (a NaN scale
+    decodes non-finite on device and dies at the in-graph gate);
+    structural garbage raises :class:`CorruptPayload` exactly like
+    :func:`decode_update`."""
+    if codec not in UPDATE_CODECS:
+        raise ValueError(
+            f"unknown update codec {codec!r} (one of {UPDATE_CODECS})")
+    if len(payload) != len(template_leaves) or \
+            len(np.atleast_1d(scales)) != len(template_leaves):
+        raise CorruptPayload(
+            f"update payload has {len(payload)} leaves / "
+            f"{len(np.atleast_1d(scales))} scales, model has "
+            f"{len(template_leaves)}")
+    scales = np.atleast_1d(np.asarray(scales, np.float32))
+    out = []
+    for p, t in zip(payload, template_leaves):
+        t = np.asarray(t)
+        if not _is_float(t):
+            p = np.asarray(p)
+            if p.size != t.size:
+                # the fused densify reshapes on device — a wrong-sized
+                # dense leaf must die HERE as structural garbage, not as
+                # a trace error inside the server's receive loop
+                raise CorruptPayload(
+                    f"dense leaf has {p.size} entries, model leaf has "
+                    f"{t.size}")
+            out.append(p)
+            continue
+        if codec == "delta":
+            p = np.asarray(p, np.float32)
+            if p.size != t.size:
+                raise CorruptPayload(
+                    f"delta leaf has {p.size} entries, model leaf has "
+                    f"{t.size}")
+            out.append(p.reshape(-1))
+        elif codec == "delta-int8":
+            try:
+                raw = zlib.decompress(np.asarray(p, np.uint8).tobytes())
+            except zlib.error as e:
+                raise CorruptPayload(f"int8 payload failed to inflate: {e}")
+            q = np.frombuffer(raw, np.int8)
+            if q.size != t.size:
+                raise CorruptPayload(
+                    f"int8 payload has {q.size} entries, model leaf has "
+                    f"{t.size}")
+            out.append(q)
+        else:  # delta-sign1
+            p = np.asarray(p, np.uint8)
+            if p.size * 8 < t.size:
+                raise CorruptPayload(
+                    f"sign payload has {p.size * 8} bits, model leaf has "
+                    f"{t.size}")
+            out.append(p)
+    return out, scales
+
+
 def payload_nbytes(payload, scales) -> int:
     """Wire-payload bytes of one encoded update (tests/bench evidence)."""
     return int(sum(np.asarray(p).nbytes for p in payload)

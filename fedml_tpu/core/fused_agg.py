@@ -1,0 +1,311 @@
+"""Fused on-device server aggregation — decode → gate → pairwise partials.
+
+The stacked server path (distributed/fedavg/aggregator._aggregate_core)
+densifies every encoded upload to host f32 (``server_manager._decode_upload``
+runs zlib + numpy per rank), re-stacks the whole cohort per leaf, and only
+then hands the gagg jit a ``[K, ...]`` stack — at fan-in 100+ the
+decode→gate→sum chain on the server is the round bottleneck (the Smart-NIC
+aggregation lesson, arXiv:2307.06561). This module is the fused alternative
+(docs/PERFORMANCE.md §Fused aggregation):
+
+- uploads stage to device AS THEIR RAW QUANTIZED LEAVES (deflated int8 is
+  inflated host-side to int8 — zlib cannot run in a jit, and int8 is 4x
+  smaller than the f32 tree the stacked path materializes; packed sign
+  BYTES and sparse idx/val go up verbatim);
+- ONE jitted ingest per arrival runs decode → densify against the
+  device-resident broadcast stash → the unconditional non-finite gate
+  (:func:`make_fused_ingest`), so a per-client f32 tree never exists on
+  host;
+- arrivals accumulate into the CANONICAL pairwise partial sums — the
+  :class:`PairwiseAccumulator` is a binary counter whose nodes are exactly
+  the aligned-block internal nodes of ``robust_agg.pairwise_sum``'s
+  balanced tree, so peak device memory is O(log fan-in) partials on the
+  in-order path instead of the full ``[K, ...]`` stack (out-of-order
+  arrivals pend until the slot cursor reaches them — the worst case decays
+  to O(K) single-slot nodes, never worse than the stack);
+- flush merges the counter, divides ONCE through the shared
+  ``robust_agg.pairwise_finalize`` (zero surviving weight keeps the global
+  model), and the new global model lands device-resident.
+
+Bitwise contract: the fused result is BIT-IDENTICAL to the stacked route
+under ``sum_assoc='pairwise'`` for the same arrived slots — gate reasons
+and quarantine ledger included (test-enforced). Three pieces make that
+hold across jit boundaries:
+
+- the per-arrival decode replays the host decoders' exact f32 ops
+  (``comm/delta._q8_leaf_decode`` / ``_sign_leaf_decode`` /
+  ``apply_delta`` / ``sparse.topk_decode``) and the gate is the per-slot
+  half of ``sanitize_updates`` (``norm_mult=inf`` — the only gate the
+  fused fold supports: the norm-outlier rule is a cohort statistic
+  computed at flush, AFTER arrivals were already folded, so robust
+  estimators and armed sanitize keep the stacked route and are refused
+  loudly when fused is forced);
+- the accumulator's LEVEL-1 combine compiles the identical
+  ``c0*w0 + c1*w1`` expression ``pairwise_weighted_stats`` evaluates per
+  aligned slot pair (XLA contracts that multiply+add to an fma — which is
+  exactly why the stacked fold pre-pads its slot axis to even length:
+  uniform level-1 expressions are what make the fold reproducible pair by
+  pair from a different jit);
+- levels >= 2 are plain adds of materialized partials on both routes.
+
+Poison policy is inherited unchanged: a NaN scale decodes non-finite ON
+DEVICE and dies at the in-graph gate; structural garbage never reaches the
+device (``comm/delta.inflate_update`` raises ``CorruptPayload`` host-side,
+quarantined ``undecodable`` exactly like the stacked path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.robust_agg import (
+    REASON_NONFINITE,
+    REASON_OK,
+    pairwise_finalize,
+)
+
+FUSED_KINDS = ("dense", "delta", "delta-int8", "delta-sign1", "topk")
+
+# one jitted partial-sum add serves every level >= 2 combine (jit caches by
+# structure: (wsum leaves, weight total) tuples all share one trace)
+_tree_add = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))
+
+_finalize = jax.jit(pairwise_finalize)
+
+
+@jax.jit
+def _pair_combine(c0, w0, c1, w1):
+    """Level-1 combine of two RAW slots: the exact per-pair expression of
+    ``pairwise_weighted_stats``'s first fold level (slot-axis pre-padded to
+    even, so every aligned pair evaluates ``c0*w0 + c1*w1`` — bit-for-bit
+    the same contraction here and there)."""
+    term = [a.astype(jnp.float32) * w0 + b.astype(jnp.float32) * w1
+            for a, b in zip(c0, c1)]
+    return term, w0 + w1
+
+
+class PairwiseAccumulator:
+    """Streaming canonical pairwise fold — ``pairwise_sum``'s association,
+    one slot at a time.
+
+    A binary counter over push order: level 0 holds (at most) one RAW
+    ``(clean_leaves, weight)`` slot, level ``l >= 1`` one complete ALIGNED
+    partial of ``2**l`` consecutive slots. Pushing carry-propagates exactly
+    the adjacent combines the stacked fold performs — the level-1 combine
+    multiplies weights in (``_pair_combine``), higher levels add partials —
+    so after K in-order pushes the live nodes ARE the canonical tree's
+    internal nodes (O(log K) of them). :meth:`merge` pads the count to the
+    next power of two with exact-zero raw slots, which is bitwise the
+    stacked fold's zero-padding (its even pre-pad + per-level odd-tail
+    pads; unrolled, leaf-padding to the next power of two)."""
+
+    def __init__(self, zero_fn):
+        self._zero_fn = zero_fn  # () -> an exact-zero RAW (clean, w) slot
+        self._levels: dict[int, object] = {}
+        self._count = 0
+        self.peak_nodes = 0  # live-node high-water mark (memory evidence)
+
+    def __len__(self) -> int:
+        return self._count
+
+    @property
+    def live_nodes(self) -> int:
+        return len(self._levels)
+
+    def push(self, raw) -> None:
+        """Append one RAW ``(clean_leaves, weight)`` slot and carry."""
+        if 0 not in self._levels:
+            self._levels[0] = raw
+        else:
+            c0, w0 = self._levels.pop(0)
+            c1, w1 = raw
+            node, lvl = _pair_combine(c0, w0, c1, w1), 1
+            while lvl in self._levels:
+                node = _tree_add(self._levels.pop(lvl), node)
+                lvl += 1
+            self._levels[lvl] = node
+        self._count += 1
+        self.peak_nodes = max(self.peak_nodes, len(self._levels))
+
+    def merge(self):
+        """Collapse to the single root ``(wsum_leaves, total)`` partial
+        (None when nothing was pushed). The accumulator is spent after."""
+        if self._count == 0:
+            return None
+        target = 1 << max(self._count - 1, 0).bit_length()
+        if target == 1:
+            target = 2  # the stacked fold pre-pads a lone slot to a pair
+        while self._count < target:
+            self.push(self._zero_fn())
+        (node,) = self._levels.values()
+        self._levels = {}
+        return node
+
+
+def _leaf_meta(leaves) -> tuple:
+    """Static (shape, dtype) per leaf — the decode functions specialize on
+    it (non-float leaves ship dense and REPLACE, float leaves densify)."""
+    return tuple((tuple(np.shape(v)), np.dtype(jnp.asarray(v).dtype))
+                 for v in leaves)
+
+
+def term_nbytes(meta) -> int:
+    """Bytes of ONE partial/slot (every leaf f32 in the fold) — the unit
+    of the fed_agg_stack_bytes{mode=fused} accounting."""
+    return int(sum(4 * int(np.prod(shape, dtype=np.int64)) if shape else 4
+                   for shape, _ in meta))
+
+
+def _unpack_sign_bits(packed, n: int):
+    """Device twin of ``np.unpackbits``: MSB-first bits of each byte,
+    truncated to ``n`` — bit-exact (the values are 0/1)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (packed[:, None] >> shifts[None, :]) & jnp.uint8(1)
+    return bits.reshape(-1)[:n]
+
+
+def _densify(kind: str, meta, payload, scales, base_leaves):
+    """Traceable: one upload's raw wire payload -> the client's effective
+    model leaves, replicating the HOST decode path's f32 ops bit for bit
+    (``comm/delta`` decoders + ``apply_delta``; ``comm/sparse.topk_decode``).
+    Non-float leaves ship dense and replace (the shared leaf convention)."""
+    out = []
+    if kind == "dense":
+        for p, (shape, dtype) in zip(payload, meta):
+            out.append(jnp.asarray(p).reshape(shape))
+        return out
+    if kind == "topk":
+        idx_list, val_list = payload
+        for g, sel, vals, (shape, dtype) in zip(base_leaves, idx_list,
+                                                val_list, meta):
+            if not np.issubdtype(dtype, np.floating):
+                out.append(jnp.asarray(vals).reshape(shape))
+                continue
+            flat = jnp.asarray(g, jnp.float32).reshape(-1)
+            flat = flat.at[jnp.asarray(sel)].add(
+                jnp.asarray(vals, jnp.float32))
+            out.append(flat.reshape(shape).astype(dtype))
+        return out
+    for i, (p, g, (shape, dtype)) in enumerate(zip(payload, base_leaves,
+                                                   meta)):
+        if not np.issubdtype(dtype, np.floating):
+            out.append(jnp.asarray(p).reshape(shape))
+            continue
+        s = jnp.asarray(scales[i], jnp.float32)
+        if kind == "delta":
+            d = jnp.asarray(p, jnp.float32).reshape(shape)
+        elif kind == "delta-int8":
+            d = (jnp.asarray(p).astype(jnp.float32) * s).reshape(shape)
+        else:  # delta-sign1
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            bits = _unpack_sign_bits(jnp.asarray(p), n)
+            d = jnp.where(bits.astype(bool), s, -s) \
+                .astype(jnp.float32).reshape(shape)
+        out.append((jnp.asarray(g, jnp.float32) + d).astype(dtype))
+    return out
+
+
+def make_fused_ingest(kind: str, meta):
+    """Build the jitted per-arrival composition for payload ``kind`` over a
+    model with leaf ``meta``: decode → densify → non-finite gate. Returns
+    ``fn(payload, scales, base, global, w) ->
+    (clean_leaves, surviving_weight, reason)`` replicating — slot for
+    slot, bit for bit — the per-slot half of ``sanitize_updates``
+    (``norm_mult=inf``) inside the stacked route."""
+    if kind not in FUSED_KINDS:
+        raise ValueError(f"unknown fused payload kind {kind!r} "
+                         f"(one of {FUSED_KINDS})")
+
+    @jax.jit
+    def ingest(payload, scales, base_leaves, global_leaves, w):
+        eff = _densify(kind, meta, payload, scales, base_leaves)
+        finite = jnp.ones((), bool)
+        for e in eff:
+            finite &= jnp.all(jnp.isfinite(e))
+        # the per-slot half of sanitize_updates: replace a non-finite
+        # upload with the global model (a zero WEIGHT alone would still
+        # poison 0 * nan) and zero its weight; report nonfinite only for
+        # participating (w > 0) slots — identical reason codes to the gate
+        clean = [jnp.where(finite, e, g.astype(e.dtype))
+                 for e, g in zip(eff, global_leaves)]
+        w = jnp.asarray(w, jnp.float32)
+        w_out = jnp.where(finite, w, jnp.float32(0.0))
+        reason = jnp.where(
+            w > 0,
+            jnp.where(finite, REASON_OK, REASON_NONFINITE),
+            REASON_OK).astype(jnp.int32)
+        return clean, w_out, reason
+
+    return ingest
+
+
+class FusedRoundIngest:
+    """One round's device-resident fused ingest state.
+
+    Slots are worker indices; arrivals push into the accumulator strictly
+    in SLOT order (a cursor: out-of-order arrivals pend device-resident
+    until every lower slot arrived or the flush skips the holes) — so the
+    fold is the canonical pairwise association over the COMPACTED sorted
+    arrival set, exactly the layout ``_aggregate_core`` stacks, and fused
+    ≡ stacked stays bitwise whatever order the wire delivered."""
+
+    def __init__(self, global_leaves, meta):
+        self._global = [jnp.asarray(v) for v in global_leaves]
+        self._meta = meta
+        zero = ([jnp.zeros(shape, dtype) for shape, dtype in meta],
+                jnp.zeros((), jnp.float32))
+        self._acc = PairwiseAccumulator(lambda: zero)
+        self._pending: dict[int, tuple] = {}
+        self._reasons: dict[int, jax.Array] = {}
+        self._cursor = 0
+        self.slots: set[int] = set()
+        self.peak_terms = 0
+
+    def add(self, slot: int, ingest_fn, payload, scales, base_leaves,
+            weight: float) -> None:
+        if slot in self.slots:
+            # exactly-once folding: a chaos duplicate that survived the
+            # upstream dedup gates must not double-count (the stacked
+            # path's dict overwrite is idempotent for identical content)
+            return
+        clean, w_out, reason = ingest_fn(
+            payload,
+            jnp.zeros((0,), jnp.float32) if scales is None
+            else jnp.asarray(scales, jnp.float32),
+            self._global if base_leaves is None else list(base_leaves),
+            self._global, jnp.float32(weight))
+        self.slots.add(slot)
+        self._reasons[slot] = reason
+        self._pending[slot] = (clean, w_out)
+        while self._cursor in self._pending:
+            self._acc.push(self._pending.pop(self._cursor))
+            self._cursor += 1
+        self.peak_terms = max(self.peak_terms,
+                              self._acc.live_nodes + len(self._pending))
+
+    def block_until_ready(self) -> None:
+        """Synchronize on every live device node (counter partials +
+        pending out-of-order slots) — the measurement seam benches use to
+        separate ingest work from the flush without reaching into the
+        accumulator's internals."""
+        for node in list(self._acc._levels.values()) \
+                + list(self._pending.values()):
+            jax.block_until_ready(node)
+
+    def flush(self):
+        """Merge → finalize: returns ``(new_global_leaves, reasons)`` with
+        ``reasons`` the ``[K']`` int32 codes over the sorted arrived slots
+        (the stacked route's compacted layout). The all-rejected round
+        keeps the global model via the shared ``pairwise_finalize``."""
+        for slot in sorted(self._pending):  # straggler holes: skip, like
+            self._acc.push(self._pending.pop(slot))  # the stacked compact
+        node = self._acc.merge()
+        if node is None:
+            return None, None
+        wsum, total = node
+        new_leaves = _finalize(wsum, total, self._global)
+        reasons = jnp.stack([self._reasons[s] for s in sorted(self.slots)])
+        return new_leaves, reasons

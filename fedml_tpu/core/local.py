@@ -64,6 +64,16 @@ class LocalSpec:
     # HBM across it — the standard TPU memory/FLOPs trade for deep models
     # or long sequences. Numerics are identical (test-enforced).
     remat: bool = False
+    # client-compute precision policy (docs/PERFORMANCE.md §Mixed
+    # precision): 'bf16' casts params/extras/float inputs to bfloat16 for
+    # the per-batch forward+backward (MXU-rate matmuls on TPU) while the
+    # f32 MASTER weights stay the scan carry — gradients flow back through
+    # the cast as f32 cotangents, the optimizer step / aggregation /
+    # server update stay f32, and no loss scaling is needed (bfloat16
+    # keeps f32's exponent range). 'f32' (default) traces NO casts: the
+    # round program is bit-identical to the pre-policy build
+    # (test-enforced).
+    compute_dtype: str = "f32"
 
 
 def _vma_of(tree) -> frozenset:
@@ -90,6 +100,21 @@ def _match_vma(tree, target_vma: frozenset):
     return jax.tree.map(f, tree)
 
 
+# accepted spellings of the LocalSpec precision policy -> compute dtype
+# (None = no casts traced at all; the policy table of docs/PERFORMANCE.md
+# §Mixed precision)
+COMPUTE_DTYPES = {"f32": None, "float32": None,
+                  "bf16": "bfloat16", "bfloat16": "bfloat16"}
+
+
+def _cast_floats(tree, dtype):
+    """Float leaves -> ``dtype``; everything else untouched (labels,
+    masks, integer counters keep their dtypes)."""
+    return jax.tree.map(
+        lambda v: v.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating) else v, tree)
+
+
 def make_local_update(task: Task, spec: LocalSpec):
     """Build the pure local-fit function for one client.
 
@@ -100,8 +125,20 @@ def make_local_update(task: Task, spec: LocalSpec):
     metrics: dict of scalars averaged/summed over real samples only.
     The fn is vma-aware: when traced inside shard_map (varying params) it
     casts the opt-state carry to match, so it needs no axis plumbing.
+
+    ``spec.compute_dtype='bf16'`` arms the mixed-precision policy: the
+    loss/grad pass runs on bf16 casts of the f32 master params (and float
+    inputs/extras), grads land f32 through the cast's transpose, and the
+    optimizer/carry/upload stay f32 — see docs/PERFORMANCE.md §Mixed
+    precision. The default traces no casts (bit-identity contract).
     """
     optimizer = spec.optimizer
+    if spec.compute_dtype not in COMPUTE_DTYPES:
+        raise ValueError(
+            f"compute_dtype={spec.compute_dtype!r} (one of "
+            f"{sorted(COMPUTE_DTYPES)})")
+    cdt = COMPUTE_DTYPES[spec.compute_dtype]
+    cdt = jnp.dtype(cdt) if cdt is not None else None
 
     def batch_step(carry, batch):
         params, extra, opt_state, global_params, rng = carry
@@ -109,7 +146,24 @@ def make_local_update(task: Task, spec: LocalSpec):
         rng, sub = jax.random.split(rng)
 
         def total_loss(p):
-            loss, new_extra, metr = task.loss(p, extra, x, y, m, sub, True)
+            if cdt is None:
+                loss, new_extra, metr = task.loss(p, extra, x, y, m, sub,
+                                                  True)
+            else:
+                # bf16 compute, f32 masters: the casts sit INSIDE the
+                # grad closure so autodiff transposes them back to f32
+                # cotangents; loss/metrics/extras re-land f32 so the scan
+                # carry (and the uploaded NetState) never changes dtype.
+                # grad-scale-free by design — bf16 keeps f32's exponent
+                # range, so underflow scaling (the fp16 ritual) is moot.
+                loss, new_extra, metr = task.loss(
+                    _cast_floats(p, cdt), _cast_floats(extra, cdt),
+                    _cast_floats(x, cdt), y, m, sub, True)
+                loss = loss.astype(jnp.float32)
+                new_extra = jax.tree.map(
+                    lambda nv, ov: nv.astype(jnp.asarray(ov).dtype),
+                    new_extra, extra)
+                metr = _cast_floats(metr, jnp.float32)
             if spec.prox_mu > 0.0:
                 # FedProx: + mu/2 * ||w - w_global||^2. The reference's
                 # distributed FedProx trainer omits this term (its trainer is

@@ -312,8 +312,26 @@ def pairwise_weighted_stats(stacked, weights):
     (f32) and pairwise-folded; the weight total folds the same way. The
     mean is ``wsum / total`` — division happens ONCE, at the final
     consumer (``pairwise_finalize``), which is what lets an edge tier ship
-    raw partials without a lossy divide-then-remultiply round trip."""
+    raw partials without a lossy divide-then-remultiply round trip.
+
+    The slot axis is zero-padded to EVEN length BEFORE the term multiply.
+    XLA contracts the multiply into the first fold level as an fma
+    (verified on CPU; ``optimization_barrier`` does not block the LLVM-
+    level contraction), but only when the first level needs no zero-pad
+    concatenate — so without this pre-pad the fold's BITS depended on the
+    slot count's PARITY. Padding up front makes level 1 the same
+    ``t[2i] = s[2i]*w[2i] + s[2i+1]*w[2i+1]`` expression for every K,
+    which is what lets the streaming fused server ingest
+    (core/fused_agg.py) reproduce the fold pair by pair across jit
+    boundaries, bit for bit (its pair-combine jit compiles the identical
+    expression). The pad slot is an exact-zero term (0 * 0), so values
+    are unchanged; only odd-K bit patterns moved (from the accidental
+    plain-multiply form to the canonical fma form)."""
     w = jnp.asarray(weights, jnp.float32)
+    if w.shape[0] % 2:
+        w = jnp.concatenate([w, jnp.zeros((1,), jnp.float32)])
+        stacked = jax.tree.map(
+            lambda s: jnp.concatenate([s, jnp.zeros_like(s[:1])]), stacked)
     wsum = jax.tree.map(
         lambda s: pairwise_sum(s.astype(jnp.float32) * _wshape(w, s)),
         stacked)

@@ -343,7 +343,10 @@ def synthetic_sequences(
 
 def synthetic_packed_population(path: str, num_clients: int, dim: int = 16,
                                 num_classes: int = 5, seed: int = 0,
-                                test_rows: int = 512) -> str:
+                                test_rows: int = 512,
+                                size_lo: int = 6, size_hi: int = 25,
+                                tail_size: int = 96,
+                                tail_every: int = 200) -> str:
     """Write a deterministic SYNTHETIC packed-npy population straight to
     disk (core/client_source.PackedNpySource layout) without ever
     materializing it — the fixture for the flat-memory evidence (ci.sh
@@ -358,9 +361,14 @@ def synthetic_packed_population(path: str, num_clients: int, dim: int = 16,
 
     _os.makedirs(path, exist_ok=True)
     rs = np.random.RandomState(seed)
-    sizes = rs.randint(6, 25, num_clients).astype(np.int64)
-    tail = max(num_clients // 200, 1)
-    sizes[rs.choice(num_clients, tail, replace=False)] = 96
+    # size_lo/size_hi/tail_size parameterize the skew: the bf16+bucket
+    # bench (FEDML_BENCH_FUSED) stretches the tail so the static batch
+    # budget is priced by a client most cohorts never sample — the
+    # FEMNIST-lognormal shape the bucket ladder exists for. Defaults are
+    # the original fixture (byte-identical populations for old callers).
+    sizes = rs.randint(size_lo, size_hi, num_clients).astype(np.int64)
+    tail = max(num_clients // tail_every, 1)
+    sizes[rs.choice(num_clients, tail, replace=False)] = tail_size
     offsets = np.zeros(num_clients + 1, np.int64)
     np.cumsum(sizes, out=offsets[1:])
     total = int(offsets[-1])

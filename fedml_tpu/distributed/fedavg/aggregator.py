@@ -37,6 +37,15 @@ Decoded quantized garbage (NaN scales from a poisoned client, corrupt
 payloads surviving CRC) either arrives non-finite and dies at the
 unconditional gate or never arrives at all (quarantined ``undecodable``
 at decode).
+
+Fused alternative (``fused_agg=True``, docs/PERFORMANCE.md §Fused
+aggregation): the decode→gate→sum chain moves on device — uploads stage
+as their raw quantized leaves, one jit per arrival densifies against the
+device-resident broadcast stash and folds into canonical pairwise
+partials (core/fused_agg.py), and the flush merges O(log fan-in)
+partials instead of stacking the cohort. Bitwise the
+``sum_assoc='pairwise'`` stacked route; robust estimators and the
+norm-outlier gate keep the stacked route (refused loudly under fused).
 """
 
 from __future__ import annotations
@@ -56,6 +65,7 @@ from fedml_tpu.core.local import Task, make_eval_fn
 from fedml_tpu.core.robust_agg import (
     COORDINATEWISE,
     DEFAULT_NORM_MULT,
+    REASON_OK,
     QuarantineLedger,
     gated_aggregate,
     make_robust_aggregator,
@@ -75,7 +85,8 @@ class FedAvgAggregator:
                  sanitize: bool | float | None = None,
                  shard_server_state: bool = False,
                  partition_rules=None,
-                 sum_assoc: str = "auto"):
+                 sum_assoc: str = "auto",
+                 fused_agg: bool = False):
         if cfg.sampling != "uniform":
             # this runtime's client_sampling + weighted aggregate implement
             # the uniform scheme only — refuse rather than silently ignore
@@ -130,6 +141,48 @@ class FedAvgAggregator:
         self._sanitize_mult = (
             None if sanitize is False
             else DEFAULT_NORM_MULT if sanitize is True else float(sanitize))
+        # Fused on-device aggregation (core/fused_agg.py, docs/
+        # PERFORMANCE.md §Fused aggregation): uploads stage as their raw
+        # quantized leaves, one jit per arrival runs decode -> densify ->
+        # non-finite gate -> weighted term, and arrivals fold into the
+        # canonical pairwise partials — bitwise the stacked route under
+        # sum_assoc='pairwise', without per-client f32 trees on host or a
+        # [K, ...] device stack. The fold happens BEFORE the flush, so
+        # only the per-slot (non-finite) gate composes — cohort statistics
+        # (norm-outlier rule, robust estimators) keep the stacked route
+        # and are refused loudly here rather than silently skipped.
+        if fused_agg:
+            if not type(self)._stage_uploads_on_arrival:
+                raise ValueError(
+                    f"{type(self).__name__} aggregates on the HOST "
+                    "representation — fused_agg needs the device-staged "
+                    "float path (run the stacked route)")
+            if aggregator is not None:
+                raise ValueError(
+                    "fused_agg folds arrivals into pairwise partials as "
+                    "they land — robust estimators need the full stacked "
+                    "cohort at flush; run aggregator= on the stacked "
+                    "route (fused_agg=False)")
+            if self._sanitize_mult is not None:
+                raise ValueError(
+                    "fused_agg supports the unconditional non-finite gate "
+                    "only: the norm-outlier rule is a cohort statistic "
+                    "(median of norms) computed at flush, after arrivals "
+                    "were already folded — run sanitize= on the stacked "
+                    "route (fused_agg=False)")
+            if shard_server_state:
+                raise ValueError(
+                    "fused_agg + shard_server_state is not wired: the "
+                    "fused ingest pins its own per-arrival jit "
+                    "composition — run the sharded server stacked")
+            if sum_assoc == "auto":
+                # the fused fold IS the canonical pairwise association —
+                # there is no fused twin of the historical tensordot
+                sum_assoc = "pairwise"
+        self.fused_agg = bool(fused_agg)
+        self._fused = None  # FusedRoundIngest of the active round
+        self._fused_ingest: dict[str, object] = {}
+        self._last_flush: dict | None = None
         # gate -> estimator -> suspected merge -> all-rejected fallback:
         # the ONE jittable composition both runtimes share
         # (core/robust_agg.gated_aggregate). The gate runs every
@@ -237,6 +290,13 @@ class FedAvgAggregator:
         self._state_placement = ("sharded" if self._partitioner is not None
                                  else "replicated")
         self._model_nbytes = _tree_bytes(self.net)
+        if self.fused_agg:
+            from fedml_tpu.core import fused_agg as _fused_mod
+
+            self._fused_meta = _fused_mod._leaf_meta(
+                jax.tree.leaves(self.net))
+            self._fused_term_nbytes = _fused_mod.term_nbytes(
+                self._fused_meta)
         self._record_server_state_bytes()
 
     def _record_server_state_bytes(self, opt_state=()) -> None:
@@ -295,6 +355,25 @@ class FedAvgAggregator:
         """Stamp the round uploads are now accepted for (called by the
         server manager right before each broadcast)."""
         self.current_round = int(round_idx)
+        # fused ingest state is per round: a fresh accumulator against the
+        # round's OWN global model (arrivals gate/replace against it)
+        self._fused = None
+
+    def _admit_upload(self, index: int, round_idx: int | None) -> bool:
+        """The shared upload-slotting admission rule (see
+        :meth:`add_local_trained_result` for the reject vocabulary)."""
+        if index not in self.flag_client_model_uploaded:
+            _obs.record_stale_upload("unknown_rank")
+            log.warning("reject upload for unknown worker index %s "
+                        "(workers 0..%d)", index, self.worker_num - 1)
+            return False
+        if round_idx is not None and int(round_idx) != self.current_round:
+            _obs.record_stale_upload("stale")
+            log.warning("reject out-of-round upload from index %s "
+                        "(tagged round %s, current %d)",
+                        index, round_idx, self.current_round)
+            return False
+        return True
 
     def add_local_trained_result(self, index: int, wire_leaves,
                                  sample_num: int,
@@ -310,18 +389,35 @@ class FedAvgAggregator:
 
         ``round_idx=None`` (legacy caller) skips the round check only.
         """
-        if index not in self.flag_client_model_uploaded:
-            _obs.record_stale_upload("unknown_rank")
-            log.warning("reject upload for unknown worker index %s "
-                        "(workers 0..%d)", index, self.worker_num - 1)
-            return
-        if round_idx is not None and int(round_idx) != self.current_round:
-            _obs.record_stale_upload("stale")
-            log.warning("reject out-of-round upload from index %s "
-                        "(tagged round %s, current %d)",
-                        index, round_idx, self.current_round)
+        if not self._admit_upload(index, round_idx):
             return
         self.model_dict[index] = self._stage_upload(wire_leaves)
+        self.sample_num_dict[index] = sample_num
+        self.flag_client_model_uploaded[index] = True
+
+    def add_fused_result(self, index: int, kind: str, payload, scales,
+                         sample_num, round_idx: int | None,
+                         base_leaves) -> None:
+        """Fused twin of :meth:`add_local_trained_result` (docs/
+        PERFORMANCE.md §Fused aggregation): the upload arrives as its RAW
+        wire payload (``kind`` one of core/fused_agg.FUSED_KINDS) plus the
+        device-resident broadcast stash it encoded against, and one jitted
+        ingest decodes, gates, and folds it into the round's canonical
+        pairwise partials — no host densify, no per-slot stacking. Same
+        admission rule and barrier bookkeeping as the stacked path."""
+        if not self._admit_upload(index, round_idx):
+            return
+        from fedml_tpu.core import fused_agg as _fused_mod
+
+        if self._fused is None:
+            self._fused = _fused_mod.FusedRoundIngest(
+                jax.tree.leaves(self.net), self._fused_meta)
+        fn = self._fused_ingest.get(kind)
+        if fn is None:
+            fn = self._fused_ingest[kind] = _fused_mod.make_fused_ingest(
+                kind, self._fused_meta)
+        self._fused.add(index, fn, payload, scales, base_leaves,
+                        float(sample_num))
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded[index] = True
 
@@ -339,6 +435,13 @@ class FedAvgAggregator:
         aside for aggregates that must REPLACE the sample-count half of
         the weight without losing the staleness half (the DP uniform
         average, fedavg_robust.py)."""
+        if self.fused_agg:
+            # the async ingest stages dense buffered entries — the server
+            # manager refuses the combination at construction; this is the
+            # belt-and-braces guard for direct callers
+            raise ValueError("fused_agg is wired for the synchronous "
+                             "barrier — async buffered flushes load dense "
+                             "staged entries (run the stacked route)")
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self._async_meta = {}
@@ -365,12 +468,80 @@ class FedAvgAggregator:
         self._aggregate_core()
         return pack_pytree(self.net)
 
+    def _stack_uploads(self, ranks) -> list:
+        """The ``[K, ...]`` estimator layout per leaf, stacked DIRECTLY
+        from the staged placements: a staged device leaf enters the stack
+        as-is (re-wrapping each in ``jnp.asarray`` per rank per leaf cost a
+        dispatch apiece for nothing), a host (numpy) leaf transfers once
+        inside the stack. Regression-pinned by a no-transfer assertion
+        over the staged path (tests/test_fused_bf16.py)."""
+        n_leaves = len(self.model_dict[ranks[0]])
+        return [jnp.stack([self.model_dict[r][i] for r in ranks])
+                for i in range(n_leaves)]
+
+    def _aggregate_fused(self):
+        """The fused flush (docs/PERFORMANCE.md §Fused aggregation):
+        arrivals already decoded/gated/folded on device — merge the
+        pairwise partials, divide once, land the new global model. Bitwise
+        the stacked ``sum_assoc='pairwise'`` route over the same arrived
+        slots, ledger included (test-enforced)."""
+        t0 = time.perf_counter()
+        fr, self._fused = self._fused, None
+        if fr is None or not fr.slots:
+            log.warning("round %d: no decodable uploads — keeping the "
+                        "current global model", self.current_round)
+            self.sample_num_dict.clear()
+            return
+        slots = sorted(fr.slots)
+        avg_leaves, reasons_dev = fr.flush()
+        _perf.record_agg_bytes(self._state_placement,
+                               self._model_nbytes * len(slots))
+        stack_bytes = fr.peak_terms * self._fused_term_nbytes
+        _perf.set_agg_stack_bytes("fused", stack_bytes)
+        reasons = np.asarray(reasons_dev)
+        if reasons.any():
+            ids = self.client_sampling(self.current_round)
+            self.quarantine.record_codes(
+                self.current_round, reasons,
+                clients=[int(ids[s]) for s in slots],
+                ranks=[s + 1 for s in slots])
+            if (reasons != REASON_OK).all():
+                log.warning("round %d: all %d uploads quarantined — "
+                            "keeping the current global model",
+                            self.current_round, len(slots))
+        self.net = unpack_pytree(self.net, avg_leaves)
+        self.sample_num_dict.clear()
+        flush_s = time.perf_counter() - t0
+        _perf.record_flush_seconds(flush_s)
+        self._last_flush = {"fused": True, "flush_s": round(flush_s, 6),
+                            "stack_bytes": int(stack_bytes)}
+        log.info("fused aggregate time cost: %.3fs (%d partials peak)",
+                 flush_s, fr.peak_terms)
+
+    def agg_record(self) -> dict:
+        """The ``agg`` block the server manager rides on telemetry round
+        records (report.py renders ``flush_s``/``prec``; absent on pre-PR
+        logs): server-state placement, the last flush's
+        mode/latency/staging bytes, and the cfg's client-compute
+        precision policy (both runtimes share the cfg, so the stamp holds
+        for the clients this server dispatched)."""
+        rec = {"mode": self._state_placement}
+        if getattr(self.cfg, "precision", "f32") not in ("f32", "float32"):
+            rec["prec"] = self.cfg.precision
+        if self._last_flush is not None:
+            rec.update(self._last_flush)
+        return rec
+
     def _aggregate_core(self):
         """Gate + estimate + update ``self.net`` WITHOUT packing it for the
         wire — subclasses that transform the state further before broadcast
         (FedOpt's server step, the robust noise pass) call this and pack
         once at the end, so a sharded server plane is gathered exactly once
         per round (the gather belongs at broadcast-pack time only)."""
+        # getattr: partially-built instances (tests, legacy subclass
+        # constructions) predate the fused attribute and mean stacked
+        if getattr(self, "fused_agg", False):
+            return self._aggregate_fused()
         t0 = time.perf_counter()
         ranks = sorted(self.model_dict)
         if not ranks:
@@ -380,10 +551,7 @@ class FedAvgAggregator:
             log.warning("round %d: no decodable uploads — keeping the "
                         "current global model", self.current_round)
             return
-        stacked = [
-            jnp.stack([jnp.asarray(self.model_dict[r][i]) for r in ranks])
-            for i in range(len(self.model_dict[ranks[0]]))
-        ]
+        stacked = self._stack_uploads(ranks)
         weights = jnp.asarray([self.sample_num_dict[r] for r in ranks], jnp.float32)
 
         # the shared composition: gate (non-finite unconditionally; norm
@@ -421,14 +589,24 @@ class FedAvgAggregator:
             self.quarantine.record_codes(
                 self.current_round, reasons,
                 clients=client_l, ranks=rank_l)
-            if float(jnp.sum(new_w)) == 0.0:
+            # all-quarantined flag from the reason codes the ledger just
+            # pulled to host — float(jnp.sum(new_w)) here was a BLOCKING
+            # device fetch on the hot path (fedlint host-sync now pins the
+            # pattern); new_w stays a device value end to end
+            if (reasons != REASON_OK).all():
                 log.warning("round %d: all %d uploads quarantined — "
                             "keeping the current global model",
                             self.current_round, len(ranks))
         self.net = unpack_pytree(self.net, avg_leaves)
         self.model_dict.clear()
         self.sample_num_dict.clear()
-        log.info("aggregate time cost: %.3fs", time.perf_counter() - t0)
+        flush_s = time.perf_counter() - t0
+        _perf.record_flush_seconds(flush_s)
+        _perf.set_agg_stack_bytes("stacked", self._model_nbytes * len(ranks))
+        self._last_flush = {"fused": False, "flush_s": round(flush_s, 6),
+                            "stack_bytes": int(self._model_nbytes
+                                               * len(ranks))}
+        log.info("aggregate time cost: %.3fs", flush_s)
 
     # ------------------------------------------------------------ sampling
     def client_sampling(self, round_idx: int) -> np.ndarray:

@@ -83,6 +83,7 @@ def run_simulated(
     heartbeat_max_age_s: float | None = None,
     sum_assoc: str = "auto",
     edges: int | None = None,
+    fused_agg: bool = False,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -133,7 +134,16 @@ def run_simulated(
     efficiency). Encoded uplinks — top-k AND the delta tiers — compose
     with ``async_buffer_k``: they densify against the version-stamped
     broadcast the dispatch wave carried (the former dense-only refusal is
-    lifted; only a genuinely unversioned base is an error)."""
+    lifted; only a genuinely unversioned base is an error).
+
+    ``fused_agg``: fused on-device server aggregation (docs/PERFORMANCE.md
+    §Fused aggregation) — uploads stage as their raw quantized leaves and
+    one jit per arrival runs decode → densify → non-finite gate → pairwise
+    fold against the device-resident broadcast stash, so the server never
+    materializes per-client f32 trees on host and peak staging is O(log
+    fan-in) partials. Bitwise ``sum_assoc='pairwise'`` (which it implies);
+    robust estimators / armed ``sanitize`` / ``shard_server_state`` /
+    ``async_buffer_k`` keep the stacked route and are refused loudly."""
     if edges:
         # hierarchical 2-tier topology (distributed/fedavg/hierarchy.py,
         # docs/ROBUSTNESS.md §Hierarchical tiers): 1 root + E edge
@@ -150,6 +160,7 @@ def run_simulated(
             "shard_server_state": shard_server_state or None,
             "heartbeat_max_age_s": heartbeat_max_age_s,
             "sum_assoc": None if sum_assoc == "auto" else sum_assoc,
+            "fused_agg": fused_agg or None,
         }
         bad = [k for k, v in unsupported.items() if v is not None]
         if bad:
@@ -182,7 +193,8 @@ def run_simulated(
                                        sanitize=sanitize,
                                        shard_server_state=shard_server_state,
                                        partition_rules=partition_rules,
-                                       sum_assoc=sum_assoc)
+                                       sum_assoc=sum_assoc,
+                                       fused_agg=fused_agg)
         server = FedAvgServerManager(aggregator_, rank=0, size=size,
                                      backend=backend, ckpt_dir=ckpt_dir,
                                      round_timeout_s=round_timeout_s,

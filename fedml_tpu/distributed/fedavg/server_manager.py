@@ -69,6 +69,12 @@ class FedAvgServerManager(ServerManager):
         # stale (async requeues it), while a version NEVER stashed is a
         # loud protocol error.
         self._version_pack: dict[int, list] = {}
+        # fused on-device aggregation (docs/PERFORMANCE.md §Fused
+        # aggregation): the same versioned stash, but placed ON DEVICE once
+        # per broadcast — every encoded arrival densifies against it inside
+        # the aggregator's per-arrival jit instead of a host numpy pass
+        self._fused = bool(getattr(aggregator, "fused_agg", False))
+        self._version_dev: dict[int, list] = {}
         # rank -> the version its last upload PROVED it holds (the upload's
         # round tag: a client can only have encoded against a broadcast it
         # decoded). Drives the delta-broadcast warm set — optimistic
@@ -96,6 +102,11 @@ class FedAvgServerManager(ServerManager):
         # the synchronous barrier, untouched.
         self._async = async_buffer_k is not None
         self._buffer = None
+        if self._async and self._fused:
+            raise ValueError(
+                "fused_agg is wired for the synchronous barrier — the "
+                "async ingest admits/stages dense buffered entries (run "
+                "async_buffer_k with the stacked route)")
         if self._async and self.delta_broadcast:
             log.warning("delta_broadcast ignored in async buffered mode: "
                         "per-rank dispatch holds arbitrary versions, so "
@@ -466,6 +477,13 @@ class FedAvgServerManager(ServerManager):
 
     def _stash_version(self, version: int, decoded_leaves) -> None:
         self._version_pack[int(version)] = decoded_leaves
+        if self._fused:
+            # one H2D per broadcast version (async — overlaps the round)
+            # instead of a host densify per upload against the numpy stash
+            import jax
+
+            self._version_dev[int(version)] = [
+                jax.device_put(v) for v in decoded_leaves]
         if self._async:
             retain = max(self._VERSION_RETAIN,
                          (self._staleness_bound or 0) + 2)
@@ -476,6 +494,7 @@ class FedAvgServerManager(ServerManager):
             retain = 2
         for v in [v for v in self._version_pack if v <= version - retain]:
             del self._version_pack[v]
+            self._version_dev.pop(v, None)
 
     def _decode_upload(self, msg_params, sender: int, version: int):
         """Densify one upload's wire payload into full model leaves:
@@ -531,6 +550,85 @@ class FedAvgServerManager(ServerManager):
             log.warning("quarantining undecodable upload from rank %d "
                         "(%s)", sender, e)
             return None
+
+    def _stage_fused(self, msg_params, sender: int, version: int,
+                     sample_num) -> bool:
+        """Fused twin of ``_decode_upload`` + ``add_local_trained_result``
+        (docs/PERFORMANCE.md §Fused aggregation): host work is structural
+        validation ONLY (zlib inflate to int8, leaf-count/size checks —
+        comm/delta.inflate_update); the densify → gate → fold runs inside
+        the aggregator's per-arrival jit against the device-resident
+        version stash. Returns False when the payload is structurally
+        undecodable (quarantined + counted, exactly like the stacked
+        path); raises on a genuinely unversioned base."""
+        import numpy as np
+
+        from fedml_tpu.comm.delta import CorruptPayload, inflate_update
+
+        has_sparse = MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
+        has_upd = MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params
+        base_dev = None
+        if has_sparse or has_upd:
+            base_dev = self._version_dev.get(int(version))
+            base = self._version_pack.get(int(version))
+            if base is None or base_dev is None:
+                raise RuntimeError(
+                    f"upload from rank {sender} is encoded against version "
+                    f"{version}, which was never broadcast (or predates "
+                    f"this server) — encoded uplinks require a versioned "
+                    f"base (stashed: {sorted(self._version_pack)})")
+        # EVERY structural failure — validation here, inflate_update, or a
+        # shape error surfacing at the ingest jit's trace — must cost one
+        # upload, never the receive loop (add_fused_result sits inside the
+        # try for exactly that reason: the stacked _decode_upload's
+        # contract, kept on the fused route)
+        try:
+            if not (has_sparse or has_upd):
+                self.aggregator.add_fused_result(
+                    sender - 1, "dense",
+                    msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS], None,
+                    sample_num, version, None)
+                return True
+            if has_sparse:
+                idx = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_IDX]
+                val = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_VAL]
+                if len(idx) != len(base) or len(val) != len(base):
+                    raise CorruptPayload(
+                        f"sparse payload has {len(idx)}/{len(val)} leaves, "
+                        f"model has {len(base)}")
+                for sel, t in zip(idx, base):
+                    sel = np.asarray(sel)
+                    # the device scatter silently drops out-of-bounds
+                    # indices where the host path raised IndexError —
+                    # validate here so a bit-flipped index still costs
+                    # exactly one upload, on both routes
+                    if sel.size and np.issubdtype(
+                            np.asarray(t).dtype, np.floating) and (
+                            int(sel.max()) >= np.asarray(t).size
+                            or int(sel.min()) < 0):
+                        raise CorruptPayload(
+                            f"sparse index out of range for a "
+                            f"{np.asarray(t).size}-entry leaf")
+                self.aggregator.add_fused_result(
+                    sender - 1, "topk", (list(idx), list(val)), None,
+                    sample_num, version, base_dev)
+                return True
+            codec = str(msg_params[MyMessage.MSG_ARG_KEY_UPDATE_CODEC])
+            raw, scales = inflate_update(
+                msg_params[MyMessage.MSG_ARG_KEY_UPDATE_PAYLOAD],
+                msg_params[MyMessage.MSG_ARG_KEY_UPDATE_SCALE],
+                codec, base)
+            self.aggregator.add_fused_result(
+                sender - 1, codec, raw, scales, sample_num, version,
+                base_dev)
+            return True
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            self.aggregator.quarantine.record(
+                self.round_idx, sender, "undecodable")
+            _obs.record_update_rejected("undecodable")
+            log.warning("quarantining undecodable upload from rank %d "
+                        "(%s)", sender, e)
+            return False
 
     def send_init_msg(self):
         if self._async:
@@ -918,6 +1016,21 @@ class FedAvgServerManager(ServerManager):
             # proof of possession: an upload tagged round v means the
             # sender decoded broadcast v — the delta-downlink warm set
             self._rank_version[int(sender)] = int(msg_round)
+            if self._fused:
+                # fused ingest: structural validation on host, densify →
+                # gate → pairwise fold on device (no per-client f32 tree
+                # ever exists here). An undecodable payload still
+                # satisfies the barrier, exactly like the stacked path.
+                ok = self._stage_fused(
+                    msg_params, int(sender), int(msg_round),
+                    msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES])
+                if not ok and (int(sender) - 1) in \
+                        self.aggregator.flag_client_model_uploaded:
+                    self.aggregator.flag_client_model_uploaded[
+                        int(sender) - 1] = True
+                if self.aggregator.check_whether_all_receive():
+                    self._advance_round()
+                return
             # densify encoded uplinks (top-k / delta / quantized) against
             # the STASHED broadcast of the upload's version — the already-
             # decoded leaves kept at send time (re-packing the full model
@@ -987,6 +1100,11 @@ class FedAvgServerManager(ServerManager):
                        and hist[-1].get("round") == self.round_idx else None),
                 **({"critical_path": cp} if cp else {}),
                 **({"quarantine": q} if q else {}),
+                # flush latency + staging mode (docs/PERFORMANCE.md §Fused
+                # aggregation); report.py renders flush_s, hidden on logs
+                # that predate the block
+                **({"agg": self.aggregator.agg_record()}
+                   if hasattr(self.aggregator, "agg_record") else {}),
                 **self._round_record_extra())
             self._tracer.next_round()
         else:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
-from fedml_tpu.algorithms.fedavg import FedAvgConfig, make_client_optimizer
+from fedml_tpu.algorithms.fedavg import FedAvgConfig, resolve_local_spec
 from fedml_tpu.comm.message import pack_pytree, unpack_pytree
 from fedml_tpu.core.client_data import FederatedData, pack_clients
 from fedml_tpu.core.local import LocalSpec, Task, make_local_update
@@ -34,10 +34,9 @@ class DistributedTrainer:
         b_needed = int(np.ceil(max_count / cfg.batch_size))
         self.num_batches = min(cfg.max_batches or b_needed, b_needed)
 
-        spec = local_spec or LocalSpec(
-            optimizer=make_client_optimizer(cfg), epochs=cfg.epochs,
-            remat=cfg.remat,
-        )
+        # same cfg.precision resolution as the SPMD engine so the two
+        # runtimes run identical local-fit programs (bf16 included)
+        spec = resolve_local_spec(local_spec, cfg)
         self.local_update = jax.jit(make_local_update(task, spec))
 
         # template NetState for wire unpacking; derive the init key exactly

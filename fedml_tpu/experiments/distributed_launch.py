@@ -276,6 +276,26 @@ def add_args(p: argparse.ArgumentParser):
                         "lossy uplink tiers (comm/ef.py); 0 is the "
                         "convergence-ablation knob, never the production "
                         "setting")
+    p.add_argument("--fused_agg", "--fused-agg", dest="fused_agg",
+                   type=int, default=0,
+                   help="fused on-device server aggregation (docs/"
+                        "PERFORMANCE.md §Fused aggregation): uploads "
+                        "stage as raw quantized leaves and one jit per "
+                        "arrival runs decode -> densify -> non-finite "
+                        "gate -> pairwise fold, so the server never "
+                        "materializes per-client f32 trees on host. "
+                        "Implies pairwise summation; refuses "
+                        "--aggregator / --shard_server_state / "
+                        "--async_buffer_k / --edges (those keep the "
+                        "stacked route)")
+    p.add_argument("--precision", type=str, default="f32",
+                   choices=["f32", "bf16"],
+                   help="client-compute precision policy (docs/"
+                        "PERFORMANCE.md §Mixed precision): bf16 runs the "
+                        "local fits on bfloat16 casts of the f32 master "
+                        "weights (grad-scale-free; aggregation and the "
+                        "server update stay f32); f32 is bit-identical "
+                        "to the pre-policy engine")
     p.add_argument("--compression", type=str, default="none",
                    choices=["none", "f16", "q8", "zlib", "f16+zlib",
                             "q8+zlib", "json"],
@@ -318,6 +338,7 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                                               None)),
             ("--sum_assoc", None if getattr(args, "sum_assoc", "auto")
              == "auto" else args.sum_assoc),  # tree IS pairwise already
+            ("--fused_agg", getattr(args, "fused_agg", 0) or None),
         ) if v is not None]
         if incompatible:
             raise ValueError(f"--edges does not compose with "
@@ -376,6 +397,12 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     agg_kw: dict = {}
     if getattr(args, "sum_assoc", "auto") != "auto":
         agg_kw["sum_assoc"] = args.sum_assoc
+    if getattr(args, "fused_agg", 0):
+        if args.algo == "turboaggregate":
+            raise ValueError(
+                "--fused_agg is not wired for turboaggregate (Shamir "
+                "shares aggregate host-side in the finite field)")
+        agg_kw["fused_agg"] = True
     if getattr(args, "aggregator", None):
         agg_kw["aggregator"] = args.aggregator
         if getattr(args, "byzantine_f", None) is not None:
@@ -557,6 +584,7 @@ def main(argv=None):
         seed=args.seed, ci=bool(args.ci),
         eval_max_samples=(10_000 if args.dataset.startswith("stackoverflow")
                           else None),
+        precision=args.precision,
     )
 
     backend_kw: dict = {"timeout_s": args.timeout_s}

@@ -166,6 +166,34 @@ def record_span(name: str, seconds: float) -> None:
     _span_hist(name).observe(seconds)
 
 
+# ---------------------------------------------- fused-aggregation metrics
+# docs/PERFORMANCE.md §Fused aggregation. Fed by the cross-process
+# aggregator's flush paths:
+#
+#     fed_flush_seconds                 (histogram) one server aggregate
+#                                       flush — ingest-side decode work is
+#                                       per-arrival (overlapped), this is
+#                                       the barrier-to-new-model latency
+#     fed_agg_stack_bytes{mode}         (gauge) peak aggregation-staging
+#                                       bytes of the last flush: stacked =
+#                                       the full [K, ...] cohort stack,
+#                                       fused = live pairwise partials
+#                                       (O(log K) on the in-order path)
+def record_flush_seconds(seconds: float) -> None:
+    _hist("fed_flush_seconds").observe(seconds)
+
+
+@lru_cache(maxsize=8)
+def _agg_stack(mode: str):
+    return REGISTRY.gauge("fed_agg_stack_bytes", mode=mode)
+
+
+def set_agg_stack_bytes(mode: str, nbytes: float) -> None:
+    """Peak aggregation-staging bytes of the last flush under ``mode``
+    (fused | stacked) — the memory half of the fused-vs-stacked claim."""
+    _agg_stack(mode).set(nbytes)
+
+
 # --------------------------------------------- sharded-server-state metrics
 # docs/PERFORMANCE.md §Partitioned server state. ``mode``/``placement`` is
 # "replicated" or "sharded" so an A/B run exports both label sets side by

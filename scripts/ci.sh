@@ -392,6 +392,71 @@ print(f"wire-codec smoke ok: int8 round-trip within half a step, NaN "
       f"quarantined ({g.quarantine.counts()}), directions exported")
 PY
   python scripts/report.py "$CODEC_DIR/events.jsonl"
+  echo "== fused-aggregation smoke (delta-int8 + NaN adversary: fused == stacked ledger, no host densify; flush metrics exported) =="
+  # fused on-device aggregation (docs/PERFORMANCE.md §Fused aggregation)
+  # must (a) reproduce the stacked pairwise route's quarantine ledger under
+  # a delta-int8 uplink with a NaN adversary (the poison dies at the
+  # IN-GRAPH gate), (b) never touch the host densify path (apply_delta /
+  # topk_decode raise if called — the client-side EF residual uses
+  # decode_update, which stays live), and (c) export the new
+  # fed_flush_seconds / fed_agg_stack_bytes{mode} families
+  python - <<'PY'
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import AdversaryPlan
+from fedml_tpu.comm import delta as delta_mod
+from fedml_tpu.comm import sparse as sparse_mod
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs.metrics import REGISTRY
+
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                   client_num_per_round=4, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+adv = lambda: AdversaryPlan.from_json(
+    {"seed": 1, "rules": [{"attack": "nan", "ranks": [2]}]})
+stacked = run_simulated(data, task, cfg, job_id="ci-fused-stacked",
+                        sum_assoc="pairwise", update_codec="delta-int8",
+                        adversary_plan=adv())
+# the fused leg must never host-densify: the server-side decoders raise
+real_apply, real_topk = delta_mod.apply_delta, sparse_mod.topk_decode
+def _boom(*a, **kw):
+    raise AssertionError("host densify called on the fused path")
+delta_mod.apply_delta = _boom
+sparse_mod.topk_decode = _boom
+try:
+    fused = run_simulated(data, task, cfg, job_id="ci-fused", fused_agg=True,
+                          update_codec="delta-int8", adversary_plan=adv())
+finally:
+    delta_mod.apply_delta, sparse_mod.topk_decode = real_apply, real_topk
+led = fused.quarantine.canonical()
+assert led == stacked.quarantine.canonical() and led, led
+assert any(e[2] == "nonfinite" and e[1] == 2 for e in led), led
+for x, y in zip(pack_pytree(stacked.net), pack_pytree(fused.net)):
+    # host vs device int8 dequant: identical up to the fma ulp (the
+    # lossless tiers are bitwise — tier-1's parity battery pins both)
+    assert float(np.max(np.abs(np.asarray(x) - np.asarray(y)))) < 1e-6
+assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(fused.net))
+snap = REGISTRY.snapshot()
+assert "fed_flush_seconds" in snap, sorted(snap)
+modes = snap.get("fed_agg_stack_bytes", {})
+assert any("mode=fused" in k for k in modes) and \
+    any("mode=stacked" in k for k in modes), modes
+print(f"fused-aggregation smoke ok: ledger {len(led)} entries equal, "
+      f"no host densify, stack bytes {modes}")
+PY
+  # the committed FEDML_BENCH_FUSED A/B artifact must stay within spec
+  # (fused flush >= 2x stacked at fan-in 128, bf16+bucketed >= 2x f32
+  # rounds/s at 100k streamed clients, fused ingest RSS bounded)
+  python scripts/bench_gate.py BENCH_FUSED_r01.json \
+    --gate scripts/ci_fused_gate.json
   echo "== flat-memory streamed smoke (100k-virtual-client PackedNpySource run; fed_host_rss_bytes flat across rounds, gated via bench_gate.py) =="
   # the streamed data plane (docs/PERFORMANCE.md §Streaming & cohort
   # bucketing) must hold host RSS FLAT in population size: a 100k-client

@@ -82,6 +82,12 @@ def render_table(records: list[dict]) -> str:
             "srv": (r.get("agg") or {}).get("mode"),
             "srv_dev_B": (r.get("agg") or {}).get(
                 "server_state_bytes_per_device"),
+            # fused aggregation + mixed precision (docs/PERFORMANCE.md
+            # §Fused aggregation / §Mixed precision): server flush latency
+            # (fused or stacked) and the client-compute precision policy —
+            # both hide gracefully on logs that predate the fields
+            "flush_s": (r.get("agg") or {}).get("flush_s"),
+            "prec": (r.get("agg") or {}).get("prec"),
             # buffered-async runs (docs/ROBUSTNESS.md §Asynchronous
             # buffered rounds): buffer size folded, staleness quantiles of
             # the folded updates, cumulative shed count, buffer fill time

@@ -162,6 +162,50 @@ def test_host_sync_clean_fixture_and_out_of_scope_dir(tmp_path):
                 rules=["host-sync"]) == []
 
 
+# blocking device fetch fused into a HOST expression — the
+# FedAvgAggregator all-quarantined check shipped exactly this shape
+# (float(jnp.sum(new_w)) on the aggregate hot path)
+HOST_SYNC_BLOCKING_BAD = """\
+import jax.numpy as jnp
+
+
+def aggregate(new_w, reasons):
+    if float(jnp.sum(new_w)) == 0.0:
+        return None
+    return int(jnp.argmax(new_w))
+"""
+
+HOST_SYNC_BLOCKING_OK = """\
+import numpy as np
+
+
+def aggregate(new_w, reasons):
+    # host state the caller already fetched: no device sync here
+    reasons = np.asarray(reasons)
+    if (reasons != 0).all():
+        return None
+    return float(reasons[0])
+"""
+
+
+def test_host_sync_flags_blocking_fetch_on_host_path(tmp_path):
+    out = lint(tmp_path, "distributed/agg.py", HOST_SYNC_BLOCKING_BAD,
+               rules=["host-sync"])
+    msgs = " | ".join(f.message for f in out)
+    assert len(out) == 2, msgs
+    assert "float(jnp.sum(...))" in msgs and "int(jnp.argmax(...))" in msgs
+    assert "blocking device fetch" in msgs
+
+
+def test_host_sync_blocking_fetch_clean_and_scope(tmp_path):
+    # float() of already-host values is the POINT of a drain path
+    assert lint(tmp_path, "core/agg.py", HOST_SYNC_BLOCKING_OK,
+                rules=["host-sync"]) == []
+    # out of the hot-path dirs: not in scope
+    assert lint(tmp_path, "obs/agg.py", HOST_SYNC_BLOCKING_BAD,
+                rules=["host-sync"]) == []
+
+
 LOCK_BAD = """\
 import threading
 
