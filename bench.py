@@ -587,6 +587,76 @@ def _measure_async() -> None:
     print(json.dumps(rec), flush=True)
 
 
+def _measure_dp() -> None:
+    """FEDML_BENCH_DP ε-vs-accuracy A/B (docs/ROBUSTNESS.md §Privacy
+    ledger): the masked secure-aggregation tier (distributed/
+    turboaggregate.py) run once without DP and once per noise multiplier
+    at MATCHED rounds and seed — per leg the final eval plus the privacy
+    ledger's cumulative ε@δ (the round records carry the same block the
+    blob summarizes). The blob is the privacy-cost evidence the CI gate
+    (scripts/ci_dp_gate.json) pins: ε must fall as z rises, and the
+    accuracy cost at the working point must stay bounded. Runs forced-CPU
+    loopback — the measurement isolates the DP mechanism, not device
+    throughput."""
+    t0 = time.perf_counter()
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.models.linear import LogisticRegression
+
+    rounds = _env_int("FEDML_BENCH_DP_ROUNDS", 8)
+    world = _env_int("FEDML_BENCH_DP_WORLD", 9)
+    clip = float(os.environ.get("FEDML_BENCH_DP_CLIP", "0.5"))
+    data = synthetic_images(num_clients=32, image_shape=(8, 8, 1),
+                            num_classes=4, samples_per_client=24,
+                            test_samples=128, seed=3)
+    task = classification_task(LogisticRegression(num_classes=4))
+    cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=32,
+                       client_num_per_round=world - 1, epochs=1,
+                       batch_size=8, lr=0.1, frequency_of_the_test=1,
+                       seed=0)
+
+    def leg(name: str, **kw) -> dict:
+        agg = ta.run_simulated(data, task, cfg, job_id=f"bench-dp-{name}",
+                               **kw)
+        if not agg.history or agg.history[-1]["round"] != rounds - 1:
+            raise RuntimeError(f"dp A/B leg {name} did not complete "
+                               f"{rounds} rounds: {agg.history[-1:]}")
+        rec = {"final_acc": round(agg.history[-1]["test_acc"], 4),
+               "final_loss": round(agg.history[-1]["test_loss"], 4)}
+        block = agg.privacy_record()
+        if block:
+            rec.update(eps=block["eps"], delta=block["delta"],
+                       z=block["z"], clip=block["clip"], q=block["q"])
+        return rec
+
+    legs = {"plain": leg("plain")}
+    for z in (0.6, 1.2):
+        legs[f"z{z:g}"] = leg(
+            f"z{z:g}", defense_type="dp", noise_multiplier=z,
+            norm_bound=clip)
+    _mark(t0, f"dp A/B measured: {legs}")
+    rec = {
+        "metric": "fedavg_dp_epsilon_at_z1.2",
+        "value": legs["z1.2"]["eps"],
+        "unit": "epsilon",
+        "mode": "dp_ab",
+        "dp_ab": legs,
+        "rounds": rounds,
+        "world_size": world,
+        "clip": clip,
+        # ε must FALL as z rises (the accountant's basic monotonicity,
+        # gated), and the working point's accuracy cost stays bounded
+        "eps_ratio_z0.6_over_z1.2": round(
+            legs["z0.6"]["eps"] / max(legs["z1.2"]["eps"], 1e-9), 3),
+        "dp_acc_drop_at_z0.6": round(
+            legs["plain"]["final_acc"] - legs["z0.6"]["final_acc"], 4),
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+
+
 def _measure_codec() -> None:
     """FEDML_BENCH_CODEC A/B (docs/PERFORMANCE.md §Wire efficiency): the
     loopback cross-process stack run once per uplink codec tier — dense
@@ -1055,6 +1125,17 @@ def main() -> None:
             raise RuntimeError(f"bench: async A/B child failed (rc={rc})")
         _emit(rec)
         return
+    if os.environ.get("FEDML_BENCH_DP") is not None:
+        # ε-vs-accuracy A/B over the masked secure tier — forced-CPU
+        # child (loopback threads; the DP mechanism is the measurement)
+        rc, out = _run_child([here, "--measure", "dp"],
+                             _cpu_env(os.environ),
+                             _env_int("FEDML_BENCH_DP_TIMEOUT", 600))
+        rec = _last_json_line(out)
+        if rec is None:
+            raise RuntimeError(f"bench: dp A/B child failed (rc={rc})")
+        _emit(rec)
+        return
     env, backend = _probe_backend()
 
     cheap_timeout = _env_int("FEDML_BENCH_CHEAP_TIMEOUT", 900)
@@ -1192,6 +1273,8 @@ if __name__ == "__main__":
             _measure_async()
         elif sys.argv[2] == "codec":
             _measure_codec()
+        elif sys.argv[2] == "dp":
+            _measure_dp()
         elif sys.argv[2] == "fused_agg":
             _measure_fused_agg()
         elif sys.argv[2].startswith("bf16_"):

@@ -1,11 +1,14 @@
 """Worked example: cross-silo FL where the server NEVER sees a client update.
 
 Runs the cross-process runtime (one manager per party over the in-process
-loopback transport; swap backend="GRPC" for real hosts) with TurboAggregate's
-coded-share wire format: each silo quantizes its weighted update into
-GF(2^31-1), Shamir-encodes it, and uploads only the share matrix; the server
-sums shares and reconstructs the aggregate by Lagrange interpolation —
-additive homomorphism means individual updates stay secret
+loopback transport; swap backend="GRPC" for real hosts) with the masked
+secure-aggregation wire format (docs/ROBUSTNESS.md §Secure aggregation):
+each silo quantizes its weighted update into GF(2^31-1) and uploads ONE
+masked vector — cancelling pairwise masks (counter-PRG over DH pair
+seeds) plus a Shamir-shared self-mask — so the server folds uploads mod p
+and decodes only the cohort SUM. Silos that drop mid-round recover via
+survivor reveal frames (pass round_timeout_s=...); defense_type='dp'
+adds accounted DP with a privacy block on every round record
 (fedml_tpu/distributed/turboaggregate.py).
 
 Run:  JAX_PLATFORMS=cpu python examples/cross_silo_secure_aggregation.py
@@ -29,7 +32,7 @@ def main():
                        client_num_per_round=4, epochs=1, batch_size=10,
                        lr=0.1, frequency_of_the_test=1)
 
-    # secure cross-process run: only Shamir shares travel
+    # secure cross-process run: only masked field vectors travel
     agg = turboaggregate.run_simulated(data, task, cfg, job_id="secure-demo")
     print("secure-aggregation eval history:")
     for rec in agg.history:

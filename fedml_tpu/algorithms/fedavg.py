@@ -1254,7 +1254,8 @@ class FedAvgAPI:
                 metrics={k: float(v[i]) for k, v in ms_host.items()},
                 block=True, agg=self._agg_record,
                 **self._pack_extra(start_round + i),
-                **self._quarantine_extra(start_round + i))
+                **self._quarantine_extra(start_round + i),
+                **self._privacy_extra())
 
     def _drain_block_entry(self, start_round: int, entry):
         """Block analogue of _drain_round_entry: the only sync, one block
@@ -1504,6 +1505,12 @@ class FedAvgAPI:
         entries = self.quarantine.for_round(round_idx)
         return {"quarantine": entries} if entries else {}
 
+    def _privacy_extra(self) -> dict:
+        """The optional ``privacy`` block a DP engine rides on round
+        records (docs/ROBUSTNESS.md §Privacy ledger) — {} here;
+        FedAvgRobustAPI overrides with its accountant's cumulative ε."""
+        return {}
+
     # ------------------------------------------------------------------ train
     def _dispatch_round(self, round_idx: int, ids, cb):
         """Advance the rng chain and dispatch one round program — the ONE
@@ -1539,7 +1546,8 @@ class FedAvgAPI:
                 metrics={k: float(v) for k, v in metrics.items()},
                 agg=self._agg_record,
                 **self._pack_extra(round_idx),
-                **self._quarantine_extra(round_idx))
+                **self._quarantine_extra(round_idx),
+                **self._privacy_extra())
             if self.telemetry.tracer is not None:
                 # close the trace envelope HERE: left open it would absorb
                 # inter-round idle (timing loops, the post-run gap to
@@ -1597,7 +1605,8 @@ class FedAvgAPI:
                 metrics={k: float(v) for k, v in host.items()},
                 agg=self._agg_record,
                 **self._pack_extra(round_idx),
-                **self._quarantine_extra(round_idx))
+                **self._quarantine_extra(round_idx),
+                **self._privacy_extra())
         return round_idx, host
 
     def _warn_tracer_unsupported(self):

@@ -52,6 +52,8 @@ class FedAvgRobustAPI(FedAvgAPI):
         (core/privacy.py)."""
         self.defense_type = defense_type
         self.accountant = None
+        self._privacy_cache = None
+        self._dp_block_charged = False
         hooks = {}
         if defense_type in ("norm_diff_clipping", "weak_dp", "dp"):
             def clip_hook(net_k: NetState, net_global: NetState, rng):
@@ -90,6 +92,7 @@ class FedAvgRobustAPI(FedAvgAPI):
                 self._dp_q = (config.client_num_per_round
                               / config.client_num_in_total)
                 self._dp_z = noise_multiplier
+                self._dp_C = norm_bound
 
             def noise_hook(net: NetState, rng):
                 return NetState(add_gaussian_noise(rng, net.params, stddev), net.extra)
@@ -103,21 +106,47 @@ class FedAvgRobustAPI(FedAvgAPI):
                 jnp.asarray(a) for a in batch_global(px, py, config.eval_batch_size)
             )
 
+    def _charge(self, rounds: int) -> None:
+        """Step the accountant and refresh the privacy ledger surfaces
+        (round-record block + the live ε gauge the privacy_budget health
+        rule alerts on)."""
+        from fedml_tpu.core.privacy import charge_and_record
+
+        self._privacy_cache = charge_and_record(
+            self.accountant, self._dp_q, self._dp_z, self._dp_C,
+            realized_m=self.cfg.client_num_per_round, rounds=rounds)
+
+    def _privacy_extra(self) -> dict:
+        return ({"privacy": dict(self._privacy_cache)}
+                if self._privacy_cache is not None else {})
+
     def run_round(self, round_idx: int):
-        m = super().run_round(round_idx)
-        if self.accountant is not None:
-            self.accountant.step(self._dp_q, self._dp_z)
-        return m
+        # charge BEFORE the dispatch: the round's telemetry record must
+        # carry the ε that INCLUDES this round's spend (a budget ledger
+        # may over-report mid-flight, never under-report). When a block
+        # already charged its rounds up front, the per-round calls it
+        # degrades to (fedavg.py run_rounds' mesh/stacked fallback
+        # dispatches via run_round) must NOT charge again — double-
+        # counting would report ~2x the true ε and trip the budget alert
+        # at half the real spend.
+        if self.accountant is not None and not self._dp_block_charged:
+            self._charge(1)
+        return super().run_round(round_idx)
 
     def run_rounds(self, start_round: int, num_rounds: int):
         # the scan block applies clip/noise hooks with the pre-derived
         # sequential key stream (fedavg.py _build_block_fn), so DP rides
-        # the flagship throughput path; the accountant just charges all
-        # the block's rounds at once (q and z are static per engine)
-        ms = super().run_rounds(start_round, num_rounds)
-        if self.accountant is not None:
-            self.accountant.step(self._dp_q, self._dp_z, rounds=num_rounds)
-        return ms
+        # the flagship throughput path; the accountant charges all the
+        # block's rounds up front — every record in the block reports the
+        # end-of-block ε (conservative, never an under-report)
+        if self.accountant is None:
+            return super().run_rounds(start_round, num_rounds)
+        self._charge(num_rounds)
+        self._dp_block_charged = True
+        try:
+            return super().run_rounds(start_round, num_rounds)
+        finally:
+            self._dp_block_charged = False
 
     def epsilon(self, delta: float = 1e-5) -> float:
         """Cumulative (ε, δ)-DP spent by the rounds run so far."""

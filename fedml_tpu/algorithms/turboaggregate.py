@@ -1,17 +1,21 @@
-"""TurboAggregate — secure aggregation via coded shares over GF(p).
+"""TurboAggregate — pairwise-masked secure aggregation, standalone engine.
 
-Reference: fedml_api/distributed/turboaggregate/ — Lagrange-coded MPC over a
-finite field (mpc_function.py: modular_inv :4-18, gen_Lagrange_coeffs :38-59,
-BGW_encoding :62-76) arranged in a decentralized ring; TA_Aggregator.aggregate
-(TA_Aggregator.py:56+) reconstructs the sum without seeing any single update.
+Reference: fedml_api/distributed/turboaggregate/ (Lagrange-coded MPC over a
+finite field). The TPU form now shares its whole masking layer with the
+cross-process tier (core/secure_agg.py, docs/ROBUSTNESS.md §Secure
+aggregation): each simulated client quantizes its weighted update into
+GF(2^31-1), adds cancelling pairwise masks (jitted counter-PRG over
+sha256-derived DH pair seeds) plus a Shamir-shared self-mask, and the
+"server" half of the loop folds masked vectors mod p and decodes only the
+SUM after reconstructing the self-mask seeds from t+1 shares. Additive
+homomorphism makes the result equal plain FedAvg up to quantization
+(tested: <1e-3 relative error); no per-client cleartext update ever
+exists on the aggregation path.
 
-TPU form: clients quantize their updates into GF(2^31-1)
-(collectives.finite_field.field_encode), Shamir-encode into n shares; share j
-of every client is summed (this is where, on hardware, an int psum over ICI
-runs per share index — no party ever holds another's cleartext update);
-the aggregate is reconstructed from t+1 summed shares by Lagrange
-interpolation at 0 and dequantized. Additive homomorphism makes the result
-equal plain FedAvg up to quantization (tested: <1e-3 relative error).
+This engine runs the full-cohort protocol (the simulated cohort cannot
+drop mid-`run_round`); dropout recovery — reveal frames, elastic partial
+decode, shed-and-rebroadcast — lives on the cross-process tier
+(distributed/turboaggregate.py), where clients actually fail.
 """
 
 from __future__ import annotations
@@ -21,27 +25,37 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
-from fedml_tpu.collectives import finite_field as ff
+from fedml_tpu.core import secure_agg as sa
 from fedml_tpu.core.local import NetState
 from fedml_tpu.utils.tree import tree_unvectorize, tree_vectorize
 
 
 class TurboAggregateAPI(FedAvgAPI):
-    """FedAvg whose aggregation path goes through coded shares.
+    """FedAvg whose aggregation path goes through masked field vectors.
 
     The engine's device-side weighted mean is replaced by a host-driven
-    secure-sum: each client's weighted params vector is field-encoded and
-    Shamir-shared; only summed shares are decoded.
+    secure sum: each client's weighted params vector is field-encoded and
+    masked (core/secure_agg.py); only the folded masked sum is decoded.
     """
 
     def __init__(self, dataset, task, config: FedAvgConfig,
-                 n_shares: int = 5, threshold_t: int = 2,
-                 quant_scale: float = 2**16, **kwargs):
+                 threshold_t: int | None = None,
+                 quant_scale: float = 2**16,
+                 secagg_max_abs: float = 4.0, n_shares=None, **kwargs):
         if config.client_num_per_round > 32:
             raise ValueError("TurboAggregate secure path is for cross-silo scale")
-        self.n_shares = n_shares
-        self.threshold_t = threshold_t
+        # n_shares kept for API compatibility; self-mask seeds are Shamir-
+        # shared across the whole cohort now (one share per slot).
+        # threshold_t=None adapts to the cohort (min(2, K-1)); an explicit
+        # out-of-range t stays a loud error.
+        if threshold_t is None:
+            threshold_t = sa.default_threshold_t(config.client_num_per_round)
         self.quant_scale = quant_scale
+        # capacity guard at construction (collectives/finite_field.py):
+        # cohort * 2 * quant_scale * max_abs must stay inside GF(p)
+        self.secagg = sa.SecAggConfig(
+            cohort=config.client_num_per_round, threshold_t=threshold_t,
+            quant_scale=quant_scale, max_abs=secagg_max_abs)
         super().__init__(dataset, task, config, **kwargs)
         # rebuild round fn: we need the per-client nets, not the engine mean
         self._local_batch = jax.jit(self._build_local_batch())
@@ -60,7 +74,7 @@ class TurboAggregateAPI(FedAvgAPI):
 
     def run_round(self, round_idx: int):
         cb = self._pack_round_host(round_idx)
-        self.rng, rk, sk = jax.random.split(self.rng, 3)
+        self.rng, rk = jax.random.split(self.rng)
         nets, metrics = self._local_batch(rk, self.net,
                                           jnp.asarray(cb.x), jnp.asarray(cb.y),
                                           jnp.asarray(cb.mask))
@@ -68,25 +82,30 @@ class TurboAggregateAPI(FedAvgAPI):
         nsamp = np.asarray(cb.num_samples, np.float64)
         wts = nsamp / max(nsamp.sum(), 1e-12)
 
-        # --- secure aggregation of params ---
-        # each client: weighted vector -> field encode -> Shamir shares
+        # --- masked secure aggregation of params (core/secure_agg.py) ---
+        # each slot: weighted vector -> field encode -> self + pairwise
+        # masks; the fold is one streaming add mod p per slot
         template = self.net.params
-        summed_shares = None
+        acc = None
         for k in range(K):
             pk = jax.tree.map(lambda v, i=k: v[i], nets.params)
-            vec = tree_vectorize(pk) * wts[k]
-            z = ff.field_encode(vec, self.quant_scale)
-            shares = ff.shamir_encode(z, jax.random.fold_in(sk, k),
-                                      self.n_shares, self.threshold_t)
-            sh = np.asarray(shares, np.int64)
-            summed_shares = sh if summed_shares is None else (
-                (summed_shares + sh) % ff.P_DEFAULT
-            )
-        alphas = np.arange(1, self.n_shares + 1, dtype=np.int64)
-        z_sum = ff.shamir_decode(jnp.asarray(summed_shares), jnp.asarray(alphas),
-                                 self.threshold_t)
-        vec_sum = np.asarray(ff.field_decode(z_sum, self.quant_scale), np.float32)
-        new_params = tree_unvectorize(jnp.asarray(vec_sum), template)
+            vec = np.asarray(tree_vectorize(pk), np.float64)
+            masked = sa.mask_update(vec, float(wts[k]), k, self.cfg.seed,
+                                    round_idx, self.secagg)
+            acc = sa.fold_masked(acc, masked, self.secagg.p)
+        # full cohort: reconstruct every self-mask seed from the t+1-of-K
+        # Shamir shares and strip; no pairwise mask survives the full sum
+        slots = list(range(K))
+        self_seeds = {
+            i: sa.recover_self_seed(
+                slots,
+                sa.self_mask_shares(self.cfg.seed, round_idx, i,
+                                    self.secagg)[slots],
+                self.secagg.threshold_t, self.secagg.p)
+            for i in slots}
+        vec_sum = sa.unmask_sum(acc, slots, [], self_seeds, {}, self.secagg)
+        new_params = tree_unvectorize(
+            jnp.asarray(np.asarray(vec_sum, np.float32)), template)
 
         # extras (BN stats) take the plain weighted mean (not secret)
         from fedml_tpu.utils.tree import tree_weighted_mean

@@ -150,6 +150,31 @@ def _clock_or_rng_violation(name: str, call: ast.Call,
     return None
 
 
+def _entropy_violation(name: str, imports: set[str]) -> str | None:
+    """Why ``name(...)`` is nondeterministic key material, or None.
+
+    The secure-aggregation contract (core/secure_agg.py, docs/
+    ROBUSTNESS.md §Secure aggregation): every mask/share seed in core/
+    and collectives/ must flow through the sha256 derive chain so chaos
+    runs replay bit-for-bit — os.urandom / the secrets module would make
+    masked aggregates unreplayable AND unauditable. Import-guarded like
+    the stdlib-random check: a local variable named ``secrets`` (or an
+    ``urandom`` helper) in a file that never imports the module must not
+    trip the live-tree gate."""
+    parts = name.split(".")
+    if name in ("os.urandom", "urandom") and "os" in imports:
+        # bare 'urandom' covers the from-import form; the os-import guard
+        # keeps same-named local helpers in os-free files clean
+        return ("os.urandom() is nondeterministic key material (derive "
+                "seeds via the sha256 chain — core/secure_agg."
+                "derive_secret)")
+    if parts[0] == "secrets" and len(parts) == 2 and "secrets" in imports:
+        return (f"{name}() is nondeterministic key material (derive "
+                "seeds via the sha256 chain — core/secure_agg."
+                "derive_secret)")
+    return None
+
+
 # ===================================================================== rules
 @register
 class JitPurity(Rule):
@@ -442,18 +467,30 @@ class Determinism(Rule):
     The PR-2 replay contract: every chaos/comm/core decision derives from
     seeds via sha256/fold_in chains (monotonic DURATION reads,
     time.perf_counter/monotonic, are fine — they never steer replayed
-    decisions)."""
+    decisions). In core/ and collectives/ the rule additionally bans
+    nondeterministic KEY MATERIAL (os.urandom, the secrets module): every
+    secure-aggregation mask/share seed must flow through the sha256
+    derive chain (core/secure_agg.py) or masked runs stop replaying.
+    comm/ is exempt from the entropy half — transport nonces (the gRPC
+    dedup epoch) are not replayed state."""
 
     name = "determinism"
     description = ("no wall-clock reads or unseeded np.random/random calls "
-                   "in core/, chaos/, comm/")
+                   "in core/, chaos/, comm/; no os.urandom/secrets key "
+                   "material in core/, collectives/")
 
     def check(self, module: Module) -> Iterator[Finding]:
-        if not module.in_dirs("core", "chaos", "comm"):
+        entropy_scope = module.in_dirs("core", "collectives")
+        if not (module.in_dirs("core", "chaos", "comm") or entropy_scope):
             return
-        has_random = "random" in module_imports(module)
+        clock_scope = module.in_dirs("core", "chaos", "comm")
+        imports = module_imports(module)
+        has_random = "random" in imports
         for name, call in _call_names(module.tree):
-            why = _clock_or_rng_violation(name, call, has_random)
+            why = (_clock_or_rng_violation(name, call, has_random)
+                   if clock_scope else None)
+            if why is None and entropy_scope:
+                why = _entropy_violation(name, imports)
             if why is not None:
                 yield module.finding(self, call, (
                     f"{why} in a replay-deterministic module (derive from "

@@ -122,6 +122,39 @@ def shamir_decode(shares, alphas, t: int, p: int = P_DEFAULT):
     return acc
 
 
+def assert_field_capacity(n_terms: int, quant_scale: float,
+                          max_abs: float = 1.0, p: int = P_DEFAULT) -> float:
+    """Loud guard against silent mod-p wraparound in aggregation sums.
+
+    Summing ``n_terms`` field-encoded values whose pre-quantization
+    magnitudes are bounded by ``max_abs`` produces signed magnitudes up to
+    ``n_terms * quant_scale * max_abs``; the signed decode range is
+    (-p/2, p/2), so the sum stays decodable iff
+
+        n_terms * 2 * quant_scale * max_abs < p.
+
+    Large cohorts or a generous ``quant_scale`` can cross this silently —
+    the decoded aggregate would wrap to garbage with no error anywhere —
+    so aggregators must call this at CONSTRUCTION, not discover it at
+    round N. Returns the fraction of the field the worst-case sum uses
+    (the headroom diagnostic); raises ValueError at or past capacity.
+    """
+    if n_terms < 1:
+        raise ValueError(f"n_terms={n_terms} must be >= 1")
+    if quant_scale <= 0 or max_abs <= 0:
+        raise ValueError(
+            f"quant_scale={quant_scale} and max_abs={max_abs} must be > 0")
+    need = 2.0 * float(n_terms) * float(quant_scale) * float(max_abs)
+    if need >= p:
+        raise ValueError(
+            f"field capacity exceeded: {n_terms} terms * 2 * quant_scale="
+            f"{quant_scale:g} * max_abs={max_abs:g} = {need:.4g} >= p={p} "
+            "— the aggregated sum would wrap mod p and decode to garbage; "
+            "lower quant_scale (costs precision), shrink the cohort, or "
+            "tighten the clip bound feeding max_abs")
+    return need / p
+
+
 @_x64
 def field_encode(x, scale: float = 2**16, p: int = P_DEFAULT):
     """Quantize float array into GF(p): round(x * scale) mod p (negatives wrap)."""

@@ -93,3 +93,60 @@ class DPAccountant:
 
     def epsilon(self, delta: float) -> float:
         return rdp_to_epsilon(self._rdp, self.alphas, delta)
+
+    def best_order(self, delta: float) -> tuple[int, float]:
+        """(alpha*, cumulative RDP at alpha*) — the order the ε conversion
+        settled on, the 'cumulative RDP' half of the privacy ledger."""
+        log_inv_delta = math.log(1.0 / delta)
+        i = int(np.argmin([r + log_inv_delta / (a - 1)
+                           for r, a in zip(self._rdp, self.alphas)]))
+        return self.alphas[i], float(self._rdp[i])
+
+
+# the privacy ledger's default reporting delta; every surface that renders
+# ε (round records, /healthz, the bench artifact) states it alongside
+DEFAULT_DELTA = 1e-5
+
+
+def privacy_block(accountant: DPAccountant, q: float, noise_multiplier: float,
+                  clip: float, delta: float = DEFAULT_DELTA,
+                  realized_m: int | None = None) -> dict:
+    """The ``privacy`` block a DP round record carries (docs/ROBUSTNESS.md
+    §Privacy ledger): cumulative ε@δ plus the round's mechanism parameters
+    — sampling rate q, noise multiplier z, clip bound C, the REALIZED
+    survivor count m the noise was calibrated over (elastic/secure rounds
+    shrink it), and the RDP order the conversion settled on. ε is computed
+    from the accountant's cumulative RDP totals, which ride checkpoints —
+    resume neither under-reports ε nor replays noise keys."""
+    alpha, rdp = accountant.best_order(delta)
+    block = {
+        "eps": round(accountant.epsilon(delta), 6),
+        "delta": delta,
+        "q": round(float(q), 8),
+        "z": float(noise_multiplier),
+        "clip": float(clip),
+        "rdp_alpha": int(alpha),
+        "rdp": round(rdp, 6),
+    }
+    if realized_m is not None:
+        block["m"] = int(realized_m)
+    return block
+
+
+def charge_and_record(accountant: DPAccountant, q: float,
+                      noise_multiplier: float, clip: float,
+                      realized_m: int | None = None,
+                      rounds: int = 1) -> dict:
+    """The one step-then-surface sequence every DP aggregator runs:
+    charge the accountant, build the round record's ``privacy`` block,
+    refresh the live ``fed_privacy_epsilon`` gauge (the privacy_budget
+    health rule's input). Three engines ride this — the masked secure
+    tier, the cross-process dp defense, the standalone engine — and the
+    ledger fields must not drift between them."""
+    from fedml_tpu.obs import perf_instrument as _perf
+
+    accountant.step(q, noise_multiplier, rounds=rounds)
+    block = privacy_block(accountant, q, noise_multiplier, clip,
+                          realized_m=realized_m)
+    _perf.set_privacy_epsilon(block["eps"])
+    return block

@@ -69,9 +69,16 @@ from fedml_tpu.utils.tree import tree_weighted_mean
 # records it for every cohort slot of an edge block whose partial never
 # arrived (crashed/partitioned edge rank — the round degrades to an
 # elastic zero-term partial, docs/ROBUSTNESS.md §Cross-tier robust
-# gating). Appended AFTER the in-graph codes so 0..3 stay stable.
+# gating). 'secagg_dropout' and 'secagg_shed' are ledger-only codes of
+# the masked secure-aggregation tier (docs/ROBUSTNESS.md §Secure
+# aggregation): 'secagg_dropout' marks a cohort slot whose masked upload
+# never arrived on a round the survivors RECOVERED (mask recovery
+# stripped its orphaned pairwise masks); 'secagg_shed' marks every slot
+# of a round that fell below the t+1 recovery threshold (or lost a
+# reveal) and was shed + re-broadcast instead of wedging. Appended AFTER
+# the in-graph codes so 0..3 stay stable.
 REASONS = ("ok", "nonfinite", "norm_outlier", "suspected", "undecodable",
-           "edge_lost")
+           "edge_lost", "secagg_dropout", "secagg_shed")
 REASON_OK, REASON_NONFINITE, REASON_NORM_OUTLIER, REASON_SUSPECTED = range(4)
 
 # sanitation default: reject ||update|| > 4x the weighted-median norm.

@@ -1,0 +1,351 @@
+"""Pairwise-masked secure aggregation over GF(2^31 - 1) (the SecAgg mold).
+
+Protocol (Bonawitz et al., CCS'17, adapted to this repo's star topology
+and determinism discipline):
+
+- every client quantizes its weighted update into GF(p)
+  (``collectives.finite_field.field_encode``) and adds
+  (1) **cancelling pairwise masks** — for each cohort pair (i, j) a mask
+  vector expanded by a jitted counter-PRG from a seed only i and j share
+  (a Diffie-Hellman exchange in GF(p): ``s_ij = pk_j^sk_i = pk_i^sk_j``,
+  ``pk = g^sk``), added by the lower slot and subtracted by the higher so
+  the masks vanish from the cohort SUM; and
+  (2) a **self-mask** ``PRG(b_i)`` whose seed ``b_i`` is Shamir-shared
+  across the cohort (``collectives.finite_field.shamir_encode``) — the
+  server can only strip it with shares from >= t+1 cohort members.
+
+- the server's per-upload cost is ONE streaming add mod p
+  (``fold_masked``): masking must stay a cheap fold at fan-in, never a
+  per-client host reconstruction (the Smart-NIC server lesson,
+  arXiv:2307.06561).
+
+- **dropout tolerance**: when clients die mid-round the pairwise masks
+  between each survivor i and each dead slot j no longer cancel.
+  Survivors reveal exactly the seeds that repair the sum — their own
+  ``s_ij`` for the DEAD slots only (a pairwise secret masks nothing else
+  once j's contribution is gone) — and the server strips the live
+  clients' self-masks from the Shamir shares the survivor slots hold.
+  Below ``threshold_t + 1`` survivors nothing is recoverable and the
+  round must shed loudly.
+
+Determinism note (the fedlint contract): every secret here derives from
+the session seed via sha256 (``derive_secret``) — no ``os.urandom``, no
+``secrets`` module — so a chaos run replays bit-for-bit. That choice is
+what makes dropout recovery a *simulated configuration* (FL_PyTorch,
+arXiv:2202.03099) rather than a bolt-on: the privacy property is carried
+by the protocol shape (who sends what to whom), while the key material is
+replayable by construction. A production deployment swaps
+``derive_secret`` for real entropy plus an advertise round-trip for the
+public keys; every other line — masking arithmetic, share thresholds,
+recovery rule — ships unchanged.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from fedml_tpu.collectives import finite_field as ff
+
+P_DEFAULT = ff.P_DEFAULT
+
+# primitive root of GF(2^31 - 1) (the Lehmer/MINSTD generator base): its
+# powers cover the whole multiplicative group, so pk = g^sk loses no key
+# bits and the DH pair seeds s_ij range over the full field
+GENERATOR = 7
+
+
+def _x64(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        with jax.enable_x64():
+            return fn(*args, **kwargs)
+
+    return wrapped
+
+
+# ------------------------------------------------------------------ secrets
+def derive_secret(seed: int, round_idx: int, tag: str, slot: int,
+                  p: int = P_DEFAULT) -> int:
+    """One per-(round, slot) secret in [1, p-1), sha256 counter-mode from
+    the session seed — the replayable stand-in for client entropy (see
+    module docstring)."""
+    key = f"secagg|{seed}|{round_idx}|{tag}|{slot}".encode()
+    h = hashlib.sha256(key).digest()
+    return int.from_bytes(h[:8], "little") % (p - 2) + 1
+
+
+def secret_key(seed: int, round_idx: int, slot: int,
+               p: int = P_DEFAULT) -> int:
+    """The slot's DH secret exponent for this round."""
+    return derive_secret(seed, round_idx, "sk", slot, p)
+
+
+def self_mask_seed(seed: int, round_idx: int, slot: int,
+                   p: int = P_DEFAULT) -> int:
+    """The slot's self-mask PRG seed b_i (Shamir-shared via
+    :func:`self_mask_shares`)."""
+    return derive_secret(seed, round_idx, "self", slot, p)
+
+
+def public_key(sk: int, p: int = P_DEFAULT) -> int:
+    """pk = g^sk mod p (advertised in a deployment; derived here)."""
+    return pow(GENERATOR, sk, p)
+
+
+def public_keys(seed: int, round_idx: int, cohort: int,
+                p: int = P_DEFAULT) -> list[int]:
+    """Every slot's public key for the round (the simulated advertise
+    phase — each party computes the same list from the session seed)."""
+    return [public_key(secret_key(seed, round_idx, s, p), p)
+            for s in range(cohort)]
+
+
+def pair_seed(sk_own: int, pk_peer: int, p: int = P_DEFAULT) -> int:
+    """The shared pairwise mask seed: pk_peer^sk_own = g^(sk_i * sk_j),
+    symmetric in (i, j) — only the two endpoints can compute it."""
+    return pow(pk_peer, sk_own, p)
+
+
+# ---------------------------------------------------------------------- PRG
+# Counter-mode splitmix64: mask[k] = mix(seed + (k+1) * gamma) mod p. The
+# modular reduction's bias is ~2^-33 per element — irrelevant for masking
+# (the masks cancel exactly; only their distribution matters) and kept for
+# a branch-free jittable expansion. prg_expand_np is the numpy oracle the
+# tests pin the jitted path against.
+_GAMMA = 0x9E3779B97F4A7C15
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+
+def _prg_body(seed, n: int, p: int):
+    k = jnp.arange(1, n + 1, dtype=jnp.uint64)
+    z = seed + k * jnp.uint64(_GAMMA)
+    z = (z ^ (z >> jnp.uint64(30))) * jnp.uint64(_MIX1)
+    z = (z ^ (z >> jnp.uint64(27))) * jnp.uint64(_MIX2)
+    z = z ^ (z >> jnp.uint64(31))
+    return (z % jnp.uint64(p)).astype(jnp.int64)
+
+
+# module-level jitted entry points: jax caches executables per callable
+# object, so these must be created ONCE (a jax.jit inside the function
+# body would recompile the kernel on every call)
+_prg_jit = jax.jit(_prg_body, static_argnums=(1, 2))
+
+
+@_x64
+def prg_expand(seed: int, n: int, p: int = P_DEFAULT):
+    """Expand one seed into n field elements (jitted counter-PRG)."""
+    return _prg_jit(jnp.asarray(seed, jnp.uint64), n, p)
+
+
+def prg_expand_np(seed: int, n: int, p: int = P_DEFAULT) -> np.ndarray:
+    """Numpy twin of :func:`prg_expand` — the replay oracle."""
+    k = np.arange(1, n + 1, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        z = np.uint64(seed) + k * np.uint64(_GAMMA)
+        z = (z ^ (z >> np.uint64(30))) * np.uint64(_MIX1)
+        z = (z ^ (z >> np.uint64(27))) * np.uint64(_MIX2)
+        z = z ^ (z >> np.uint64(31))
+    return (z % np.uint64(p)).astype(np.int64)
+
+
+def _mask_fold_body(vec, seeds, signs, n: int, p: int):
+    """vec + sum_m signs[m] * PRG(seeds[m]) mod p, one fused scan."""
+
+    def body(acc, sd_sign):
+        sd, sg = sd_sign
+        return (acc + sg * _prg_body(sd, n, p)) % p, None
+
+    out, _ = lax.scan(body, vec % p, (seeds, signs))
+    return out
+
+
+_mask_fold_jit = jax.jit(_mask_fold_body, static_argnums=(3, 4))
+
+
+@_x64
+def apply_masks(vec, seeds, signs, p: int = P_DEFAULT):
+    """Add (sign +1) / subtract (sign -1) the PRG expansions of ``seeds``
+    onto an int64 field vector — the one jitted kernel both masking (the
+    client) and unmasking (the server's recovery pass) run."""
+    vec = jnp.asarray(vec, jnp.int64)
+    seeds = jnp.asarray(seeds, jnp.uint64)
+    signs = jnp.asarray(signs, jnp.int64)
+    if seeds.shape[0] == 0:
+        return vec % p
+    return _mask_fold_jit(vec, seeds, signs, int(vec.shape[0]), p)
+
+
+# ------------------------------------------------------------------- config
+def default_threshold_t(cohort: int) -> int:
+    """The adaptive Shamir-threshold default both runtimes share: t = 2
+    where the cohort can carry it, degrading to t = 1 for 2-slot cohorts
+    (t must stay <= cohort - 1 or nothing could ever reconstruct). One
+    definition — the standalone engine and the cross-process tier must
+    not fork it, or their recovery semantics silently diverge."""
+    return max(1, min(2, int(cohort) - 1))
+
+
+@dataclass(frozen=True)
+class SecAggConfig:
+    """One cohort's masking parameters.
+
+    ``cohort``       K slots (== client_num_per_round);
+    ``threshold_t``  Shamir degree t — stripping any self-mask (and hence
+                     decoding any round, full or partial) needs shares
+                     from >= t+1 cohort slots, so t+1 is also the
+                     dropout-recovery threshold: fewer survivors => the
+                     round sheds;
+    ``quant_scale``  fixed-point scale for field_encode;
+    ``max_abs``      loud capacity bound — every masked coordinate is
+                     promised <= max_abs before quantization, and
+                     construction verifies cohort * 2 * quant_scale *
+                     max_abs < p (finite_field.assert_field_capacity) so
+                     the summed field values cannot silently wrap.
+    """
+
+    cohort: int
+    threshold_t: int = 2
+    quant_scale: float = 2**16
+    max_abs: float = 4.0
+    p: int = P_DEFAULT
+
+    def __post_init__(self):
+        if not 1 <= self.threshold_t <= self.cohort - 1:
+            # t=0 would put the secret verbatim in every share; t+1 >
+            # cohort could never reconstruct even from a full round
+            raise ValueError(
+                f"threshold_t={self.threshold_t} needs t in [1, cohort-1="
+                f"{self.cohort - 1}]: recovery reconstructs from t+1 "
+                "survivor shares")
+        ff.assert_field_capacity(self.cohort, self.quant_scale,
+                                 self.max_abs, self.p)
+
+    @property
+    def recovery_min(self) -> int:
+        """Minimum survivors for a decodable round."""
+        return self.threshold_t + 1
+
+
+# ------------------------------------------------------------- client side
+def pair_masks_for(seed: int, round_idx: int, slot: int, cfg: SecAggConfig
+                   ) -> tuple[np.ndarray, np.ndarray]:
+    """(seeds, signs) of slot's pairwise masks against every other cohort
+    slot: + for the lower slot of each pair, - for the higher, so the
+    cohort sum cancels exactly."""
+    sk = secret_key(seed, round_idx, slot, cfg.p)
+    pks = public_keys(seed, round_idx, cfg.cohort, cfg.p)
+    seeds, signs = [], []
+    for j in range(cfg.cohort):
+        if j == slot:
+            continue
+        seeds.append(pair_seed(sk, pks[j], cfg.p))
+        signs.append(1 if slot < j else -1)
+    return (np.asarray(seeds, np.uint64), np.asarray(signs, np.int64))
+
+
+def mask_update(vec, weight: float, slot: int, seed: int, round_idx: int,
+                cfg: SecAggConfig) -> np.ndarray:
+    """Quantize ``vec * weight`` into GF(p) and add this slot's self +
+    pairwise masks. Returns the int64 wire payload — the only thing a
+    client ever uploads about its update. Enforces the capacity promise
+    HERE, in the one function every engine masks through: a coordinate
+    past ``cfg.max_abs`` would wrap the cohort sum mod p and decode to
+    garbage with no error anywhere downstream."""
+    scaled = np.asarray(vec, np.float64) * float(weight)
+    peak = float(np.max(np.abs(scaled))) if scaled.size else 0.0
+    if peak > cfg.max_abs:
+        raise ValueError(
+            f"masked update coordinate {peak:.4g} exceeds the capacity "
+            f"promise max_abs={cfg.max_abs:g} — the cohort sum would "
+            "wrap GF(p) silently (raise the max_abs promise / lower "
+            "quant_scale, or clip the update)")
+    with jax.enable_x64():
+        q = jnp.asarray(
+            ff.field_encode(jnp.asarray(scaled, jnp.float64),
+                            cfg.quant_scale, cfg.p), jnp.int64)
+    seeds, signs = pair_masks_for(seed, round_idx, slot, cfg)
+    seeds = np.concatenate(
+        [np.asarray([self_mask_seed(seed, round_idx, slot, cfg.p)],
+                    np.uint64), seeds])
+    signs = np.concatenate([np.asarray([1], np.int64), signs])
+    return np.asarray(apply_masks(q, seeds, signs, cfg.p), np.int64)
+
+
+def self_mask_shares(seed: int, round_idx: int, slot: int,
+                     cfg: SecAggConfig) -> np.ndarray:
+    """Shamir shares of this slot's self-mask seed, one per cohort slot
+    (share k is addressed to slot k; a deployment encrypts it for k —
+    the star relay ships it via the server, which can use at most the
+    shares the survivor slots reveal)."""
+    b = self_mask_seed(seed, round_idx, slot, cfg.p)
+    key = jax.random.PRNGKey(
+        derive_secret(seed, round_idx, "shamir", slot, cfg.p))
+    with jax.enable_x64():
+        shares = ff.shamir_encode(jnp.asarray([b], jnp.int64), key,
+                                  cfg.cohort, cfg.threshold_t, cfg.p)
+        return np.asarray(shares[:, 0], np.int64)
+
+
+# ------------------------------------------------------------- server side
+def fold_masked(acc, masked, p: int = P_DEFAULT):
+    """The server's whole per-upload cost: one streaming add mod p."""
+    masked = np.asarray(masked, np.int64)
+    if acc is None:
+        return masked % p
+    return (acc + masked) % p
+
+
+def recover_self_seed(holder_slots, shares, t: int,
+                      p: int = P_DEFAULT) -> int:
+    """Reconstruct one self-mask seed from the shares the listed holder
+    slots revealed (>= t+1 required; Lagrange at 0 over alphas slot+1)."""
+    holder_slots = [int(s) for s in holder_slots]
+    if len(holder_slots) < t + 1:
+        raise ValueError(
+            f"self-mask recovery needs >= {t + 1} shares, got "
+            f"{len(holder_slots)}")
+    with jax.enable_x64():
+        alphas = jnp.asarray([s + 1 for s in holder_slots], jnp.int64)
+        sh = jnp.asarray(shares, jnp.int64).reshape(len(holder_slots), 1)
+        return int(ff.shamir_decode(sh, alphas, t, p)[0])
+
+
+def unmask_sum(acc, survivors, dead, self_seeds: dict[int, int],
+               pair_seeds_by_survivor: dict[int, dict[int, int]],
+               cfg: SecAggConfig) -> np.ndarray:
+    """Strip the masks a partial (or full) cohort sum still carries and
+    decode to float:
+
+    - every SURVIVOR's self-mask PRG(b_i) (seeds reconstructed from the
+      revealed Shamir shares);
+    - for every (survivor i, dead j) pair the orphaned pairwise mask,
+      with i's sign (the dead side never arrived).
+
+    ``pair_seeds_by_survivor[i][j]`` is survivor i's revealed s_ij; a
+    full round passes ``dead=[]`` and ``{}``.
+    Returns the float64 decoded weighted SUM over the survivors.
+    """
+    survivors, dead = sorted(int(s) for s in survivors), sorted(
+        int(d) for d in dead)
+    seeds, signs = [], []
+    for i in survivors:
+        seeds.append(self_seeds[i])
+        signs.append(-1)
+    for i in survivors:
+        for j in dead:
+            seeds.append(pair_seeds_by_survivor[i][j])
+            signs.append(-1 if i < j else 1)  # undo i's + / - side
+    y = apply_masks(np.asarray(acc, np.int64),
+                    np.asarray(seeds, np.uint64),
+                    np.asarray(signs, np.int64), cfg.p)
+    with jax.enable_x64():
+        return np.asarray(ff.field_decode(y, cfg.quant_scale, cfg.p),
+                          np.float64)

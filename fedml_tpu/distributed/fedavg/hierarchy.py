@@ -676,7 +676,7 @@ class HierFedAvgServerManager(FedAvgServerManager):
             hier["rejected"] = list(rej)
         if self._robust and self._last_verdict_rtt is not None:
             hier["verdict_rtt_s"] = round(self._last_verdict_rtt, 6)
-        return {"hier": hier}
+        return {"hier": hier, **super()._round_record_extra()}
 
     def _broadcast_model(self, msg_type: str, global_params) -> None:
         """One frame per EDGE (fan-out O(edges)): the model + that edge

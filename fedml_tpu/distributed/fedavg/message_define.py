@@ -89,6 +89,23 @@ class MyMessage:
     MSG_ARG_KEY_EVIDENCE_WEIGHT = "ev_weight"
     MSG_ARG_KEY_VERDICT_WEIGHTS = "verdict_w"
     MSG_ARG_KEY_VERDICT_REASONS = "verdict_reasons"
+    # masked secure aggregation (docs/ROBUSTNESS.md §Secure aggregation;
+    # distributed/turboaggregate.py): uploads carry the MASKED field
+    # vector + the Shamir share vector of the client's self-mask seed
+    # (share k addressed to cohort slot k) inside MODEL_PARAMS' leaf
+    # list. When clients drop inside round_timeout_s the server sends
+    # each SURVIVOR one s2c_reveal frame naming the dead slots
+    # (SECAGG_DEAD, round-tagged); the survivor answers one c2s_reveal
+    # frame with its pairwise seeds for exactly those slots
+    # (SECAGG_PAIR_SEEDS, same order as the echoed SECAGG_DEAD) — the
+    # shares/seeds that let the server strip the dead clients' orphaned
+    # pairwise masks and the live clients' self-masks. Below t+1
+    # survivors (or a reveal lost past the deadline) the round sheds and
+    # re-broadcasts instead of wedging.
+    MSG_TYPE_S2C_REVEAL_REQUEST = "s2c_reveal"
+    MSG_TYPE_C2S_REVEAL_SHARES = "c2s_reveal"
+    MSG_ARG_KEY_SECAGG_DEAD = "secagg_dead"
+    MSG_ARG_KEY_SECAGG_PAIR_SEEDS = "secagg_pair_seeds"
     # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
     # MODEL_PARAMS and BASE_VERSION names the global version the delta was
     # computed against — the client must hold exactly that version (the

@@ -368,8 +368,26 @@ class FedAvgServerManager(ServerManager):
                    extra_state=extra or None)
 
     def _broadcast_finish(self):
+        # final best-effort delivery to EVERY rank, including ones the
+        # elastic sender had marked undeliverable (the async path's
+        # _finish_async rule, now on the sync path too): a rank that
+        # RECOVERED after its crash window but whose reprobe round never
+        # came would otherwise miss FINISH and block in its receive loop
+        # until the simulated-launch join timeout abandons the thread. A
+        # still-dead rank just re-fails the send (re-marked, skipped).
+        self._undeliverable.clear()
+        self._update_alive_gauge()
         for rank in range(1, self.size):
-            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank))
+            msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank)
+            # round-tag the FINISH like every other s2c frame: the chaos
+            # layer's windowed rules key on the frame's round (falling
+            # back to the link's LAST-KNOWN round for untagged frames),
+            # so an untagged FINISH to a rank whose link last saw a
+            # crash-window round would read as still-crashed forever —
+            # even though the window is over (stock peers ignore the
+            # extra param; the wire is otherwise unchanged)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
         self.finish()
 
     def run(self):
@@ -872,7 +890,8 @@ class FedAvgServerManager(ServerManager):
                         "staleness": [int(s) for s in stale],
                         "buffer_fill_s": round(fill_s, 6),
                         "shed": self._shed_snapshot()}},
-                    **({"quarantine": q} if q else {}))
+                    **({"quarantine": q} if q else {}),
+                    **self._round_record_extra())
                 self._tracer.next_round()
             else:
                 self.aggregator.aggregate()
@@ -903,8 +922,10 @@ class FedAvgServerManager(ServerManager):
         self._undeliverable.clear()
         self._update_alive_gauge()
         for rank in range(1, self.size):
-            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH,
-                                      self.rank, rank))
+            msg = Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, rank)
+            # round-tagged like the sync FINISH (see _broadcast_finish)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
         if not self._awaiting:
             self.finish()
             return
@@ -1063,8 +1084,18 @@ class FedAvgServerManager(ServerManager):
 
     def _round_record_extra(self) -> dict:
         """Extra blocks a subclass rides on the telemetry round record
-        (the hierarchical server adds its ``hier`` fan-in block)."""
-        return {}
+        (the hierarchical server adds its ``hier`` fan-in block). The
+        ``privacy`` block is universal: any aggregator that exposes
+        ``privacy_record()`` (the DP defenses, the masked secure tier —
+        docs/ROBUSTNESS.md §Privacy ledger) gets its cumulative ε@δ +
+        mechanism parameters on every emitted round."""
+        extra: dict = {}
+        pr = getattr(self.aggregator, "privacy_record", None)
+        if pr is not None:
+            block = pr()
+            if block:
+                extra["privacy"] = block
+        return extra
 
     def _advance_round(self):
         """Aggregate what's collected, eval, and start the next round (or

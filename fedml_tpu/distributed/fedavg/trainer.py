@@ -17,6 +17,17 @@ from fedml_tpu.core.client_data import FederatedData, pack_clients
 from fedml_tpu.core.local import LocalSpec, Task, make_local_update
 
 
+def num_batches_for(max_count: int, cfg: FedAvgConfig) -> int:
+    """The per-client batch-depth formula every party must agree on: the
+    natural depth for the largest client, capped by cfg.max_batches.
+    Shared with the secure-aggregation server (distributed/
+    turboaggregate.py), which reproduces the clients' deterministic
+    sample caps to compute the public cohort weight total — a fork here
+    would silently mis-scale the decoded elastic mean."""
+    b_needed = int(np.ceil(max_count / cfg.batch_size))
+    return min(cfg.max_batches or b_needed, b_needed)
+
+
 class DistributedTrainer:
     def __init__(self, client_rank: int, dataset: FederatedData, task: Task,
                  cfg: FedAvgConfig, local_spec: LocalSpec | None = None):
@@ -31,8 +42,7 @@ class DistributedTrainer:
             max_count = int(np.max(self._source.client_sizes))
         else:
             max_count = max(len(v) for v in dataset.train_idx_map.values())
-        b_needed = int(np.ceil(max_count / cfg.batch_size))
-        self.num_batches = min(cfg.max_batches or b_needed, b_needed)
+        self.num_batches = num_batches_for(max_count, cfg)
 
         # same cfg.precision resolution as the SPMD engine so the two
         # runtimes run identical local-fit programs (bf16 included)

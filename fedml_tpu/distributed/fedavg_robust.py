@@ -66,6 +66,7 @@ class FedAvgRobustAggregator(FedAvgAggregator):
                                  f"> 0, got {noise_multiplier}")
             self.accountant = DPAccountant()
             self._dp_z, self._dp_C = noise_multiplier, norm_bound
+        self._privacy_cache = None
         self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
         self._stddev = stddev
 
@@ -111,8 +112,16 @@ class FedAvgRobustAggregator(FedAvgAggregator):
         if self.defense_type in ("weak_dp", "dp"):
             if self.defense_type == "dp":
                 sd = self._dp_z * self._dp_C / max(m_received, 1)
-                self.accountant.step(
-                    m_received / self.cfg.client_num_in_total, self._dp_z)
+                # privacy-budget ledger (docs/ROBUSTNESS.md §Privacy
+                # ledger): the block the server manager rides on this
+                # round's record, plus the live ε gauge the
+                # privacy_budget health rule alerts on
+                from fedml_tpu.core.privacy import charge_and_record
+
+                self._privacy_cache = charge_and_record(
+                    self.accountant,
+                    m_received / self.cfg.client_num_in_total,
+                    self._dp_z, self._dp_C, realized_m=m_received)
             else:
                 sd = self._stddev
             self._noise_rng, k = jax.random.split(self._noise_rng)
@@ -126,6 +135,11 @@ class FedAvgRobustAggregator(FedAvgAggregator):
         if self.accountant is None:
             raise ValueError("defense_type='dp' required for accounting")
         return self.accountant.epsilon(delta)
+
+    def privacy_record(self) -> dict | None:
+        """The round record's ``privacy`` block (None outside dp mode) —
+        the server manager rides it on every emitted round."""
+        return self._privacy_cache
 
 
 def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",

@@ -1,21 +1,43 @@
-"""Distributed TurboAggregate — secure aggregation over the cross-process runtime.
+"""Distributed TurboAggregate — dropout-tolerant masked secure aggregation.
 
-Mirror of fedml_api/distributed/turboaggregate/ (TA_Aggregator.py:56+,
-mpc_function.py:38-76): clients never upload cleartext updates. Each client
-quantizes its weighted params (weight = its share of the round's public
-sample counts, computable by every party from the deterministic sampler)
-into GF(2^31-1), Shamir-encodes them, and uploads only the share matrix; the
-server sums shares in the field and reconstructs the *sum* by Lagrange
-interpolation at 0 — additive homomorphism means no single update is ever
-visible server-side. BN/extra statistics (not secret) travel in cleartext
-and take the plain weighted mean.
+The original mirror of fedml_api/distributed/turboaggregate/ shipped whole
+Shamir share MATRICES per client and died the moment any client dropped
+mid-round. This tier is the SecAgg-mold replacement (core/secure_agg.py,
+docs/ROBUSTNESS.md §Secure aggregation):
 
-The field/Shamir primitives are the same collectives.finite_field ops the
-SPMD TurboAggregateAPI uses, so the secure path matches plain FedAvg up to
-quantization (<1e-3 relative, tested).
+- clients upload ONE masked field vector (their weighted update quantized
+  into GF(2^31-1) plus cancelling pairwise masks and a Shamir-shared
+  self-mask) — the server never sees a cleartext update, and its
+  per-upload cost is a single streaming add mod p (``fold_masked``);
+- with ``round_timeout_s`` armed, clients that crash/partition inside the
+  deadline degrade the round instead of wedging it: the server asks each
+  survivor for the pairwise seeds of exactly the dead slots
+  (``s2c_reveal``/``c2s_reveal`` frames), strips the orphaned masks and
+  the survivors' self-masks, and lands the EXACT elastic partial
+  aggregate (survivor reweighting, sample-weight exact vs a numpy
+  oracle); below ``threshold_t + 1`` survivors — or with a reveal lost
+  past the deadline — the round sheds loudly: every lost slot is
+  ledgered, ``fed_secagg_rounds_total{outcome="shed"}`` counts it, and
+  the round re-broadcasts (the all-uploads-lost wedge-fix path) so a
+  recovered fleet re-converges;
+- ``defense_type='dp'`` runs accounted DP-FedAvg ON the masked path:
+  clients clip their round delta to C before masking, the server
+  calibrates Gaussian noise ``z*C/m`` over the REALIZED survivor count m,
+  and every round record carries the ``privacy`` block (ε@δ, q, z, C,
+  cumulative RDP — core/privacy.privacy_block). DP state (RDP totals +
+  noise RNG) rides the server checkpoint, so resume neither under-reports
+  ε nor replays noise keys.
+
+Replay is bit-for-bit: every mask seed derives from the session seed via
+sha256 (core/secure_agg.derive_secret — the fedlint determinism
+discipline), so a chaos run's masked aggregates, ledger, and recovery
+frames replay exactly.
 """
 
 from __future__ import annotations
+
+import logging
+import time
 
 import jax
 import jax.numpy as jnp
@@ -23,117 +45,565 @@ import numpy as np
 
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.collectives import finite_field as ff
-from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.comm.message import Message, pack_pytree
+from fedml_tpu.core import secure_agg as sa
 from fedml_tpu.core.local import NetState
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
 from fedml_tpu.distributed.utils import backend_kwargs, launch_simulated
+from fedml_tpu.obs import comm_instrument as _obs
+from fedml_tpu.obs import perf_instrument as _perf
 from fedml_tpu.utils.tree import (tree_unvectorize, tree_vectorize,
                                   tree_weighted_mean)
 
+log = logging.getLogger("fedml_tpu.distributed.fedavg")
+
+
+def _batch_cap(dataset, cfg: FedAvgConfig) -> int:
+    """The trainer's num_batches formula (trainer.num_batches_for — ONE
+    definition) as a sample cap: the server must compute the SAME
+    per-client cap to reproduce the deterministic cohort weight total
+    (sample counts are public; the masked sum is not)."""
+    from fedml_tpu.distributed.fedavg.trainer import num_batches_for
+
+    max_count = max(len(v) for v in dataset.train_idx_map.values())
+    return num_batches_for(max_count, cfg) * cfg.batch_size
+
+
+def cohort_sample_counts(round_idx: int, cfg: FedAvgConfig, dataset,
+                         cap: int) -> tuple[np.ndarray, list[int]]:
+    """(sampled client ids, per-slot sample counts) — computable by every
+    party from the deterministic sampler, which is what lets clients
+    pre-normalize their weights without a weight-exchange phase."""
+    from fedml_tpu.core.sampling import sample_clients
+
+    ids = sample_clients(round_idx, cfg.client_num_in_total,
+                         cfg.client_num_per_round, cfg.seed)
+    counts = [min(len(dataset.train_idx_map[int(i)]), cap) for i in ids]
+    return ids, counts
+
+
+def _secagg_config(cfg: FedAvgConfig, threshold_t: int | None,
+                   quant_scale: float, defense_type: str,
+                   norm_bound: float,
+                   secagg_max_abs: float) -> sa.SecAggConfig:
+    """One construction rule for every party: DP mode's clip bound IS the
+    capacity promise (||delta||_2 <= C bounds every coordinate); the
+    weighted path promises ``secagg_max_abs`` and enforces it at mask
+    time. ``threshold_t=None`` adapts to the cohort (min(2, K-1) — a
+    2-client cohort cannot carry t=2); an EXPLICIT t out of range stays
+    a loud error. Raises at construction when the cohort would wrap
+    GF(p)."""
+    if threshold_t is None:
+        threshold_t = sa.default_threshold_t(cfg.client_num_per_round)
+    max_abs = float(norm_bound) if defense_type == "dp" \
+        else float(secagg_max_abs)
+    return sa.SecAggConfig(cohort=cfg.client_num_per_round,
+                           threshold_t=threshold_t,
+                           quant_scale=quant_scale, max_abs=max_abs)
+
 
 class SecureTrainer(DistributedTrainer):
-    """DistributedTrainer whose wire format is [shares, *extra_leaves]."""
+    """DistributedTrainer whose wire format is [masked_vec, b_shares,
+    *extra_leaves] — the update never leaves the client unmasked."""
 
-    def __init__(self, client_rank, dataset, task, cfg, n_shares=5,
-                 threshold_t=2, quant_scale=2**16):
+    def __init__(self, client_rank, dataset, task, cfg, threshold_t=None,
+                 quant_scale=2**16, defense_type: str = "none",
+                 norm_bound: float = 30.0, secagg_max_abs: float = 4.0,
+                 n_shares=None):
+        from fedml_tpu.core.client_source import ClientDataSource
+
+        if isinstance(dataset, ClientDataSource):
+            raise ValueError(
+                "the masked secure-aggregation tier is cross-silo: it "
+                "needs every cohort member's public sample count "
+                "(train_idx_map) for the pre-normalized weights — "
+                "streamed ClientDataSources are refused")
         super().__init__(client_rank, dataset, task, cfg)
-        self.n_shares, self.threshold_t = n_shares, threshold_t
-        self.quant_scale = quant_scale
+        if n_shares is not None:
+            log.debug("SecureTrainer: n_shares is ignored — self-mask "
+                      "seeds are Shamir-shared across the whole cohort")
+        # cohort SLOT (stable per rank) — not the per-round dataset client
+        # id the server re-assigns via CLIENT_INDEX
+        self.slot = client_rank - 1
+        self.defense_type = defense_type
+        self.norm_bound = float(norm_bound)
+        self.secagg = _secagg_config(cfg, threshold_t, quant_scale,
+                                     defense_type, norm_bound,
+                                     secagg_max_abs)
 
     def _round_weight(self, round_idx: int, n: int) -> float:
-        """This client's sample-weight n_k / sum_cohort(n_j). Sample counts
-        are public and the sampler is deterministic, so every party computes
-        the same cohort total — keeping encoded field values <= |w|*scale
-        (pre-normalized like the in-process path; an n_k-scaled share would
-        burn mod-p headroom and wrap silently at FEMNIST scale)."""
-        from fedml_tpu.core.sampling import sample_clients
+        """This client's n_k / sum_cohort(n_j), from the public sampler —
+        pre-normalized so encoded field values stay inside the capacity
+        promise (an n_k-scaled upload would burn mod-p headroom and wrap
+        silently at scale)."""
+        _, counts = cohort_sample_counts(round_idx, self.cfg, self.dataset,
+                                         _batch_cap(self.dataset, self.cfg))
+        return n / max(sum(counts), 1)
 
-        ids = sample_clients(round_idx, self.cfg.client_num_in_total,
-                             self.cfg.client_num_per_round, self.cfg.seed)
-        cap = self.num_batches * self.cfg.batch_size
-        total = sum(min(len(self.dataset.train_idx_map[int(i)]), cap) for i in ids)
-        return n / max(total, 1)
+    def reveal_pair_seeds(self, round_idx: int,
+                          dead_slots: list[int]) -> list[int]:
+        """The recovery reveal: this survivor's pairwise seeds for exactly
+        the DEAD slots (each masks nothing once the dead contribution is
+        gone) — never a seed for a live pair, never the self-mask seed."""
+        sk = sa.secret_key(self.cfg.seed, round_idx, self.slot,
+                           self.secagg.p)
+        pks = sa.public_keys(self.cfg.seed, round_idx, self.secagg.cohort,
+                             self.secagg.p)
+        return [sa.pair_seed(sk, pks[int(j)], self.secagg.p)
+                for j in dead_slots]
 
     def train(self, round_idx: int):
+        if self.defense_type == "dp":
+            # snapshot the broadcast BEFORE the fit overwrites self.net:
+            # the clipped ROUND DELTA is what gets masked
+            global_vec = np.asarray(tree_vectorize(self.net.params),
+                                    np.float64)
         n = self.fit(round_idx)  # self.net now holds the local fit
-        w = self._round_weight(round_idx, n)
-        vec = tree_vectorize(self.net.params) * w
-        z = ff.field_encode(vec, self.quant_scale)
-        key = jax.random.fold_in(
-            jax.random.PRNGKey(self.cfg.seed + 1013), round_idx)
-        key = jax.random.fold_in(key, self.client_index)
-        shares = np.asarray(
-            ff.shamir_encode(z, key, self.n_shares, self.threshold_t), np.int64)
+        if self.defense_type == "dp":
+            # clip the ROUND DELTA to the L2 ball C, mask unweighted: the
+            # server divides by the realized survivor count and the noise
+            # z*C/m assumes exactly this sensitivity
+            vec = np.asarray(tree_vectorize(self.net.params),
+                             np.float64) - global_vec
+            nrm = float(np.linalg.norm(vec))
+            if nrm > self.norm_bound:
+                vec = vec * (self.norm_bound / nrm)
+            weight = 1.0
+        else:
+            vec = np.asarray(tree_vectorize(self.net.params), np.float64)
+            weight = self._round_weight(round_idx, n)
+        # mask_update enforces the capacity promise (max_abs) for every
+        # engine — a coordinate past it would wrap the cohort sum
+        masked = sa.mask_update(vec, weight, self.slot, self.cfg.seed,
+                                round_idx, self.secagg)
+        b_shares = sa.self_mask_shares(self.cfg.seed, round_idx, self.slot,
+                                       self.secagg)
         extras = pack_pytree(self.net.extra)
-        return [shares] + extras, n
+        return [masked, b_shares] + extras, n
 
 
 class TAAggregator(FedAvgAggregator):
-    """Sums share matrices in GF(p); reconstructs only the aggregate."""
+    """Folds masked uploads mod p (one add per arrival); decodes only the
+    survivor SUM after mask recovery."""
 
-    # Shamir shares are int64 host math (mod-p numpy) — device staging at
+    # masked vectors are int64 host math (mod-p numpy) — device staging at
     # arrival would buy nothing and jnp would truncate the field elements
     _stage_uploads_on_arrival = False
 
     def __init__(self, dataset, task, cfg: FedAvgConfig, worker_num: int,
-                 n_shares=5, threshold_t=2, quant_scale=2**16):
+                 threshold_t=None, quant_scale=2**16,
+                 defense_type: str = "none",  # 'none' | 'dp'
+                 norm_bound: float = 30.0, noise_multiplier: float = 1.0,
+                 secagg_max_abs: float = 4.0, n_shares=None):
+        from fedml_tpu.core.client_source import ClientDataSource
+
+        if isinstance(dataset, ClientDataSource):
+            raise ValueError(
+                "the masked secure-aggregation tier is cross-silo: "
+                "streamed ClientDataSources are refused (public cohort "
+                "sample counts need train_idx_map)")
         super().__init__(dataset, task, cfg, worker_num)
-        self.n_shares, self.threshold_t = n_shares, threshold_t
-        self.quant_scale = quant_scale
+        if defense_type not in ("none", "dp"):
+            raise ValueError(f"unknown defense_type {defense_type!r} for "
+                             "the secure-aggregation tier ('none' | 'dp')")
+        # capacity guard at CONSTRUCTION (collectives/finite_field.py):
+        # K terms * 2 * quant_scale * max_abs must stay inside GF(p)
+        self.secagg = _secagg_config(cfg, threshold_t, quant_scale,
+                                     defense_type, norm_bound,
+                                     secagg_max_abs)
+        self.quant_scale = float(quant_scale)
+        self.defense_type = defense_type
+        self.accountant = None
+        self._privacy_cache = None
+        if defense_type == "dp":
+            from fedml_tpu.core.privacy import DPAccountant
+
+            if noise_multiplier <= 0:
+                raise ValueError("defense_type='dp' needs noise_multiplier"
+                                 f" > 0, got {noise_multiplier}")
+            self.accountant = DPAccountant()
+            self._dp_z, self._dp_C = float(noise_multiplier), float(norm_bound)
+            self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
+        _perf.ensure_secagg_families()
+        # per-round masked-fold state (begin_round resets; _frozen parks
+        # the fold while a recovery phase is in flight so a late upload
+        # cannot corrupt the already-fixed survivor sum)
+        self._acc = None
+        self._round_slots: set[int] = set()
+        self._b_shares: dict[int, np.ndarray] = {}
+        self._extras: dict[int, list] = {}
+        self._frozen = False
+        self._recovery: tuple[list[int], list[int], dict] | None = None
+
+    def begin_round(self, round_idx: int) -> None:
+        super().begin_round(round_idx)
+        self._acc = None
+        self._round_slots = set()
+        self._b_shares = {}
+        self._extras = {}
+        self._frozen = False
+        self._recovery = None
+        self.sample_num_dict.clear()
+
+    def add_local_trained_result(self, index: int, wire_leaves,
+                                 sample_num: int,
+                                 round_idx: int | None = None) -> None:
+        if not self._admit_upload(index, round_idx):
+            return
+        if self._frozen:
+            # recovery in flight: the survivor set (and the reveal
+            # requests out for it) is FIXED — folding a late slot now
+            # would leave its masks unstrippable; the shed/re-broadcast
+            # path gives the rank a fresh shot at the round
+            _obs.record_stale_upload("stale")
+            log.warning("secagg: dropping late upload from slot %d — "
+                        "mask recovery already in flight", index)
+            return
+        if index in self._round_slots:
+            # chaos-duplicated upload: the fold is additive, so exactly-
+            # once matters here where the dense path's slot overwrite was
+            # naturally idempotent
+            _obs.record_stale_upload("stale")
+            log.warning("secagg: dropping duplicate upload from slot %d",
+                        index)
+            return
+        masked, b_shares = wire_leaves[0], wire_leaves[1]
+        self._acc = sa.fold_masked(self._acc, masked, self.secagg.p)
+        self._round_slots.add(index)
+        self._b_shares[index] = np.asarray(b_shares, np.int64)
+        self._extras[index] = list(wire_leaves[2:])
+        self.sample_num_dict[index] = sample_num
+        self.flag_client_model_uploaded[index] = True
+
+    def set_recovery(self, survivors, dead,
+                     pair_reveals: dict[int, dict[int, int]]) -> None:
+        """Fix the survivor/dead split (and the survivor-revealed pairwise
+        seeds) the next ``aggregate()`` decodes with. Dead slots are
+        ledgered ``secagg_dropout`` with the clients they would have
+        trained."""
+        survivors = sorted(int(s) for s in survivors)
+        dead = sorted(int(d) for d in dead)
+        if len(survivors) < self.secagg.recovery_min:
+            raise ValueError(
+                f"secagg recovery needs >= {self.secagg.recovery_min} "
+                f"survivors, got {len(survivors)}")
+        self._recovery = (survivors, dead, dict(pair_reveals))
+        if dead:
+            ids = self.client_sampling(self.current_round)
+            for j in dead:
+                self.quarantine.record(self.current_round, j + 1,
+                                       "secagg_dropout",
+                                       client=int(ids[j]))
+                _obs.record_update_rejected("secagg_dropout")
+            _perf.record_secagg_dropped(len(dead))
 
     def aggregate(self):
-        ranks = sorted(self.model_dict)
+        if self._recovery is None:
+            # full barrier (no elastic manager in the stack): every slot
+            self.set_recovery(sorted(self._round_slots), [], {})
+        survivors, dead, reveals = self._recovery
+        t0 = time.perf_counter()
+        # strip survivors' self-masks from the shares the SURVIVOR slots
+        # hold (>= t+1 by the recovery threshold) + the dead slots'
+        # orphaned pairwise masks from the survivor reveals
+        self_seeds = {
+            i: sa.recover_self_seed(
+                survivors, self._b_shares[i][survivors],
+                self.secagg.threshold_t, self.secagg.p)
+            for i in survivors}
+        vec_sum = sa.unmask_sum(self._acc, survivors, dead, self_seeds,
+                                reveals, self.secagg)
+        nsamp = np.asarray([self.sample_num_dict[i] for i in survivors],
+                           np.float64)
+        if self.defense_type == "dp":
+            # clients masked UNWEIGHTED clipped deltas: uniform average
+            # over the realized m + noise z*C/m, accountant charged with
+            # the realized sampling rate (elastic rounds shrink m)
+            m = len(survivors)
+            delta = vec_sum / m
+            sd = self._dp_z * self._dp_C / m
+            self._noise_rng, k = jax.random.split(self._noise_rng)
+            noise = np.asarray(
+                jax.random.normal(k, np.shape(delta), jnp.float32),
+                np.float64) * sd
+            global_vec = np.asarray(tree_vectorize(self.net.params),
+                                    np.float64)
+            new_vec = global_vec + delta + noise
+            from fedml_tpu.core.privacy import charge_and_record
 
-        summed = None
-        for r in ranks:
-            sh = np.asarray(self.model_dict[r][0], np.int64)
-            summed = sh if summed is None else (summed + sh) % ff.P_DEFAULT
-        alphas = np.arange(1, self.n_shares + 1, dtype=np.int64)
-        z_sum = ff.shamir_decode(jnp.asarray(summed), jnp.asarray(alphas),
-                                 self.threshold_t)
-        # clients upload pre-normalized weights (weights sum to 1), so the
-        # reconstructed field sum IS the weighted average
-        vec = ff.field_decode(z_sum, self.quant_scale)
-        new_params = tree_unvectorize(jnp.asarray(vec, jnp.float32),
-                                      self.net.params)
+            self._privacy_cache = charge_and_record(
+                self.accountant, m / self.cfg.client_num_in_total,
+                self._dp_z, self._dp_C, realized_m=m)
+        else:
+            # clients pre-normalized by the FULL cohort total T; the
+            # decoded sum is sum_S (n_i/T) x_i — rescale by T / sum_S n_i
+            # for the exact survivor-weighted mean (the elastic rule)
+            _, counts = cohort_sample_counts(
+                self.current_round, self.cfg, self.dataset,
+                _batch_cap(self.dataset, self.cfg))
+            new_vec = vec_sum * (max(sum(counts), 1)
+                                 / max(float(nsamp.sum()), 1e-12))
+        new_params = tree_unvectorize(
+            jnp.asarray(np.asarray(new_vec, np.float32)), self.net.params)
 
+        # extras (BN stats) are not secret: plain weighted mean over the
+        # survivors' cleartext extra leaves
         extra_leaves = jax.tree.leaves(self.net.extra)
-        if extra_leaves:
+        if extra_leaves and survivors:
             stacked = [
-                jnp.stack([jnp.asarray(self.model_dict[r][1 + i]) for r in ranks])
-                for i in range(len(extra_leaves))
+                jnp.stack([jnp.asarray(self._extras[i][k])
+                           for i in survivors])
+                for k in range(len(extra_leaves))
             ]
-            wts = jnp.asarray([self.sample_num_dict[r] for r in ranks], jnp.float32)
-            avg = tree_weighted_mean(stacked, wts)
-            new_extra = jax.tree.unflatten(jax.tree.structure(self.net.extra), avg)
+            avg = tree_weighted_mean(stacked,
+                                     jnp.asarray(nsamp, jnp.float32))
+            new_extra = jax.tree.unflatten(
+                jax.tree.structure(self.net.extra), avg)
         else:
             new_extra = self.net.extra
 
         self.net = NetState(new_params, new_extra)
-        self.model_dict.clear()
+        self._acc, self._recovery = None, None
+        self._round_slots, self._b_shares, self._extras = set(), {}, {}
         self.sample_num_dict.clear()
+        _perf.record_flush_seconds(time.perf_counter() - t0)
         return pack_pytree(self.net)
+
+    def privacy_record(self) -> dict | None:
+        """The round record's ``privacy`` block (None outside dp mode) —
+        the server manager rides it on every emitted round."""
+        return self._privacy_cache
+
+
+class TASecureClientManager(FedAvgClientManager):
+    """FedAvgClientManager that answers mask-recovery reveal requests."""
+
+    def register_message_receive_handlers(self):
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_REVEAL_REQUEST,
+            self.handle_message_reveal_request)
+
+    def handle_message_reveal_request(self, msg_params):
+        round_idx = int(msg_params[MyMessage.MSG_ARG_KEY_ROUND])
+        dead = [int(d) for d in
+                np.asarray(msg_params[MyMessage.MSG_ARG_KEY_SECAGG_DEAD])]
+        seeds = self.trainer.reveal_pair_seeds(round_idx, dead)
+        msg = Message(MyMessage.MSG_TYPE_C2S_REVEAL_SHARES, self.rank,
+                      self.server_rank)
+        msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DEAD,
+                       np.asarray(dead, np.int64))
+        msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_PAIR_SEEDS,
+                       np.asarray(seeds, np.int64))
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, round_idx)
+        # reveals bypass the async uplink sender: tiny frames, and the
+        # round cannot advance until they land — FIFO with nothing
+        self.send_message(msg)
+
+
+class TASecureServerManager(FedAvgServerManager):
+    """FedAvgServerManager with the mask-recovery state machine.
+
+    Phases per round: ``uploads`` (the ordinary barrier / elastic
+    timeout) -> when slots are missing and survivors >= t+1, ``recovery``
+    (reveal requests out, replies folding in) -> aggregate. Below
+    threshold, or on a reveal lost past the watchdog deadline, the round
+    SHEDS: every lost slot is ledgered, the outcome metric counts it, and
+    the round re-broadcasts (the wedge-fix path) so a recovered fleet
+    re-converges instead of wedging."""
+
+    def __init__(self, aggregator: TAAggregator, **kw):
+        if kw.get("async_buffer_k") is not None:
+            raise ValueError("the masked secure-aggregation tier needs "
+                             "the synchronous cohort — async_buffer_k is "
+                             "refused")
+        if kw.get("delta_broadcast"):
+            raise ValueError("delta_broadcast is not wired for the "
+                             "masked secure-aggregation tier (uploads "
+                             "prove no base version — run dense)")
+        if kw.get("heartbeat_max_age_s") is not None:
+            raise ValueError("heartbeat cohort admission is not wired for "
+                             "the masked secure-aggregation tier (an "
+                             "excluded slot's masks would orphan every "
+                             "round) — rely on round_timeout_s recovery")
+        super().__init__(aggregator, **kw)
+        self._phase = "uploads"
+        self._reveal: dict | None = None
+        self._last_secagg: dict | None = None
+
+    def register_message_receive_handlers(self):
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_REVEAL_SHARES,
+            self.handle_message_reveal_shares)
+
+    # ------------------------------------------------------------ recovery
+    def _advance_round(self):
+        """Route through mask recovery before the base aggregate: a full
+        cohort decodes immediately; missing slots start the reveal phase
+        (or shed below threshold). Caller holds _round_lock."""
+        agg: TAAggregator = self.aggregator
+        survivors = sorted(agg._round_slots)
+        dead = [s for s in range(agg.worker_num) if s not in agg._round_slots]
+        if not dead:
+            agg.set_recovery(survivors, [], {})
+            _perf.record_secagg_round("full")
+            self._last_secagg = {"outcome": "full", "dead": []}
+            super()._advance_round()
+            return
+        if len(survivors) < agg.secagg.recovery_min:
+            self._shed_round(
+                survivors, dead,
+                f"{len(survivors)} survivors < recovery threshold "
+                f"{agg.secagg.recovery_min}")
+            return
+        self._begin_recovery(survivors, dead)
+
+    def _begin_recovery(self, survivors: list[int], dead: list[int]) -> None:
+        agg: TAAggregator = self.aggregator
+        agg._frozen = True
+        self._phase = "recovery"
+        self._reveal = {"survivors": survivors, "dead": dead,
+                        "seeds": {}, "t0": time.perf_counter()}
+        log.warning("secagg round %d: slots %s dropped — asking %d "
+                    "survivors to reveal their pairwise seeds",
+                    self.round_idx, dead, len(survivors))
+        for slot in survivors:
+            msg = Message(MyMessage.MSG_TYPE_S2C_REVEAL_REQUEST, self.rank,
+                          slot + 1)
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DEAD,
+                           np.asarray(dead, np.int64))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+
+    def handle_message_reveal_shares(self, msg_params):
+        with self._round_lock:
+            if self._phase != "recovery" or self._reveal is None:
+                _obs.record_stale_upload("stale")
+                return
+            if int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                  self.round_idx)) != self.round_idx:
+                _obs.record_stale_upload("stale")
+                return
+            slot = int(msg_params[Message.MSG_ARG_KEY_SENDER]) - 1
+            rv = self._reveal
+            if slot not in rv["survivors"] or slot in rv["seeds"]:
+                return  # unknown or duplicate reveal: exactly-once fold
+            dead = [int(d) for d in np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_SECAGG_DEAD])]
+            seeds = np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_SECAGG_PAIR_SEEDS],
+                np.int64)
+            if dead != rv["dead"] or len(seeds) != len(dead):
+                log.warning("secagg: reveal from slot %d names dead set "
+                            "%s != %s — dropped", slot, dead, rv["dead"])
+                return
+            rv["seeds"][slot] = {j: int(s) for j, s in zip(dead, seeds)}
+            if len(rv["seeds"]) < len(rv["survivors"]):
+                return
+            # every survivor revealed: strip, decode, and run the base
+            # round advance (aggregate -> eval -> ckpt -> next broadcast)
+            dt = time.perf_counter() - rv["t0"]
+            agg: TAAggregator = self.aggregator
+            agg.set_recovery(rv["survivors"], rv["dead"], rv["seeds"])
+            _perf.record_secagg_round("recovered")
+            _perf.record_secagg_recovery_seconds(dt)
+            self._last_secagg = {"outcome": "recovered",
+                                 "dead": list(rv["dead"]),
+                                 "recovery_s": round(dt, 6)}
+            self._phase, self._reveal = "uploads", None
+            FedAvgServerManager._advance_round(self)
+
+    def _shed_round(self, survivors: list[int], dead: list[int],
+                    why: str) -> None:
+        """Below-threshold / reveal-lost: ledger every lost slot, count
+        the outcome, re-broadcast the SAME round (fresh fault draws; a
+        recovered fleet re-converges). Caller holds _round_lock."""
+        agg: TAAggregator = self.aggregator
+        ids = agg.client_sampling(self.round_idx)
+        for slot in dead:
+            agg.quarantine.record(self.round_idx, slot + 1, "secagg_shed",
+                                  client=int(ids[slot]))
+            _obs.record_update_rejected("secagg_shed")
+        _perf.record_secagg_round("shed")
+        _perf.record_secagg_dropped(len(dead))
+        log.error("secagg round %d SHED (%s): lost slots %s ledgered — "
+                  "re-broadcasting the round", self.round_idx, why, dead)
+        self._phase, self._reveal = "uploads", None
+        self._last_secagg = {"outcome": "shed", "dead": list(dead)}
+        # the all-uploads-lost wedge-fix path: clear the elastic
+        # undeliverable marks (round_idx is NOT advancing, so the reprobe
+        # cadence can never trigger) and re-broadcast; _broadcast_model's
+        # begin_round resets the masked fold for the fresh attempt
+        self._undeliverable.clear()
+        self._update_alive_gauge()
+        self._broadcast_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              agg.get_global_model_params())
+
+    def on_timeout(self, idle_s: float):
+        with self._round_lock:
+            if self._phase == "recovery" and not self._finished.is_set():
+                rv = self._reveal or {"survivors": [], "dead": [],
+                                      "seeds": {}}
+                missing = [s for s in rv["survivors"]
+                           if s not in rv["seeds"]]
+                self._shed_round(
+                    rv["survivors"], rv["dead"],
+                    f"reveal frames lost from slots {missing} after "
+                    f"{idle_s:.1f}s")
+                return
+        super().on_timeout(idle_s)
+
+    def _round_record_extra(self) -> dict:
+        extra = super()._round_record_extra()
+        if self._last_secagg is not None:
+            extra["secagg"] = dict(self._last_secagg)
+        return extra
 
 
 def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
-                  job_id="turboagg-sim", base_port=50000, n_shares=5,
-                  threshold_t=2, quant_scale=2**16):
+                  job_id="turboagg-sim", base_port=50000, threshold_t=None,
+                  quant_scale=2**16, defense_type: str = "none",
+                  norm_bound: float = 30.0, noise_multiplier: float = 1.0,
+                  secagg_max_abs: float = 4.0, chaos_plan=None,
+                  round_timeout_s: float | None = None, telemetry=None,
+                  ckpt_dir: str | None = None, n_shares=None):
     """All ranks as threads (mpirun-on-localhost analogue); returns the
-    aggregator with .net/.history."""
+    aggregator with .net/.history. ``chaos_plan`` + ``round_timeout_s``
+    arm the dropout-recovery scenario deterministically; ``defense_type=
+    'dp'`` runs accounted DP on the masked path (privacy block on every
+    round record)."""
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port)
-    aggregator = TAAggregator(dataset, task, cfg, worker_num=size - 1,
-                              n_shares=n_shares, threshold_t=threshold_t,
-                              quant_scale=quant_scale)
-    server = FedAvgServerManager(aggregator, rank=0, size=size, backend=backend, **kw)
-    clients = []
-    for r in range(1, size):
-        trainer = SecureTrainer(r, dataset, task, cfg, n_shares=n_shares,
-                                threshold_t=threshold_t, quant_scale=quant_scale)
-        clients.append(FedAvgClientManager(trainer, rank=r, size=size,
-                                           backend=backend, **kw))
-    launch_simulated(server, clients)
+    from fedml_tpu import chaos as _chaos
+
+    if chaos_plan is not None:  # None must not clobber an installed plan
+        _chaos.install_plan(chaos_plan)
+    try:
+        aggregator = TAAggregator(
+            dataset, task, cfg, worker_num=size - 1,
+            threshold_t=threshold_t, quant_scale=quant_scale,
+            defense_type=defense_type, norm_bound=norm_bound,
+            noise_multiplier=noise_multiplier,
+            secagg_max_abs=secagg_max_abs, n_shares=n_shares)
+        server = TASecureServerManager(
+            aggregator, rank=0, size=size, backend=backend,
+            round_timeout_s=round_timeout_s, telemetry=telemetry,
+            ckpt_dir=ckpt_dir, **kw)
+        clients = []
+        for r in range(1, size):
+            trainer = SecureTrainer(
+                r, dataset, task, cfg, threshold_t=threshold_t,
+                quant_scale=quant_scale, defense_type=defense_type,
+                norm_bound=norm_bound, secagg_max_abs=secagg_max_abs)
+            clients.append(TASecureClientManager(
+                trainer, rank=r, size=size, backend=backend, **kw))
+        launch_simulated(server, clients)
+    finally:
+        if chaos_plan is not None:
+            _chaos.install_plan(None)
     return aggregator

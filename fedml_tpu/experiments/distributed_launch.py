@@ -18,7 +18,9 @@ client_num_per_round (one process per sampled client, FedAvgAPI.py:20-28).
 --algo selects the algorithm on the shared runtime (the reference's unified
 multi-algorithm launcher, fedml_experiments/distributed/fed_launch/main.py):
 fedavg | fedopt (server optimizer) | fedprox (proximal clients) |
-fedavg_robust (server defenses) | turboaggregate (Shamir shares on the wire).
+fedavg_robust (server defenses) | turboaggregate (masked secure
+aggregation with dropout recovery — docs/ROBUSTNESS.md §Secure
+aggregation).
 """
 
 from __future__ import annotations
@@ -44,7 +46,28 @@ def add_args(p: argparse.ArgumentParser):
     p.add_argument("--norm_bound", type=float, default=30.0)
     p.add_argument("--stddev", type=float, default=0.025)
     p.add_argument("--noise_multiplier", type=float, default=1.0,
-                   help="z for --defense_type dp (accounted DP-FedAvg)")
+                   help="z for --defense_type dp (accounted DP-FedAvg; "
+                        "also the masked secure tier's DP mode — "
+                        "--algo turboaggregate --defense_type dp)")
+    # masked secure aggregation (--algo turboaggregate,
+    # docs/ROBUSTNESS.md §Secure aggregation)
+    p.add_argument("--secagg_threshold_t", "--secagg-threshold-t",
+                   dest="secagg_threshold_t", type=int, default=None,
+                   help="turboaggregate: Shamir threshold t — decoding "
+                        "any round needs >= t+1 surviving cohort slots; "
+                        "below that the round sheds + re-broadcasts "
+                        "(default: min(2, cohort-1))")
+    p.add_argument("--secagg_quant_scale", "--secagg-quant-scale",
+                   dest="secagg_quant_scale", type=float, default=2**16,
+                   help="turboaggregate: fixed-point scale quantizing "
+                        "updates into GF(2^31-1); construction refuses "
+                        "cohorts that would wrap the field "
+                        "(collectives/finite_field.assert_field_capacity)")
+    p.add_argument("--secagg_max_abs", "--secagg-max-abs",
+                   dest="secagg_max_abs", type=float, default=4.0,
+                   help="turboaggregate: promised bound on any masked "
+                        "update coordinate (the field-capacity guard's "
+                        "max|w|); DP mode uses --norm_bound instead")
     p.add_argument("--edges", type=int, default=0,
                    help="hierarchical 2-tier topology (docs/ROBUSTNESS.md "
                         "§Hierarchical tiers): ranks 1..E become EDGE "
@@ -313,10 +336,43 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     """Construct this rank's manager for --algo (does not run it)."""
     from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
     from fedml_tpu.distributed.fedavg.api import init_client
-    from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
     from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 
     backend = args.backend.upper()
+    if args.algo == "turboaggregate":
+        # the masked secure tier's refusal matrix — every unsupported
+        # composition is a LOUD error on every rank (the former
+        # warn-and-ignore for --shard_server_state included; ranks share
+        # argv, so client and server refuse identically, test-pinned)
+        incompatible = [name for name, v in (
+            ("--shard_server_state",
+             getattr(args, "shard_server_state", 0) or None),
+            ("--fused_agg", getattr(args, "fused_agg", 0) or None),
+            ("--async_buffer_k", getattr(args, "async_buffer_k", None)),
+            ("--update_codec", getattr(args, "update_codec", None)),
+            ("--sparsify_ratio", getattr(args, "sparsify_ratio", None)),
+            ("--aggregator", getattr(args, "aggregator", None)),
+            ("--byzantine_f", getattr(args, "byzantine_f", None)),
+            ("--delta_broadcast",
+             getattr(args, "delta_broadcast", 0) or None),
+            ("--heartbeat_max_age_s",
+             getattr(args, "heartbeat_max_age_s", None)),
+            ("--sum_assoc", None if getattr(args, "sum_assoc", "auto")
+             == "auto" else args.sum_assoc),
+            ("--edges", getattr(args, "edges", 0) or None),
+            # a masked upload carries no model-space structure an
+            # adversary plan could perturb meaningfully — silently
+            # running it would fake a Byzantine-robustness result
+            ("--adversary_plan", getattr(args, "adversary_plan", None)),
+        ) if v is not None]
+        if incompatible:
+            raise ValueError(
+                f"--algo turboaggregate (masked secure aggregation) does "
+                f"not compose with {incompatible}: masked field vectors "
+                "aggregate host-side mod p — there is no device-resident "
+                "server plane to shard/fuse, no per-update structure for "
+                "codecs or robust estimators, and the synchronous cohort "
+                "is the protocol (docs/ROBUSTNESS.md §Secure aggregation)")
     edges = int(getattr(args, "edges", 0) or 0)
     if edges:
         # hierarchical 2-tier topology: rank 0 root, 1..E edges, rest
@@ -398,10 +454,6 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
     if getattr(args, "sum_assoc", "auto") != "auto":
         agg_kw["sum_assoc"] = args.sum_assoc
     if getattr(args, "fused_agg", 0):
-        if args.algo == "turboaggregate":
-            raise ValueError(
-                "--fused_agg is not wired for turboaggregate (Shamir "
-                "shares aggregate host-side in the finite field)")
         agg_kw["fused_agg"] = True
     if getattr(args, "aggregator", None):
         agg_kw["aggregator"] = args.aggregator
@@ -444,24 +496,30 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                 stddev=args.stddev, noise_multiplier=args.noise_multiplier,
                 **agg_kw)
         elif args.algo == "turboaggregate":
-            from fedml_tpu.distributed.turboaggregate import TAAggregator
+            from fedml_tpu.distributed.turboaggregate import (
+                TAAggregator,
+                TASecureServerManager,
+            )
 
-            if agg_kw.get("shard_server_state"):
-                logging.getLogger("fedml_tpu.launch").warning(
-                    "--shard_server_state ignored for turboaggregate: "
-                    "Shamir shares aggregate host-side in the finite "
-                    "field, there is no device-resident server plane to "
-                    "partition")
-            agg = TAAggregator(data, task, cfg, worker_num=args.world_size - 1)
+            agg = TAAggregator(
+                data, task, cfg, worker_num=args.world_size - 1,
+                threshold_t=args.secagg_threshold_t,
+                quant_scale=args.secagg_quant_scale,
+                defense_type=("dp" if args.defense_type == "dp"
+                              else "none"),
+                norm_bound=args.norm_bound,
+                noise_multiplier=args.noise_multiplier,
+                secagg_max_abs=args.secagg_max_abs)
+            return TASecureServerManager(
+                agg, rank=0, size=args.world_size, backend=backend,
+                ckpt_dir=args.ckpt_dir,
+                round_timeout_s=args.round_timeout_s,
+                telemetry=telemetry, **backend_kw)
         else:  # fedavg / fedprox share the plain weighted-average server
             agg = FedAvgAggregator(data, task, cfg,
                                    worker_num=args.world_size - 1, **agg_kw)
         srv_kw: dict = {}
         if getattr(args, "async_buffer_k", None) is not None:
-            if args.algo == "turboaggregate":
-                raise ValueError(
-                    "--async_buffer_k is not wired for turboaggregate "
-                    "(Shamir shares need the full synchronous cohort)")
             srv_kw.update(async_buffer_k=args.async_buffer_k,
                           staleness=args.staleness,
                           staleness_bound=args.staleness_bound,
@@ -491,15 +549,21 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                            local_spec=prox_spec(cfg, args.fedprox_mu),
                            adversary_plan=adv, **codec_kw, **backend_kw)
     if args.algo == "turboaggregate":
-        from fedml_tpu.distributed.turboaggregate import SecureTrainer
+        from fedml_tpu.distributed.turboaggregate import (
+            SecureTrainer,
+            TASecureClientManager,
+        )
 
-        if codec_kw["update_codec"] or sp:
-            raise ValueError(
-                "--update_codec/--sparsify_ratio are not wired for "
-                "turboaggregate (Shamir shares ship dense)")
-        trainer = SecureTrainer(args.rank, data, task, cfg)
-        return FedAvgClientManager(trainer, rank=args.rank, size=args.world_size,
-                                   backend=backend, **backend_kw)
+        trainer = SecureTrainer(
+            args.rank, data, task, cfg,
+            threshold_t=args.secagg_threshold_t,
+            quant_scale=args.secagg_quant_scale,
+            defense_type=("dp" if args.defense_type == "dp" else "none"),
+            norm_bound=args.norm_bound,
+            secagg_max_abs=args.secagg_max_abs)
+        return TASecureClientManager(trainer, rank=args.rank,
+                                     size=args.world_size,
+                                     backend=backend, **backend_kw)
     return init_client(data, task, cfg, args.rank, args.world_size, backend,
                        adversary_plan=adv, **codec_kw, **backend_kw)
 

@@ -68,6 +68,11 @@ DEFAULT_RULES: list[dict] = [
     {"rule": "quorum", "severity": "critical", "min_fraction": 1.0},
     {"rule": "device_memory", "severity": "critical", "max_fraction": 0.92},
     {"rule": "stall", "severity": "critical", "after_s": 300.0},
+    # privacy-budget ledger (docs/ROBUSTNESS.md §Privacy ledger): fires
+    # once when the DP accountant's cumulative ε crosses the budget. Not
+    # evaluable (never fires) on runs without a ``privacy`` block on
+    # their round records; override max_epsilon per deployment.
+    {"rule": "privacy_budget", "severity": "warning", "max_epsilon": 10.0},
 ]
 
 _KNOWN_RULES = {r["rule"] for r in DEFAULT_RULES}
@@ -135,6 +140,9 @@ class HealthMonitor:
         self._nonfinite_seen = False
         self._quar_per_round: list[float] = []
         self._shed_per_round: list[float] = []
+        # cumulative DP ε from the newest round record's privacy block
+        # (None = not a DP run; the privacy_budget rule stays quiet)
+        self._privacy_eps: float | None = None
         self._last_quar = self.registry.total("fed_updates_rejected_total")
         self._last_shed = self.registry.total("fed_async_shed_total")
         # edge-trigger state + the full fired/resolved ledger
@@ -173,6 +181,9 @@ class HealthMonitor:
             for v in (rec.get("metrics") or {}).values():
                 if isinstance(v, float) and not math.isfinite(v):
                     self._nonfinite_seen = True
+            eps = (rec.get("privacy") or {}).get("eps")
+            if isinstance(eps, (int, float)):
+                self._privacy_eps = float(eps)
             if rec.get("eval"):
                 self._fold_eval(rec["eval"])
             # per-round quarantine/shed movement from the registry totals
@@ -267,6 +278,11 @@ class HealthMonitor:
             age = self._clock() - self._progress_t
             thresh = float(rule.get("after_s", 300.0))
             return age > thresh, age, thresh
+        if kind == "privacy_budget":
+            if self._privacy_eps is None:
+                return None  # not a DP run (no privacy block seen)
+            thresh = float(rule.get("max_epsilon", 10.0))
+            return self._privacy_eps > thresh, self._privacy_eps, thresh
         return None
 
     def check(self) -> list[dict]:
@@ -352,6 +368,9 @@ class HealthMonitor:
                 "quarantine_total": self.registry.total(
                     "fed_updates_rejected_total"),
                 "shed_total": self.registry.total("fed_async_shed_total"),
+                # cumulative DP ε (null outside DP runs) — the live twin
+                # of the round records' privacy block / fed_privacy_epsilon
+                "privacy_epsilon": self._privacy_eps,
                 "alerts_fired_total": self.registry.total("fed_alerts_total"),
                 "alerts": sorted(self._active.values(),
                                  key=lambda a: a["rule"]),

@@ -262,3 +262,57 @@ def ensure_async_shed_families() -> None:
     for reason in ("stale", "overflow", "nonfinite", "crash", "suspect",
                    "undecodable"):
         _async_shed(reason)
+
+
+# --------------------------------------- secure aggregation + privacy
+# docs/ROBUSTNESS.md §Secure aggregation / §Privacy ledger. Fed by the
+# masked secure-aggregation tier (distributed/turboaggregate.py) and the
+# DP aggregators (distributed/fedavg_robust.py, algorithms/
+# fedavg_robust.py):
+#
+#     fed_secagg_rounds_total{outcome}    masked rounds by how they
+#                                         decoded: full (whole cohort),
+#                                         recovered (dropout + mask
+#                                         recovery), shed (below the t+1
+#                                         threshold / reveal lost —
+#                                         round re-broadcast)
+#     fed_secagg_dropped_slots_total      cohort slots whose masked
+#                                         upload never arrived
+#     fed_secagg_recovery_seconds         (histogram) reveal fan-out ->
+#                                         last reveal reply per recovery
+#     fed_privacy_epsilon                 cumulative DP ε at the ledger's
+#                                         reporting δ — the budget the
+#                                         privacy_budget health rule
+#                                         alerts on
+@lru_cache(maxsize=4)
+def _secagg_rounds(outcome: str):
+    return REGISTRY.counter("fed_secagg_rounds_total", outcome=outcome)
+
+
+def record_secagg_round(outcome: str) -> None:
+    _secagg_rounds(outcome).inc()
+
+
+@lru_cache(maxsize=1)
+def _secagg_dropped():
+    return REGISTRY.counter("fed_secagg_dropped_slots_total")
+
+
+def record_secagg_dropped(n: int) -> None:
+    _secagg_dropped().inc(n)
+
+
+def record_secagg_recovery_seconds(seconds: float) -> None:
+    _hist("fed_secagg_recovery_seconds").observe(seconds)
+
+
+def set_privacy_epsilon(eps: float) -> None:
+    REGISTRY.gauge("fed_privacy_epsilon").set(float(eps))
+
+
+def ensure_secagg_families() -> None:
+    """Pre-register the secure-aggregation outcome children at zero so a
+    masked run's Prometheus export always carries the full family."""
+    for outcome in ("full", "recovered", "shed"):
+        _secagg_rounds(outcome)
+    _secagg_dropped()

@@ -457,6 +457,69 @@ PY
   # rounds/s at 100k streamed clients, fused ingest RSS bounded)
   python scripts/bench_gate.py BENCH_FUSED_r01.json \
     --gate scripts/ci_fused_gate.json
+  echo "== secure-aggregation + privacy smoke (masked == plain within tolerance; mid-run dropout recovers; fed_privacy_epsilon exported) =="
+  # the masked secure-aggregation tier (docs/ROBUSTNESS.md §Secure
+  # aggregation) must (a) match plain FedAvg within quantization on a
+  # clean run, (b) RECOVER a mid-run
+  # dropout (chaos drop on one rank's uplink -> reveal round-trip ->
+  # elastic partial, ledgered secagg_dropout), and (c) carry the privacy
+  # ledger end to end in dp mode: privacy block on every round record,
+  # fed_privacy_epsilon + fed_secagg_rounds_total in the Prometheus text
+  python - <<'PY'
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import FaultPlan
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed import turboaggregate as ta
+from fedml_tpu.distributed.fedavg import run_simulated as plain_run
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs.metrics import REGISTRY
+
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                   client_num_per_round=3, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+plain = plain_run(data, task, cfg, job_id="ci-secagg-plain")
+masked = ta.run_simulated(data, task, cfg, job_id="ci-secagg")
+for x, y in zip(pack_pytree(plain.net), pack_pytree(masked.net)):
+    assert float(np.max(np.abs(np.asarray(x, np.float64)
+                               - np.asarray(y, np.float64)))) < 5e-3
+# mid-run dropout: rank 2's round-1 uplink is dropped once -> the server
+# recovers via the reveal round-trip and ledgers the slot
+plan = FaultPlan.from_json({"seed": 3, "rules": [
+    {"fault": "drop", "direction": "send", "src": [2], "dst": [0],
+     "rounds": [1, 2], "max_per_link": 1}]})
+# threshold_t=1: a 3-slot cohort tolerates one dropout (2 survivors >=
+# t+1); the default t=2 would shed instead of recovering here
+rec = ta.run_simulated(data, task, cfg, job_id="ci-secagg-drop",
+                       chaos_plan=plan, round_timeout_s=3.0,
+                       threshold_t=1)
+led = rec.quarantine.canonical()
+assert any(e[2] == "secagg_dropout" and e[1] == 2 for e in led), led
+assert rec.history and rec.history[-1]["round"] == cfg.comm_round - 1
+# dp mode: privacy ledger end to end
+dp = ta.run_simulated(data, task, cfg, job_id="ci-secagg-dp",
+                      defense_type="dp", noise_multiplier=1.0,
+                      norm_bound=0.5)
+block = dp.privacy_record()
+assert block and block["eps"] > 0 and block["z"] == 1.0, block
+prom = REGISTRY.to_prometheus()
+assert "fed_privacy_epsilon" in prom and "fed_secagg_rounds_total" in prom
+snap = REGISTRY.snapshot()
+outcomes = snap.get("fed_secagg_rounds_total", {})
+assert outcomes.get("outcome=recovered", 0) >= 1, outcomes
+print(f"secure-aggregation smoke ok: masked == plain, dropout recovered "
+      f"(ledger {len(led)} entries), eps={block['eps']:.3f} exported")
+PY
+  # the committed FEDML_BENCH_DP epsilon-vs-accuracy artifact must stay
+  # within spec (accounting math + monotonicity + bounded accuracy cost)
+  python scripts/bench_gate.py BENCH_DP_r01.json \
+    --gate scripts/ci_dp_gate.json
   echo "== flat-memory streamed smoke (100k-virtual-client PackedNpySource run; fed_host_rss_bytes flat across rounds, gated via bench_gate.py) =="
   # the streamed data plane (docs/PERFORMANCE.md §Streaming & cohort
   # bucketing) must hold host RSS FLAT in population size: a 100k-client

@@ -109,6 +109,13 @@ def render_table(records: list[dict]) -> str:
                     if (r.get("hier") or {}).get("rejected") is not None
                     else None),
             "vrtt_s": (r.get("hier") or {}).get("verdict_rtt_s"),
+            # masked secure aggregation + privacy ledger
+            # (docs/ROBUSTNESS.md §Secure aggregation / §Privacy ledger):
+            # how the round decoded (full | recovered | shed attempts
+            # surface via the ledger), and the DP accountant's cumulative
+            # ε@δ — both hide on logs that predate the blocks
+            "secagg": (r.get("secagg") or {}).get("outcome"),
+            "eps": (r.get("privacy") or {}).get("eps"),
             "buf_k": (r.get("async") or {}).get("k"),
             "stale_p50": _staleness_quantile(r, 0.5),
             "stale_max": _staleness_quantile(r, 1.0),

@@ -98,3 +98,28 @@ def test_shamir_additive_homomorphism():
     alphas = np.arange(1, n + 1, dtype=np.int64)
     rec = ff.shamir_decode(summed, alphas, t)
     np.testing.assert_allclose(ff.field_decode(rec), np.array([1.5, 1.0]), atol=1e-4)
+
+
+def test_field_capacity_guard_and_actual_wrap():
+    """assert_field_capacity pins the overflow boundary — and the
+    boundary is REAL: a sum the guard admits decodes exactly, a sum it
+    refuses actually wraps mod p into garbage. Large cohorts or a
+    generous quant_scale used to cross this silently."""
+    import pytest
+
+    p, scale = ff.P_DEFAULT, float(2**8)
+    k_max = int(np.floor((p - 1) / (2 * scale)))  # max_abs = 1.0
+    assert ff.assert_field_capacity(k_max, scale, 1.0) < 1.0
+    with pytest.raises(ValueError, match="field capacity exceeded"):
+        ff.assert_field_capacity(k_max + 1, scale, 1.0)
+    # demonstrate the wrap the guard exists to prevent: n encoded values
+    # of -1.0 sum to -n*scale, decodable only while n*scale < p/2
+    n_ok, n_wrap = 1000, (p // 2) // int(scale) + 1
+    enc = np.asarray(ff.field_encode(np.array([-1.0]), scale)).astype(object)
+    ok = (enc * n_ok) % p
+    np.testing.assert_allclose(
+        np.asarray(ff.field_decode(ok.astype(np.int64), scale)),
+        [-float(n_ok)], atol=1e-6)
+    wrapped = (enc * n_wrap) % p
+    dec = np.asarray(ff.field_decode(wrapped.astype(np.int64), scale))
+    assert abs(dec[0] - (-float(n_wrap))) > 1.0  # wrapped: not the sum

@@ -324,6 +324,64 @@ def test_determinism_clean_fixture_and_scope(tmp_path):
                 rules=["determinism"]) == []
 
 
+ENTROPY_BAD = """\
+import os
+import secrets
+
+
+def make_seed():
+    raw = os.urandom(8)
+    tok = secrets.randbits(64)
+    return raw, tok
+"""
+
+ENTROPY_OK = """\
+import hashlib
+
+
+def make_seed(session_seed, round_idx, slot):
+    key = f"secagg|{session_seed}|{round_idx}|{slot}".encode()
+    return int.from_bytes(hashlib.sha256(key).digest()[:8], "little")
+"""
+
+
+def test_determinism_flags_entropy_key_material(tmp_path):
+    """os.urandom / secrets are banned key material in core/ and
+    collectives/ — every secure-agg seed must flow through the sha256
+    derive chain (core/secure_agg.py) or masked runs stop replaying."""
+    for d in ("core", "collectives"):
+        out = lint(tmp_path, f"{d}/keys.py", ENTROPY_BAD,
+                   rules=["determinism"])
+        msgs = " | ".join(f.message for f in out)
+        assert "os.urandom" in msgs and "secrets.randbits" in msgs, msgs
+        assert len(out) == 2
+
+
+def test_determinism_entropy_scope_and_clean_fixture(tmp_path):
+    # the sha256 chain is the sanctioned derivation
+    assert lint(tmp_path, "core/keys.py", ENTROPY_OK,
+                rules=["determinism"]) == []
+    assert lint(tmp_path, "collectives/keys.py", ENTROPY_OK,
+                rules=["determinism"]) == []
+    # comm/ is exempt from the entropy half (transport nonces — the gRPC
+    # dedup epoch — are not replayed state), as is everything else
+    assert lint(tmp_path, "comm/keys.py", ENTROPY_BAD,
+                rules=["determinism"]) == []
+    assert lint(tmp_path, "obs/keys.py", ENTROPY_BAD,
+                rules=["determinism"]) == []
+    # import-guarded (the has_random pattern): a local variable named
+    # 'secrets' / a helper named 'urandom' in a file that never imports
+    # the module must not trip the live-tree gate
+    shadowed = (
+        "def load():\n"
+        "    secrets = {'k': 1}\n"
+        "    return secrets.get('k'), urandom(8)\n"
+        "def urandom(n):\n"
+        "    return b'0' * n\n")
+    assert lint(tmp_path, "core/shadow.py", shadowed,
+                rules=["determinism"]) == []
+
+
 METRIC_BAD = """\
 from fedml_tpu.obs.metrics import REGISTRY
 

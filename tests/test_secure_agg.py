@@ -1,0 +1,559 @@
+"""Masked secure aggregation (core/secure_agg.py + distributed/
+turboaggregate.py) and the privacy-budget ledger — the dropout-tolerant
+SecAgg tier of docs/ROBUSTNESS.md §Secure aggregation / §Privacy ledger:
+
+- counter-PRG jit path pinned to its numpy oracle; DH pair seeds
+  symmetric; pairwise masks cancel exactly in the cohort sum;
+- full-cohort masked decode == the weighted sum (numpy-oracle exact up
+  to quantization); dropout decode == the exact SURVIVOR weighted mean;
+- Shamir self-mask recovery honors the t+1 threshold;
+- on the wire: masked loopback run == plain FedAvg within quantization;
+  a seeded 2-of-8 crash plan recovers via reveal frames to the elastic
+  partial (ledger attribution exact, bit-for-bit replay); a
+  below-threshold round sheds, re-broadcasts, and reconverges;
+- DP on the masked path: privacy block on every round record, epsilon
+  exact across checkpoint/resume, /healthz + prometheus surfaces, the
+  privacy_budget alert edge-triggers once;
+- the launcher's turboaggregate refusal matrix is loud and complete.
+"""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.collectives import finite_field as ff
+from fedml_tpu.core import secure_agg as sa
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.fixture(scope="module")
+def lr_setup():
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=24, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    return data, task
+
+
+def _cfg(rounds=2, per_round=3, seed=0, **kw):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=per_round, epochs=1,
+                        batch_size=6, lr=0.1, frequency_of_the_test=1,
+                        seed=seed, **kw)
+
+
+def _params_close(a, b, atol):
+    import jax
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=atol)
+
+
+def _params_equal(a, b):
+    import jax
+
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------- primitives
+@pytest.mark.smoke
+def test_prg_counter_mode_matches_numpy_oracle():
+    """The jitted counter-PRG and its numpy twin are the same stream —
+    the replay oracle — and distinct seeds give distinct streams."""
+    for seed in (1, 12345, 2**31 - 2, 2**63 - 1):
+        got = np.asarray(sa.prg_expand(seed, 64))
+        want = sa.prg_expand_np(seed, 64)
+        assert np.array_equal(got, want), seed
+        assert got.min() >= 0 and got.max() < sa.P_DEFAULT
+    assert not np.array_equal(sa.prg_expand_np(1, 64),
+                              sa.prg_expand_np(2, 64))
+
+
+def test_pair_seed_symmetric_per_pair_per_round():
+    """s_ij from i's view == from j's view (the DH property the reveal
+    protocol relies on); pairs and rounds get distinct seeds."""
+    seed = 11
+    pks = sa.public_keys(seed, 0, 4)
+    sks = [sa.secret_key(seed, 0, s) for s in range(4)]
+    for i in range(4):
+        for j in range(4):
+            if i == j:
+                continue
+            assert sa.pair_seed(sks[i], pks[j]) == \
+                sa.pair_seed(sks[j], pks[i])
+    assert sa.pair_seed(sks[0], pks[1]) != sa.pair_seed(sks[0], pks[2])
+    pks1 = sa.public_keys(seed, 1, 4)
+    sks1 = [sa.secret_key(seed, 1, s) for s in range(4)]
+    assert sa.pair_seed(sks[0], pks[1]) != sa.pair_seed(sks1[0], pks1[1])
+
+
+def test_pairwise_masks_cancel_in_cohort_sum():
+    """Masking all-zero updates: the folded sum carries ONLY the self
+    masks — every pairwise term cancelled exactly."""
+    cfg = sa.SecAggConfig(cohort=5, threshold_t=2)
+    seed, rnd, n = 3, 0, 40
+    acc = None
+    for slot in range(5):
+        acc = sa.fold_masked(
+            acc, sa.mask_update(np.zeros(n), 1.0, slot, seed, rnd, cfg),
+            cfg.p)
+    want = np.zeros(n, np.int64)
+    for slot in range(5):
+        b = sa.self_mask_seed(seed, rnd, slot)
+        want = (want + sa.prg_expand_np(b, n)) % cfg.p
+    assert np.array_equal(acc, want)
+
+
+def test_full_cohort_decode_matches_weighted_sum_oracle():
+    cfg = sa.SecAggConfig(cohort=4, threshold_t=2)
+    seed, rnd, n = 7, 2, 57
+    rng = np.random.RandomState(0)
+    xs = rng.randn(4, n) * 0.3
+    ws = np.asarray([0.4, 0.1, 0.3, 0.2])
+    acc = None
+    for i in range(4):
+        acc = sa.fold_masked(
+            acc, sa.mask_update(xs[i], float(ws[i]), i, seed, rnd, cfg),
+            cfg.p)
+    seeds = {i: sa.recover_self_seed(
+        range(4), sa.self_mask_shares(seed, rnd, i, cfg), cfg.threshold_t)
+        for i in range(4)}
+    dec = sa.unmask_sum(acc, range(4), [], seeds, {}, cfg)
+    np.testing.assert_allclose(dec, (xs * ws[:, None]).sum(0),
+                               atol=4 * 4 / cfg.quant_scale)
+
+
+def test_dropout_decode_matches_survivor_sum_oracle():
+    """The acceptance arithmetic: fold only survivor uploads, reveal the
+    dead pairs, and the decode is the exact survivor weighted sum."""
+    cfg = sa.SecAggConfig(cohort=6, threshold_t=2)
+    seed, rnd, n = 9, 1, 33
+    rng = np.random.RandomState(1)
+    xs = rng.randn(6, n) * 0.2
+    ws = rng.rand(6) / 6.0
+    surv, dead = [0, 2, 3, 5], [1, 4]
+    acc = None
+    for i in surv:
+        acc = sa.fold_masked(
+            acc, sa.mask_update(xs[i], float(ws[i]), i, seed, rnd, cfg),
+            cfg.p)
+    pks = sa.public_keys(seed, rnd, 6)
+    reveals = {i: {j: sa.pair_seed(sa.secret_key(seed, rnd, i), pks[j])
+                   for j in dead} for i in surv}
+    seeds = {i: sa.recover_self_seed(
+        surv, sa.self_mask_shares(seed, rnd, i, cfg)[surv],
+        cfg.threshold_t) for i in surv}
+    dec = sa.unmask_sum(acc, surv, dead, seeds, reveals, cfg)
+    np.testing.assert_allclose(
+        dec, (xs[surv] * np.asarray(ws)[surv, None]).sum(0),
+        atol=6 * 4 / cfg.quant_scale)
+
+
+def test_shamir_threshold_semantics():
+    """Self-mask recovery needs >= t+1 shares; any t+1 subset works."""
+    cfg = sa.SecAggConfig(cohort=5, threshold_t=2)
+    shares = sa.self_mask_shares(42, 0, 3, cfg)
+    want = sa.self_mask_seed(42, 0, 3)
+    for subset in ([0, 1, 2], [1, 3, 4], [0, 2, 4], [0, 1, 2, 3, 4]):
+        got = sa.recover_self_seed(subset, shares[subset], cfg.threshold_t)
+        assert got == want, subset
+    with pytest.raises(ValueError, match="needs >="):
+        sa.recover_self_seed([0, 1], shares[[0, 1]], cfg.threshold_t)
+
+
+@pytest.mark.smoke
+def test_field_capacity_guard_pins_overflow_boundary():
+    """K * 2 * quant_scale * max_abs < p, loud at construction: the
+    largest admissible K passes, K at the boundary raises."""
+    p = ff.P_DEFAULT
+    scale, max_abs = 2**16, 1.0
+    k_max = int(np.floor((p - 1) / (2 * scale * max_abs)))  # 16383
+    assert 2 * (k_max) * scale * max_abs < p
+    assert 2 * (k_max + 1) * scale * max_abs >= p
+    frac = ff.assert_field_capacity(k_max, scale, max_abs)
+    assert 0.99 < frac < 1.0
+    with pytest.raises(ValueError, match="field capacity exceeded"):
+        ff.assert_field_capacity(k_max + 1, scale, max_abs)
+    with pytest.raises(ValueError, match="field capacity exceeded"):
+        ff.assert_field_capacity(8, scale, max_abs=2**14)  # huge values
+    with pytest.raises(ValueError, match="must be > 0"):
+        ff.assert_field_capacity(8, 0.0)
+    # the SecAggConfig constructor enforces the same guard
+    with pytest.raises(ValueError, match="field capacity exceeded"):
+        sa.SecAggConfig(cohort=k_max + 1, threshold_t=2)
+
+
+def test_secagg_config_validation():
+    with pytest.raises(ValueError, match="threshold_t"):
+        sa.SecAggConfig(cohort=3, threshold_t=3)  # t+1 > cohort
+    with pytest.raises(ValueError, match="threshold_t"):
+        sa.SecAggConfig(cohort=3, threshold_t=0)
+    assert sa.SecAggConfig(cohort=3, threshold_t=2).recovery_min == 3
+
+
+def test_privacy_block_reports_accountant_state():
+    from fedml_tpu.core.privacy import DPAccountant, privacy_block
+
+    acc = DPAccountant().step(0.25, 1.0, rounds=4)
+    block = privacy_block(acc, 0.25, 1.0, 0.5, realized_m=6)
+    assert block["eps"] == pytest.approx(acc.epsilon(1e-5), abs=1e-5)
+    assert block["m"] == 6 and block["z"] == 1.0 and block["clip"] == 0.5
+    alpha, rdp = acc.best_order(1e-5)
+    assert block["rdp_alpha"] == alpha
+    assert block["rdp"] == pytest.approx(rdp, abs=1e-5)
+
+
+# ------------------------------------------------------------ wire protocol
+def test_masked_run_matches_plain_within_quantization(lr_setup):
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.distributed.fedavg import run_simulated as plain_run
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=2, per_round=3)
+    plain = plain_run(data, task, cfg, job_id="t-sa-plain")
+    masked = ta.run_simulated(data, task, cfg, job_id="t-sa-masked")
+    _params_close(plain.net.params, masked.net.params, atol=5e-3)
+    assert masked.quarantine.canonical() == []
+
+
+def test_duplicate_masked_upload_folds_exactly_once(lr_setup):
+    """The fold is additive, so chaos duplicates need an explicit
+    exactly-once gate where the dense slot-overwrite was idempotent."""
+    from fedml_tpu.distributed.turboaggregate import TAAggregator
+
+    data, task = lr_setup
+    cfg = _cfg(per_round=3)
+    agg = TAAggregator(data, task, cfg, worker_num=3)
+    agg.begin_round(0)
+    masked = np.arange(7, dtype=np.int64)
+    shares = np.zeros(3, np.int64)
+    agg.add_local_trained_result(0, [masked, shares], 5, round_idx=0)
+    acc_once = np.asarray(agg._acc).copy()
+    agg.add_local_trained_result(0, [masked, shares], 5, round_idx=0)
+    assert np.array_equal(agg._acc, acc_once)
+    # frozen fold (recovery in flight) parks late uploads entirely
+    agg._frozen = True
+    agg.add_local_trained_result(1, [masked, shares], 5, round_idx=0)
+    assert 1 not in agg._round_slots
+
+
+def test_crash_dropout_recovers_ledgers_and_replays(lr_setup):
+    """The acceptance scenario: a seeded 2-of-8 crash window inside
+    round_timeout_s. The masked aggregate equals the unmasked elastic
+    partial (same plan on plain FedAvg) within quantization, the
+    quarantine ledger attributes every lost slot, and the run replays
+    bit-for-bit."""
+    from fedml_tpu.chaos import FaultPlan
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.distributed.fedavg import run_simulated as plain_run
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=3, per_round=8)
+    plan = lambda: FaultPlan.from_json(  # noqa: E731 — rebuilt per run
+        {"seed": 5, "rules": [
+            {"fault": "crash", "ranks": [2, 5], "rounds": [1, 2]}]})
+    before = REGISTRY.snapshot().get("fed_secagg_rounds_total", {})
+    masked = ta.run_simulated(data, task, cfg, job_id="t-sa-crash",
+                              chaos_plan=plan(), round_timeout_s=2.0)
+    led = masked.quarantine.canonical()
+    # every lost slot attributed: ranks 2 and 5 (slots 1 and 4) on every
+    # round they were dark (crash window + the elastic reprobe cadence)
+    drops = [e for e in led if e[2] == "secagg_dropout"]
+    assert {e[1] for e in drops} == {2, 5}, led
+    assert any(e[0] == 1 for e in drops), led
+    after = REGISTRY.snapshot().get("fed_secagg_rounds_total", {})
+    assert after.get("outcome=recovered", 0) > before.get(
+        "outcome=recovered", 0)
+    assert masked.history and masked.history[-1]["round"] == 2
+
+    # same plan on the PLAIN elastic runtime: the masked partial is the
+    # exact elastic weighted mean, so final models agree to quantization
+    plain = plain_run(data, task, cfg, job_id="t-sa-crash-plain",
+                      chaos_plan=plan(), round_timeout_s=2.0)
+    _params_close(plain.net.params, masked.net.params, atol=5e-3)
+
+    # bit-for-bit replay: identical ledger AND identical model bits
+    again = ta.run_simulated(data, task, cfg, job_id="t-sa-crash-replay",
+                             chaos_plan=plan(), round_timeout_s=2.0)
+    assert again.quarantine.canonical() == led
+    _params_equal(masked.net.params, again.net.params)
+
+
+def test_below_threshold_round_sheds_rebroadcasts_reconverges(lr_setup):
+    """2 survivors < t+1=3: the round sheds loudly (every lost slot
+    ledgered, outcome counted), re-broadcasts, and — the drop budget
+    exhausted — the retry completes with the clean run's exact bits."""
+    from fedml_tpu.chaos import FaultPlan
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=2, per_round=4)
+    clean = ta.run_simulated(data, task, cfg, job_id="t-sa-clean4")
+    plan = FaultPlan.from_json({"seed": 2, "rules": [
+        {"fault": "drop", "direction": "send", "src": [2, 3], "dst": [0],
+         "rounds": [1, 2], "max_per_link": 1}]})
+    before = REGISTRY.snapshot().get("fed_secagg_rounds_total", {})
+    shed = ta.run_simulated(data, task, cfg, job_id="t-sa-shed",
+                            chaos_plan=plan, round_timeout_s=2.0,
+                            threshold_t=2)
+    led = shed.quarantine.canonical()
+    assert {e[1] for e in led if e[2] == "secagg_shed"} == {2, 3}, led
+    after = REGISTRY.snapshot().get("fed_secagg_rounds_total", {})
+    assert after.get("outcome=shed", 0) > before.get("outcome=shed", 0)
+    assert shed.history and shed.history[-1]["round"] == 1
+    # the retried round re-fits deterministically: final bits == clean
+    _params_equal(clean.net.params, shed.net.params)
+
+
+def test_reveal_covers_only_dead_pairs(lr_setup):
+    """Privacy shape of the recovery frames: a survivor reveals pairwise
+    seeds for exactly the dead slots — never live pairs, never self."""
+    from fedml_tpu.distributed.turboaggregate import SecureTrainer
+
+    data, task = lr_setup
+    trainer = SecureTrainer(3, data, task, _cfg(per_round=5))
+    assert trainer.slot == 2  # rank 3 -> cohort slot 2
+    seeds = trainer.reveal_pair_seeds(1, [0, 4])
+    assert len(seeds) == 2
+    pks = sa.public_keys(trainer.cfg.seed, 1, 5)
+    for j, s in zip([0, 4], seeds):
+        # symmetric: the dead side's view of the pair seed is identical
+        assert s == sa.pair_seed(
+            sa.secret_key(trainer.cfg.seed, 1, j), pks[trainer.slot])
+
+
+# ---------------------------------------------------------- privacy ledger
+def test_dp_round_records_carry_privacy_block(lr_setup, tmp_path):
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.obs import Telemetry
+    from fedml_tpu.obs.events import read_jsonl
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    data, task = lr_setup
+    cfg = _cfg(rounds=3, per_round=3)
+    tel = Telemetry(log_dir=str(tmp_path))
+    dp = ta.run_simulated(data, task, cfg, job_id="t-sa-dp",
+                          defense_type="dp", noise_multiplier=1.0,
+                          norm_bound=0.5, telemetry=tel)
+    tel.close()
+    recs = [r for r in read_jsonl(str(tmp_path / "events.jsonl"))
+            if r.get("kind") == "round"]
+    assert len(recs) == 3
+    eps = [r["privacy"]["eps"] for r in recs]
+    assert all(e > 0 for e in eps) and eps == sorted(eps), eps
+    for r in recs:
+        blk = r["privacy"]
+        assert blk["z"] == 1.0 and blk["clip"] == 0.5
+        assert blk["m"] == 3 and blk["delta"] == 1e-5
+        assert blk["q"] == pytest.approx(3 / 8)
+    assert dp.privacy_record()["eps"] == eps[-1]
+    assert "fed_privacy_epsilon" in REGISTRY.to_prometheus()
+    # secagg block rides the same records
+    assert all(r.get("secagg", {}).get("outcome") == "full" for r in recs)
+
+
+def test_dp_epsilon_and_noise_keys_survive_resume(lr_setup, tmp_path):
+    """Interrupted-and-resumed DP run == uninterrupted run: same final
+    model bits (noise keys not replayed) and exactly the same ε."""
+    from fedml_tpu.distributed import turboaggregate as ta
+
+    data, task = lr_setup
+    ck = str(tmp_path / "ck")
+    full = ta.run_simulated(data, task, _cfg(rounds=4, per_round=3),
+                           job_id="t-sa-dp-full", defense_type="dp",
+                           noise_multiplier=1.0, norm_bound=0.5)
+    ta.run_simulated(data, task, _cfg(rounds=2, per_round=3),
+                     job_id="t-sa-dp-a", defense_type="dp",
+                     noise_multiplier=1.0, norm_bound=0.5, ckpt_dir=ck)
+    resumed = ta.run_simulated(data, task, _cfg(rounds=4, per_round=3),
+                               job_id="t-sa-dp-b", defense_type="dp",
+                               noise_multiplier=1.0, norm_bound=0.5,
+                               ckpt_dir=ck)
+    _params_equal(full.net.params, resumed.net.params)
+    assert resumed.privacy_record()["eps"] == pytest.approx(
+        full.privacy_record()["eps"], abs=1e-9)
+    np.testing.assert_allclose(resumed.accountant._rdp,
+                               full.accountant._rdp, rtol=1e-12)
+
+
+def test_privacy_budget_alert_edge_triggers_once():
+    from fedml_tpu.obs.health import HealthMonitor
+    from fedml_tpu.obs.metrics import MetricsRegistry
+
+    mon = HealthMonitor(
+        registry=MetricsRegistry(),
+        rules=[{"rule": "privacy_budget", "severity": "warning",
+                "max_epsilon": 1.0}])
+    mon.on_round({"round": 0, "privacy": {"eps": 0.4}})
+    assert mon.alerts == []
+    assert mon.snapshot()["privacy_epsilon"] == 0.4
+    mon.on_round({"round": 1, "privacy": {"eps": 1.5}})
+    mon.on_round({"round": 2, "privacy": {"eps": 2.0}})
+    fired = [a for a in mon.alerts if a["state"] == "fired"]
+    assert len(fired) == 1 and fired[0]["rule"] == "privacy_budget"
+    assert mon.snapshot()["status"] == "degraded"
+    # non-DP monitors never evaluate the rule
+    quiet = HealthMonitor(registry=MetricsRegistry())
+    quiet.on_round({"round": 0})
+    assert quiet.alerts == [] and \
+        quiet.snapshot()["privacy_epsilon"] is None
+
+
+def test_standalone_dp_records_carry_privacy_block(tmp_path):
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_lr
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import Telemetry
+    from fedml_tpu.obs.events import read_jsonl
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    data = synthetic_lr(num_clients=4, dim=8, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4,
+                       client_num_per_round=2, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=1, seed=0)
+    tel = Telemetry(log_dir=str(tmp_path))
+    api = FedAvgRobustAPI(data, task, cfg, defense_type="dp",
+                          noise_multiplier=1.0, norm_bound=1.0,
+                          telemetry=tel)
+    for r in range(2):
+        api.run_round(r)
+    tel.close()
+    recs = [r for r in read_jsonl(str(tmp_path / "events.jsonl"))
+            if r.get("kind") == "round"]
+    assert len(recs) == 2
+    eps = [r["privacy"]["eps"] for r in recs]
+    assert all(e > 0 for e in eps) and eps == sorted(eps)
+    assert eps[-1] == pytest.approx(api.epsilon(1e-5), abs=1e-5)
+
+
+def test_dp_block_fallback_does_not_double_charge(monkeypatch):
+    """FedAvgAPI.run_rounds can degrade to per-round dispatch (the
+    mesh/stacked fallback calls self.run_round per round): the block's
+    up-front accountant charge must suppress the per-round charges, or
+    the ledger reports ~2x the true ε and the budget alert fires at half
+    the real spend."""
+    import fedml_tpu.algorithms.fedavg as fedavg_mod
+    from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustAPI
+    from fedml_tpu.core.privacy import DPAccountant
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_lr
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_lr(num_clients=4, dim=8, num_classes=3, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    cfg = FedAvgConfig(comm_round=3, client_num_in_total=4,
+                       client_num_per_round=2, epochs=1, batch_size=6,
+                       lr=0.1, frequency_of_the_test=1, seed=0)
+    api = FedAvgRobustAPI(data, task, cfg, defense_type="dp",
+                          noise_multiplier=1.0, norm_bound=1.0)
+
+    def per_round_fallback(self, start, n):
+        for r in range(start, start + n):
+            self.run_round(r)
+        return {}
+
+    monkeypatch.setattr(fedavg_mod.FedAvgAPI, "run_rounds",
+                        per_round_fallback)
+    monkeypatch.setattr(fedavg_mod.FedAvgAPI, "run_round",
+                        lambda self, r: {})
+    api.run_rounds(0, 3)
+    want = DPAccountant().step(api._dp_q, api._dp_z, rounds=3)
+    np.testing.assert_allclose(api.accountant._rdp, want._rdp, rtol=1e-12)
+    assert api._dp_block_charged is False  # flag restored after the block
+
+
+def test_report_renders_privacy_and_secagg_columns():
+    import importlib.util
+    import pathlib
+    spec = importlib.util.spec_from_file_location(
+        "report", pathlib.Path(__file__).resolve().parents[1]
+        / "scripts" / "report.py")
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    new = [{"kind": "round", "round": 0, "clients": [1],
+            "metrics": {"update_norm": 1.0},
+            "privacy": {"eps": 1.25, "z": 1.0},
+            "secagg": {"outcome": "recovered", "dead": [2]}}]
+    old = [{"kind": "round", "round": 0, "clients": [1],
+            "metrics": {"update_norm": 1.0}}]
+    table = report.render_table(new)
+    assert "eps" in table and "1.25" in table and "recovered" in table
+    stale = report.render_table(old)
+    assert "eps" not in stale and "secagg" not in stale
+
+
+# --------------------------------------------------------- launcher matrix
+@pytest.mark.parametrize("flags", [
+    ["--shard_server_state", "1"],
+    ["--fused_agg", "1"],
+    ["--async_buffer_k", "2"],
+    ["--update_codec", "delta-int8"],
+    ["--sparsify_ratio", "0.1"],
+    ["--aggregator", "median"],
+    ["--byzantine_f", "1"],
+    ["--delta_broadcast", "1"],
+    ["--heartbeat_max_age_s", "5"],
+    ["--sum_assoc", "pairwise"],
+    ["--edges", "2"],
+    ["--adversary_plan", '{"seed": 1, "rules": []}'],
+])
+def test_launcher_turboaggregate_refusal_matrix(flags):
+    """Every unsupported composition refuses LOUDLY (the former
+    --shard_server_state warn-and-ignore included), on server and client
+    ranks alike — ranks share argv."""
+    import argparse
+
+    from fedml_tpu.experiments.distributed_launch import add_args, init_role
+
+    for rank in ("0", "1"):
+        args = add_args(argparse.ArgumentParser()).parse_args(
+            ["--rank", rank, "--world_size", "4",
+             "--algo", "turboaggregate", *flags])
+        with pytest.raises(ValueError, match="does not compose"):
+            init_role(args, None, None, None, {})
+
+
+def test_run_simulated_refuses_unwired_server_modes(lr_setup):
+    from fedml_tpu.distributed.turboaggregate import (
+        TAAggregator,
+        TASecureServerManager,
+    )
+
+    data, task = lr_setup
+    cfg = _cfg(per_round=3)
+    agg = TAAggregator(data, task, cfg, worker_num=3)
+    for kw in ({"async_buffer_k": 2}, {"delta_broadcast": True},
+               {"heartbeat_max_age_s": 5.0}):
+        with pytest.raises(ValueError):
+            TASecureServerManager(agg, rank=0, size=4, backend="LOOPBACK",
+                                  job_id="t-sa-refuse", **kw)
+
+
+def test_streamed_sources_refused(lr_setup):
+    from fedml_tpu.core.client_source import InMemorySource
+    from fedml_tpu.distributed.turboaggregate import (
+        SecureTrainer,
+        TAAggregator,
+    )
+
+    data, task = lr_setup
+    src = InMemorySource(data)
+    cfg = _cfg(per_round=3)
+    with pytest.raises(ValueError, match="cross-silo"):
+        TAAggregator(src, task, cfg, worker_num=3)
+    with pytest.raises(ValueError, match="cross-silo"):
+        SecureTrainer(1, src, task, cfg)

@@ -595,11 +595,14 @@ def test_shed_vocab_pinned_to_perf_instrument():
     fam = REGISTRY.snapshot().get("fed_async_shed_total", {})
     for reason in SHED_REASONS:
         assert f"reason={reason}" in fam, (reason, sorted(fam))
-    # the quarantine-ledger vocabulary, pinned alongside: the two
-    # ledger-only reasons (no in-graph code) every runtime's ledger may
-    # carry — 'undecodable' (PR-9 wire tiers) and 'edge_lost' (cross-tier
-    # elastic edge loss, docs/ROBUSTNESS.md §Cross-tier robust gating)
+    # the quarantine-ledger vocabulary, pinned alongside: the ledger-only
+    # reasons (no in-graph code) every runtime's ledger may carry —
+    # 'undecodable' (PR-9 wire tiers), 'edge_lost' (cross-tier elastic
+    # edge loss, docs/ROBUSTNESS.md §Cross-tier robust gating), and the
+    # masked-secure-aggregation pair 'secagg_dropout'/'secagg_shed'
+    # (§Secure aggregation dropout recovery / below-threshold shed)
     from fedml_tpu.core.robust_agg import REASONS
 
     assert REASONS == ("ok", "nonfinite", "norm_outlier", "suspected",
-                       "undecodable", "edge_lost")
+                       "undecodable", "edge_lost", "secagg_dropout",
+                       "secagg_shed")
