@@ -661,6 +661,72 @@ class ExceptSwallow(Rule):
 
 
 @register
+class FsyncDiscipline(Rule):
+    """Durability commit points route through the shared fsync helpers.
+
+    ``core/wal.py`` and ``core/checkpoint.py`` are the crash-recovery
+    substrate (docs/ROBUSTNESS.md §Server crash recovery): a bare
+    ``open(..., 'w')`` there writes through the page cache only, so the
+    "committed" round/WAL record a recovery later trusts can silently
+    not exist after power loss — crash-safe until the cache says
+    otherwise. Every write in those modules must go through the shared
+    helpers (``durable_open``/``durable_write``/``durable_replace`` in
+    core/wal.py) or live inside a ``durable_*``-named helper that owns
+    its own fsync ceremony (the WAL's append-handle constructor)."""
+
+    name = "fsync-discipline"
+    description = ("no bare open-for-write in core wal/checkpoint "
+                   "modules — route commit points through the shared "
+                   "durable_* fsync helpers")
+
+    _TARGETS = ("wal.py", "checkpoint.py")
+    _WRITE_MODES = ("w", "a", "x", "+")
+
+    def _scoped(self, module: Module) -> bool:
+        parts = module.parts
+        return parts[-1] in self._TARGETS and "core" in parts[:-1]
+
+    def _write_mode(self, call: ast.Call) -> bool:
+        mode = None
+        if len(call.args) >= 2:
+            mode = call.args[1]
+        for kw in call.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if mode is None:
+            return False  # bare open(path) reads — recovery's job
+        if not (isinstance(mode, ast.Constant)
+                and isinstance(mode.value, str)):
+            return True  # dynamic mode: assume the worst
+        return any(c in mode.value for c in self._WRITE_MODES)
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not self._scoped(module):
+            return
+        # map each open() call to its enclosing function name (if any)
+        enclosing: dict[int, str] = {}
+        for fn in ast.walk(module.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    enclosing.setdefault(id(sub), fn.name)
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "open"
+                    and self._write_mode(node)):
+                continue
+            fn_name = enclosing.get(id(node), "")
+            if fn_name.startswith("durable_") or \
+                    fn_name.startswith("_durable_"):
+                continue  # the shared helpers own their fsync ceremony
+            yield module.finding(self, node, (
+                "bare open-for-write at a WAL/checkpoint commit point — "
+                "route it through core/wal.py's durable_open/"
+                "durable_write (tmp -> fsync -> rename) so the record "
+                "survives the crash it exists to recover from"))
+
+
+@register
 class NoBarePrint(Rule):
     """Library code routes output through logging or the obs EventLog.
 

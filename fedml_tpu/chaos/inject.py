@@ -197,6 +197,13 @@ class ChaosCommManager:
                  fault, direction, src, dst, seq, round_idx)
 
     def _crashed(self, rank, round_idx) -> bool:
+        # rank 0 is exempt: a crash rule naming the server is a SUPERVISED
+        # RESTART (docs/ROBUSTNESS.md §Server crash recovery) executed by
+        # the supervision layer through the checkpoint + WAL recovery
+        # path — black-holing the coordinator's wire would model a
+        # permanent outage no protocol can survive, not a restart
+        if rank == 0:
+            return False
         return any(r.fault == "crash" and rank in (r.ranks or ())
                    and r.in_window(round_idx) for r in self.plan.rules)
 

@@ -59,7 +59,20 @@ class FaultRule:
     so the cap is deterministic under thread interleaving. ``delay_s``
     parameterizes delay/straggle; ``groups`` parameterizes partition
     (ranks in different groups cannot reach each other); ``ranks``
-    parameterizes crash (those ranks go dark for the window)."""
+    parameterizes crash (those ranks go dark for the window).
+
+    A crash rule naming RANK 0 is a **server crash** (docs/ROBUSTNESS.md
+    §Server crash recovery): the wire layer does not black-hole it — the
+    supervision layer executes it as a deterministic kill-and-restart
+    through the checkpoint + WAL recovery path (``run_simulated`` in
+    loopback; the real process dies under ``--supervise``).
+    ``after_uploads`` refines WHERE in the window's first round the
+    server dies: None = between commits (entering the round, before any
+    frame of it leaves); an integer m >= 0 = mid-round, once m uploads of
+    the round were accepted (their WAL records durable, their payloads
+    lost with the process); -1 = at the secure-aggregation reveal
+    fan-out (the masked tier's recovery state machine — the crash must
+    shed the round, never half-recover the fold)."""
 
     fault: str
     direction: str = "send"
@@ -71,6 +84,7 @@ class FaultRule:
     max_per_link: int | None = None
     groups: list[list[int]] | None = None
     ranks: list[int] | None = None
+    after_uploads: int | None = None
 
     def __post_init__(self):
         if self.fault not in FAULTS:
@@ -86,6 +100,20 @@ class FaultRule:
             raise ValueError("partition rule needs 'groups': [[...], [...]]")
         if self.fault == "crash" and not self.ranks:
             raise ValueError("crash rule needs 'ranks': [...]")
+        if self.after_uploads is not None and self.fault != "crash":
+            raise ValueError("after_uploads only parameterizes crash rules")
+        if self.after_uploads is not None and self.after_uploads < -1:
+            # -1 = the secagg reveal fan-out; anything below can never
+            # match a crash point and would be silently inert
+            raise ValueError(
+                f"after_uploads must be >= -1, got {self.after_uploads}")
+        if self.fault == "crash" and 0 in (self.ranks or ()) \
+                and self.rounds is None:
+            # a rank-0 crash is a supervised server restart: an unbounded
+            # window would re-kill the server the moment it recovered,
+            # forever — demand an explicit round
+            raise ValueError("a crash rule naming rank 0 (server restart) "
+                             "needs a 'rounds' window")
 
     def in_window(self, round_idx: int | None) -> bool:
         if self.rounds is None:
@@ -185,6 +213,20 @@ class FaultPlan:
         return _decide(self.seed, rule_idx, direction, src, dst,
                        seq) < rule.prob
 
+    def server_crash_points(self) -> list[tuple[int, int | None]]:
+        """The supervision schedule a rank-0 crash rule encodes (docs/
+        ROBUSTNESS.md §Server crash recovery): sorted ``(round,
+        after_uploads)`` points, one per rule, each consumed by exactly
+        one kill-and-restart. The wire injector ignores rank 0 in crash
+        rules — a dead server is a restart, not a black hole."""
+        return sorted(
+            ((int(r.rounds[0]), r.after_uploads)
+             for r in self.rules
+             if r.fault == "crash" and 0 in (r.ranks or ())),
+            # None (between commits) sorts before any mid-round point of
+            # the same round; mixing None and int must not TypeError
+            key=lambda p: (p[0], p[1] is not None, p[1] or 0))
+
     # --------------------------------------------------------- serialization
     @classmethod
     def from_json(cls, spec: str | dict[str, Any]) -> "FaultPlan":
@@ -210,7 +252,7 @@ class FaultPlan:
         def rule_doc(r: FaultRule) -> dict:
             doc = {"fault": r.fault, "direction": r.direction}
             for k in ("src", "dst", "rounds", "max_per_link", "groups",
-                      "ranks"):
+                      "ranks", "after_uploads"):
                 v = getattr(r, k)
                 if v is not None:
                     doc[k] = v

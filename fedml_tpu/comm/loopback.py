@@ -11,6 +11,7 @@ the gRPC backend uses — a loopback test is a serialization test.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
 
 from fedml_tpu.comm.base import BaseCommManager
@@ -23,19 +24,42 @@ _registry_lock = threading.Lock()
 class LoopbackCommManager(BaseCommManager):
     backend_name = "loopback"
 
+    # an uplink to an unregistered RANK 0 retries inside this window
+    # before failing — the loopback analogue of the gRPC backend's
+    # backoff on UNAVAILABLE (docs/ROBUSTNESS.md §Server crash recovery:
+    # a client must SURVIVE the server's restart outage, not die on the
+    # first refused frame; a supervised in-process restart re-registers
+    # rank 0 within milliseconds). Sends to any OTHER unregistered rank
+    # fail immediately — the server's elastic machinery owns dead
+    # clients, and a retry there would only stall teardown. Either way
+    # the failure is a ConnectionError — a transport error the elastic
+    # paths tolerate — never an opaque RuntimeError that kills the rank.
+    RETRY_WINDOW_S = 3.0
+    _RETRY_TICK_S = 0.02
+
     def __init__(self, job_id: str, rank: int, size: int):
         super().__init__()
         self.job_id, self.rank, self.size = job_id, rank, size
         with _registry_lock:
             _registry[job_id][rank] = self
 
+    def _peer(self, dest: int):
+        with _registry_lock:
+            return _registry[self.job_id].get(dest)
+
     def send_message(self, msg: Message) -> None:
         frame = self._encode(msg)  # force the real wire path (and count it)
         dest = int(msg.get_receiver_id())
-        with _registry_lock:
-            peer = _registry[self.job_id].get(dest)
+        peer = self._peer(dest)
+        if peer is None and dest == 0:
+            deadline = time.monotonic() + self.RETRY_WINDOW_S
+            while peer is None and time.monotonic() < deadline:
+                time.sleep(self._RETRY_TICK_S)
+                peer = self._peer(dest)
         if peer is None:
-            raise RuntimeError(f"loopback: rank {dest} not registered in job {self.job_id}")
+            raise ConnectionError(
+                f"loopback: rank {dest} not registered in job "
+                f"{self.job_id}")
         peer._receive_frame(frame)
 
     def stop_receive_message(self) -> None:

@@ -132,8 +132,19 @@ class DistributedManager(Observer):
                 else:
                     idle = None
             if idle is not None:  # callback outside the lock: a handler
-                self.on_timeout(idle)  # calling receive_message must not
-                # deadlock against its own watchdog
+                try:
+                    self.on_timeout(idle)  # calling receive_message must
+                    # not deadlock against its own watchdog
+                except BaseException as e:
+                    # a simulated server crash (detected by name so this
+                    # layer needs no distributed import, like
+                    # _is_transport_error's RpcError) legitimately kills
+                    # the watchdog: the manager's run() re-raises it to
+                    # the supervision driver — exit quietly instead of
+                    # spraying a thread traceback
+                    if type(e).__name__ == "SimulatedServerCrash":
+                        return
+                    raise
 
     def send_message(self, message: Message) -> None:
         self.com_manager.send_message(message)

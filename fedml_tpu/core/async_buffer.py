@@ -63,8 +63,12 @@ STALENESS_KINDS = ("constant", "polynomial", "exponential")
 # payload was structural garbage: quarantined at decode, requeued). Note
 # encoded uplinks also shed 'stale' when their versioned base was evicted
 # from the server's bounded broadcast stash.
+# 'server_restart' is the crash-recovery shed (docs/ROBUSTNESS.md §Server
+# crash recovery): work that was in flight when the server died — the
+# WAL-journaled buffer entries lost with the process, and post-restart
+# arrivals whose echoed restart_epoch predates the recovery.
 SHED_REASONS = ("stale", "overflow", "nonfinite", "crash", "suspect",
-                "undecodable")
+                "undecodable", "server_restart")
 
 
 # ------------------------------------------------------ staleness discounts
@@ -201,7 +205,7 @@ class AsyncBuffer:
     its round lock; the simulator is single-threaded.
     """
 
-    def __init__(self, k: int, capacity: int | None = None):
+    def __init__(self, k: int, capacity: int | None = None, journal=None):
         k = int(k)
         if k < 1:
             raise ValueError(f"async buffer k must be >= 1, got {k}")
@@ -210,6 +214,12 @@ class AsyncBuffer:
         if self.capacity < 1:
             raise ValueError(f"buffer capacity must be >= 1, "
                              f"got {self.capacity}")
+        # crash-recovery journal hook (docs/ROBUSTNESS.md §Server crash
+        # recovery): callable(event, entry) invoked on 'admit'/'shed' so
+        # the server's WAL records buffer membership — a restarted server
+        # ledgers exactly the entries that died with the process. None =
+        # the pre-WAL behavior, zero extra work.
+        self.journal = journal
         self._entries: list[BufferedUpdate] = []
 
     def __len__(self) -> int:
@@ -232,11 +242,15 @@ class AsyncBuffer:
         capacity (stalest first), possibly including the new entry itself
         when it is the stalest of the lot."""
         self._entries.append(entry)
+        if self.journal is not None:
+            self.journal("admit", entry)
         shed: list[BufferedUpdate] = []
         while len(self._entries) > self.capacity:
             victim = min(self._entries, key=lambda e: (e.version, e.seq))
             self._entries.remove(victim)
             shed.append(victim)
+            if self.journal is not None:
+                self.journal("shed", victim)
         return shed
 
     def drain(self) -> list[BufferedUpdate]:

@@ -12,6 +12,15 @@ from typing import Any
 import jax
 import numpy as np
 
+from fedml_tpu.core.wal import durable_open, durable_replace, durable_write
+
+
+class TornCheckpoint(Exception):
+    """A checkpoint file that cannot even be LOADED (truncated zip, short
+    read, crash mid-write) — distinct from a structure mismatch, which is
+    a configuration error and stays loud. ``restore_latest`` skips (and
+    counts) torn files; direct ``restore_round`` callers see the raise."""
+
 
 def _gather_leaf(v):
     """Gather-on-save for mesh-partitioned server state: a sharded leaf is
@@ -56,22 +65,25 @@ def save_round(ckpt_dir: str, round_idx: int, net, server_opt_state, rng,
         ckptr.wait_until_finished()
     except Exception:
         leaves, treedef = jax.tree.flatten(state)
-        # atomic: write under a tmp name that _completed_rounds ignores, then
-        # rename — a crash mid-save must not leave a loadable-looking file
+        # atomic + durable: write under a tmp name that _completed_rounds
+        # ignores, fsync, then rename (+ dir fsync) — a crash mid-save must
+        # not leave a loadable-looking file, and a crash right after the
+        # rename must not lose the rename (core/wal.py durability helpers;
+        # the fedlint fsync-discipline rule pins this path)
         tmp = path + ".npz.tmp"
         try:
-            with open(tmp, "wb") as f:
+            with durable_open(tmp, "wb") as f:
                 np.savez(f, treedef=str(treedef),
                          **{f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)})
-            os.replace(tmp, path + ".npz")
+            durable_replace(tmp, path + ".npz")
         finally:
             if os.path.exists(tmp):  # don't let an orphan eat a _prune slot
                 os.unlink(tmp)
     if history is not None:
         import json
 
-        with open(os.path.join(ckpt_dir, "history.json"), "w") as f:
-            json.dump(history, f)
+        durable_write(os.path.join(ckpt_dir, "history.json"),
+                      json.dumps(history).encode())
     _prune(ckpt_dir, keep)
     return path
 
@@ -168,14 +180,32 @@ def latest_round(ckpt_dir: str) -> int | None:
 
 def restore_round(ckpt_dir: str, round_idx: int, template: Any):
     """Restore a checkpoint into the same pytree structure as ``template``
-    (a dict with net/server_opt_state/rng/round built like in save_round)."""
+    (a dict with net/server_opt_state/rng/round built like in save_round).
+
+    Raises :class:`TornCheckpoint` when the file cannot be LOADED (a crash
+    mid-write left a truncated container) — structure/shape mismatches
+    against the template stay ValueError (a configuration error, never a
+    torn artifact)."""
     path = os.path.join(ckpt_dir, f"round_{round_idx:06d}")
     if os.path.isdir(path):
-        import orbax.checkpoint as ocp
+        try:
+            import orbax.checkpoint as ocp
 
-        ckptr = ocp.StandardCheckpointer()
-        return ckptr.restore(os.path.abspath(path), target=template)
-    npz = np.load(path + ".npz", allow_pickle=False)
+            ckptr = ocp.StandardCheckpointer()
+            return ckptr.restore(os.path.abspath(path), target=template)
+        except (OSError, EOFError) as e:
+            raise TornCheckpoint(f"unreadable checkpoint dir {path}: {e}")
+    try:
+        npz = np.load(path + ".npz", allow_pickle=False)
+    except (OSError, EOFError, ValueError) as e:
+        # zipfile.BadZipFile is an OSError subclass... no — it subclasses
+        # Exception; name-match it so this module needs no zipfile import
+        raise TornCheckpoint(f"unloadable checkpoint {path}.npz: {e}")
+    except Exception as e:  # noqa: BLE001 — np.load raises BadZipFile /
+        # zlib.error on truncation; anything else load-phase is torn too
+        if type(e).__name__ not in ("BadZipFile", "error"):
+            raise
+        raise TornCheckpoint(f"unloadable checkpoint {path}.npz: {e}")
     leaves, treedef = jax.tree.flatten(template)
     # the npz fallback maps leaves to the template purely by index, so a
     # template whose structure differs from the saved one (e.g. a dp run's
@@ -189,13 +219,41 @@ def restore_round(ckpt_dir: str, round_idx: int, template: Any):
             f"{n_saved} leaves / treedef {npz['treedef']}, template has "
             f"{len(leaves)} leaves / treedef {treedef} — was the run "
             "configuration (e.g. --defense_type) changed across resume?")
-    restored = [npz[f"leaf_{i}"] for i in range(len(leaves))]
+    try:
+        # members decompress lazily — a mid-file truncation that spared
+        # the zip directory still surfaces here, as torn, not as a crash
+        restored = [npz[f"leaf_{i}"] for i in range(len(leaves))]
+    except Exception as e:  # noqa: BLE001 — BadZipFile/zlib.error/EOFError
+        raise TornCheckpoint(f"truncated checkpoint member in {path}.npz: {e}")
     for i, (t, r) in enumerate(zip(leaves, restored)):
         if np.shape(t) != np.shape(r):
             raise ValueError(
                 f"checkpoint leaf {i} shape mismatch at {path}.npz: "
                 f"saved {np.shape(r)}, template {np.shape(t)}")
     return jax.tree.unflatten(treedef, restored)
+
+
+def restore_latest(ckpt_dir: str, template: Any):
+    """Restore the newest RESTORABLE checkpoint: a torn newest file (crash
+    mid-save that still published a name, or bit rot) is skipped — counted
+    on ``fed_ckpt_torn_total`` and warned — and recovery falls back to the
+    previous round instead of crashing the restart loop. Returns
+    ``(round_idx, state)`` or ``None`` when nothing is restorable."""
+    import logging
+
+    if not os.path.isdir(ckpt_dir):
+        return None
+    log = logging.getLogger("fedml_tpu.checkpoint")
+    for r in sorted(_completed_rounds(ckpt_dir), reverse=True):
+        try:
+            return r, restore_round(ckpt_dir, r, template)
+        except TornCheckpoint as e:
+            from fedml_tpu.obs import perf_instrument as _perf
+
+            _perf.record_ckpt_torn()
+            log.warning("skipping torn checkpoint round %d: %s "
+                        "(falling back to the previous round)", r, e)
+    return None
 
 
 def _prune(ckpt_dir: str, keep: int):

@@ -78,7 +78,7 @@ from fedml_tpu.utils.tree import tree_weighted_mean
 # reveal) and was shed + re-broadcast instead of wedging. Appended AFTER
 # the in-graph codes so 0..3 stay stable.
 REASONS = ("ok", "nonfinite", "norm_outlier", "suspected", "undecodable",
-           "edge_lost", "secagg_dropout", "secagg_shed")
+           "edge_lost", "secagg_dropout", "secagg_shed", "server_restart")
 REASON_OK, REASON_NONFINITE, REASON_NORM_OUTLIER, REASON_SUSPECTED = range(4)
 
 # sanitation default: reject ||update|| > 4x the weighted-median norm.
@@ -833,17 +833,26 @@ class QuarantineLedger:
     def __init__(self):
         self._entries: list[dict] = []
         self._lock = threading.Lock()
+        # crash-recovery journal hook (docs/ROBUSTNESS.md §Server crash
+        # recovery): callable(entry_dict) invoked per verdict so the
+        # server's WAL carries a forensic trail of mid-round quarantines;
+        # the ledger's commit-time authority stays quarantine.json. None =
+        # no journaling, zero extra work.
+        self.journal = None
 
     def record(self, round_idx: int, rank: int, reason: str,
                client=None) -> None:
         if reason not in REASONS or reason == "ok":
             raise ValueError(f"unrecordable quarantine reason {reason!r}")
+        entry = {
+            "round": int(round_idx), "rank": int(rank),
+            "reason": reason,
+            "client": None if client is None else int(client),
+        }
         with self._lock:
-            self._entries.append({
-                "round": int(round_idx), "rank": int(rank),
-                "reason": reason,
-                "client": None if client is None else int(client),
-            })
+            self._entries.append(entry)
+        if self.journal is not None:
+            self.journal(dict(entry))
 
     def record_codes(self, round_idx: int, reasons, clients=None,
                      ranks=None) -> None:
@@ -863,6 +872,30 @@ class QuarantineLedger:
             self.record(round_idx, rank, reason, client=client)
             _obs.record_update_rejected(reason)
             _obs.record_suspected_rank(rank)
+
+    def entries(self) -> list[dict]:
+        """Copy of the raw entries in record order — what the server
+        checkpoints alongside the model (quarantine.json) so a restarted
+        process reports the SAME ledger an uninterrupted run would
+        (docs/ROBUSTNESS.md §Server crash recovery)."""
+        with self._lock:
+            return [dict(e) for e in self._entries]
+
+    def restore(self, entries) -> None:
+        """Re-install checkpointed/WAL-replayed entries (crash recovery).
+        Routed through :meth:`record` so the reason vocabulary stays
+        validated; metric families are NOT re-fed — the restarted
+        process's counters track what IT observed, the ledger tracks the
+        run — and the journal hook is suppressed (restored entries are
+        already durable; re-journaling them would grow the WAL per
+        boot)."""
+        j, self.journal = self.journal, None
+        try:
+            for e in entries:
+                self.record(int(e["round"]), int(e["rank"]), e["reason"],
+                            client=e.get("client"))
+        finally:
+            self.journal = j
 
     def canonical(self) -> list[tuple]:
         with self._lock:

@@ -168,6 +168,12 @@ def run_simulated(
                 f"edges={edges} (hierarchical topology) does not compose "
                 f"with {bad} — run the flat topology for those modes "
                 "(tree aggregation is pairwise by construction)")
+        if chaos_plan is not None and chaos_plan.server_crash_points():
+            raise ValueError(
+                "chaos crash rules naming rank 0 (supervised server "
+                "restart — docs/ROBUSTNESS.md §Server crash recovery) are "
+                "wired for the flat topology; the edge tier has no "
+                "session-resume protocol yet")
         from fedml_tpu.distributed.fedavg.hierarchy import (
             run_simulated_hierarchical,
         )
@@ -187,26 +193,43 @@ def run_simulated(
     if chaos_plan is not None:  # None must not clobber an installed plan
         _chaos.install_plan(chaos_plan)
     try:
-        aggregator_ = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1,
-                                       aggregator=aggregator,
-                                       aggregator_params=aggregator_params,
-                                       sanitize=sanitize,
-                                       shard_server_state=shard_server_state,
-                                       partition_rules=partition_rules,
-                                       sum_assoc=sum_assoc,
-                                       fused_agg=fused_agg)
-        server = FedAvgServerManager(aggregator_, rank=0, size=size,
-                                     backend=backend, ckpt_dir=ckpt_dir,
-                                     round_timeout_s=round_timeout_s,
-                                     telemetry=telemetry,
-                                     async_buffer_k=async_buffer_k,
-                                     staleness=staleness,
-                                     staleness_bound=staleness_bound,
-                                     buffer_deadline_s=buffer_deadline_s,
-                                     buffer_capacity=buffer_capacity,
-                                     heartbeat_max_age_s=heartbeat_max_age_s,
-                                     delta_broadcast=delta_broadcast,
-                                     **kw)
+        # chaos crash rules naming RANK 0 are server restarts (docs/
+        # ROBUSTNESS.md §Server crash recovery): this driver executes
+        # them deterministically — kill the manager at the scheduled
+        # point (SimulatedServerCrash, a SIGKILL analogue: no farewell
+        # frames, no graceful saves) and boot a FRESH manager through
+        # the real checkpoint + WAL recovery path.
+        active = _chaos.active_plan()
+        crash_points = (active.server_crash_points()
+                        if active is not None else [])
+        if crash_points and ckpt_dir is None:
+            raise ValueError(
+                "a chaos crash rule naming rank 0 (server restart) needs "
+                "ckpt_dir= — recovery replays checkpoint + WAL")
+
+        def build_server():
+            agg = FedAvgAggregator(dataset, task, cfg, worker_num=size - 1,
+                                   aggregator=aggregator,
+                                   aggregator_params=aggregator_params,
+                                   sanitize=sanitize,
+                                   shard_server_state=shard_server_state,
+                                   partition_rules=partition_rules,
+                                   sum_assoc=sum_assoc,
+                                   fused_agg=fused_agg)
+            return FedAvgServerManager(agg, rank=0, size=size,
+                                       backend=backend, ckpt_dir=ckpt_dir,
+                                       round_timeout_s=round_timeout_s,
+                                       telemetry=telemetry,
+                                       async_buffer_k=async_buffer_k,
+                                       staleness=staleness,
+                                       staleness_bound=staleness_bound,
+                                       buffer_deadline_s=buffer_deadline_s,
+                                       buffer_capacity=buffer_capacity,
+                                       heartbeat_max_age_s=heartbeat_max_age_s,
+                                       delta_broadcast=delta_broadcast,
+                                       **kw)
+
+        server = build_server()
         clients = [
             init_client(dataset, task, cfg, rank, size, backend,
                         sparsify_ratio=sparsify_ratio,
@@ -221,8 +244,86 @@ def run_simulated(
             enable_compile_cache()
             # one rank compiles, every sibling deserializes from disk
             clients[0].warmup()
-        launch_simulated(server, clients)
+        if not crash_points:
+            launch_simulated(server, clients)
+            aggregator_ = server.aggregator
+        else:
+            server = run_supervised_simulated(server, clients,
+                                              crash_points, build_server)
+            aggregator_ = server.aggregator
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)
     return aggregator_
+
+
+def run_supervised_simulated(server, clients, crash_points, build_server,
+                             join_timeout: float = 60.0):
+    """Loopback supervision loop (docs/ROBUSTNESS.md §Server crash
+    recovery): run the server until a scheduled SimulatedServerCrash
+    fires, abandon the dead manager's transport WITHOUT any farewell
+    frame (clients observe exactly the silence a dead process leaves),
+    and boot a fresh manager — fresh aggregator, fresh memory — that
+    recovers through checkpoint + WAL. Each crash point is consumed by
+    one kill; the recovered server does not re-crash on it. Clients run
+    once, spanning every server generation (they survive the outage and
+    answer the resume probe — session resumption)."""
+    import logging
+    import threading
+
+    from fedml_tpu.distributed.fedavg.server_manager import (
+        SimulatedServerCrash,
+    )
+
+    log = logging.getLogger("fedml_tpu.distributed.fedavg")
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    remaining = list(crash_points)
+    while True:
+        server._crash_plan = list(remaining)
+        try:
+            server.run()
+        except SimulatedServerCrash as e:
+            remaining = remaining[1:]
+            log.warning("supervisor: %s — abandoning the dead manager and "
+                        "restarting through recovery (%d scheduled "
+                        "crash(es) left)", e, len(remaining))
+            abandon_simulated_server(server)
+            server = build_server()
+            continue
+        if remaining:
+            # the campaign finished with scheduled kills never fired
+            # (e.g. an elastic round accepted fewer uploads than the
+            # after_uploads threshold) — say so loudly, or a soak trial
+            # 'passes' a recovery path that was never exercised
+            log.warning("supervisor: run completed with %d scheduled "
+                        "crash point(s) never fired: %s — the recovery "
+                        "path was NOT exercised", len(remaining),
+                        remaining)
+        break
+    for t in threads:
+        t.join(timeout=join_timeout)
+    return server
+
+
+def abandon_simulated_server(server) -> None:
+    """SIGKILL analogue for an in-process server manager: free its
+    transport registration so the next generation can bind rank 0, close
+    its journal handle (post-mortem appends become no-ops), and flag it
+    finished so its timers/watchdog exit. Crucially sends NOTHING — a
+    dead process says no goodbyes."""
+    import logging
+
+    server._finished.set()
+    try:
+        cm = server.com_manager
+        inner = getattr(cm, "inner", cm)  # unwrap a chaos proxy
+        inner.stop_receive_message()
+    except Exception:  # noqa: BLE001 — teardown of a "dead" manager must
+        # not kill the supervisor; the next boot re-binds rank 0 anyway
+        logging.getLogger("fedml_tpu.distributed.fedavg").warning(
+            "supervisor: abandoning dead server transport failed",
+            exc_info=True)
+    if getattr(server, "wal", None) is not None:
+        server.wal.close()

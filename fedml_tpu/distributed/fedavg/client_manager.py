@@ -102,6 +102,14 @@ class FedAvgClientManager(ClientManager):
         # broadcast (MSG_ARG_KEY_DELTA_PARAMS) reconstructs from
         self._held = None
         self._held_version: int | None = None
+        # server session state (docs/ROBUSTNESS.md §Server crash recovery):
+        # the restart epoch of the newest s2c frame, echoed on every
+        # upload so a restarted server can shed this client's pre-crash
+        # in-flight work exactly once; the last async dispatch wave seen,
+        # answered on the post-restart s2c_resume probe. Epoch 0 = no
+        # crash yet — nothing is echoed and the wire is unchanged.
+        self._restart_epoch = 0
+        self._last_wave: int | None = None
         self._trace_buf: ClientSpanBuffer | None = None  # lazy: see module doc
         super().__init__(rank, size, backend, **kw)
 
@@ -115,10 +123,36 @@ class FedAvgClientManager(ClientManager):
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_FINISH, lambda _m: self.finish()
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_RESUME_PROBE,
+            self.handle_message_resume_probe,
+        )
 
     def handle_message_init(self, msg_params):
         self.round_idx = 0
         self._sync_and_train(msg_params)
+
+    def handle_message_resume_probe(self, msg_params):
+        """Post-restart server probe (docs/ROBUSTNESS.md §Server crash
+        recovery): adopt the new restart epoch — every later upload echoes
+        it, which is what lets the server shed this client's pre-crash
+        in-flight work — and answer with the last round (and async
+        dispatch wave) this client saw, so the server re-dispatches or
+        sheds deterministically. Handlers run serially: if this client
+        was mid-fit when the server died, the probe is answered right
+        after that fit's (now epoch-stale) upload is queued."""
+        self._restart_epoch = int(msg_params.get(
+            MyMessage.MSG_ARG_KEY_RESTART_EPOCH, self._restart_epoch))
+        msg = Message(MyMessage.MSG_TYPE_C2S_RESUME_ACK, self.rank,
+                      self.server_rank)
+        msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_ROUND,
+                       int(self.round_idx))
+        msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_WAVE,
+                       -1 if self._last_wave is None
+                       else int(self._last_wave))
+        msg.add_params(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                       self._restart_epoch)
+        self.send_message(msg)
 
     def handle_message_receive_model(self, msg_params):
         self.round_idx += 1  # fallback when the server omits the round tag
@@ -128,6 +162,11 @@ class FedAvgClientManager(ClientManager):
         # trust the server's round counter (keeps stragglers aligned after an
         # elastic partial aggregation skipped them)
         self.round_idx = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx))
+        # adopt the server's restart epoch from any s2c frame carrying one
+        # (a post-crash broadcast can arrive before the resume probe)
+        ep = msg_params.get(MyMessage.MSG_ARG_KEY_RESTART_EPOCH)
+        if ep is not None:
+            self._restart_epoch = int(ep)
         buf = None
         blob = msg_params.get(TRACE_KEY)
         if isinstance(blob, dict) and blob.get("tid"):  # server is tracing
@@ -147,6 +186,8 @@ class FedAvgClientManager(ClientManager):
         # synchronous rounds: round_idx keys the fit, nothing is echoed,
         # and the wire is unchanged.
         wave = msg_params.get(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE)
+        if wave is not None:
+            self._last_wave = int(wave)  # answered on a resume probe
         if MyMessage.MSG_ARG_KEY_DELTA_PARAMS in msg_params:
             # round-delta broadcast (docs/ROBUSTNESS.md §Delta broadcast):
             # reconstruct global@r = held@base + delta. The server only
@@ -218,6 +259,11 @@ class FedAvgClientManager(ClientManager):
                 msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, wire_leaves)
             msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if self._restart_epoch:
+                # echo the session tag: a restarted server's epoch gate
+                # sheds pre-crash uploads by exactly this mismatch
+                msg.add_params(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                               self._restart_epoch)
             if wave is not None:  # echo the async work-unit key verbatim
                 msg.add_params(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE, int(wave))
                 # ... and the client id, so the server's ingest path never

@@ -106,6 +106,23 @@ class MyMessage:
     MSG_TYPE_C2S_REVEAL_SHARES = "c2s_reveal"
     MSG_ARG_KEY_SECAGG_DEAD = "secagg_dead"
     MSG_ARG_KEY_SECAGG_PAIR_SEEDS = "secagg_pair_seeds"
+    # server crash recovery (docs/ROBUSTNESS.md §Server crash recovery):
+    # after a restart every s2c frame carries the server's RESTART_EPOCH
+    # (absent on epoch-0 runs — the wire is unchanged until a crash
+    # actually happens; stock peers ignore it) and clients echo it on
+    # every upload, so the epoch gate sheds pre-crash in-flight work
+    # exactly once (counted ``server_restart``) instead of double-folding
+    # it into the re-dispatched round. A server that recovers a WAL with
+    # an OPEN (uncommitted) round first sends each rank one s2c_resume
+    # probe; the client answers c2s_resume with the LAST round (and async
+    # dispatch wave) it saw, letting the server deterministically decide
+    # per rank between re-dispatch and shed before re-broadcasting the
+    # open round under the new epoch.
+    MSG_TYPE_S2C_RESUME_PROBE = "s2c_resume"
+    MSG_TYPE_C2S_RESUME_ACK = "c2s_resume"
+    MSG_ARG_KEY_RESTART_EPOCH = "restart_epoch"
+    MSG_ARG_KEY_LAST_SEEN_ROUND = "last_seen_round"
+    MSG_ARG_KEY_LAST_SEEN_WAVE = "last_seen_wave"
     # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
     # MODEL_PARAMS and BASE_VERSION names the global version the delta was
     # computed against — the client must hold exactly that version (the

@@ -45,10 +45,27 @@ from fedml_tpu.obs.tracing import TRACE_KEY
 log = logging.getLogger("fedml_tpu.distributed.fedavg")
 
 
+class SimulatedServerCrash(BaseException):
+    """Deterministic SIGKILL analogue for loopback supervision (chaos
+    ``crash`` rules naming rank 0 — docs/ROBUSTNESS.md §Server crash
+    recovery): raised at a journaled crash point and deliberately a
+    BaseException so no elastic/chaos ``except Exception`` swallows it.
+    Only the supervision driver (``run_simulated``) catches it: the dead
+    manager's transport is abandoned without any farewell frame and a
+    FRESH manager boots through the real checkpoint + WAL recovery
+    path."""
+
+    def __init__(self, round_idx: int, point: str):
+        super().__init__(f"simulated server crash at round {round_idx} "
+                         f"({point})")
+        self.round_idx, self.point = round_idx, point
+
+
 class FedAvgServerManager(ServerManager):
     def __init__(self, aggregator: FedAvgAggregator, rank=0, size=0,
                  backend="LOOPBACK", round_timeout_s: float | None = None,
                  ckpt_dir: str | None = None, telemetry=None,
+                 wal_dir: str | None = None,
                  async_buffer_k: int | None = None,
                  staleness="constant", staleness_bound: int | None = None,
                  buffer_deadline_s: float | None = None,
@@ -207,7 +224,50 @@ class FedAvgServerManager(ServerManager):
                                  dataset_source=dataset_source(
                                      aggregator.dataset),
                                  tracing=self._dtracer is not None)
-        if ckpt_dir is not None:
+        # ---- server crash recovery (docs/ROBUSTNESS.md §Server crash
+        # recovery): a ckpt_dir implies the durable round WAL next to it
+        # (override with wal_dir). Boot order matters: replay FIRST (the
+        # restart epoch and the open-round evidence), then open the log
+        # for append and journal this boot, then restore state.
+        self.wal = None
+        self._wal_replay = None
+        self._restart_epoch = 0
+        self._resume_round: int | None = None
+        self._resume_pending: set[int] = set()
+        self._resume_acks: dict[int, tuple[int, int]] = {}
+        self._crash_plan: list[tuple[int, int | None]] = []
+        self._sim_crash: SimulatedServerCrash | None = None
+        self._uploads_this_round = 0
+        if wal_dir is None and ckpt_dir is not None:
+            wal_dir = os.path.join(ckpt_dir, "wal")
+        if wal_dir is not None:
+            from fedml_tpu.core.wal import RoundWAL
+            from fedml_tpu.obs import perf_instrument as _perf
+
+            self._wal_replay = RoundWAL.replay(wal_dir)
+            self._restart_epoch = self._wal_replay.restart_epochs
+            self.wal = RoundWAL(wal_dir)
+            self.wal.append("restart", sync=True,
+                            epoch=self._restart_epoch)
+            _perf.ensure_restart_families()
+            _perf.sync_server_restarts(self._restart_epoch)
+            # the aggregator journals what the WAL must witness: DP
+            # pre-charges (fsync'd BEFORE noise is drawn — ε can never be
+            # under-reported) and quarantine verdicts (forensic trail; the
+            # ledger's commit-time authority is quarantine.json)
+            self.aggregator.wal = self.wal
+            if hasattr(self.aggregator, "quarantine"):
+                self.aggregator.quarantine.journal = (
+                    lambda e: self.wal.append("quarantine", **e))
+            if self._buffer is not None:
+                # async buffer membership rides the WAL: recovery ledgers
+                # exactly the admitted-and-unflushed entries that died
+                # with the process
+                self._buffer.journal = self._journal_buffer
+            if self._restart_epoch:
+                log.warning("server restart epoch %d (WAL at %s): "
+                            "recovering", self._restart_epoch, wal_dir)
+        if ckpt_dir is not None or self._wal_replay is not None:
             self._maybe_resume()
         self._round_lock = threading.Lock()
         self._validate_world_size(size)
@@ -312,47 +372,133 @@ class FedAvgServerManager(ServerManager):
         return st
 
     def _maybe_resume(self):
-        from fedml_tpu.core.checkpoint import latest_round, restore_round
+        import time as _time
 
-        r = latest_round(self.ckpt_dir)
-        if r is None:
-            return
+        t0 = _time.monotonic()
         import numpy as np
 
-        template = dict(self._ckpt_state_template(), round=np.asarray(0, np.int64))
-        state = restore_round(self.ckpt_dir, r, template)
-        # sharded server plane: checkpoints gather on save (shard-agnostic
-        # layout; the npz fallback restores plain host arrays) — re-partition
-        # per the rule table so the device-resident-sharded invariant
-        # survives resume, mirroring the standalone engine's load_state,
-        # and refresh the per-device sizing gauge
-        part = getattr(self.aggregator, "_partitioner", None)
-        self.aggregator.net = (part.shard(state["net"]) if part is not None
-                               else state["net"])
-        if hasattr(self.aggregator, "_server_opt_state"):
-            opt = state["server_opt_state"]
-            self.aggregator._server_opt_state = (
-                part.shard(opt) if part is not None else opt)
-        if part is not None:
-            self.aggregator._record_server_state_bytes(
-                getattr(self.aggregator, "_server_opt_state", ()))
-        if hasattr(self.aggregator, "_noise_rng"):
-            self.aggregator._noise_rng = state["rng"]
-        if "dp_rdp" in state and getattr(self.aggregator, "accountant",
-                                         None) is not None:
-            import numpy as np
+        from fedml_tpu.core.checkpoint import restore_latest
 
-            self.aggregator.accountant._rdp = np.asarray(state["dp_rdp"])
-        self.round_idx = int(state["round"]) + 1
-        # reload persisted eval history so post-resume saves don't rewrite
-        # history.json with only the post-restart records
-        hist_path = os.path.join(self.ckpt_dir, "history.json")
-        if os.path.exists(hist_path):
+        committed = -1
+        if self.ckpt_dir is not None:
+            template = dict(self._ckpt_state_template(),
+                            round=np.asarray(0, np.int64))
+            # the newest RESTORABLE checkpoint is the commit authority: a
+            # torn newest file (crash mid-save) is skipped + counted and
+            # recovery falls back to the previous round
+            hit = restore_latest(self.ckpt_dir, template)
+            if hit is not None:
+                committed, state = hit
+                # sharded server plane: checkpoints gather on save (shard-
+                # agnostic layout; the npz fallback restores plain host
+                # arrays) — re-partition per the rule table so the device-
+                # resident-sharded invariant survives resume, mirroring
+                # the standalone engine's load_state, and refresh the
+                # per-device sizing gauge
+                part = getattr(self.aggregator, "_partitioner", None)
+                self.aggregator.net = (part.shard(state["net"])
+                                       if part is not None else state["net"])
+                if hasattr(self.aggregator, "_server_opt_state"):
+                    opt = state["server_opt_state"]
+                    self.aggregator._server_opt_state = (
+                        part.shard(opt) if part is not None else opt)
+                if part is not None:
+                    self.aggregator._record_server_state_bytes(
+                        getattr(self.aggregator, "_server_opt_state", ()))
+                if hasattr(self.aggregator, "_noise_rng"):
+                    self.aggregator._noise_rng = state["rng"]
+                if "dp_rdp" in state and getattr(self.aggregator,
+                                                 "accountant",
+                                                 None) is not None:
+                    self.aggregator.accountant._rdp = np.asarray(
+                        state["dp_rdp"])
+            # reload persisted eval history + quarantine ledger so a
+            # restarted process reports the SAME artifacts an
+            # uninterrupted run would (post-resume saves must not rewrite
+            # them with only the post-restart records)
             import json
 
-            with open(hist_path) as f:
-                self.aggregator.history = json.load(f)
-        log.info("resumed from checkpoint: next round %d", self.round_idx)
+            hist_path = os.path.join(self.ckpt_dir, "history.json")
+            if os.path.exists(hist_path):
+                with open(hist_path) as f:
+                    self.aggregator.history = json.load(f)
+            quar_path = os.path.join(self.ckpt_dir, "quarantine.json")
+            if os.path.exists(quar_path) and \
+                    hasattr(self.aggregator, "quarantine"):
+                with open(quar_path) as f:
+                    self.aggregator.quarantine.restore(json.load(f))
+        replay = self._wal_replay
+        if committed < 0 and (replay is None or not replay.records):
+            return  # genuinely fresh start
+        self.round_idx = committed + 1
+        self._recover_in_flight(committed, replay)
+        if self.wal is not None:
+            from fedml_tpu.obs import perf_instrument as _perf
+
+            _perf.record_recovery_seconds(_time.monotonic() - t0)
+        log.info("resumed from checkpoint+WAL: committed round %d, next "
+                 "round %d%s (restart epoch %d)", committed, self.round_idx,
+                 " [open round re-runs]" if self._resume_round is not None
+                 else "", self._restart_epoch)
+
+    def _recover_in_flight(self, committed: int, replay) -> None:
+        """WAL half of recovery: reconstruct what the crash interrupted.
+
+        - an OPEN round (anything journaled past the last commit) re-runs
+          as ``self.round_idx`` behind a resume probe, and every upload
+          the dead server had ACCEPTED (sync ``upload`` / async buffer
+          ``admit`` records — the payloads died with the process) is
+          ledgered ``server_restart``, slot-exact;
+        - DP pre-charges past the committed round re-charge the
+          accountant (the noise MAY have been released pre-crash; ε must
+          never read lower than the charges incurred — the conservative
+          direction);
+        - async dispatch-wave counters resume past their journaled
+          maxima, keeping the per-rank sampling chain monotonic.
+
+        Subclasses extend (the masked secure tier sheds a half-revealed
+        round as ``secagg_shed`` — docs/ROBUSTNESS.md §Secure
+        aggregation)."""
+        if replay is None:
+            return
+        acct = getattr(self.aggregator, "accountant", None)
+        if acct is not None:
+            for rec in replay.of_kind("precharge"):
+                if int(rec.get("round", -1)) > committed:
+                    acct.step(float(rec["q"]), float(rec["z"]))
+                    log.warning("recovery: re-charged DP accountant for "
+                                "the pre-crash charge of round %d "
+                                "(q=%.6f, z=%.3f)", rec["round"],
+                                rec["q"], rec["z"])
+        if self._async:
+            for rank, w in replay.dispatch_waves().items():
+                self._dispatch_wave[rank] = w + 1
+        in_flight = replay.since_last_commit(
+            ("broadcast", "dispatch", "upload", "admit"))
+        if not in_flight or self.round_idx >= self.round_num:
+            return
+        self._resume_round = self.round_idx
+        lost = replay.since_last_commit(("upload", "admit"))
+        # an admit whose entry was overflow-SHED pre-crash held no
+        # foldable work at death (and was already counted overflow by the
+        # live server) — it must not be re-ledgered server_restart
+        shed_keys = {(int(r.get("rank", -1)), int(r.get("wave", -1)))
+                     for r in replay.since_last_commit("shed")}
+        lost = [rec for rec in lost
+                if rec.get("kind") != "admit"
+                or (int(rec["rank"]),
+                    int(rec.get("wave", -1))) not in shed_keys]
+        for rec in lost:
+            self.aggregator.quarantine.record(
+                int(rec.get("round", self.round_idx)), int(rec["rank"]),
+                "server_restart", client=rec.get("client"))
+            _obs.record_update_rejected("server_restart")
+            if self._async:
+                self._record_shed("server_restart")
+        log.warning("recovery: round %d was in flight at the crash — "
+                    "%d accepted upload(s) lost with the process "
+                    "(ledgered server_restart); re-dispatching behind a "
+                    "resume probe", self.round_idx, len(lost))
 
     def _maybe_save(self):
         if self.ckpt_dir is None:
@@ -366,6 +512,22 @@ class FedAvgServerManager(ServerManager):
                    st["server_opt_state"], st["rng"],
                    history=self.aggregator.history,
                    extra_state=extra or None)
+        # the quarantine ledger rides the commit (atomic + fsync'd): a
+        # restarted process must report the same ledger an uninterrupted
+        # run would — the WAL's quarantine records are forensic only
+        if hasattr(self.aggregator, "quarantine"):
+            import json
+
+            from fedml_tpu.core.wal import durable_write
+
+            durable_write(os.path.join(self.ckpt_dir, "quarantine.json"),
+                          json.dumps(
+                              self.aggregator.quarantine.entries()).encode())
+        if self.wal is not None:
+            # commit AFTER the checkpoint rename: the checkpoint is the
+            # state authority; the record witnesses it and resets the
+            # WAL's in-flight (since_last_commit) window
+            self.wal.commit(self.round_idx)
 
     def _broadcast_finish(self):
         # final best-effort delivery to EVERY rank, including ones the
@@ -394,14 +556,33 @@ class FedAvgServerManager(ServerManager):
         if self.round_idx >= self.round_num:  # resumed past the last round
             self._broadcast_finish()
             return
-        self.send_init_msg()
+        if self._resume_round is not None:
+            # recovery found an open round: probe before re-dispatching so
+            # the fleet's in-flight pre-crash work is accounted, then the
+            # ack quorum (or the backstop) re-broadcasts under this epoch
+            with self._round_lock:
+                self._send_resume_probes()
+        else:
+            self.send_init_msg()
         super().run()
+        if self._sim_crash is not None:
+            # a crash point fired on a non-dispatch thread (watchdog /
+            # timer) and stopped the loop: surface it to the supervision
+            # driver from the thread that owns run()
+            raise self._sim_crash
 
     def _broadcast_model(self, msg_type: str, global_params) -> None:
         """Sample this round's clients and broadcast ``global_params`` to
         every rank under ``msg_type`` — the shared body of send_init_msg
         and the round-advance sync (they must not diverge). Starts the
         round's trace and rides its context on each frame when tracing."""
+        self._maybe_crash("broadcast")
+        if self.wal is not None:
+            # journal the round opening BEFORE any frame leaves: recovery
+            # must know round r was in flight even if the crash lands
+            # mid-broadcast
+            self.wal.append("broadcast", sync=True, round=self.round_idx)
+        self._uploads_this_round = 0
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         self._round_ids = [int(c) for c in client_indexes]
         # stamp the aggregator's accepted round BEFORE any client can
@@ -481,11 +662,22 @@ class FedAvgServerManager(ServerManager):
                     msg.mark_lossless(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(client_indexes[rank - 1]))
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            if self._restart_epoch:
+                # post-restart session tag, echoed on every upload so the
+                # epoch gate sheds pre-crash in-flight work exactly once;
+                # absent at epoch 0 — the wire is unchanged until a crash
+                # actually happened
+                msg.add_params(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                               self._restart_epoch)
             if tr is not None:  # trace context rides the header scalars
                 msg.add_params(TRACE_KEY, tr.broadcast_ctx(rank))
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
+        # after_uploads=0: mid-round with the broadcast OUT but zero
+        # uploads accepted — distinct from None (between commits, before
+        # any frame of the round leaves)
+        self._maybe_crash("post_broadcast")
 
     # ------------------------------------------- versioned broadcast stash
     # Retain enough versions to cover any admissible async staleness, with
@@ -694,11 +886,21 @@ class FedAvgServerManager(ServerManager):
             self._stash_version(self.round_idx,
                                 codec_roundtrip(self._bcast_pack))
         cid = int(self.aggregator.client_sampling(wave)[rank - 1])
+        if self.wal is not None:
+            # journaled (fsync'd) so a restarted server resumes every
+            # rank's wave counter PAST this dispatch — the sampling chain
+            # stays monotonic across restarts and recovery knows work was
+            # in flight
+            self.wal.append("dispatch", sync=True, round=self.round_idx,
+                            rank=rank, wave=wave, client=cid)
         msg = Message(msg_type or MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                       self.rank, rank)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self._bcast_pack)
         msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, cid)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+        if self._restart_epoch:
+            msg.add_params(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                           self._restart_epoch)
         # the wave rides the dispatch and comes back on the upload: it is
         # the work-unit key (sampling + the client's rng/batch fold), and
         # reconstructing it server-side from the counter would misattribute
@@ -901,6 +1103,12 @@ class FedAvgServerManager(ServerManager):
         self._maybe_save()
         self.round_idx += 1
         self._bcast_pack = None  # repack lazily at the next dispatch
+        # crash points in async terms: a flush IS the commit boundary —
+        # 'between commits' fires here (the new round exists, nothing of
+        # it dispatched), and the per-round upload counter resets so
+        # 'after_uploads' counts THIS round's admissions
+        self._uploads_this_round = 0
+        self._maybe_crash("broadcast")
         if self.round_idx >= self.round_num:
             self._finish_async()
             return
@@ -908,6 +1116,9 @@ class FedAvgServerManager(ServerManager):
         for rank in parked:
             self._dispatch_one(rank)
         self._async_reprobe()
+        # after_uploads=0 in async terms: the new round's dispatches are
+        # out, nothing admitted yet
+        self._maybe_crash("post_broadcast")
 
     def _finish_async(self) -> None:
         """Broadcast FINISH, then DRAIN instead of tearing down: the
@@ -943,6 +1154,153 @@ class FedAvgServerManager(ServerManager):
 
         _perf.record_async_shed(reason)
         self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+
+    def _journal_buffer(self, event: str, e) -> None:
+        """AsyncBuffer journal hook: buffer membership rides the WAL so
+        recovery ledgers exactly the admitted-and-unflushed entries that
+        died with the process. Admits are fsync'd (the lost-slot ledger
+        is a correctness artifact); overflow sheds are forensic."""
+        if self.wal is None:
+            return
+        extra = {} if event == "admit" else {"reason": "overflow"}
+        self.wal.append("admit" if event == "admit" else "shed",
+                        sync=event == "admit", round=int(e.version),
+                        rank=int(e.rank), client=int(e.client),
+                        wave=int(e.wave), nsamp=float(e.nsamp), **extra)
+        if event == "admit":
+            self._uploads_this_round += 1
+            self._maybe_crash("upload")
+
+    # ------------------------------------------------ crash points (chaos)
+    def _maybe_crash(self, point: str) -> None:
+        """Deterministic simulated-crash hook (loopback supervision,
+        docs/ROBUSTNESS.md §Server crash recovery): ``_crash_plan`` holds
+        ``(round, after_uploads)`` points derived from chaos ``crash``
+        rules naming rank 0 — ``after_uploads=None`` dies BETWEEN COMMITS
+        (entering the round, before any frame of it leaves), an integer
+        dies MID-ROUND once that many uploads of the round were accepted
+        (``0`` = broadcast out, nothing accepted yet)
+        (their WAL records already fsync'd, their payloads about to die
+        with the process). Only the head of the plan is consulted; the
+        supervision driver pops it per boot, so a recovered server does
+        not re-crash on the same point."""
+        if not self._crash_plan:
+            return
+        rnd, after = self._crash_plan[0]
+        why = None
+        if point == "broadcast" and after is None \
+                and self.round_idx == int(rnd):
+            why = "between commits"
+        elif point == "post_broadcast" and after is not None \
+                and int(after) == 0 and self.round_idx == int(rnd):
+            # m=0 must fire with the broadcast out and ZERO uploads
+            # journaled — the upload hook can't express it (it only runs
+            # after an accept)
+            why = "mid-round after 0 uploads"
+        elif point == "reveal" and after is not None and int(after) == -1 \
+                and self.round_idx == int(rnd):
+            # after_uploads = -1: die at the secagg reveal fan-out — the
+            # recovery state machine's most dangerous window (the fold
+            # must shed, never half-recover)
+            why = "mid-reveal"
+        elif point == "upload" and after is not None and int(after) >= 1 \
+                and self.round_idx == int(rnd) \
+                and self._uploads_this_round >= int(after):
+            why = f"mid-round after {self._uploads_this_round} uploads"
+        if why is None:
+            return
+        exc = SimulatedServerCrash(self.round_idx, why)
+        # crash points can fire on the WATCHDOG thread (elastic timeouts,
+        # the secagg reveal path) where a bare raise would kill only that
+        # thread: flag the crash and stop the dispatch loop WITHOUT any
+        # farewell frame (the loopback deregistration IS process death),
+        # then raise — run() re-raises the flag to the supervision driver
+        # whichever thread died first
+        self._sim_crash = exc
+        try:
+            inner = getattr(self.com_manager, "inner", self.com_manager)
+            inner.stop_receive_message()
+        except Exception:  # noqa: BLE001 — dying is the whole point
+            log.debug("simulated crash: transport teardown failed",
+                      exc_info=True)
+        raise exc
+
+    # --------------------------------------------------- session resumption
+    def _send_resume_probes(self) -> None:
+        """Post-restart probe fan-out (docs/ROBUSTNESS.md §Server crash
+        recovery): recovery found an OPEN round, so clients may hold
+        in-flight pre-crash work. Each rank gets one s2c_resume frame
+        carrying the new restart epoch; its c2s_resume answer (last-seen
+        round + async wave) tells the server who is alive and what they
+        hold before the open round is re-dispatched. A backstop timer
+        proceeds without the silent ranks (they re-enter through the
+        elastic undeliverable/reprobe machinery)."""
+        self._resume_pending = set(range(1, self.size))
+        log.info("resume probe: round %d re-runs under restart epoch %d — "
+                 "probing %d rank(s)", self._resume_round,
+                 self._restart_epoch, len(self._resume_pending))
+        for rank in range(1, self.size):
+            msg = Message(MyMessage.MSG_TYPE_S2C_RESUME_PROBE, self.rank,
+                          rank)
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._resume_round)
+            msg.add_params(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                           self._restart_epoch)
+            self.send_message(msg)
+        grace = self.round_timeout_s or 5.0
+        t = threading.Timer(grace, self._resume_backstop)
+        t.daemon = True
+        t.start()
+
+    def _resume_backstop(self) -> None:
+        with self._round_lock:
+            if self._resume_round is None or self._finished.is_set():
+                return
+            log.warning("resume probe: %d rank(s) silent past the grace — "
+                        "re-dispatching without them (elastic machinery "
+                        "owns their rejoin)", len(self._resume_pending))
+            self._complete_resume()
+
+    def handle_message_resume_ack(self, msg_params):
+        with self._round_lock:
+            if self._resume_round is None:
+                return  # late/duplicate ack after the backstop proceeded
+            sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+            last = int(msg_params.get(MyMessage.MSG_ARG_KEY_LAST_SEEN_ROUND,
+                                      -1))
+            wave = int(msg_params.get(MyMessage.MSG_ARG_KEY_LAST_SEEN_WAVE,
+                                      -1))
+            self._resume_pending.discard(sender)
+            self._resume_acks[sender] = (last, wave)
+            log.info("resume probe: rank %d last saw round %d (wave %d); "
+                     "%d pending", sender, last, wave,
+                     len(self._resume_pending))
+            if not self._resume_pending:
+                self._complete_resume()
+
+    def _complete_resume(self) -> None:
+        """Re-dispatch the open round under the new epoch. Caller holds
+        _round_lock. Ranks whose ack shows pre-crash work for this round
+        get it superseded (the epoch gate sheds the stale upload when it
+        lands); ranks that never answered ride the elastic path."""
+        rnd, self._resume_round = self._resume_round, None
+        if rnd is None:
+            return
+        stale = sorted(r for r, (last, _w) in self._resume_acks.items()
+                       if last >= rnd)
+        if stale:
+            log.info("resume: ranks %s hold pre-crash round-%d work — "
+                     "superseded by the re-dispatch (epoch gate sheds it "
+                     "on arrival)", stale, rnd)
+        if self._async:
+            # async re-dispatch: every rank gets fresh work at the
+            # recovered round; wave counters already resume past the
+            # journaled maxima
+            self.aggregator.begin_round(self.round_idx)
+            for rank in range(1, self.size):
+                self._dispatch_one(rank)
+            return
+        self._broadcast_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                              self.aggregator.get_global_model_params())
 
     def _shed_snapshot(self) -> dict:
         return dict(self._shed_counts)
@@ -1015,9 +1373,44 @@ class FedAvgServerManager(ServerManager):
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
             self.handle_message_receive_model_from_client,
         )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_RESUME_ACK,
+            self.handle_message_resume_ack,
+        )
+
+    def _epoch_admits(self, msg_params) -> bool:
+        """Restart-epoch gate (docs/ROBUSTNESS.md §Server crash recovery):
+        an upload whose echoed epoch predates this boot is PRE-CRASH
+        in-flight work — its slot was already ledgered ``server_restart``
+        at recovery (if the dead server had accepted it) and the open
+        round was re-dispatched, so folding it now would double-count.
+        Counted, never ledgered (arrival timing is wall-clock; the ledger
+        stays deterministic). Epoch-0 uploads against an epoch-0 server
+        pass untouched — the pre-crash wire is unchanged."""
+        up_epoch = int(msg_params.get(MyMessage.MSG_ARG_KEY_RESTART_EPOCH,
+                                      0))
+        if up_epoch == self._restart_epoch:
+            return True
+        _obs.record_stale_upload("server_restart")
+        log.warning("dropping upload from rank %s at restart epoch %d "
+                    "(server now at %d) — superseded by the post-crash "
+                    "re-dispatch",
+                    msg_params.get(Message.MSG_ARG_KEY_SENDER), up_epoch,
+                    self._restart_epoch)
+        return False
 
     def handle_message_receive_model_from_client(self, msg_params):
         with self._round_lock:
+            if not self._epoch_admits(msg_params):
+                if self._async:
+                    # the pre-crash dispatch is dead; hand the rank fresh
+                    # work under the new epoch so it rejoins the fleet
+                    sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+                    self._record_shed("server_restart")
+                    self._awaiting.pop(sender, None)
+                    if not self._draining:
+                        self._dispatch_one(sender)
+                return
             if self._async:
                 self._handle_async_upload(msg_params)
                 return
@@ -1078,6 +1471,22 @@ class FedAvgServerManager(ServerManager):
                 msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES],
                 round_idx=int(msg_round),
             )
+            if self.wal is not None and \
+                    self.aggregator.flag_client_model_uploaded.get(
+                        int(sender) - 1):
+                # journal the ACCEPT (fsync'd): the payload lives only in
+                # this process — if we die before the round commits,
+                # recovery ledgers this slot ``server_restart``
+                self._uploads_this_round += 1
+                self.wal.append(
+                    "upload", sync=True, round=int(msg_round),
+                    rank=int(sender),
+                    client=(self._round_ids[int(sender) - 1]
+                            if int(sender) - 1 < len(self._round_ids)
+                            else None),
+                    nsamp=float(
+                        msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]))
+                self._maybe_crash("upload")
             if not self.aggregator.check_whether_all_receive():
                 return
             self._advance_round()
@@ -1095,6 +1504,13 @@ class FedAvgServerManager(ServerManager):
             block = pr()
             if block:
                 extra["privacy"] = block
+        if self._restart_epoch:
+            # crash-recovery provenance (docs/ROBUSTNESS.md §Server crash
+            # recovery): rounds emitted after a restart carry the epoch —
+            # report.py renders a ``restarts`` column, hidden on runs (and
+            # logs) that never crashed
+            extra["server"] = {"restarts": self._restart_epoch,
+                               "restart_epoch": self._restart_epoch}
         return extra
 
     def _advance_round(self):
@@ -1149,6 +1565,16 @@ class FedAvgServerManager(ServerManager):
             return
         self._broadcast_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                               global_params)
+
+    def finish(self):
+        try:
+            super().finish()
+        finally:
+            if self.wal is not None:
+                # flush + close the journal; a zombie timer appending
+                # after this is a no-op (closed-handle check), which is
+                # exactly the post-mortem silence a dead process has
+                self.wal.close()
 
     def on_timeout(self, idle_s: float):
         """Watchdog (own thread): no traffic for round_timeout_s."""

@@ -118,9 +118,22 @@ class FedAvgRobustAggregator(FedAvgAggregator):
                 # privacy_budget health rule alerts on
                 from fedml_tpu.core.privacy import charge_and_record
 
+                q = m_received / self.cfg.client_num_in_total
+                wal = getattr(self, "wal", None)
+                if wal is not None:
+                    # WAL pre-charge, fsync'd BEFORE the noise key is
+                    # drawn (§Server crash recovery): a crash between
+                    # charge and commit replays this record into the
+                    # restarted accountant, so the reported cumulative ε
+                    # can never be lower than the charges incurred (the
+                    # conservative direction — a crash between pre-charge
+                    # and the noise draw over-counts one round)
+                    wal.append("precharge", sync=True,
+                               round=int(self.current_round),
+                               q=float(q), z=float(self._dp_z),
+                               clip=float(self._dp_C), m=int(m_received))
                 self._privacy_cache = charge_and_record(
-                    self.accountant,
-                    m_received / self.cfg.client_num_in_total,
+                    self.accountant, q,
                     self._dp_z, self._dp_C, realized_m=m_received)
             else:
                 sd = self._stddev

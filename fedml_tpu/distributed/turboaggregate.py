@@ -326,6 +326,17 @@ class TAAggregator(FedAvgAggregator):
             m = len(survivors)
             delta = vec_sum / m
             sd = self._dp_z * self._dp_C / m
+            wal = getattr(self, "wal", None)
+            if wal is not None:
+                # WAL pre-charge, fsync'd BEFORE the noise key is drawn
+                # (docs/ROBUSTNESS.md §Server crash recovery): a restarted
+                # accountant replays this record, so the reported ε can
+                # never be lower than the charges actually incurred
+                wal.append("precharge", sync=True,
+                           round=int(self.current_round),
+                           q=float(m / self.cfg.client_num_in_total),
+                           z=float(self._dp_z), clip=float(self._dp_C),
+                           m=int(m))
             self._noise_rng, k = jax.random.split(self._noise_rng)
             noise = np.asarray(
                 jax.random.normal(k, np.shape(delta), jnp.float32),
@@ -433,7 +444,11 @@ class TASecureServerManager(FedAvgServerManager):
         super().__init__(aggregator, **kw)
         self._phase = "uploads"
         self._reveal: dict | None = None
-        self._last_secagg: dict | None = None
+        if not hasattr(self, "_last_secagg"):
+            # crash recovery (_recover_in_flight, called from the base
+            # __init__) may already have recorded a shed outcome here —
+            # don't clobber it
+            self._last_secagg: dict | None = None
 
     def register_message_receive_handlers(self):
         super().register_message_receive_handlers()
@@ -463,10 +478,50 @@ class TASecureServerManager(FedAvgServerManager):
             return
         self._begin_recovery(survivors, dead)
 
+    def _recover_in_flight(self, committed: int, replay) -> None:
+        """Crash recovery × the secagg state machine (docs/ROBUSTNESS.md
+        §Server crash recovery): the base recovery ledgers the accepted
+        masked uploads as ``server_restart`` and re-dispatches the open
+        round — which for the masked tier IS the shed-and-rebroadcast
+        path (fresh boot = fresh fold state: ``_acc``/``_recovery``/
+        ``_phase`` reset, clients re-mask for the re-broadcast round, so
+        a half-revealed fold can never survive a restart). If the WAL
+        shows a reveal was in flight, the dead slots it was recovering
+        are additionally ledgered ``secagg_shed`` — the same verdict the
+        live shed path records — and the outcome metric counts a shed."""
+        super()._recover_in_flight(committed, replay)
+        if replay is None or self._resume_round is None:
+            return
+        reveals = replay.since_last_commit("secagg_reveal")
+        if not reveals:
+            return
+        rec = reveals[-1]
+        dead = [int(s) for s in rec.get("dead", [])]
+        ids = self.aggregator.client_sampling(self.round_idx)
+        for slot in dead:
+            self.aggregator.quarantine.record(
+                self.round_idx, slot + 1, "secagg_shed",
+                client=int(ids[slot]))
+            _obs.record_update_rejected("secagg_shed")
+        _perf.record_secagg_round("shed")
+        _perf.record_secagg_dropped(len(dead))
+        self._last_secagg = {"outcome": "shed", "dead": dead}
+        log.error("secagg round %d SHED (server crashed mid-reveal): "
+                  "lost slots %s ledgered — the resume probe re-runs the "
+                  "round clean", self.round_idx, dead)
+
     def _begin_recovery(self, survivors: list[int], dead: list[int]) -> None:
         agg: TAAggregator = self.aggregator
         agg._frozen = True
         self._phase = "recovery"
+        if self.wal is not None:
+            # journal the reveal fan-out (fsync'd): a crash from here to
+            # the fold must recover as a SHED round, never a half-reveal
+            self.wal.append("secagg_reveal", sync=True,
+                            round=int(self.round_idx),
+                            survivors=[int(s) for s in survivors],
+                            dead=[int(d) for d in dead])
+        self._maybe_crash("reveal")
         self._reveal = {"survivors": survivors, "dead": dead,
                         "seeds": {}, "t0": time.perf_counter()}
         log.warning("secagg round %d: slots %s dropped — asking %d "
@@ -584,16 +639,33 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
     if chaos_plan is not None:  # None must not clobber an installed plan
         _chaos.install_plan(chaos_plan)
     try:
-        aggregator = TAAggregator(
-            dataset, task, cfg, worker_num=size - 1,
-            threshold_t=threshold_t, quant_scale=quant_scale,
-            defense_type=defense_type, norm_bound=norm_bound,
-            noise_multiplier=noise_multiplier,
-            secagg_max_abs=secagg_max_abs, n_shares=n_shares)
-        server = TASecureServerManager(
-            aggregator, rank=0, size=size, backend=backend,
-            round_timeout_s=round_timeout_s, telemetry=telemetry,
-            ckpt_dir=ckpt_dir, **kw)
+        # rank-0 crash rules are supervised server restarts (docs/
+        # ROBUSTNESS.md §Server crash recovery) — the masked tier rides
+        # the same driver as the fedavg runtime: kill at the scheduled
+        # point, recover through checkpoint + WAL, shed any half-revealed
+        # round (never a half-recovered fold)
+        active = _chaos.active_plan()
+        crash_points = (active.server_crash_points()
+                        if active is not None else [])
+        if crash_points and ckpt_dir is None:
+            raise ValueError(
+                "a chaos crash rule naming rank 0 (server restart) needs "
+                "ckpt_dir= — recovery replays checkpoint + WAL")
+
+        def build_server():
+            agg = TAAggregator(
+                dataset, task, cfg, worker_num=size - 1,
+                threshold_t=threshold_t, quant_scale=quant_scale,
+                defense_type=defense_type, norm_bound=norm_bound,
+                noise_multiplier=noise_multiplier,
+                secagg_max_abs=secagg_max_abs, n_shares=n_shares)
+            return TASecureServerManager(
+                agg, rank=0, size=size, backend=backend,
+                round_timeout_s=round_timeout_s, telemetry=telemetry,
+                ckpt_dir=ckpt_dir, **kw)
+
+        server = build_server()
+        aggregator = server.aggregator
         clients = []
         for r in range(1, size):
             trainer = SecureTrainer(
@@ -602,7 +674,16 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
                 norm_bound=norm_bound, secagg_max_abs=secagg_max_abs)
             clients.append(TASecureClientManager(
                 trainer, rank=r, size=size, backend=backend, **kw))
-        launch_simulated(server, clients)
+        if crash_points:
+            from fedml_tpu.distributed.fedavg.api import (
+                run_supervised_simulated,
+            )
+
+            server = run_supervised_simulated(server, clients,
+                                              crash_points, build_server)
+            aggregator = server.aggregator
+        else:
+            launch_simulated(server, clients)
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)

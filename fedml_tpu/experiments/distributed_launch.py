@@ -128,7 +128,21 @@ def add_args(p: argparse.ArgumentParser):
                         "moves on (dead/straggler clients are dropped; "
                         "their stale uploads are discarded by round id)")
     p.add_argument("--ckpt_dir", type=str, default=None,
-                   help="server round checkpoints; restart resumes the job")
+                   help="server round checkpoints; restart resumes the job "
+                        "(also arms the durable round WAL at "
+                        "<ckpt_dir>/wal — docs/ROBUSTNESS.md §Server "
+                        "crash recovery)")
+    p.add_argument("--supervise", type=int, default=0, metavar="N",
+                   help="rank 0: run the server as a SUPERVISED child "
+                        "process and restart it up to N times when it "
+                        "dies (SIGKILL, crash, OOM). The child recovers "
+                        "through checkpoint + WAL (requires --ckpt_dir); "
+                        "clients survive the outage via the gRPC backoff "
+                        "and answer the restarted server's resume probe "
+                        "(docs/ROBUSTNESS.md §Server crash recovery). "
+                        "The child pid is published at "
+                        "<ckpt_dir>/server.pid for chaos drivers. 0 = "
+                        "run in-process (default)")
     p.add_argument("--async_buffer_k", "--async-buffer-k",
                    dest="async_buffer_k", type=int, default=None,
                    help="rank 0: buffered-async rounds (docs/ROBUSTNESS.md "
@@ -578,12 +592,75 @@ def _load_adversary_plan(spec: str | None):
     return AdversaryPlan.from_spec(spec)
 
 
+def _supervise(args, argv) -> int:
+    """Rank-0 supervision loop (docs/ROBUSTNESS.md §Server crash
+    recovery): run the real server as a child process, restart it up to
+    ``--supervise N`` times when it dies abnormally (SIGKILL, crash,
+    OOM). Every restart recovers through checkpoint + WAL — the child's
+    OWN boot path, nothing supervisor-special — so the supervisor stays
+    a dumb loop: spawn, publish the pid, wait, decide. A clean exit (rc
+    0) ends the job; exhausting the budget forwards the child's rc (the
+    restart-storm health rule fires well before a runaway loop)."""
+    import os
+    import subprocess
+    import sys
+
+    log = logging.getLogger("fedml_tpu.launch")
+    if not args.ckpt_dir:
+        raise ValueError("--supervise needs --ckpt_dir: the restarted "
+                         "server recovers through checkpoint + WAL")
+    child_argv = list(sys.argv[1:] if argv is None else argv)
+    # strip --supervise (both '--supervise N' and '--supervise=N' forms)
+    # so the child runs the server in-process
+    out, skip = [], False
+    for tok in child_argv:
+        if skip:
+            skip = False
+            continue
+        if tok == "--supervise":
+            skip = True
+            continue
+        if tok.startswith("--supervise="):
+            continue
+        out.append(tok)
+    child_argv = out
+    os.makedirs(args.ckpt_dir, exist_ok=True)
+    pid_path = os.path.join(args.ckpt_dir, "server.pid")
+    restarts = 0
+    while True:
+        child = subprocess.Popen(
+            [sys.executable, "-m",
+             "fedml_tpu.experiments.distributed_launch", *child_argv])
+        # the pid file is the chaos driver's kill handle (ci.sh SIGKILLs
+        # it mid-round); atomic-replace so a reader never sees a torn pid
+        from fedml_tpu.core.wal import durable_write
+
+        durable_write(pid_path, str(child.pid).encode())
+        log.info("supervise: server child pid %d (restart %d/%d)",
+                 child.pid, restarts, args.supervise)
+        rc = child.wait()
+        if rc == 0:
+            log.info("supervise: server exited cleanly after %d "
+                     "restart(s)", restarts)
+            return 0
+        restarts += 1
+        if restarts > args.supervise:
+            log.error("supervise: restart budget %d exhausted (last rc "
+                      "%s) — giving up", args.supervise, rc)
+            return rc if rc > 0 else 1
+        log.warning("supervise: server died (rc %s) — restarting "
+                    "(%d/%d); recovery replays checkpoint + WAL",
+                    rc, restarts, args.supervise)
+
+
 def main(argv=None):
     args = add_args(argparse.ArgumentParser("fedml_tpu.distributed")).parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
         format=f"%(asctime)s rank{args.rank} %(name)s %(levelname)s %(message)s",
     )
+    if args.rank == 0 and args.supervise:
+        raise SystemExit(_supervise(args, argv))
     from fedml_tpu.utils.metrics import set_process_title
 
     role = ("server" if args.rank == 0

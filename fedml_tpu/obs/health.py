@@ -73,6 +73,12 @@ DEFAULT_RULES: list[dict] = [
     # evaluable (never fires) on runs without a ``privacy`` block on
     # their round records; override max_epsilon per deployment.
     {"rule": "privacy_budget", "severity": "warning", "max_epsilon": 10.0},
+    # server crash recovery (docs/ROBUSTNESS.md §Server crash recovery):
+    # fires when the supervised server has restarted more than
+    # max_restarts times — a crash LOOP (bad checkpoint, poisoned WAL,
+    # deterministic fault) that supervision alone would retry forever.
+    # Not evaluable on runs that never restart (family absent or zero).
+    {"rule": "restart_storm", "severity": "critical", "max_restarts": 3.0},
 ]
 
 _KNOWN_RULES = {r["rule"] for r in DEFAULT_RULES}
@@ -283,6 +289,15 @@ class HealthMonitor:
                 return None  # not a DP run (no privacy block seen)
             thresh = float(rule.get("max_epsilon", 10.0))
             return self._privacy_eps > thresh, self._privacy_eps, thresh
+        if kind == "restart_storm":
+            fam = snap.get("fed_server_restarts_total")
+            if not fam:
+                return None  # WAL never armed / no restart yet
+            restarts = float(sum(fam.values()))
+            if restarts <= 0:
+                return None  # family pre-registered but the run is clean
+            thresh = float(rule.get("max_restarts", 3.0))
+            return restarts > thresh, restarts, thresh
         return None
 
     def check(self) -> list[dict]:
@@ -371,6 +386,10 @@ class HealthMonitor:
                 # cumulative DP ε (null outside DP runs) — the live twin
                 # of the round records' privacy block / fed_privacy_epsilon
                 "privacy_epsilon": self._privacy_eps,
+                # server crash recovery (docs/ROBUSTNESS.md §Server crash
+                # recovery): the WAL's restart epoch (0 = never crashed)
+                "restart_epoch": int(self.registry.total(
+                    "fed_restart_epoch")),
                 "alerts_fired_total": self.registry.total("fed_alerts_total"),
                 "alerts": sorted(self._active.values(),
                                  key=lambda a: a["rule"]),

@@ -121,6 +121,11 @@ class MetricsHTTPServer:
                 "quarantine_total": self.registry.total(
                     "fed_updates_rejected_total"),
                 "shed_total": self.registry.total("fed_async_shed_total"),
+                # server crash recovery: the WAL's restart epoch (0 =
+                # never crashed; docs/ROBUSTNESS.md §Server crash
+                # recovery)
+                "restart_epoch": int(self.registry.total(
+                    "fed_restart_epoch")),
             }
         snap["port"] = self.port
         return snap

@@ -260,7 +260,7 @@ def ensure_async_shed_families() -> None:
     # mirrors core/async_buffer.SHED_REASONS (obs must not import core —
     # the dependency points the other way; drift is test-pinned)
     for reason in ("stale", "overflow", "nonfinite", "crash", "suspect",
-                   "undecodable"):
+                   "undecodable", "server_restart"):
         _async_shed(reason)
 
 
@@ -316,3 +316,45 @@ def ensure_secagg_families() -> None:
     for outcome in ("full", "recovered", "shed"):
         _secagg_rounds(outcome)
     _secagg_dropped()
+
+
+# ---------------------------------------------------- server crash recovery
+# docs/ROBUSTNESS.md §Server crash recovery:
+#     fed_server_restarts_total          completed server restarts (the
+#                                        restart epoch, synced at boot so
+#                                        a restarted PROCESS's fresh
+#                                        registry still reports the count)
+#     fed_restart_epoch                  (gauge) the live restart epoch —
+#                                        also on /healthz
+#     fed_recovery_seconds               (histogram) checkpoint restore +
+#                                        WAL replay wall time per boot
+#     fed_ckpt_torn_total                torn checkpoint files skipped by
+#                                        restore_latest's fallback
+def sync_server_restarts(epoch: int) -> None:
+    """Bring ``fed_server_restarts_total`` up to the WAL's restart epoch:
+    a restarted process boots with a fresh registry, so the counter is
+    advanced by the DELTA between the journaled epoch and whatever this
+    process already counted (simulated in-process restarts inc once per
+    boot; a twice-restarted real process lands at 2 in one step)."""
+    delta = float(epoch) - REGISTRY.total("fed_server_restarts_total")
+    if delta > 0:
+        _counter("fed_server_restarts_total").inc(delta)
+    REGISTRY.gauge("fed_restart_epoch").set(float(epoch))
+
+
+def record_recovery_seconds(seconds: float) -> None:
+    _hist("fed_recovery_seconds").observe(seconds)
+
+
+def record_ckpt_torn() -> None:
+    _counter("fed_ckpt_torn_total").inc()
+
+
+def ensure_restart_families() -> None:
+    """Pre-register the crash-recovery families at zero so any WAL-armed
+    run's Prometheus export carries them (the restart-storm health rule
+    and the ci.sh supervised-restart leg read the family, not its
+    absence)."""
+    _counter("fed_server_restarts_total")
+    REGISTRY.gauge("fed_restart_epoch")
+    _counter("fed_ckpt_torn_total")

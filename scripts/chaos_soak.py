@@ -251,6 +251,83 @@ def backdoor_defense_trial(rounds: int = 4, aggregator: str | None = "krum",
     }
 
 
+def server_crash_trial(data, task, seed: int, rounds: int = 4,
+                       world_size: int = 4,
+                       mid_round: bool = False) -> dict:
+    """One supervised-server-crash trial (docs/ROBUSTNESS.md §Server
+    crash recovery): run an uninterrupted oracle, then the same job with
+    a seeded rank-0 crash rule (between commits, or mid-round after
+    ``1 + seed % (world_size - 2)`` accepted uploads) driven through the
+    checkpoint + WAL recovery path. A between-commits crash must land
+    bitwise on the oracle's final model AND quarantine ledger; a
+    mid-round crash must complete with every lost slot ledgered
+    ``server_restart`` and the re-run round folding sample-weight exact
+    (here: the full cohort redoes the round, so bits match the oracle
+    too)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.chaos import FaultPlan
+    from fedml_tpu.comm.message import pack_pytree
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    cfg_kw = dict(client_num_in_total=data.num_clients,
+                  client_num_per_round=world_size - 1, epochs=1,
+                  batch_size=8, lr=0.1, frequency_of_the_test=1, seed=0)
+    crash_round = 1 + seed % max(rounds - 1, 1)
+    rule = {"fault": "crash", "ranks": [0],
+            "rounds": [crash_round, crash_round + 1]}
+    if mid_round:
+        rule["after_uploads"] = 1 + seed % max(world_size - 2, 1)
+    t0 = time.perf_counter()
+    rec = {"seed": seed, "mode": "server_crash",
+           "crash_round": crash_round, "mid_round": mid_round, "ok": False,
+           "n_faults": 1}
+    ckpt_dir = tempfile.mkdtemp(prefix="soak-sc-")
+    try:
+        oracle = run_simulated(
+            data, task, FedAvgConfig(comm_round=rounds, **cfg_kw),
+            job_id=f"soak-sc-oracle-{seed}", round_timeout_s=2.0)
+        crashed = run_simulated(
+            data, task, FedAvgConfig(comm_round=rounds, **cfg_kw),
+            job_id=f"soak-sc-{seed}",
+            chaos_plan=FaultPlan.from_json(
+                {"seed": seed, "rules": [dict(rule)]}),
+            round_timeout_s=2.0, ckpt_dir=ckpt_dir)
+        completed = (crashed.history[-1]["round"] == rounds - 1
+                     if crashed.history else False)
+        bits_eq = all(np.array_equal(np.asarray(a), np.asarray(b))
+                      for a, b in zip(pack_pytree(crashed.net),
+                                      pack_pytree(oracle.net)))
+        lost = [e for e in crashed.quarantine.entries()
+                if e["reason"] == "server_restart"]
+        if mid_round:
+            # the full fleet redid the round, so bits still match; the
+            # ledger must carry exactly the accepted-then-lost slots
+            ledger_ok = (len(lost) == rule["after_uploads"]
+                         and all(e["round"] == crash_round for e in lost))
+        else:
+            ledger_ok = (crashed.quarantine.canonical()
+                         == oracle.quarantine.canonical())
+        rec.update(ok=bool(completed and bits_eq and ledger_ok),
+                   completed=completed, bits_equal=bits_eq,
+                   ledger_ok=ledger_ok,
+                   lost_slots=[e["rank"] for e in lost])
+        if not rec["ok"]:
+            rec["error"] = (f"completed={completed} bits={bits_eq} "
+                            f"ledger={ledger_ok}")
+    except Exception as e:  # noqa: BLE001 — a soak trial failure is data
+        rec["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        # a long soak must not leak one model-sized ckpt+WAL dir per trial
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    rec["seconds"] = round(time.perf_counter() - t0, 3)
+    return rec
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser("chaos_soak")
     ap.add_argument("--trials", type=int, default=10)
@@ -305,8 +382,24 @@ def main(argv=None) -> int:
                          "compare a chaos-free tree run's quarantine "
                          "ledger + model bits against its flat pairwise "
                          "twin; the summary gains per-tier fan-in stats")
+    ap.add_argument("--server-crash", "--server_crash",
+                    dest="server_crash", action="store_true",
+                    help="supervised rank-0 crash tier (docs/ROBUSTNESS.md "
+                         "§Server crash recovery): every trial kills the "
+                         "loopback server at a seeded point — even trials "
+                         "between round commits (final model AND "
+                         "quarantine ledger must land bitwise on an "
+                         "uninterrupted oracle), odd trials mid-round "
+                         "(run must complete with every accepted-then-"
+                         "lost slot ledgered server_restart). Recovery "
+                         "runs the real checkpoint + WAL + resume-probe "
+                         "path per trial; excludes the other tiers")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
+    if args.server_crash and (args.edges or args.async_buffer_k
+                              or args.adversary_plan or args.compression):
+        ap.error("--server-crash is its own tier — drop --edges/"
+                 "--async-buffer-k/--adversary-plan/--compression")
     if args.edges:
         if args.async_buffer_k:
             ap.error("--edges does not compose with --async-buffer-k "
@@ -326,6 +419,38 @@ def main(argv=None) -> int:
                             num_classes=4, samples_per_client=24,
                             test_samples=96, seed=3)
     task = classification_task(LogisticRegression(num_classes=4))
+
+    if args.server_crash:
+        trials = []
+        for i in range(args.trials):
+            seed = args.seed0 + i
+            rec = server_crash_trial(data, task, seed,
+                                     rounds=max(args.rounds, 3),
+                                     world_size=args.world_size,
+                                     mid_round=bool(i % 2))
+            trials.append(rec)
+            print(f"trial {seed}: {'ok' if rec['ok'] else 'FAIL'} "
+                  f"(crash@{rec['crash_round']} "
+                  f"{'mid-round' if rec['mid_round'] else 'between-commits'}"
+                  f", {rec['seconds']}s)", file=sys.stderr)
+        n_ok = sum(t["ok"] for t in trials)
+        summary = {
+            "metric": "chaos_soak_pass_rate",
+            "value": round(n_ok / max(1, len(trials)), 3),
+            "unit": "fraction",
+            "mode": "server_crash",
+            "trials": len(trials),
+            "passed": n_ok,
+            "rounds_per_trial": max(args.rounds, 3),
+            "records": trials,
+        }
+        out = json.dumps(summary, indent=1, default=str)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(out)
+        else:
+            print(out)
+        return 0 if n_ok == len(trials) else 1
 
     adv_spec = None
     if args.adversary_plan:

@@ -694,6 +694,64 @@ print(f"cross-tier robust smoke ok: tree-median == flat bitwise, "
       f"{tree.fanin_history}, evidence {int(ev_b)}B / verdict {int(vd_b)}B "
       f"over {cfg.comm_round} rounds (budget {budget}B)")
 PY
+  echo "== supervised server-restart smoke (real gRPC fleet; SIGKILL the server child mid-campaign under --supervise; run completes, fed_server_restarts_total == 1, report renders restarts) =="
+  # server crash tolerance end-to-end (docs/ROBUSTNESS.md §Server crash
+  # recovery) on REAL processes: rank 0 runs as a supervised child
+  # (--supervise publishes its pid at <ckpt_dir>/server.pid), we SIGKILL
+  # it once a round has committed, the supervisor restarts it, recovery
+  # replays checkpoint + WAL, the surviving client processes ride the
+  # gRPC backoff + resume probe, and the campaign completes. The final
+  # telemetry close must export fed_server_restarts_total == 1 and the
+  # post-restart round records must render a `restarts` column.
+  SUP_DIR=./tmp/ci_supervise; rm -rf "$SUP_DIR"; mkdir -p "$SUP_DIR"
+  SUP_WORLD=3; SUP_PORT=50620
+  SUP_ARGS="--world_size $SUP_WORLD --backend grpc --base_port $SUP_PORT \
+    --dataset synthetic --model lr --client_num_in_total 2 \
+    --comm_round 6 --batch_size 10 --lr 0.1 --frequency_of_the_test 1"
+  python -m fedml_tpu.experiments.distributed_launch --rank 0 $SUP_ARGS \
+    --round_timeout_s 30 --supervise 2 --ckpt_dir "$SUP_DIR/ckpt" \
+    --telemetry-dir "$SUP_DIR/tel" > "$SUP_DIR/server.out" 2>&1 &
+  SUP_PID=$!
+  SUP_CLIENT_PIDS=""
+  for r in $(seq 1 $((SUP_WORLD - 1))); do
+    python -m fedml_tpu.experiments.distributed_launch --rank "$r" \
+      $SUP_ARGS > "$SUP_DIR/client$r.out" 2>&1 &
+    SUP_CLIENT_PIDS="$SUP_CLIENT_PIDS $!"
+  done
+  # wait until a round has COMMITTED (a checkpoint exists), then kill the
+  # server child dead — no goodbyes, exactly what the WAL is for
+  for i in $(seq 1 240); do
+    if [ -e "$SUP_DIR/ckpt/server.pid" ] \
+        && ls "$SUP_DIR"/ckpt/round_* >/dev/null 2>&1; then break; fi
+    sleep 0.5
+  done
+  ls "$SUP_DIR"/ckpt/round_* >/dev/null  # fail loudly if never committed
+  kill -9 "$(cat "$SUP_DIR/ckpt/server.pid")"
+  echo "-- SIGKILLed server child $(cat "$SUP_DIR/ckpt/server.pid"); waiting for the supervised campaign"
+  wait $SUP_PID
+  for p in $SUP_CLIENT_PIDS; do wait "$p"; done
+  python - "$SUP_DIR" <<'PY'
+import json, subprocess, sys
+
+d = sys.argv[1]
+recs = [json.loads(l) for l in open(f"{d}/tel/events.jsonl")]
+rounds = [r for r in recs if r.get("kind") == "round"]
+assert max(r["round"] for r in rounds) == 5, \
+    f"campaign did not complete: {sorted(r['round'] for r in rounds)}"
+assert any((r.get("server") or {}).get("restarts") == 1 for r in rounds), \
+    "no post-restart round carries the server block"
+prom = open(f"{d}/tel/metrics.prom").read()
+line = [l for l in prom.splitlines()
+        if l.startswith("fed_server_restarts_total")]
+assert line and float(line[0].split()[-1]) == 1.0, line
+table = subprocess.run(
+    [sys.executable, "scripts/report.py", f"{d}/tel/events.jsonl"],
+    capture_output=True, text=True, check=True).stdout
+assert "restarts" in table, table[:400]
+print(f"supervised server-restart smoke ok: {len(rounds)} round records "
+      f"across the kill, fed_server_restarts_total == 1, restarts column "
+      f"rendered")
+PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi
@@ -797,4 +855,11 @@ python scripts/chaos_soak.py --trials 3 --rounds 3 --compression delta-int8 \
 python scripts/chaos_soak.py --trials 3 --rounds 3 --world_size 7 --edges 2 \
   --adversary-plan '{"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [1], "factor": 10.0}]}' \
   --out ./tmp/chaos_soak_edges.json
+# server-crash tier (docs/ROBUSTNESS.md §Server crash recovery): seeded
+# rank-0 kills through checkpoint + WAL recovery — even trials between
+# commits must land bitwise on an uninterrupted oracle (model AND
+# quarantine ledger), odd trials mid-round must complete with every
+# accepted-then-lost slot ledgered server_restart
+python scripts/chaos_soak.py --server-crash --trials 4 --rounds 4 \
+  --out ./tmp/chaos_soak_crash.json
 echo "CI GREEN"

@@ -116,6 +116,11 @@ def render_table(records: list[dict]) -> str:
             # ε@δ — both hide on logs that predate the blocks
             "secagg": (r.get("secagg") or {}).get("outcome"),
             "eps": (r.get("privacy") or {}).get("eps"),
+            # server crash recovery (docs/ROBUSTNESS.md §Server crash
+            # recovery): cumulative supervised restarts behind this round
+            # — the column hides on runs (and pre-WAL logs) that never
+            # crashed
+            "restarts": (r.get("server") or {}).get("restarts"),
             "buf_k": (r.get("async") or {}).get("k"),
             "stale_p50": _staleness_quantile(r, 0.5),
             "stale_max": _staleness_quantile(r, 1.0),

@@ -36,10 +36,11 @@ def rules_hit(findings):
 
 
 # ------------------------------------------------------------- rule registry
-def test_all_eight_rules_registered():
+def test_all_rules_registered():
     assert set(RULES) == {
         "jit-purity", "host-sync", "lock-discipline", "determinism",
         "metric-discipline", "wire-keys", "except-swallow", "no-bare-print",
+        "fsync-discipline",
     }
     for rule in RULES.values():
         assert rule.description, rule.name
@@ -725,6 +726,57 @@ def test_cli_exit_codes_and_json_blob(fedlint_cli, tmp_path, capsys):
     capsys.readouterr()
 
 
+FSYNC_BAD = """\
+import json
+
+
+def save_state(path, doc):
+    with open(path, "w") as f:
+        json.dump(doc, f)
+"""
+
+FSYNC_CLEAN = """\
+import json
+
+from fedml_tpu.core.wal import durable_write
+
+
+def save_state(path, doc):
+    durable_write(path, json.dumps(doc).encode())
+
+
+def load_state(path):
+    with open(path) as f:  # reads are recovery's job — not flagged
+        return json.load(f)
+
+
+def _durable_append_handle(path):
+    # durable_*-named helpers own their fsync ceremony
+    return open(path, "ab")
+"""
+
+
+def test_fsync_discipline_flags_bare_write_in_scoped_modules(tmp_path):
+    out = lint(tmp_path, "core/checkpoint.py", FSYNC_BAD,
+               rules=["fsync-discipline"])
+    assert rules_hit(out) == {"fsync-discipline"}
+    out = lint(tmp_path, "core/wal.py", FSYNC_BAD,
+               rules=["fsync-discipline"])
+    assert rules_hit(out) == {"fsync-discipline"}
+
+
+def test_fsync_discipline_clean_fixture_and_scope(tmp_path):
+    assert lint(tmp_path, "core/wal.py", FSYNC_CLEAN,
+                rules=["fsync-discipline"]) == []
+    # out of scope: any other module may open-for-write freely (their
+    # durability story is their own), including a checkpoint.py OUTSIDE
+    # core/
+    assert lint(tmp_path, "obs/events.py", FSYNC_BAD,
+                rules=["fsync-discipline"]) == []
+    assert lint(tmp_path, "data/checkpoint.py", FSYNC_BAD,
+                rules=["fsync-discipline"]) == []
+
+
 # every rule's positive fixture, through the CLI: exit code 1 each
 _POSITIVE_FIXTURES = {
     "jit-purity": ("core/x.py", JIT_PURITY_BAD),
@@ -735,6 +787,7 @@ _POSITIVE_FIXTURES = {
     "wire-keys": ("comm/x.py", WIRE_BAD),
     "except-swallow": ("comm/x.py", EXCEPT_BAD),
     "no-bare-print": ("core/x.py", PRINT_BAD),
+    "fsync-discipline": ("core/wal.py", FSYNC_BAD),
 }
 
 

@@ -1,0 +1,331 @@
+"""Durable round WAL (core/wal.py) + crash-safe checkpoints
+(core/checkpoint.py) — the recovery substrate of docs/ROBUSTNESS.md
+§Server crash recovery:
+
+- CRC-framed append/replay round-trips; a torn tail is dropped + counted,
+  never misparsed; a corrupt mid-file frame truncates the suffix (the
+  safe direction);
+- the replay view answers the recovery questions: restart epochs, last
+  commit, the open round, since-last-commit in-flight sets, async
+  dispatch-wave maxima;
+- durable_write publishes atomically (old or new content, never torn);
+- checkpoint saves are tmp → fsync → atomic rename, and a TRUNCATED
+  newest checkpoint is skipped (counted on fed_ckpt_torn_total) with
+  recovery falling back to the previous round — while a template
+  structure mismatch stays a loud ValueError.
+"""
+
+import json
+import os
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.wal import (RoundWAL, durable_write,
+                                _HDR, _MAGIC, _SEGMENT)
+
+
+def _wal_path(d):
+    return os.path.join(str(d), _SEGMENT)
+
+
+# ------------------------------------------------------------------- framing
+def test_append_replay_round_trip(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.append("restart", sync=True, epoch=0)
+    wal.append("broadcast", sync=True, round=0)
+    wal.append("upload", sync=True, round=0, rank=1, client=5, nsamp=24.0)
+    wal.commit(0)
+    wal.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.torn == 0
+    assert [r["kind"] for r in rep.records] == ["restart", "broadcast",
+                                                "upload", "commit"]
+    assert rep.records[2] == {"kind": "upload", "round": 0, "rank": 1,
+                              "client": 5, "nsamp": 24.0}
+    assert rep.last_commit == 0 and rep.restart_epochs == 1
+
+
+def test_replay_missing_and_empty_dir(tmp_path):
+    rep = RoundWAL.replay(str(tmp_path / "nowhere"))
+    assert rep.records == [] and rep.torn == 0
+    assert rep.last_commit == -1 and rep.restart_epochs == 0
+    assert rep.open_round(-1) is None
+    assert rep.since_last_commit() == []
+
+
+def test_torn_tail_dropped_and_counted(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.append("broadcast", sync=True, round=3)
+    wal.append("upload", sync=True, round=3, rank=2)
+    wal.close()
+    # tear the tail mid-frame: everything before stays intact by CRC
+    path = _wal_path(tmp_path)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 7)
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.torn == 1
+    assert [r["kind"] for r in rep.records] == ["broadcast"]
+
+
+def test_corrupt_frame_truncates_suffix(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.append("broadcast", sync=True, round=0)
+    wal.append("commit", sync=True, round=0)
+    wal.append("broadcast", sync=True, round=1)
+    wal.close()
+    path = _wal_path(tmp_path)
+    with open(path, "rb") as f:
+        data = f.read()
+    # flip one byte inside the SECOND record's payload: CRC catches it,
+    # and the third (intact) record after it is unreachable — lose the
+    # suffix, never misparse
+    off = len(_MAGIC)
+    length, _ = _HDR.unpack_from(data, off)
+    second_payload = off + _HDR.size + length + _HDR.size
+    data = (data[:second_payload]
+            + bytes([data[second_payload] ^ 0xFF])
+            + data[second_payload + 1:])
+    with open(path, "wb") as f:
+        f.write(data)
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.torn == 1
+    assert [r["kind"] for r in rep.records] == ["broadcast"]
+    assert rep.last_commit == -1  # the commit record died with the flip
+
+
+def test_bad_magic_is_empty_replay(tmp_path):
+    os.makedirs(tmp_path, exist_ok=True)
+    with open(_wal_path(tmp_path), "wb") as f:
+        f.write(b"garbage")
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.records == [] and rep.torn == 1
+
+
+def test_append_after_close_is_noop(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.append("broadcast", sync=True, round=0)
+    wal.close()
+    wal.append("upload", sync=True, round=0, rank=1)  # post-mortem: silent
+    rep = RoundWAL.replay(str(tmp_path))
+    assert [r["kind"] for r in rep.records] == ["broadcast"]
+
+
+def test_reopen_appends_across_boots(tmp_path):
+    # boot 1 journals and "dies"; boot 2 reopens the same segment
+    w1 = RoundWAL(str(tmp_path))
+    w1.append("restart", sync=True, epoch=0)
+    w1.append("broadcast", sync=True, round=0)
+    w1.close()
+    w2 = RoundWAL(str(tmp_path))
+    w2.append("restart", sync=True, epoch=1)
+    w2.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.restart_epochs == 2
+    assert [r["kind"] for r in rep.records] == ["restart", "broadcast",
+                                                "restart"]
+
+
+def test_reopen_truncates_torn_tail(tmp_path):
+    # boot 1 dies MID-APPEND (torn partial frame at the tail); boot 2 must
+    # truncate it away before appending, or boot 2's records land after
+    # bytes every later replay stops at — invisible forever (restart
+    # epochs undercount, commits vanish, lost uploads unledgered)
+    w1 = RoundWAL(str(tmp_path))
+    w1.append("restart", sync=True, epoch=0)
+    w1.append("broadcast", sync=True, round=0)
+    w1.close()
+    with open(_wal_path(tmp_path), "ab") as f:
+        f.write(_HDR.pack(99, 12345) + b"torn")
+    w2 = RoundWAL(str(tmp_path))
+    w2.append("restart", sync=True, epoch=1)
+    w2.commit(0)
+    w2.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.torn == 0  # the tail was repaired, not re-dropped
+    assert [r["kind"] for r in rep.records] == ["restart", "broadcast",
+                                                "restart", "commit"]
+    assert rep.restart_epochs == 2 and rep.last_commit == 0
+
+
+def test_reopen_sets_aside_bad_magic(tmp_path):
+    # an unreadable segment (bad magic) is set aside, never appended to —
+    # a fresh segment keeps the new boot's records replayable
+    with open(_wal_path(tmp_path), "wb") as f:
+        f.write(b"NOTAMAGIC-garbage")
+    w = RoundWAL(str(tmp_path))
+    w.append("restart", sync=True, epoch=0)
+    w.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.torn == 0 and rep.restart_epochs == 1
+    assert os.path.exists(_wal_path(tmp_path) + ".corrupt")
+
+
+# ------------------------------------------------------------ recovery views
+def test_open_round_and_since_last_commit(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    wal.append("broadcast", sync=True, round=0)
+    wal.append("upload", sync=True, round=0, rank=1)
+    wal.commit(0)
+    wal.append("broadcast", sync=True, round=1)
+    wal.append("upload", sync=True, round=1, rank=2, client=7)
+    wal.append("precharge", sync=True, round=1, q=0.5, z=1.0)
+    wal.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.last_commit == 0
+    assert rep.open_round(0) == 1
+    assert rep.open_round(1) is None  # committed past it -> nothing open
+    lost = rep.since_last_commit("upload")
+    assert [(r["round"], r["rank"]) for r in lost] == [(1, 2)]
+    assert [r["kind"] for r in rep.since_last_commit()] == [
+        "broadcast", "upload", "precharge"]
+    assert rep.for_round(1, "precharge")[0]["q"] == 0.5
+
+
+def test_since_last_commit_accumulates_across_double_crash(tmp_path):
+    """Two crashes in one round: each boot's lost uploads accumulate in
+    the in-flight window until a commit finally lands."""
+    wal = RoundWAL(str(tmp_path))
+    wal.commit(0)
+    wal.append("broadcast", sync=True, round=1)
+    wal.append("upload", sync=True, round=1, rank=1)   # boot 1, lost
+    wal.append("restart", sync=True, epoch=1)          # boot 2
+    wal.append("broadcast", sync=True, round=1)
+    wal.append("upload", sync=True, round=1, rank=3)   # boot 2, lost
+    wal.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert [r["rank"] for r in rep.since_last_commit("upload")] == [1, 3]
+    wal = RoundWAL(str(tmp_path))
+    wal.commit(1)
+    wal.close()
+    rep = RoundWAL.replay(str(tmp_path))
+    assert rep.since_last_commit("upload") == []
+
+
+def test_dispatch_waves_maxima(tmp_path):
+    wal = RoundWAL(str(tmp_path))
+    for rank, wave in ((1, 0), (2, 0), (1, 1), (1, 2), (2, 1)):
+        wal.append("dispatch", sync=True, round=0, rank=rank, wave=wave)
+    wal.close()
+    assert RoundWAL.replay(str(tmp_path)).dispatch_waves() == {1: 2, 2: 1}
+
+
+# ---------------------------------------------------------------- durability
+def test_durable_write_is_atomic_publish(tmp_path):
+    p = str(tmp_path / "state.json")
+    durable_write(p, b'{"v": 1}')
+    assert json.load(open(p)) == {"v": 1}
+    durable_write(p, b'{"v": 2}')
+    assert json.load(open(p)) == {"v": 2}
+    assert not os.path.exists(p + ".tmp")  # no orphaned tmp
+
+
+# ------------------------------------------------- crash-safe checkpoints
+@pytest.fixture
+def ckpt_state():
+    import jax
+
+    net = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+           "b": np.zeros(3, np.float32)}
+    rng = jax.random.PRNGKey(0)
+    return net, (), rng
+
+
+@pytest.fixture
+def force_npz(monkeypatch):
+    """Force the npz fallback (the torn-file contract under test targets
+    the single-file container; orbax, when present, writes directories
+    whose torn shapes are its own problem)."""
+    import sys
+
+    monkeypatch.setitem(sys.modules, "orbax", None)
+    monkeypatch.setitem(sys.modules, "orbax.checkpoint", None)
+
+
+def _template(net, opt, rng):
+    return {"net": net, "server_opt_state": opt, "rng": rng,
+            "round": np.asarray(0, np.int64)}
+
+
+def test_truncated_newest_checkpoint_falls_back(tmp_path, ckpt_state, force_npz):
+    """The satellite contract: a checkpoint torn by a crash mid-write is
+    skipped (counted on fed_ckpt_torn_total) and recovery restores the
+    previous round instead of crashing the restart loop."""
+    from fedml_tpu.core.checkpoint import (TornCheckpoint, restore_latest,
+                                           restore_round, save_round)
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    net, opt, rng = ckpt_state
+    d = str(tmp_path / "ckpt")
+    save_round(d, 0, net, opt, rng)
+    net2 = {k: v + 1 for k, v in net.items()}
+    save_round(d, 1, net2, opt, rng)
+    # tear round 1 mid-file (the zip directory at the tail dies)
+    p1 = os.path.join(d, "round_000001.npz")
+    with open(p1, "r+b") as f:
+        f.truncate(os.path.getsize(p1) // 2)
+    with pytest.raises(TornCheckpoint):
+        restore_round(d, 1, _template(net, opt, rng))
+    before = REGISTRY.total("fed_ckpt_torn_total")
+    hit = restore_latest(d, _template(net, opt, rng))
+    assert hit is not None
+    r, state = hit
+    assert r == 0
+    np.testing.assert_array_equal(np.asarray(state["net"]["w"]), net["w"])
+    assert REGISTRY.total("fed_ckpt_torn_total") == before + 1
+
+
+def test_all_checkpoints_torn_returns_none(tmp_path, ckpt_state, force_npz):
+    from fedml_tpu.core.checkpoint import restore_latest, save_round
+
+    net, opt, rng = ckpt_state
+    d = str(tmp_path / "ckpt")
+    save_round(d, 0, net, opt, rng)
+    p = os.path.join(d, "round_000000.npz")
+    with open(p, "r+b") as f:
+        f.truncate(10)
+    assert restore_latest(d, _template(net, opt, rng)) is None
+
+
+def test_structure_mismatch_stays_loud(tmp_path, ckpt_state, force_npz):
+    """A torn file is recoverable-by-fallback; a template that disagrees
+    with what was saved is a CONFIGURATION error and must raise, exactly
+    as before (the dp-resumed-without-dp leaf-shift hazard)."""
+    from fedml_tpu.core.checkpoint import restore_round, save_round
+
+    net, opt, rng = ckpt_state
+    d = str(tmp_path / "ckpt")
+    save_round(d, 0, net, opt, rng,
+               extra_state={"dp_rdp": np.zeros(3)})
+    with pytest.raises(ValueError, match="structure mismatch"):
+        restore_round(d, 0, _template(net, opt, rng))
+
+
+def test_no_bare_tmp_left_behind(tmp_path, ckpt_state, force_npz):
+    from fedml_tpu.core.checkpoint import save_round
+
+    net, opt, rng = ckpt_state
+    d = str(tmp_path / "ckpt")
+    save_round(d, 0, net, opt, rng, history=[{"round": 0}])
+    leftovers = [f for f in os.listdir(d) if f.endswith(".tmp")]
+    assert leftovers == []
+    assert json.load(open(os.path.join(d, "history.json"))) == [{"round": 0}]
+
+
+# --------------------------------------------------------------- wire vocab
+def test_frame_layout_is_pinned(tmp_path):
+    """The on-disk framing is a compatibility surface: 8-byte magic, then
+    [u32 len][u32 crc32(payload)][canonical-JSON payload] per record."""
+    wal = RoundWAL(str(tmp_path))
+    wal.append("commit", sync=True, round=7)
+    wal.close()
+    with open(_wal_path(tmp_path), "rb") as f:
+        data = f.read()
+    assert data[:8] == b"FWAL0001"
+    length, crc = struct.unpack_from("<II", data, 8)
+    payload = data[16:16 + length]
+    assert zlib.crc32(payload) == crc
+    assert json.loads(payload) == {"kind": "commit", "round": 7}

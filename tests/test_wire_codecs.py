@@ -598,11 +598,13 @@ def test_shed_vocab_pinned_to_perf_instrument():
     # the quarantine-ledger vocabulary, pinned alongside: the ledger-only
     # reasons (no in-graph code) every runtime's ledger may carry —
     # 'undecodable' (PR-9 wire tiers), 'edge_lost' (cross-tier elastic
-    # edge loss, docs/ROBUSTNESS.md §Cross-tier robust gating), and the
+    # edge loss, docs/ROBUSTNESS.md §Cross-tier robust gating), the
     # masked-secure-aggregation pair 'secagg_dropout'/'secagg_shed'
-    # (§Secure aggregation dropout recovery / below-threshold shed)
+    # (§Secure aggregation dropout recovery / below-threshold shed), and
+    # 'server_restart' (uploads accepted-then-lost to a server crash,
+    # §Server crash recovery)
     from fedml_tpu.core.robust_agg import REASONS
 
     assert REASONS == ("ok", "nonfinite", "norm_outlier", "suspected",
                        "undecodable", "edge_lost", "secagg_dropout",
-                       "secagg_shed")
+                       "secagg_shed", "server_restart")
