@@ -46,6 +46,7 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 from dataclasses import dataclass, field
 
@@ -242,6 +243,10 @@ class RoundWAL:
     def append(self, kind: str, sync: bool = False, **fields) -> None:
         rec = dict(fields)
         rec["kind"] = str(kind)
+        # wall-clock stamp: the post-mortem timeline (obs/flightrec.py)
+        # orders WAL records against flight-record dumps by it. setdefault
+        # so a caller (or a replay-driven rewrite) can pin its own.
+        rec.setdefault("ts", round(time.time(), 6))  # fedlint: disable=determinism — wall-clock stamp for the post-mortem timeline only; replay ignores it and a replay-driven rewrite pins its own
         payload = json.dumps(rec, sort_keys=True).encode()
         frame = _HDR.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:

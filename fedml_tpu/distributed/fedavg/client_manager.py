@@ -20,6 +20,7 @@ from fedml_tpu.comm.managers import ClientManager
 from fedml_tpu.comm.message import Message
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
+from fedml_tpu.obs.fleet import TELEMETRY_KEY, DigestEmitter, attach_digest
 from fedml_tpu.obs.tracing import TRACE_KEY, ClientSpanBuffer
 
 
@@ -111,6 +112,11 @@ class FedAvgClientManager(ClientManager):
         self._restart_epoch = 0
         self._last_wave: int | None = None
         self._trace_buf: ClientSpanBuffer | None = None  # lazy: see module doc
+        # fleet digest emitter (obs/fleet.py): lazily created the first
+        # time a broadcast carries the __telemetry marker — same
+        # zero-client-config contract as tracing. None = plane off = the
+        # uplink is byte-identical.
+        self._digest: DigestEmitter | None = None
         super().__init__(rank, size, backend, **kw)
 
     def register_message_receive_handlers(self):
@@ -174,8 +180,25 @@ class FedAvgClientManager(ClientManager):
                 self._trace_buf = ClientSpanBuffer(self.rank)
             buf = self._trace_buf
             buf.on_broadcast(blob)
-        span = buf.span if buf is not None else \
-            (lambda _name: contextlib.nullcontext())
+        # fleet plane marker (obs/fleet.py): the server's collector is
+        # armed — start digesting (lazy, like the trace buffer)
+        dig = None
+        tmark = msg_params.get(TELEMETRY_KEY)
+        if isinstance(tmark, dict):
+            if self._digest is None:
+                self._digest = DigestEmitter(self.rank)
+            dig = self._digest
+            dig.on_downlink(tmark)
+
+        @contextlib.contextmanager
+        def span(name):
+            # compose the (independent) trace span and digest phase
+            # timers — either plane can be on without the other
+            with (buf.span(name) if buf is not None
+                  else contextlib.nullcontext()):
+                with (dig.phase(name) if dig is not None
+                      else contextlib.nullcontext()):
+                    yield
         # buffered-async dispatch (docs/ROBUSTNESS.md §Asynchronous buffered
         # rounds): the server's dispatch-wave counter is the work-unit key —
         # the local fit folds its rng/batch order by the WAVE (so a
@@ -273,6 +296,8 @@ class FedAvgClientManager(ClientManager):
                     int(msg_params[MyMessage.MSG_ARG_KEY_CLIENT_INDEX]))
         if buf is not None:  # span buffer + clock stamps ride the uplink
             msg.add_params(TRACE_KEY, buf.upload_blob())
+        if dig is not None:  # the fleet digest rides the same frame
+            attach_digest(msg, dig.digest(self.round_idx, wave=wave))
         self._send_upload(msg)
 
     def _send_upload(self, msg):

@@ -343,6 +343,12 @@ class FedAvgEdgeManager(DistributedManager):
         self._evidence_sent = False
         self._staged: tuple | None = None  # (stacked, global) held for phase 3
         self._last_partial: tuple | None = None  # retransmit cache
+        # fleet plane (obs/fleet.py): the root's downlink marker arms the
+        # lazy digest emitter; children's uplink digests fold into ONE
+        # blob on this edge's partial so root ingress stays O(edges)
+        self._fleet_marker: dict | None = None
+        self._digest = None
+        self._child_digests: dict[int, dict] = {}
         ts = kw.pop("timeout_s", None)
         self.round_timeout_s = round_timeout_s
         super().__init__(rank, topology.world_size, backend,
@@ -399,6 +405,18 @@ class FedAvgEdgeManager(DistributedManager):
             self._evidence_sent = False
             self._staged = None
             self._last_partial = None
+            # fleet marker: the edge REBUILDS worker frames, so the
+            # enablement marker must be explicitly relayed (like every
+            # other side-band key) or the workers never start digesting
+            tmark = msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY)
+            self._fleet_marker = tmark if isinstance(tmark, dict) else None
+            self._child_digests = {}
+            if self._fleet_marker is not None:
+                if self._digest is None:
+                    from fedml_tpu.obs.fleet import DigestEmitter
+
+                    self._digest = DigestEmitter(self.rank)
+                self._digest.on_downlink(self._fleet_marker)
         for i, slot in enumerate(self._slots):
             msg = Message(msg_type, self.rank,
                           self.topology.worker_rank(slot))
@@ -406,6 +424,9 @@ class FedAvgEdgeManager(DistributedManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                            self._clients[i])
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            if self._fleet_marker is not None:
+                msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY,
+                               self._fleet_marker)
             self.send_message(msg)
 
     def _handle_child_upload(self, msg_params) -> None:
@@ -414,6 +435,12 @@ class FedAvgEdgeManager(DistributedManager):
         with self._lock:
             if self._round is None:
                 return
+            # fleet digest: collected on ARRIVAL, before any round/dedup
+            # gate — even a stale or late upload proves the rank is alive,
+            # and the fold below only keeps the latest blob per child
+            dig = msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY)
+            if isinstance(dig, dict):
+                self._child_digests[sender] = dig
             tag = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self._round)
             if int(tag) != self._round:
                 from fedml_tpu.obs import comm_instrument as _obs
@@ -501,6 +528,18 @@ class FedAvgEdgeManager(DistributedManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_CLIENTS,
                        list(self._clients))
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+        if self._fleet_marker is not None and self._digest is not None:
+            # the folded blob: this edge's own digest + its block's child
+            # digests under "block" — ONE side-band payload per edge frame,
+            # so the root ingests the whole block while its ingress stays
+            # O(edges). Built here (not cached) so a verdict-retry
+            # retransmit carries fresh liveness; the model payload above
+            # is still the cached bit-identical partial.
+            from fedml_tpu.obs.fleet import attach_digest
+
+            blob = self._digest.digest(self._round)
+            blob["block"] = list(self._child_digests.values())
+            attach_digest(msg, blob)
         self._forwarded = True
         self.send_message(msg)
 
@@ -714,6 +753,9 @@ class HierFedAvgServerManager(FedAvgServerManager):
             msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
             if tr is not None:
                 msg.add_params(TRACE_KEY, tr.broadcast_ctx(rank))
+            if self._fleet is not None:
+                msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY,
+                               self._fleet.marker())
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
@@ -825,6 +867,9 @@ class HierFedAvgServerManager(FedAvgServerManager):
             if self._dtracer is not None:
                 self._dtracer.on_upload(sender,
                                         msg_params.get(TRACE_KEY))
+            if self._fleet is not None:
+                self._fleet.ingest(
+                    msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY))
             samples = msg_params.get(MyMessage.MSG_ARG_KEY_EDGE_SAMPLES)
             self.aggregator.add_edge_result(
                 sender - 1,

@@ -123,6 +123,17 @@ class MyMessage:
     MSG_ARG_KEY_RESTART_EPOCH = "restart_epoch"
     MSG_ARG_KEY_LAST_SEEN_ROUND = "last_seen_round"
     MSG_ARG_KEY_LAST_SEEN_WAVE = "last_seen_wave"
+    # fleet observability plane (docs/OBSERVABILITY.md §Fleet rollup;
+    # obs/fleet.py owns the semantics — this constant mirrors
+    # fleet.TELEMETRY_KEY, test-pinned equal): with Telemetry(fleet=True)
+    # on rank 0 every s2c frame carries a small enablement marker under
+    # this key and every uplink piggybacks the rank's compact digest
+    # (round/wave, counter deltas, phase-timing sketch, ε, memory); an
+    # edge folds its block's digests into ONE blob on its e2s_agg frame
+    # so root ingress stays O(edges). Stock peers ignore the key; with
+    # the plane off (the default) no frame carries it — the wire is
+    # byte-identical, test-enforced.
+    MSG_ARG_KEY_TELEMETRY = "__telemetry"
     # round-delta broadcast (server -> warm client): DELTA_PARAMS replaces
     # MODEL_PARAMS and BASE_VERSION names the global version the delta was
     # computed against — the client must hold exactly that version (the

@@ -199,6 +199,10 @@ class FedAvgServerManager(ServerManager):
         # Telemetry bundle opted in (trace_dir / trace=True). None = no
         # __trace params on any frame — the wire is byte-identical.
         self._dtracer = telemetry.tracer if telemetry is not None else None
+        # fleet observability plane (obs/fleet.py): present only when the
+        # bundle armed a collector (Telemetry(fleet=True)). None = no
+        # __telemetry marker on any frame — the wire is byte-identical.
+        self._fleet = getattr(telemetry, "fleet", None)
         if self._async and self._dtracer is not None:
             # the per-round distributed-trace model is sequential
             # (begin_round..finish_round); async flushes overlap in-flight
@@ -671,6 +675,12 @@ class FedAvgServerManager(ServerManager):
                                self._restart_epoch)
             if tr is not None:  # trace context rides the header scalars
                 msg.add_params(TRACE_KEY, tr.broadcast_ctx(rank))
+            if self._fleet is not None:
+                # fleet enablement marker (obs/fleet.py): tells the rank
+                # to piggyback digests on its uploads; absent with the
+                # plane off, so the wire stays byte-identical
+                msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY,
+                               self._fleet.marker())
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
@@ -923,6 +933,12 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.core.async_buffer import BufferedUpdate
 
         sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+        if self._fleet is not None:
+            # fleet digest ingest happens before every gate: a shed or
+            # stale upload still proves what its rank was doing (the
+            # fleet view is liveness telemetry, not fold accounting)
+            self._fleet.ingest(
+                msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY))
         if self._draining or self.round_idx >= self.round_num:
             # post-FINISH drain: absorb (and discard) the uploads that
             # were in flight when the job completed, then stop the loop —
@@ -1217,6 +1233,14 @@ class FedAvgServerManager(ServerManager):
         # then raise — run() re-raises the flag to the supervision driver
         # whichever thread died first
         self._sim_crash = exc
+        # black box (obs/flightrec.py): the crash is the one moment the
+        # in-memory ring MUST become durable — record the crash marker,
+        # then dump before the transport goes down
+        from fedml_tpu.obs import flightrec as _flightrec
+
+        _flightrec.flight_record("sim_crash", rank=self.rank,
+                                 round=self.round_idx, point=point, why=why)
+        _flightrec.dump_active("sim_crash")
         try:
             inner = getattr(self.com_manager, "inner", self.com_manager)
             inner.stop_receive_message()
@@ -1427,6 +1451,9 @@ class FedAvgServerManager(ServerManager):
                 # the arrival alone keeps slack computable)
                 self._dtracer.on_upload(int(sender),
                                         msg_params.get(TRACE_KEY))
+            if self._fleet is not None:
+                self._fleet.ingest(
+                    msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY))
             # proof of possession: an upload tagged round v means the
             # sender decoded broadcast v — the delta-downlink warm set
             self._rank_version[int(sender)] = int(msg_round)

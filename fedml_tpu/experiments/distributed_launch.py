@@ -249,6 +249,27 @@ def add_args(p: argparse.ArgumentParser):
                         "header). Rank 0 serves the full health verdict "
                         "(obs/health.py rule table + memory telemetry); "
                         "client ranks serve their process registry")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="arm the fleet observability plane (docs/"
+                        "OBSERVABILITY.md §Fleet rollup): every uplink "
+                        "piggybacks a compact per-rank digest (round/wave, "
+                        "counter deltas, phase-timing sketch, ε, memory) "
+                        "and rank 0 serves the merged per-rank view as "
+                        "/fleetz (watch live with scripts/fedtop.py). "
+                        "Implies telemetry on rank 0; without an explicit "
+                        "--metrics_port rank 0 binds an ephemeral HTTP "
+                        "port (logged + in the run header) and CLIENT "
+                        "ranks run no HTTP server at all — the in-band "
+                        "rollup is their export path. Every rank also "
+                        "arms a crash flight recorder (dumps under "
+                        "<telemetry-dir|ckpt-dir>/flightrec; stitch with "
+                        "scripts/report.py --post-mortem)")
+    p.add_argument("--fleet_job", "--fleet-job", dest="fleet_job",
+                   type=str, default="",
+                   help="optional job label namespacing the fleet rollup "
+                        "metric families (the reserved 'job' label on "
+                        "fed_fleet_*; run identity itself rides the run_id "
+                        "automatically)")
     p.add_argument("--trace-dir", "--trace_dir", dest="trace_dir",
                    type=str, default=None,
                    help="rank 0: enable cross-rank distributed tracing "
@@ -746,12 +767,18 @@ def main(argv=None):
     # --metrics_port N: rank r binds N + r (0 = ephemeral everywhere) —
     # live /metrics + /healthz per rank, docs/OBSERVABILITY.md §Live
     # endpoints. Rank 0's server rides its Telemetry bundle (health rules +
-    # memwatch implied); client ranks serve a bare registry endpoint.
+    # memwatch implied); client ranks serve a bare registry endpoint. With
+    # --fleet and NO explicit --metrics_port, rank 0 still binds an
+    # ephemeral port (so /fleetz exists; logged + run header) but client
+    # ranks run no HTTP server — the in-band rollup IS their export path,
+    # and N surprise listeners on a shared host is exactly what the fleet
+    # plane exists to avoid.
     rank_port = (args.metrics_port + (args.rank if args.metrics_port else 0)
                  if args.metrics_port is not None else None)
+    fleet_on = bool(args.fleet)
     metrics_server = None
     telemetry = None
-    if args.rank == 0 and (args.telemetry_dir or args.trace_dir
+    if args.rank == 0 and (args.telemetry_dir or args.trace_dir or fleet_on
                            or rank_port is not None):
         from fedml_tpu.obs import Telemetry
 
@@ -761,11 +788,13 @@ def main(argv=None):
         # endpoints are the output)
         telemetry = Telemetry(log_dir=args.telemetry_dir or args.trace_dir,
                               trace_dir=args.trace_dir,
-                              http_port=rank_port)
+                              http_port=(0 if rank_port is None and fleet_on
+                                         else rank_port),
+                              fleet=fleet_on, fleet_job=args.fleet_job)
         if telemetry.http_port is not None:
             logging.getLogger("fedml_tpu.launch").info(
-                "live endpoints: http://127.0.0.1:%d/metrics (+ /healthz)",
-                telemetry.http_port)
+                "live endpoints: http://127.0.0.1:%d/metrics (+ /healthz%s)",
+                telemetry.http_port, ", /fleetz" if fleet_on else "")
     elif args.rank != 0 and rank_port is not None:
         from fedml_tpu.obs import start_metrics_server
 
@@ -773,6 +802,24 @@ def main(argv=None):
         logging.getLogger("fedml_tpu.launch").info(
             "live endpoints: http://127.0.0.1:%d/metrics (+ /healthz)",
             metrics_server.port)
+    if fleet_on:
+        # crash flight recorder (obs/flightrec.py) on EVERY rank: rank 0's
+        # Telemetry armed one above when a log dir exists; client/edge
+        # ranks arm theirs here so a SIGKILL'd fleet still leaves durable
+        # per-rank dumps for report.py --post-mortem. All ranks share the
+        # launch argv, so <telemetry-dir|ckpt-dir>/flightrec is the same
+        # directory everywhere.
+        import os as _os
+
+        from fedml_tpu.obs.flightrec import (active_recorder,
+                                             install_flight_recorder,
+                                             install_sigterm_dump)
+
+        base = args.telemetry_dir or args.ckpt_dir
+        if args.rank != 0 and base and active_recorder() is None:
+            install_flight_recorder(
+                rank=args.rank, out_dir=_os.path.join(base, "flightrec"))
+        install_sigterm_dump()
     mgr = init_role(args, data, task, cfg, backend_kw, telemetry=telemetry)
     if args.warmup and args.rank != 0 and hasattr(mgr, "warmup"):
         # AOT-compile before blocking on the first broadcast; rides the
@@ -792,6 +839,13 @@ def main(argv=None):
             telemetry.close()
         if metrics_server is not None:
             metrics_server.close()
+        if fleet_on and args.rank != 0:
+            # rank 0's close dump rides telemetry.close(); client/edge
+            # ranks flush their ring here so even a clean run leaves the
+            # full per-rank post-mortem set
+            from fedml_tpu.obs.flightrec import dump_active
+
+            dump_active("close")
     if args.chaos_plan:
         from fedml_tpu import chaos
 

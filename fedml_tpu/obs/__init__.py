@@ -31,7 +31,14 @@ reports through:
                        on round records (``Telemetry(memwatch=True)``);
 - ``health``         — rule-driven ``HealthMonitor``: edge-triggered
                        alerts (convergence/slowdown/quorum/memory/stall)
-                       into the event log + ``fed_alerts_total``.
+                       into the event log + ``fed_alerts_total``;
+- ``fleet``          — the fleet observability plane: in-band
+                       ``__telemetry`` digests piggybacked on uplink
+                       frames, rank 0's ``FleetCollector`` + ``/fleetz``
+                       (``Telemetry(fleet=True)``);
+- ``flightrec``      — the crash flight recorder: a bounded per-process
+                       ring dumped durably on alert/SIGTERM/crash, and
+                       the ``report.py --post-mortem`` timeline stitcher.
 
 scripts/report.py renders a run's events.jsonl; docs/OBSERVABILITY.md has
 the schema and metric-name reference.
@@ -39,6 +46,12 @@ the schema and metric-name reference.
 
 from fedml_tpu.obs.comm_instrument import comm_counters
 from fedml_tpu.obs.events import EventLog, JsonlSink, MemorySink, read_jsonl
+from fedml_tpu.obs.fleet import (TELEMETRY_KEY, DigestEmitter, FleetCollector,
+                                 attach_digest)
+from fedml_tpu.obs.flightrec import (FlightRecorder, flight_record,
+                                     install_flight_recorder,
+                                     render_post_mortem,
+                                     uninstall_flight_recorder)
 from fedml_tpu.obs.health import DEFAULT_RULES, HealthMonitor
 from fedml_tpu.obs.httpd import MetricsHTTPServer, start_metrics_server
 from fedml_tpu.obs.memwatch import MemoryWatcher
@@ -50,10 +63,14 @@ from fedml_tpu.obs.tracing import (TRACE_KEY, ClientSpanBuffer,
 __all__ = [
     "DEFAULT_RULES",
     "REGISTRY",
+    "TELEMETRY_KEY",
     "TRACE_KEY",
     "ClientSpanBuffer",
+    "DigestEmitter",
     "DistributedTracer",
     "EventLog",
+    "FleetCollector",
+    "FlightRecorder",
     "HealthMonitor",
     "JsonlSink",
     "MemorySink",
@@ -62,7 +79,12 @@ __all__ = [
     "MetricsRegistry",
     "RoundTracer",
     "Telemetry",
+    "attach_digest",
     "comm_counters",
+    "flight_record",
+    "install_flight_recorder",
     "read_jsonl",
+    "render_post_mortem",
     "start_metrics_server",
+    "uninstall_flight_recorder",
 ]

@@ -270,6 +270,18 @@ def record_stale_upload(reason: str) -> None:
 _hb_lock = threading.Lock()
 _hb_last_seen: dict[int, float] = {}
 
+# Gauge-cardinality cap for fleet-sized cohorts (docs/OBSERVABILITY.md
+# §Fleet rollup): up to HEARTBEAT_RANK_CAP ranks every rank keeps its own
+# ``fed_last_heartbeat_age_seconds{rank}`` child (the small-cohort view
+# dashboards already use). Above the cap the export would grow
+# O(world_size) lines, so refresh_liveness keeps only the
+# HEARTBEAT_KEEP_STALEST stalest ranks (the ones an operator actually
+# looks for) plus a three-line rollup family
+# ``fed_heartbeat_age_rollup{stat=min|max|count}``; the full per-rank
+# ages stay queryable via ``heartbeat_ages()`` and the /fleetz view.
+HEARTBEAT_RANK_CAP = 64
+HEARTBEAT_KEEP_STALEST = 16
+
 
 @lru_cache(maxsize=256)
 def _hb_gauge(rank: int):
@@ -279,25 +291,51 @@ def _hb_gauge(rank: int):
 def record_rank_seen(rank) -> None:
     """A frame from ``rank`` arrived — reset its heartbeat age. Runs on
     the per-frame receive path, so the gauge child is memoized like the
-    other hot-path hooks (no registry-lock traffic per frame)."""
+    other hot-path hooks (no registry-lock traffic per frame). Above the
+    cardinality cap the per-rank gauge write is skipped — the stamps
+    (not the gauges) are the source of truth, and refresh_liveness owns
+    which children exist."""
     try:
         rank = int(rank)
     except (TypeError, ValueError):
         return  # interop peers may ship non-integer sender ids
     with _hb_lock:
         _hb_last_seen[rank] = time.time()
-    _hb_gauge(rank).set(0.0)
+        over = len(_hb_last_seen) > HEARTBEAT_RANK_CAP
+    if not over:
+        _hb_gauge(rank).set(0.0)
 
 
 def refresh_liveness() -> None:
-    """Recompute every rank's ``fed_last_heartbeat_age_seconds`` gauge
-    from its last-seen stamp (ages grow between frames; a gauge is a
-    snapshot, so exporters call this right before reading)."""
+    """Recompute the heartbeat-age gauges from the last-seen stamps (ages
+    grow between frames; a gauge is a snapshot, so exporters call this
+    right before reading). At or below HEARTBEAT_RANK_CAP ranks: one
+    gauge child per rank. Above it: only the HEARTBEAT_KEEP_STALEST
+    stalest ranks keep children (the rest are dropped from the family)
+    plus the min/max/count rollup — bounded export at any world size."""
     now = time.time()
     with _hb_lock:
         items = list(_hb_last_seen.items())
-    for rank, ts in items:
-        _hb_gauge(rank).set(max(0.0, now - ts))
+    if len(items) <= HEARTBEAT_RANK_CAP:
+        for rank, ts in items:
+            _hb_gauge(rank).set(max(0.0, now - ts))
+        return
+    ages = {rank: max(0.0, now - ts) for rank, ts in items}
+    keep = set(sorted(ages, key=ages.get, reverse=True)
+               [:HEARTBEAT_KEEP_STALEST])
+    for rank, age in ages.items():
+        if rank in keep:
+            REGISTRY.gauge("fed_last_heartbeat_age_seconds",
+                           rank=rank).set(age)
+        else:
+            REGISTRY.remove("fed_last_heartbeat_age_seconds", rank=rank)
+    # the memo may hold children just removed from the family — writes
+    # through it would land on orphans the export never sees
+    _hb_gauge.cache_clear()
+    vals = list(ages.values())
+    REGISTRY.gauge("fed_heartbeat_age_rollup", stat="min").set(min(vals))
+    REGISTRY.gauge("fed_heartbeat_age_rollup", stat="max").set(max(vals))
+    REGISTRY.gauge("fed_heartbeat_age_rollup", stat="count").set(len(vals))
 
 
 def heartbeat_ages(now: float | None = None) -> dict[int, float]:
@@ -318,6 +356,9 @@ def reset_heartbeats() -> None:
     mark the next job's ranks suspect)."""
     with _hb_lock:
         _hb_last_seen.clear()
+    # the memo may reference children a capped refresh removed — the next
+    # job must re-create real ones, not write through orphans
+    _hb_gauge.cache_clear()
 
 
 def suspect_ranks(ranks, max_age_s: float | None, round_idx: int,

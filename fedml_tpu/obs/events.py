@@ -92,17 +92,33 @@ class JsonlSink:
 
 class EventLog:
     """Emit structured records into a sink. Every record carries ``ts``
-    (wall clock), ``kind``, and the run id."""
+    (wall clock), ``kind``, and the run id. Observers (``add_observer``)
+    see every emitted record after the sink write — the flight recorder
+    (obs/flightrec.py) tees records into its crash ring this way; an
+    observer exception is logged-and-swallowed (telemetry fan-out must
+    never kill the emitting engine)."""
 
     def __init__(self, sink, run_id: str | None = None, clock=time.time):
         self.sink = sink
         self.run_id = run_id or time.strftime("run_%Y%m%d_%H%M%S")
         self._clock = clock
+        self._observers: list = []
+
+    def add_observer(self, fn) -> None:
+        self._observers.append(fn)
 
     def emit(self, kind: str, **fields) -> dict:
         rec = {"ts": self._clock(), "kind": kind, "run": self.run_id}
         rec.update(fields)
         self.sink.write(rec)
+        for fn in self._observers:
+            try:
+                fn(rec)
+            except Exception:  # noqa: BLE001 — see class docstring
+                import logging
+
+                logging.getLogger("fedml_tpu.obs.events").exception(
+                    "event observer failed on %r", kind)
         return rec
 
     def close(self) -> None:

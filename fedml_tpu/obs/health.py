@@ -79,6 +79,20 @@ DEFAULT_RULES: list[dict] = [
     # deterministic fault) that supervision alone would retry forever.
     # Not evaluable on runs that never restart (family absent or zero).
     {"rule": "restart_storm", "severity": "critical", "max_restarts": 3.0},
+    # fleet observability plane (docs/OBSERVABILITY.md §Fleet rollup) —
+    # the quorum/staleness rules evaluated over the FLEET view (in-band
+    # digests) instead of the transport's heartbeat gauges. Only
+    # evaluable once at least one digest arrived (fed_fleet_digests_total
+    # > 0), so a plane-off or just-booted run never false-fires.
+    # fleet_quorum: reporting ranks dropped below min_fraction of the
+    # expected cohort (+1 because rank 0's own row always reports).
+    # Additionally gated on the fleet reaching round 1 — during round 0
+    # ramp-up "reporting < expected" is boot order, not an outage.
+    {"rule": "fleet_quorum", "severity": "critical", "min_fraction": 1.0},
+    # fleet_staleness: the oldest rank's digest silence exceeded max_age_s
+    # — a rank that stopped uploading (wedged, partitioned, crashed)
+    # while the rest of the fleet rounds on.
+    {"rule": "fleet_staleness", "severity": "warning", "max_age_s": 120.0},
 ]
 
 _KNOWN_RULES = {r["rule"] for r in DEFAULT_RULES}
@@ -298,6 +312,38 @@ class HealthMonitor:
                 return None  # family pre-registered but the run is clean
             thresh = float(rule.get("max_restarts", 3.0))
             return restarts > thresh, restarts, thresh
+        if kind in ("fleet_quorum", "fleet_staleness"):
+            # fleet-view rules: read the collector's rollup gauges
+            # (obs/fleet.py). Not evaluable until a digest arrived — a
+            # plane-off run's families are absent, an armed-but-quiet
+            # boot reads zero digests; both stay silent.
+            digests = sum(snap.get("fed_fleet_digests_total", {}).values())
+            if not digests:
+                return None
+            if kind == "fleet_quorum":
+                if self.expected_ranks is None:
+                    return None
+                # ramp-up gate: rows only exist once a rank's FIRST digest
+                # lands, so during round 0 "reporting < expected" is just
+                # boot order, not an outage. Round 1 anywhere in the fleet
+                # means round 0 completed — every live rank had its chance
+                # to report, and a missing row is now a real absence.
+                rmax = snap.get("fed_fleet_round_max", {})
+                if not rmax or max(rmax.values()) < 1:
+                    return None
+                reporting = float(sum(
+                    snap.get("fed_fleet_ranks_reporting", {}).values()))
+                # +1: rank 0's own row reports alongside the cohort
+                thresh = (float(rule.get("min_fraction", 1.0))
+                          * (self.expected_ranks + 1))
+                return reporting < thresh, reporting, thresh
+            stale_fam = snap.get(
+                "fed_fleet_digest_staleness_max_seconds", {})
+            if not stale_fam:
+                return None
+            age = max(float(v) for v in stale_fam.values())
+            thresh = float(rule.get("max_age_s", 120.0))
+            return age > thresh, age, thresh
         return None
 
     def check(self) -> list[dict]:
@@ -305,6 +351,12 @@ class HealthMonitor:
         transitions emitted this call. Safe from any thread (the round
         emit path and the background checker race by design)."""
         fired: list[dict] = []
+        # staleness grows between digests: refresh the fleet rollup gauges
+        # before snapshotting so the background checker sees real ages
+        # (outside our lock — the collector has its own)
+        fleet = getattr(self.telemetry, "fleet", None)
+        if fleet is not None:
+            fleet.refresh()
         with self._lock:
             snap = self.registry.snapshot()
             for i, rule in enumerate(self.rules):

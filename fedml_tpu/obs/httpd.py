@@ -15,7 +15,11 @@ live view: a stdlib ``ThreadingHTTPServer`` per rank serving
   ``fed_ranks_alive``, seconds since last progress, quarantine/shed
   totals, status ``ok | degraded | stalled``) read from a
   ``HealthMonitor`` (obs/health.py) when one is attached, else a minimal
-  registry-only view.
+  registry-only view;
+- ``/fleetz``   — rank 0 only, with the fleet plane armed
+  (``Telemetry(fleet=True)``): the ``FleetCollector``'s aggregated JSON
+  (per-rank round/staleness/bytes/ε rows, fleet rollups, status —
+  obs/fleet.py, docs/OBSERVABILITY.md §Fleet rollup); 404 elsewhere.
 
 Opt-in like every obs feature: ``Telemetry(http_port=...)`` (port 0 binds
 an ephemeral port — the bound port is reported in the run header and on
@@ -47,10 +51,14 @@ class MetricsHTTPServer:
     idempotent."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 registry: MetricsRegistry | None = None, health=None):
+                 registry: MetricsRegistry | None = None, health=None,
+                 fleet=None):
         self.registry = registry or REGISTRY
         # the HealthMonitor feeding /healthz (None -> minimal snapshot)
         self.health = health
+        # the FleetCollector feeding /fleetz (None -> 404: only rank 0
+        # with the fleet plane armed serves the fleet view)
+        self.fleet = fleet
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -63,9 +71,20 @@ class MetricsHTTPServer:
                         body = (json.dumps(server.health_snapshot())
                                 + "\n").encode()
                         ctype = "application/json"
+                    elif self.path.split("?", 1)[0] == "/fleetz":
+                        if server.fleet is None:
+                            self.send_error(
+                                404, "no fleet collector on this rank "
+                                "(rank 0 with the fleet plane armed "
+                                "serves /fleetz)")
+                            return
+                        body = (json.dumps(server.fleet.snapshot(),
+                                           default=float) + "\n").encode()
+                        ctype = "application/json"
                     else:
                         self.send_error(404, "unknown path "
-                                        "(serving /metrics, /healthz)")
+                                        "(serving /metrics, /healthz, "
+                                        "/fleetz)")
                         return
                 except Exception:  # noqa: BLE001 — a scrape bug must not
                     #                 kill the handler thread loudly forever
@@ -83,7 +102,20 @@ class MetricsHTTPServer:
                 # the debug log, never stderr (the no-bare-print contract)
                 log.debug("httpd: " + fmt, *args)
 
-        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        try:
+            self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        except OSError as e:
+            # at fleet scale PORT+rank collides with whatever else the
+            # host runs — failing hard would kill the rank over a
+            # monitoring port. Fall back to an ephemeral bind, LOUDLY;
+            # the bound port rides the run header / server.port so every
+            # log reader still learns where to scrape.
+            if int(port) == 0:
+                raise  # an ephemeral bind that fails is a real error
+            log.error("metrics port %d unavailable (%s) — falling back "
+                      "to an ephemeral port (the bound port is in the "
+                      "run header and this log)", int(port), e)
+            self._httpd = ThreadingHTTPServer((host, 0), Handler)
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])  # bound (0 -> real)

@@ -181,6 +181,19 @@ class MetricsRegistry:
     def histogram(self, name: str, **labels) -> Histogram:
         return self._child("histogram", Histogram, name, labels)
 
+    def remove(self, name: str, **labels) -> bool:
+        """Drop one child from a family (True when it existed). The
+        cardinality-maintenance escape hatch for per-rank gauges on
+        fleet-sized cohorts (obs/comm_instrument heartbeat cap) — callers
+        must also invalidate any memo holding the dropped child, or later
+        writes land on an orphan the export never sees."""
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                return False
+            return fam[1].pop(key, None) is not None
+
     # ------------------------------------------------------------- export
     def snapshot(self) -> dict:
         """{name: {labels-as-sorted-tuple-str: value | histogram summary}}.

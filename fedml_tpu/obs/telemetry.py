@@ -50,7 +50,8 @@ class Telemetry:
                  memwatch: bool | None = None, mem_interval_s: float = 5.0,
                  health: bool | None = None, health_rules=None,
                  health_interval_s: float = 5.0,
-                 expected_ranks: int | None = None):
+                 expected_ranks: int | None = None,
+                 fleet: bool = False, fleet_job: str = ""):
         self.log_dir = log_dir
         # ``registry`` is where THIS bundle's own metrics live and what
         # close() dumps. Comm deltas always read the process-wide REGISTRY
@@ -90,7 +91,8 @@ class Telemetry:
         self.httpd = None
         self.http_port = None
         if health is None:
-            health = health_rules is not None or http_port is not None
+            health = (health_rules is not None or http_port is not None
+                      or fleet)
         if memwatch is None:
             memwatch = http_port is not None
         if health:
@@ -105,13 +107,45 @@ class Telemetry:
 
             self.memwatch = MemoryWatcher(interval_s=mem_interval_s,
                                           registry=self.registry).start()
+        # --- fleet observability plane (docs/OBSERVABILITY.md §Fleet
+        # rollup): rank 0's digest collector. The engines read
+        # ``telemetry.fleet`` to decide whether broadcasts carry the
+        # in-band marker; off (the default) keeps the wire byte-identical.
+        self.fleet = None
+        if fleet:
+            from fedml_tpu.obs.fleet import FleetCollector
+
+            self.fleet = FleetCollector(run_id=self.events.run_id,
+                                        job=fleet_job,
+                                        registry=self.registry,
+                                        expected_ranks=expected_ranks,
+                                        health=self.health)
+            # with the plane armed and a file-backed run, arm the crash
+            # flight recorder too (no recorder installed yet — a launcher
+            # that installed its own wins): its dumps land next to the
+            # event log, where report.py --post-mortem looks first
+            from fedml_tpu.obs import flightrec as _flightrec
+
+            if log_dir and _flightrec.active_recorder() is None:
+                _flightrec.install_flight_recorder(
+                    rank=0, run_id=self.events.run_id,
+                    out_dir=os.path.join(log_dir, "flightrec"),
+                    registry=self.registry)
         if http_port is not None:
             from fedml_tpu.obs.httpd import MetricsHTTPServer
 
             self.httpd = MetricsHTTPServer(port=http_port, host=http_host,
                                            registry=self.registry,
-                                           health=self.health)
+                                           health=self.health,
+                                           fleet=self.fleet)
             self.http_port = self.httpd.port
+        # the flight recorder tees every emitted record into its crash
+        # ring and dumps on alert-fire; the observer routes through the
+        # module-level hook so install order does not matter (no-op until
+        # a recorder is armed)
+        from fedml_tpu.obs import flightrec as _flightrec
+
+        self.events.add_observer(_flightrec.on_event)
         self._header_emitted = False
         self._last_comm = comm_counters(REGISTRY)
 
@@ -130,6 +164,9 @@ class Telemetry:
                 and isinstance(fields.get("world_size"), int)):
             # the quorum rule's cohort: everyone but the server rank
             self.health.expected_ranks = fields["world_size"] - 1
+        if (self.fleet is not None and self.fleet.expected_ranks is None
+                and isinstance(fields.get("world_size"), int)):
+            self.fleet.expected_ranks = fields["world_size"] - 1
         self.events.emit("run", config=config or {}, **fields)
 
     def comm_delta(self) -> dict:
@@ -177,6 +214,11 @@ class Telemetry:
                 rec["mem"] = mem
         rec.update(extra)
         out = self.events.emit("round", **rec)
+        if self.fleet is not None:
+            # rank 0's own /fleetz row: round progress + the DP ε the
+            # round record already carries (no wire hop for the server)
+            self.fleet.note_server(round_idx,
+                                   eps=(rec.get("privacy") or {}).get("eps"))
         if self.health is not None:
             # the per-round health hook: every engine that emits a round
             # record (standalone, pipelined drain, sync server, async
@@ -208,6 +250,12 @@ class Telemetry:
         Prometheus text dump of the registry next to it. With tracing on
         and a trace_dir, write the stitched Chrome trace (trace.json —
         load it in Perfetto / chrome://tracing)."""
+        from fedml_tpu.obs import flightrec as _flightrec
+
+        # final black-box dump before anything is torn down — a clean
+        # close leaves the same durable artifact a crash would, so a
+        # post-mortem on a *successful* run also renders
+        _flightrec.dump_active("close")
         if self.httpd is not None:
             self.httpd.close()
         if self.memwatch is not None:

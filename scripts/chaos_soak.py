@@ -77,7 +77,7 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              update_codec: str | None = None,
              sparsify_ratio: float | None = None,
              edges: int | None = None,
-             sum_assoc: str = "auto") -> dict:
+             sum_assoc: str = "auto", fleet: bool = False) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
@@ -115,7 +115,10 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     # exists for, and its alert ledger becomes part of the summary —
     # notably the quorum rule must fire once per crash window and resolve
     # once the reprobe readmits the rank (asserted below)
-    tel = Telemetry(health=True)
+    # --fleet rides the same bundle: every trial then also exercises the
+    # in-band digest plane under fault pressure, and the record gains the
+    # close-time /fleetz rollup (which ranks still reported through chaos)
+    tel = Telemetry(health=True, fleet=fleet)
     t0 = time.perf_counter()
     err = None
     agg = None
@@ -142,6 +145,12 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
     finally:
+        fleet_snap = None
+        if tel.fleet is not None:
+            s = tel.fleet.snapshot()
+            fleet_snap = {"status": s["status"],
+                          "ranks_reporting": s["ranks_reporting"],
+                          "digests_total": s["digests_total"]}
         tel.close()
     completed = bool(agg and agg.history
                      and agg.history[-1]["round"] == rounds - 1)
@@ -193,6 +202,7 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
         "alerts": alerts,
         "crash_windows": crash_rounds,
         **({"fan_in": fan_in} if fan_in else {}),
+        **({"fleet": fleet_snap} if fleet_snap else {}),
         "completed_rounds": (agg.history[-1]["round"] + 1
                              if agg and agg.history else 0),
         "faults": plan.ledger.counts(),
@@ -394,6 +404,12 @@ def main(argv=None) -> int:
                          "lost slot ledgered server_restart). Recovery "
                          "runs the real checkpoint + WAL + resume-probe "
                          "path per trial; excludes the other tiers")
+    ap.add_argument("--fleet", action="store_true",
+                    help="arm the fleet observability plane on every trial "
+                         "(docs/OBSERVABILITY.md §Fleet rollup): uplinks "
+                         "piggyback per-rank digests and each trial record "
+                         "gains the close-time /fleetz rollup — which "
+                         "ranks still reported through the fault weather")
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
     if args.server_crash and (args.edges or args.async_buffer_k
@@ -492,7 +508,8 @@ def main(argv=None) -> int:
         rec = run_plan(data, task, plan, rounds=args.rounds,
                        world_size=args.world_size, adversary_plan=adv(),
                        aggregator=aggregator, edges=args.edges,
-                       async_buffer_k=args.async_buffer_k, **codec_kw)
+                       async_buffer_k=args.async_buffer_k,
+                       fleet=args.fleet, **codec_kw)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
@@ -502,7 +519,8 @@ def main(argv=None) -> int:
                             rounds=args.rounds, world_size=args.world_size,
                             adversary_plan=adv(), aggregator=aggregator,
                             edges=args.edges,
-                            async_buffer_k=args.async_buffer_k, **codec_kw)
+                            async_buffer_k=args.async_buffer_k,
+                            fleet=args.fleet, **codec_kw)
             if args.async_buffer_k or args.edges:
                 # async dispatch counts and arrival order are
                 # thread-scheduled, so even per-link fault draws shift

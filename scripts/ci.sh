@@ -752,6 +752,104 @@ print(f"supervised server-restart smoke ok: {len(rounds)} round records "
       f"across the kill, fed_server_restarts_total == 1, restarts column "
       f"rendered")
 PY
+  echo "== fleet observability smoke (3-rank gRPC fleet under --supervise --fleet; mid-run /fleetz + fedtop --once; SIGKILL -> flight dumps + post-mortem timeline) =="
+  # the fleet plane end-to-end on REAL processes (docs/OBSERVABILITY.md
+  # §Fleet rollup / §Flight recorder & post-mortem): clients fold in-band
+  # digests onto their uplinks (no client HTTP servers — --fleet without
+  # --metrics_port on the client ranks), rank 0's /fleetz shows a row per
+  # rank mid-run, fedtop --once renders the live rollup, then the server
+  # child dies by SIGKILL under --supervise — the restarted child finishes
+  # the campaign and report.py --post-mortem stitches WAL + per-rank
+  # flight dumps into one timeline (restart epoch + starred pre-crash
+  # client events)
+  FLEET_DIR=./tmp/ci_fleet; rm -rf "$FLEET_DIR"; mkdir -p "$FLEET_DIR"
+  FLEET_WORLD=3; FLEET_PORT=50640; FLEET_HTTP=50680
+  # seeded straggle on the client uplinks pins the round cadence at >= 1s:
+  # with a warm compile cache the whole campaign otherwise finishes before
+  # the mid-run scrape window opens (no spaces in the JSON — FLEET_ARGS
+  # expands unquoted)
+  FLEET_CHAOS='{"seed":7,"rules":[{"fault":"straggle","src":[1,2],"dst":[0],"delay_s":1.0}]}'
+  FLEET_ARGS="--world_size $FLEET_WORLD --backend grpc --base_port $FLEET_PORT \
+    --dataset synthetic --model lr --client_num_in_total 2 \
+    --comm_round 10 --batch_size 10 --lr 0.1 --frequency_of_the_test 1 \
+    --chaos_plan $FLEET_CHAOS \
+    --fleet 1 --fleet_job ci --telemetry-dir $FLEET_DIR/tel"
+  python -m fedml_tpu.experiments.distributed_launch --rank 0 $FLEET_ARGS \
+    --metrics_port $FLEET_HTTP --round_timeout_s 30 --supervise 2 \
+    --ckpt_dir "$FLEET_DIR/ckpt" > "$FLEET_DIR/server.out" 2>&1 &
+  FLEET_PID=$!
+  FLEET_CLIENT_PIDS=""
+  for r in $(seq 1 $((FLEET_WORLD - 1))); do
+    python -m fedml_tpu.experiments.distributed_launch --rank "$r" \
+      $FLEET_ARGS > "$FLEET_DIR/client$r.out" 2>&1 &
+    FLEET_CLIENT_PIDS="$FLEET_CLIENT_PIDS $!"
+  done
+  # mid-run: wait for every rank's /fleetz row AND a committed round, scrape
+  # the rollup, prove fedtop --once against the live endpoint, then SIGKILL
+  # the server child dead — no goodbyes, the flight recorder's moment
+  python - "$FLEET_DIR" "$FLEET_HTTP" <<'PY'
+import glob, json, os, signal, subprocess, sys, time, urllib.request
+
+d, port = sys.argv[1], int(sys.argv[2])
+url = f"http://127.0.0.1:{port}/fleetz"
+fleetz = None
+for _ in range(480):
+    try:
+        cand = json.loads(urllib.request.urlopen(url, timeout=2).read())
+        rows = cand.get("ranks", {})
+        # round >= 1 on every client row: a round-0 digest precedes the
+        # first uplink byte accounting, so the bytes assertion below
+        # would race it
+        if (set(rows) >= {"0", "1", "2"}
+                and all((rows[r].get("round") or 0) >= 1
+                        for r in ("1", "2"))
+                and glob.glob(os.path.join(d, "ckpt", "round_*"))
+                and os.path.exists(os.path.join(d, "ckpt", "server.pid"))):
+            fleetz = cand
+            break
+    except OSError:
+        pass
+    time.sleep(0.25)
+assert fleetz, "/fleetz never showed all 3 rank rows before the deadline"
+assert fleetz["status"] == "ok" and fleetz["run"], fleetz
+assert fleetz["job"] == "ci", fleetz
+clients = {r: row for r, row in fleetz["ranks"].items() if r != "0"}
+assert all(row.get("bytes_uplink", 0) > 0 for row in clients.values()), clients
+top = subprocess.run(
+    [sys.executable, "scripts/fedtop.py", "--url", f"127.0.0.1:{port}",
+     "--once"], capture_output=True, text=True)
+assert top.returncode == 0, top.stderr[:400]
+assert "run=" in top.stdout and "job=ci" in top.stdout, top.stdout[:400]
+pid = int(open(os.path.join(d, "ckpt", "server.pid")).read())
+os.kill(pid, signal.SIGKILL)
+print(f"mid-run fleet ok: /fleetz rows {sorted(fleetz['ranks'])}, "
+      f"fedtop --once rendered, SIGKILLed server child {pid}")
+PY
+  echo "-- waiting for the supervised fleet campaign to complete"
+  wait $FLEET_PID
+  for p in $FLEET_CLIENT_PIDS; do wait "$p"; done
+  python - "$FLEET_DIR" <<'PY'
+import glob, json, re, subprocess, sys
+
+d = sys.argv[1]
+recs = [json.loads(l) for l in open(f"{d}/tel/events.jsonl")]
+rounds = [r for r in recs if r.get("kind") == "round"]
+assert max(r["round"] for r in rounds) == 9, \
+    f"campaign did not complete: {sorted(r['round'] for r in rounds)}"
+dumps = {json.load(open(p))["rank"]
+         for p in glob.glob(f"{d}/tel/flightrec/rank*.json")}
+assert dumps >= {1, 2}, f"client ranks left no flight dumps: {sorted(dumps)}"
+pm = subprocess.run(
+    [sys.executable, "scripts/report.py", f"{d}/tel/events.jsonl",
+     "--post-mortem", "--wal-dir", f"{d}/ckpt/wal"],
+    capture_output=True, text=True, check=True).stdout
+assert ">>> restart" in pm and "restart epoch 1" in pm, pm[:600]
+assert re.search(r"\* flight:[12]\b", pm), \
+    "no starred pre-crash client flight event:\n" + pm[:600]
+print(f"fleet post-mortem ok: {len(rounds)} round records across the kill, "
+      f"flight dumps from ranks {sorted(dumps)}, timeline rendered with "
+      f"restart epoch + pre-crash client events")
+PY
   echo "CI GREEN (smoke tier — run 'scripts/ci.sh full' for the whole gate)"
   exit 0
 fi

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""fedtop — live terminal dashboard over a run's ``/fleetz`` endpoint.
+
+    python scripts/fedtop.py                        # default endpoint
+    python scripts/fedtop.py --url http://127.0.0.1:9100/fleetz
+    python scripts/fedtop.py --once                 # single shot (CI)
+
+Polls rank 0's fleet snapshot (distributed_launch --fleet, or
+Telemetry(fleet=True, http_port=...)) and renders the per-rank view:
+liveness, round/wave progress, cumulative wire bytes, ε, memory, and any
+active health alerts — the at-a-glance answer to "is the fleet making
+progress, and which rank is the problem". stdlib only; docs/
+OBSERVABILITY.md §fedtop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+
+DEFAULT_URL = "http://127.0.0.1:9100/fleetz"
+
+
+def fetch(url: str, timeout_s: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024.0
+    return f"{n:.1f}TiB"
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(snap: dict) -> str:
+    """One frame: header, per-rank table, alerts."""
+    head = (f"fleet: run={snap.get('run') or '-'}"
+            f"{' job=' + snap['job'] if snap.get('job') else ''}"
+            f"  status={snap.get('status', '?')}"
+            f"  ranks={snap.get('ranks_reporting', 0)}"
+            f"/{snap.get('expected_ranks') if snap.get('expected_ranks') is not None else '?'}"
+            f"  digests={snap.get('digests_total', 0)}")
+    rollup = snap.get("rollup") or {}
+    head2 = (f"rounds [{_fmt(rollup.get('round_min'))}"
+             f"..{_fmt(rollup.get('round_max'))}]"
+             f"  up={_fmt_bytes(rollup.get('bytes_uplink'))}"
+             f"  down={_fmt_bytes(rollup.get('bytes_downlink'))}"
+             f"  eps_max={_fmt(rollup.get('eps_max'))}"
+             f"  stalest={_fmt(rollup.get('staleness_max_s'))}s")
+    cols = ("rank", "status", "round", "wave", "stale_s", "up", "down",
+            "eps", "rss", "dev")
+    rows = []
+    for rank in sorted(snap.get("ranks", {}), key=int):
+        r = snap["ranks"][rank]
+        rows.append((rank, r.get("status", "?"), _fmt(r.get("round")),
+                     _fmt(r.get("wave")), _fmt(r.get("staleness_s")),
+                     _fmt_bytes(r.get("bytes_uplink")),
+                     _fmt_bytes(r.get("bytes_downlink")),
+                     _fmt(r.get("eps")), _fmt_bytes(r.get("rss_bytes")),
+                     _fmt_bytes(r.get("device_bytes"))))
+    lines = [head, head2, ""]
+    if rows:
+        widths = [max(len(cols[i]), *(len(r[i]) for r in rows))
+                  for i in range(len(cols))]
+        lines.append("  ".join(c.rjust(w) for c, w in zip(cols, widths)))
+        lines.append("  ".join("-" * w for w in widths))
+        lines.extend("  ".join(v.rjust(w) for v, w in zip(r, widths))
+                     for r in rows)
+    else:
+        lines.append("(no rank digests yet)")
+    alerts = snap.get("alerts") or []
+    if alerts:
+        lines.append("")
+        lines.append("alerts:")
+        lines.extend(f"  {a.get('severity', '?'):<9}{a.get('rule', '?'):<16}"
+                     f"value={_fmt(a.get('value'))} "
+                     f"threshold={_fmt(a.get('threshold'))}"
+                     for a in alerts)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fedtop")
+    p.add_argument("--url", default=DEFAULT_URL,
+                   help=f"/fleetz endpoint (default {DEFAULT_URL})")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="poll interval in seconds (watch mode)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit 0 (CI-friendly)")
+    args = p.parse_args(argv)
+    url = args.url if "://" in args.url else f"http://{args.url}"
+    if not url.rstrip("/").endswith("/fleetz"):
+        url = url.rstrip("/") + "/fleetz"
+    while True:
+        try:
+            snap = fetch(url)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"fedtop: {url}: {e}", file=sys.stderr)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if args.once:
+            print(render(snap))
+            return 0
+        # ANSI clear + home: a poor man's top(1) frame flip
+        sys.stdout.write("\x1b[2J\x1b[H" + render(snap) + "\n")
+        sys.stdout.flush()
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

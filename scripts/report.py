@@ -196,6 +196,21 @@ def main(argv=None) -> int:
                         "per-rank slack, chaos-injected delay) from a "
                         "tracing-enabled run's round records; logs that "
                         "predate tracing degrade to a notice")
+    p.add_argument("--post-mortem", action="store_true",
+                   help="stitch one crash timeline from the run's WAL, the "
+                        "per-rank flight-recorder dumps, and the event "
+                        "log's alert/header records (obs/flightrec.py, "
+                        "docs/OBSERVABILITY.md §Flight recorder & post-"
+                        "mortem); restart records are flagged and the "
+                        "pre-crash window starred. Logs that predate the "
+                        "fleet plane degrade to a notice")
+    p.add_argument("--wal-dir", default=None, metavar="DIR",
+                   help="--post-mortem: the server's WAL directory "
+                        "(default: <events dir>/wal, the launcher's "
+                        "--ckpt_dir layout)")
+    p.add_argument("--flightrec-dir", default=None, metavar="DIR",
+                   help="--post-mortem: the per-rank flight-dump directory "
+                        "(default: <events dir>/flightrec)")
     args = p.parse_args(argv)
 
     from fedml_tpu.obs.events import read_jsonl
@@ -218,6 +233,15 @@ def main(argv=None) -> int:
     if args.critical_path:
         print()
         print(render_critical_path(records))
+    if args.post_mortem:
+        from fedml_tpu.obs.flightrec import render_post_mortem
+
+        base = os.path.dirname(os.path.abspath(args.events))
+        wal_dir = args.wal_dir or os.path.join(base, "wal")
+        flight_dir = args.flightrec_dir or os.path.join(base, "flightrec")
+        print()
+        print(render_post_mortem(wal_dir=wal_dir, flight_dir=flight_dir,
+                                 events=records))
 
     if args.csv:
         cols = write_csv(records, args.csv)

@@ -1,0 +1,508 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md §Fleet rollup,
+§Flight recorder & post-mortem, §fedtop).
+
+Load-bearing oracles:
+
+- with the plane off no frame carries ``__telemetry`` (wire byte-identical
+  to the pre-fleet build) and arming it does not perturb training — final
+  models match bitwise;
+- a 3-rank flat run AND a 2-tier ``edges=`` run both land per-rank rows
+  for EVERY rank in ``/fleetz`` (edges fold their block's digests, root
+  ingress stays O(edges));
+- digest overhead, measured from ``comm_bytes_total{codec=json,
+  direction=telemetry}``, averages ≤ ``DIGEST_BYTE_BUDGET`` per digest;
+- a supervised server crash leaves durable flight dumps that
+  ``render_post_mortem`` stitches with the WAL into one timeline (restart
+  anchor, starred pre-crash window, client-rank breadcrumbs);
+- concurrent scrapes of /metrics + /healthz + /fleetz during emits and
+  log rotation never tear: the final live scrape's counter totals equal
+  the ``metrics.prom`` dump (the PR-10 pin extended to fleet families).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from fedml_tpu.obs import flightrec
+from fedml_tpu.obs.events import EventLog, MemorySink, read_jsonl
+from fedml_tpu.obs.fleet import (DIGEST_BYTE_BUDGET, TELEMETRY_KEY,
+                                 DigestEmitter, FleetCollector, attach_digest)
+from fedml_tpu.obs.flightrec import (FlightRecorder, read_flight_dumps,
+                                     render_post_mortem)
+from fedml_tpu.obs.health import HealthMonitor
+from fedml_tpu.obs.httpd import MetricsHTTPServer
+from fedml_tpu.obs.metrics import REGISTRY, MetricsRegistry
+from fedml_tpu.obs.telemetry import Telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scrape(url: str):
+    return urllib.request.urlopen(url, timeout=5).read().decode()
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "scripts", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _telemetry_bytes() -> float:
+    return float(REGISTRY.snapshot().get("comm_bytes_total", {}).get(
+        "codec=json,direction=telemetry", 0.0))
+
+
+@pytest.fixture(scope="module")
+def sim_setup():
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=4, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=24, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4,
+                       client_num_per_round=2, batch_size=6,
+                       frequency_of_the_test=1)
+    return data, task, cfg
+
+
+# ------------------------------------------------------------ digest units
+def test_telemetry_key_pinned_to_protocol_vocabulary():
+    from fedml_tpu.distributed.fedavg.message_define import MyMessage
+
+    assert MyMessage.MSG_ARG_KEY_TELEMETRY == TELEMETRY_KEY == "__telemetry"
+
+
+def test_digest_shape_and_byte_budget():
+    em = DigestEmitter(rank=3, run_id="r-unit", registry=MetricsRegistry())
+    for _ in range(5):
+        with em.phase("local_fit"):
+            time.sleep(0.001)
+    blob = em.digest(5, wave=2, eps=1.25)
+    assert blob["rank"] == 3 and blob["round"] == 5 and blob["wave"] == 2
+    assert blob["run"] == "r-unit" and blob["eps"] == 1.25
+    p50, p95, p99 = blob["spans"]["local_fit"]
+    assert 0.0 < p50 <= p95 <= p99
+    # the documented budget, measured exactly as attach_digest accounts it
+    wire = len(json.dumps(blob, default=float).encode())
+    assert wire <= DIGEST_BYTE_BUDGET
+    # attach: the blob rides the frame under the pinned key and its bytes
+    # land under the telemetry direction (never uplink/downlink)
+    before = _telemetry_bytes()
+    msg = types.SimpleNamespace(params={})
+    msg.add_params = msg.params.__setitem__
+    attach_digest(msg, blob)
+    assert msg.params[TELEMETRY_KEY] is blob
+    assert _telemetry_bytes() - before == wire
+
+    em2 = DigestEmitter(1)
+    em2.on_downlink({"run": "adopted"})
+    assert em2.run_id == "adopted"  # digests label with the SERVER's run
+
+
+def test_marker_carries_run_and_job():
+    reg = MetricsRegistry()
+    col = FleetCollector(run_id="r1", registry=reg)
+    assert col.marker() == {"run": "r1"}
+    col2 = FleetCollector(run_id="r1", job="tenant-a", registry=reg)
+    assert col2.marker() == {"run": "r1", "job": "tenant-a"}
+
+
+def test_ingest_unrolls_edge_block_into_per_rank_rows():
+    reg = MetricsRegistry()
+    col = FleetCollector(run_id="r2", registry=reg)
+    col.ingest({"rank": 1, "round": 2, "ctr": {"bytes_uplink": 10},
+                "block": [{"rank": 3, "round": 2, "eps": 0.5},
+                          {"rank": 4, "round": 1}]})
+    snap = col.snapshot()
+    assert set(snap["ranks"]) == {"1", "3", "4"}
+    assert snap["digests_total"] == 3  # edge + its two children
+    assert snap["rollup"]["round_min"] == 1
+    assert snap["rollup"]["round_max"] == 2
+    assert snap["rollup"]["eps_max"] == 0.5
+    assert snap["ranks"]["1"]["bytes_uplink"] == 10
+    col.ingest("garbage")  # a malformed blob must never kill the dispatch
+    assert col.snapshot()["digests_total"] == 3
+
+
+# ------------------------------------------------------------ health rules
+def test_fleet_rules_gate_rampup_then_fire():
+    """fleet_quorum stays silent through round-0 ramp-up (rows appear one
+    by one as first digests land) and only fires once the fleet reached
+    round 1 with a rank still missing; fleet_staleness fires when the
+    oldest digest's silence crosses max_age_s."""
+    t = [1000.0]
+    reg = MetricsRegistry()
+    col = FleetCollector(run_id="rq", registry=reg, expected_ranks=3,
+                         clock=lambda: t[0])
+    mon = HealthMonitor(telemetry=types.SimpleNamespace(
+                            fleet=col, events=EventLog(MemorySink())),
+                        registry=reg, expected_ranks=3,
+                        rules=[{"rule": "fleet_quorum",
+                                "severity": "critical",
+                                "min_fraction": 1.0},
+                               {"rule": "fleet_staleness",
+                                "severity": "warning", "max_age_s": 30.0}])
+    assert mon.check() == []  # plane armed, no digest yet: not evaluable
+    col.ingest({"rank": 1, "round": 0})
+    col.note_server(0)
+    assert mon.check() == []  # round-0 ramp-up: 2/4 reporting is boot order
+    col.ingest({"rank": 2, "round": 0})
+    col.ingest({"rank": 3, "round": 0})
+    col.ingest({"rank": 1, "round": 1})  # fleet reaches round 1, all rows in
+    assert mon.check() == []  # healthy: 4/4 — the gate never false-fired
+    # rank silence: staleness crosses the rule threshold
+    t[0] += 60.0
+    fired = mon.check()
+    assert [a["rule"] for a in fired] == ["fleet_staleness"]
+    assert fired[0]["value"] > 30.0
+
+    # a rank that NEVER reported: quorum fires once round 1 is reached
+    reg2 = MetricsRegistry()
+    col2 = FleetCollector(run_id="rq2", registry=reg2, expected_ranks=3,
+                          clock=lambda: t[0])
+    mon2 = HealthMonitor(telemetry=types.SimpleNamespace(
+                             fleet=col2, events=EventLog(MemorySink())),
+                         registry=reg2, expected_ranks=3,
+                         rules=[{"rule": "fleet_quorum",
+                                 "severity": "critical",
+                                 "min_fraction": 1.0}])
+    col2.ingest({"rank": 1, "round": 0})
+    col2.ingest({"rank": 2, "round": 0})
+    col2.note_server(0)
+    assert mon2.check() == []  # still ramp-up (round_max == 0)
+    col2.ingest({"rank": 1, "round": 1})
+    fired = mon2.check()
+    assert [a["rule"] for a in fired] == ["fleet_quorum"]
+    assert fired[0]["value"] == 3.0 and fired[0]["threshold"] == 4.0
+
+
+# --------------------------------------------------- end-to-end (loopback)
+def test_fleet_off_wire_and_model_identical(sim_setup, monkeypatch):
+    """Acceptance: with the plane off no frame carries ``__telemetry``
+    (byte-identical wire) and arming it does not perturb training —
+    final models bitwise equal."""
+    from fedml_tpu.comm.message import Message, pack_pytree
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    frames = []
+    orig = Message.to_bytes
+    monkeypatch.setattr(Message, "to_bytes",
+                        lambda self, codec=None: frames.append(
+                            f := orig(self, codec)) or f)
+    agg_plain = run_simulated(*sim_setup, job_id="t-fleet-off")
+    assert frames and not any(b"__telemetry" in f for f in frames)
+
+    frames.clear()
+    tel = Telemetry(fleet=True)
+    agg_fleet = run_simulated(*sim_setup, job_id="t-fleet-on",
+                              telemetry=tel)
+    tel.close()
+    assert any(b"__telemetry" in f for f in frames)
+    for a, b in zip(pack_pytree(agg_plain.net), pack_pytree(agg_fleet.net)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flat_fleetz_over_http_and_byte_budget(sim_setup):
+    """3-rank flat run with the plane armed: /fleetz serves a per-rank row
+    for EVERY rank, the rollup tracks round progress, and the measured
+    per-digest wire overhead stays ≤ DIGEST_BYTE_BUDGET."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    bytes_before = _telemetry_bytes()
+    tel = Telemetry(fleet=True, http_port=0, memwatch=False)
+    run_simulated(*sim_setup, job_id="t-fleetz", telemetry=tel)
+    snap = json.loads(_scrape(tel.httpd.url("/fleetz")))
+    overhead = _telemetry_bytes() - bytes_before
+    tel.close()
+
+    assert set(snap["ranks"]) == {"0", "1", "2"}  # server + both clients
+    assert snap["status"] == "ok" and snap["ranks_reporting"] == 3
+    assert snap["expected_ranks"] == 2  # inferred from the run header
+    assert snap["run"] == tel.events.run_id
+    assert snap["rollup"]["round_max"] == 1  # both rounds ran
+    for r in ("1", "2"):
+        assert snap["ranks"][r]["bytes_uplink"] > 0
+        assert snap["ranks"][r]["spans"]  # phase sketch rode the digest
+    assert snap["digests_total"] >= 2
+    assert overhead / snap["digests_total"] <= DIGEST_BYTE_BUDGET
+    # plane bytes never pollute the round records' wire accounting
+    rounds = [r for r in tel.events.sink.records if r["kind"] == "round"]
+    assert all(r["comm"]["bytes_uplink"] + r["comm"]["bytes_downlink"]
+               <= r["comm"]["bytes_sent"] for r in rounds)
+
+
+def test_hierarchical_fleetz_reports_every_rank(sim_setup):
+    """2-tier run (1 root + 2 edges + 4 workers): every rank lands its own
+    /fleetz row — workers' digests ride the edges' folded blobs, so the
+    per-rank view is tier-agnostic while root ingress stays O(edges)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    data, task, _ = sim_setup
+    cfg = FedAvgConfig(comm_round=2, client_num_in_total=4,
+                       client_num_per_round=4, batch_size=6,
+                       frequency_of_the_test=1)
+    tel = Telemetry(fleet=True)
+    run_simulated(data, task, cfg, edges=2, job_id="t-fleet-hier",
+                  telemetry=tel)
+    snap = tel.fleet.snapshot()
+    tel.close()
+    assert set(snap["ranks"]) == {str(r) for r in range(7)}
+    assert snap["expected_ranks"] == 6
+    assert snap["rollup"]["round_max"] == 1
+    # the root heard O(edges) telemetry frames, yet all 4 workers report
+    for r in ("3", "4", "5", "6"):
+        assert snap["ranks"][r]["round"] is not None
+
+
+# ----------------------------------------------------- flight recorder
+def test_flight_ring_bounded_and_alert_dump(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("fed_fleet_digests_total", run="r").inc(5)
+    rec = FlightRecorder(rank=2, run_id="r-fr", out_dir=str(tmp_path),
+                         capacity=8, registry=reg)
+    for i in range(50):
+        rec.record("digest", round=i)
+    assert len(rec.records()) == 8  # bounded black box
+    assert rec.records()[-1]["round"] == 49
+    rec.on_event({"kind": "round", "round": 50, "ts": 1.0})
+    assert not os.listdir(str(tmp_path))  # plain records never dump
+    rec.on_event({"kind": "alert", "rule": "stall", "ts": 2.0})
+    dumps = read_flight_dumps(str(tmp_path))
+    assert len(dumps) == 1 and dumps[0]["rank"] == 2
+    assert dumps[0]["reason"] == "alert"
+    assert dumps[0]["counters"]["fed_fleet_digests_total{run=r}"] == 5.0
+    kinds = [r["kind"] for r in dumps[0]["ring"]]
+    assert "alert" in kinds  # the firing record itself is in the box
+
+
+def test_crash_leaves_flight_dumps_and_post_mortem_renders(sim_setup,
+                                                           tmp_path):
+    """Acceptance: a supervised rank-0 crash leaves durable flight dumps
+    (the pre-crash ring, dumped at sim_crash time) that render_post_mortem
+    stitches with the WAL into one timeline — restart anchor, starred
+    pre-crash window, client-rank digest breadcrumbs."""
+    from fedml_tpu.chaos import FaultPlan
+    from fedml_tpu.distributed.fedavg import run_simulated
+
+    d = str(tmp_path / "run")
+    os.makedirs(d)
+    flightrec.uninstall_flight_recorder()  # a prior test's box must not leak
+    try:
+        tel = Telemetry(log_dir=d, fleet=True, memwatch=False)
+        assert flightrec.active_recorder() is not None  # auto-armed
+        plan = FaultPlan.from_json({"seed": 1, "rules": [
+            {"fault": "crash", "ranks": [0], "rounds": [1, 2]}]})
+        data, task, cfg = sim_setup
+        agg = run_simulated(data, task, cfg, job_id="t-fleet-crash",
+                            telemetry=tel, chaos_plan=plan,
+                            round_timeout_s=2.0,
+                            ckpt_dir=str(tmp_path / "ck"))
+        tel.close()
+        assert agg.history[-1]["round"] == 1  # the run completed post-crash
+
+        dumps = read_flight_dumps(os.path.join(d, "flightrec"))
+        assert [b["rank"] for b in dumps] == [0]
+        ring = dumps[0]["ring"]
+        assert any(r["kind"] == "sim_crash" for r in ring)
+        # client-rank breadcrumbs: in-process loopback shares the box, so
+        # the digest/ingest records carry the CLIENT's rank field
+        assert any(r["kind"] == "digest" and r.get("rank", 0) >= 1
+                   for r in ring)
+
+        pm = render_post_mortem(wal_dir=str(tmp_path / "ck" / "wal"),
+                                flight_dir=os.path.join(d, "flightrec"),
+                                events=read_jsonl(
+                                    os.path.join(d, "events.jsonl")))
+        assert ">>> restart" in pm and "restart epoch 1" in pm
+        assert "sim_crash" in pm
+        assert "crash anchor" in pm
+        assert any(" * " in ln for ln in pm.splitlines())  # starred window
+
+        # the CLI path: report.py --post-mortem renders the same timeline
+        report = _load_script("report")
+        assert report.main([os.path.join(d, "events.jsonl"),
+                            "--post-mortem",
+                            "--wal-dir", str(tmp_path / "ck" / "wal")]) == 0
+    finally:
+        flightrec.uninstall_flight_recorder()
+
+
+def test_post_mortem_graceful_on_pre_fleet_inputs(tmp_path):
+    """Logs that predate the plane degrade to a notice, never a crash —
+    the same contract every report.py column follows."""
+    notice = render_post_mortem(wal_dir=str(tmp_path / "nope"),
+                                flight_dir=str(tmp_path / "nope2"),
+                                events=[])
+    assert "no post-mortem inputs" in notice
+    # a pre-fleet events.jsonl through the CLI: exit 0, notice printed
+    p = tmp_path / "events.jsonl"
+    p.write_text(json.dumps({"kind": "round", "round": 0, "metrics": {},
+                             "spans": {}}) + "\n")
+    report = _load_script("report")
+    assert report.main([str(p), "--post-mortem"]) == 0
+
+
+# ------------------------------------------------- cardinality + endpoints
+def test_heartbeat_gauge_cardinality_capped():
+    """Above HEARTBEAT_RANK_CAP ranks the per-rank heartbeat family keeps
+    only the KEEP_STALEST stalest children plus a min/max/count rollup —
+    the export stays bounded at any world size."""
+    from fedml_tpu.obs import comm_instrument as ci
+
+    ci.reset_heartbeats()
+    try:
+        world = ci.HEARTBEAT_RANK_CAP + 16
+        for r in range(world):
+            ci.record_rank_seen(r)
+        # age the low ranks: they are the stalest and must be the keepers
+        with ci._hb_lock:
+            for r in range(ci.HEARTBEAT_KEEP_STALEST):
+                ci._hb_last_seen[r] -= 500.0
+        ci.refresh_liveness()
+        snap = REGISTRY.snapshot()
+        fam = snap["fed_last_heartbeat_age_seconds"]
+        assert len(fam) == ci.HEARTBEAT_KEEP_STALEST
+        assert set(fam) == {f"rank={r}"
+                            for r in range(ci.HEARTBEAT_KEEP_STALEST)}
+        roll = snap["fed_heartbeat_age_rollup"]
+        assert roll["stat=count"] == world
+        assert roll["stat=max"] >= 500.0 > roll["stat=min"]
+        # the full per-rank view stays queryable off-registry
+        assert len(ci.heartbeat_ages()) == world
+    finally:
+        ci.reset_heartbeats()
+
+
+def test_occupied_metrics_port_falls_back_to_ephemeral():
+    import socket
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    taken = blocker.getsockname()[1]
+    try:
+        srv = MetricsHTTPServer(port=taken, registry=MetricsRegistry())
+        try:
+            assert srv.port > 0 and srv.port != taken  # rebound, loudly
+            assert "# TYPE" not in _scrape(srv.url("/healthz"))
+        finally:
+            srv.close()
+        # the run header carries the BOUND port, so log readers still
+        # scrape the rank that lost its requested port
+        tel = Telemetry(registry=MetricsRegistry(), http_port=taken,
+                        memwatch=False)
+        tel.run_header({})
+        assert tel.events.sink.records[0]["http_port"] \
+            == tel.http_port != taken
+        tel.close()
+    finally:
+        blocker.close()
+
+
+def test_fleetz_404_without_collector():
+    srv = MetricsHTTPServer(port=0, registry=MetricsRegistry())
+    try:
+        with pytest.raises(urllib.request.HTTPError, match="404"):
+            _scrape(srv.url("/fleetz"))
+    finally:
+        srv.close()
+
+
+def test_concurrent_scrape_emit_and_rotation_consistency(tmp_path):
+    """Satellite: /metrics + /healthz + /fleetz hammered from threads
+    while rounds emit, digests ingest, and the JSONL sink rotates — no
+    scrape errors, and the final live scrape's counter totals equal the
+    close-time metrics.prom dump (the PR-10 pin, fleet families
+    included)."""
+    d = str(tmp_path)
+    reg = MetricsRegistry()
+    flightrec.uninstall_flight_recorder()
+    try:
+        tel = Telemetry(log_dir=d, registry=reg, http_port=0, fleet=True,
+                        memwatch=False, rotate_bytes=4096, backups=2)
+        col = tel.fleet
+        stop, errors = threading.Event(), []
+
+        def hammer(path):
+            while not stop.is_set():
+                try:
+                    _scrape(tel.httpd.url(path))
+                except Exception as e:  # noqa: BLE001 — collected, asserted
+                    errors.append((path, e))
+
+        threads = [threading.Thread(target=hammer, args=(p,))
+                   for p in ("/metrics", "/healthz", "/fleetz")]
+        for t in threads:
+            t.start()
+        for i in range(60):
+            col.ingest({"rank": 1 + (i % 3), "round": i // 3,
+                        "ctr": {"bytes_uplink": 64, "bytes_downlink": 64}})
+            tel.emit_round(i, metrics={"loss_sum": 1.0},
+                           spans={"round": 0.01})
+        snap = col.snapshot()
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        scraped = _scrape(tel.httpd.url("/metrics"))
+        tel.close()
+        dumped = open(os.path.join(d, "metrics.prom")).read()
+    finally:
+        flightrec.uninstall_flight_recorder()
+
+    assert not errors
+    assert snap["digests_total"] == 60 and snap["ranks_reporting"] == 4
+    assert os.path.exists(os.path.join(d, "events.jsonl.1"))  # rotated
+    rounds = [r["round"] for r in read_jsonl(os.path.join(d,
+                                                          "events.jsonl"))
+              if r.get("kind") == "round"]
+    assert rounds[-1] == 59  # rotation lost nothing at the tail
+
+    def counter_lines(text):
+        out, in_counter = [], False
+        for line in text.splitlines():
+            if line.startswith("# TYPE "):
+                in_counter = line.endswith(" counter")
+            elif in_counter:
+                out.append(line)
+        return out
+
+    assert counter_lines(scraped) == counter_lines(dumped)
+    assert any(ln.startswith("fed_fleet_digests_total") and
+               ln.endswith("60.0") for ln in counter_lines(scraped))
+
+
+# ------------------------------------------------------------------ fedtop
+def test_fedtop_once_renders_and_fails_loud(capsys):
+    reg = MetricsRegistry()
+    col = FleetCollector(run_id="r-top", job="tenant", registry=reg,
+                         expected_ranks=2)
+    col.ingest({"rank": 1, "round": 3, "ctr": {"bytes_uplink": 2048},
+                "eps": 0.7})
+    col.note_server(3)
+    srv = MetricsHTTPServer(port=0, registry=reg, fleet=col)
+    fedtop = _load_script("fedtop")
+    try:
+        assert fedtop.main([f"--url", f"127.0.0.1:{srv.port}",
+                            "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "run=r-top" in out and "job=tenant" in out
+        assert "status=ok" in out and "ranks=2/2" in out
+        assert "2.0KiB" in out and "0.7" in out  # the rank-1 row rendered
+    finally:
+        srv.close()
+    # a dead endpoint: --once exits 1 (CI must see the failure)
+    assert fedtop.main([f"--url", f"http://127.0.0.1:{srv.port}",
+                        "--once"]) == 1

@@ -43,8 +43,12 @@ def test_append_replay_round_trip(tmp_path):
     assert rep.torn == 0
     assert [r["kind"] for r in rep.records] == ["restart", "broadcast",
                                                 "upload", "commit"]
-    assert rep.records[2] == {"kind": "upload", "round": 0, "rank": 1,
-                              "client": 5, "nsamp": 24.0}
+    up = dict(rep.records[2])
+    # every record is wall-clock stamped for the post-mortem timeline
+    # (obs/flightrec.py) unless the caller pins its own ts
+    assert isinstance(up.pop("ts"), float)
+    assert up == {"kind": "upload", "round": 0, "rank": 1,
+                  "client": 5, "nsamp": 24.0}
     assert rep.last_commit == 0 and rep.restart_epochs == 1
 
 
@@ -320,7 +324,9 @@ def test_frame_layout_is_pinned(tmp_path):
     """The on-disk framing is a compatibility surface: 8-byte magic, then
     [u32 len][u32 crc32(payload)][canonical-JSON payload] per record."""
     wal = RoundWAL(str(tmp_path))
-    wal.append("commit", sync=True, round=7)
+    # pin ts explicitly (append setdefaults a wall-clock stamp otherwise)
+    # so the byte layout below is fully deterministic
+    wal.append("commit", sync=True, round=7, ts=1.5)
     wal.close()
     with open(_wal_path(tmp_path), "rb") as f:
         data = f.read()
@@ -328,4 +334,4 @@ def test_frame_layout_is_pinned(tmp_path):
     length, crc = struct.unpack_from("<II", data, 8)
     payload = data[16:16 + length]
     assert zlib.crc32(payload) == crc
-    assert json.loads(payload) == {"kind": "commit", "round": 7}
+    assert json.loads(payload) == {"kind": "commit", "round": 7, "ts": 1.5}
