@@ -664,22 +664,26 @@ class ExceptSwallow(Rule):
 class FsyncDiscipline(Rule):
     """Durability commit points route through the shared fsync helpers.
 
-    ``core/wal.py`` and ``core/checkpoint.py`` are the crash-recovery
-    substrate (docs/ROBUSTNESS.md §Server crash recovery): a bare
-    ``open(..., 'w')`` there writes through the page cache only, so the
-    "committed" round/WAL record a recovery later trusts can silently
-    not exist after power loss — crash-safe until the cache says
-    otherwise. Every write in those modules must go through the shared
-    helpers (``durable_open``/``durable_write``/``durable_replace`` in
+    ``core/wal.py``, ``core/checkpoint.py``, and ``core/privacy.py``
+    are the crash-recovery substrate (docs/ROBUSTNESS.md §Server crash
+    recovery): a bare ``open(..., 'w')`` there writes through the page
+    cache only, so the "committed" round/WAL record a recovery later
+    trusts can silently not exist after power loss — crash-safe until
+    the cache says otherwise. ``privacy.py`` is in scope because the
+    per-client ε ledgers carry the never-under-report promise: any
+    persistence a ledger ever grows must be as durable as the WAL
+    precharge records it rides today. Every write in those modules must
+    go through the shared helpers
+    (``durable_open``/``durable_write``/``durable_replace`` in
     core/wal.py) or live inside a ``durable_*``-named helper that owns
     its own fsync ceremony (the WAL's append-handle constructor)."""
 
     name = "fsync-discipline"
-    description = ("no bare open-for-write in core wal/checkpoint "
-                   "modules — route commit points through the shared "
-                   "durable_* fsync helpers")
+    description = ("no bare open-for-write in core wal/checkpoint/"
+                   "privacy modules — route commit points through the "
+                   "shared durable_* fsync helpers")
 
-    _TARGETS = ("wal.py", "checkpoint.py")
+    _TARGETS = ("wal.py", "checkpoint.py", "privacy.py")
     _WRITE_MODES = ("w", "a", "x", "+")
 
     def _scoped(self, module: Module) -> bool:

@@ -108,6 +108,69 @@ class DPAccountant:
 DEFAULT_DELTA = 1e-5
 
 
+class ClientPrivacyLedger:
+    """Per-client RDP ledgers — ε budgets at client granularity.
+
+    The cohort-level :class:`DPAccountant` answers "how much privacy has
+    this RUN spent"; multi-tenant deployments need "how much has THIS
+    user spent", which only grows on the rounds the client actually
+    participated in. Each participation is charged at the UNsubsampled
+    Gaussian bound ``α / (2 z²)`` — conditioning on "client i was
+    sampled" forfeits the amplification-by-subsampling discount, so the
+    per-client figure is the conservative (never-under-reporting) side
+    of the cohort bound.
+
+    Durability contract: the charge sites journal the participating
+    client ids on the WAL ``precharge`` record BEFORE the noise key is
+    drawn (core/wal.py module docstring), so a server SIGKILL between
+    charge and noise replays the per-client charges too — ε may
+    over-count by one round per crash, never under-count. Keys are
+    client ids (namespace-ready for multi-tenancy: a tenant prefix on
+    the id is all a shared fleet needs)."""
+
+    def __init__(self, alphas=DEFAULT_ALPHAS):
+        self.alphas = tuple(alphas)
+        self._rdp: dict[int, np.ndarray] = {}
+
+    def charge(self, client_ids, noise_multiplier: float,
+               rounds: int = 1) -> None:
+        """Charge one participation (``rounds`` of them) to each listed
+        client at the unamplified Gaussian bound."""
+        if noise_multiplier <= 0.0:
+            raise ValueError(
+                f"noise_multiplier must be > 0, got {noise_multiplier}")
+        step = rounds * np.array(
+            [gaussian_rdp(noise_multiplier, a) for a in self.alphas])
+        for cid in client_ids:
+            cid = int(cid)
+            prev = self._rdp.get(cid)
+            self._rdp[cid] = step if prev is None else prev + step
+
+    def epsilon(self, client_id: int, delta: float = DEFAULT_DELTA) -> float:
+        rdp = self._rdp.get(int(client_id))
+        if rdp is None:
+            return 0.0
+        return rdp_to_epsilon(rdp, self.alphas, delta)
+
+    def eps_max(self, delta: float = DEFAULT_DELTA) -> float:
+        """The worst per-client ε — the budget figure /healthz and the
+        ``fed_privacy_client_epsilon`` gauge family surface."""
+        if not self._rdp:
+            return 0.0
+        return max(self.epsilon(cid, delta) for cid in self._rdp)
+
+    def summary(self, delta: float = DEFAULT_DELTA) -> dict:
+        """{eps_client_max, eps_client_mean, clients_charged} — the
+        rollup the round record's privacy block carries."""
+        if not self._rdp:
+            return {"eps_client_max": 0.0, "eps_client_mean": 0.0,
+                    "clients_charged": 0}
+        eps = [self.epsilon(cid, delta) for cid in self._rdp]
+        return {"eps_client_max": round(max(eps), 6),
+                "eps_client_mean": round(float(np.mean(eps)), 6),
+                "clients_charged": len(eps)}
+
+
 def privacy_block(accountant: DPAccountant, q: float, noise_multiplier: float,
                   clip: float, delta: float = DEFAULT_DELTA,
                   realized_m: int | None = None) -> dict:
@@ -136,17 +199,30 @@ def privacy_block(accountant: DPAccountant, q: float, noise_multiplier: float,
 def charge_and_record(accountant: DPAccountant, q: float,
                       noise_multiplier: float, clip: float,
                       realized_m: int | None = None,
-                      rounds: int = 1) -> dict:
+                      rounds: int = 1,
+                      client_ledger: ClientPrivacyLedger | None = None,
+                      client_ids=None) -> dict:
     """The one step-then-surface sequence every DP aggregator runs:
     charge the accountant, build the round record's ``privacy`` block,
     refresh the live ``fed_privacy_epsilon`` gauge (the privacy_budget
     health rule's input). Three engines ride this — the masked secure
     tier, the cross-process dp defense, the standalone engine — and the
-    ledger fields must not drift between them."""
+    ledger fields must not drift between them.
+
+    With a ``client_ledger`` + the round's participating ``client_ids``,
+    the per-client ledgers are charged too and the block gains the
+    ``eps_client_max`` / ``eps_client_mean`` / ``clients_charged``
+    rollup, mirrored onto the ``fed_privacy_client_epsilon`` gauges."""
     from fedml_tpu.obs import perf_instrument as _perf
 
     accountant.step(q, noise_multiplier, rounds=rounds)
     block = privacy_block(accountant, q, noise_multiplier, clip,
                           realized_m=realized_m)
     _perf.set_privacy_epsilon(block["eps"])
+    if client_ledger is not None and client_ids is not None:
+        client_ledger.charge(client_ids, noise_multiplier, rounds=rounds)
+        block.update(client_ledger.summary())
+        _perf.set_client_epsilon(block["eps_client_max"],
+                                 block["eps_client_mean"],
+                                 block["clients_charged"])
     return block

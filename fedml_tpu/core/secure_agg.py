@@ -235,15 +235,24 @@ class SecAggConfig:
 
 
 # ------------------------------------------------------------- client side
-def pair_masks_for(seed: int, round_idx: int, slot: int, cfg: SecAggConfig
-                   ) -> tuple[np.ndarray, np.ndarray]:
+def pair_masks_for(seed: int, round_idx: int, slot: int, cfg: SecAggConfig,
+                   peers=None) -> tuple[np.ndarray, np.ndarray]:
     """(seeds, signs) of slot's pairwise masks against every other cohort
     slot: + for the lower slot of each pair, - for the higher, so the
-    cohort sum cancels exactly."""
+    cohort sum cancels exactly.
+
+    ``peers`` restricts the pair partners to the listed GLOBAL slot ids
+    (default: the whole cohort). The hierarchical tier passes each edge
+    block's slots, so masks cancel within a block and every edge can fold
+    its block to an unmasked field partial locally; slot ids, keys, and
+    seeds stay cohort-global, so a block-scoped round decodes to exactly
+    the bits a flat round would."""
     sk = secret_key(seed, round_idx, slot, cfg.p)
     pks = public_keys(seed, round_idx, cfg.cohort, cfg.p)
+    partners = range(cfg.cohort) if peers is None \
+        else sorted(int(j) for j in peers)
     seeds, signs = [], []
-    for j in range(cfg.cohort):
+    for j in partners:
         if j == slot:
             continue
         seeds.append(pair_seed(sk, pks[j], cfg.p))
@@ -252,7 +261,7 @@ def pair_masks_for(seed: int, round_idx: int, slot: int, cfg: SecAggConfig
 
 
 def mask_update(vec, weight: float, slot: int, seed: int, round_idx: int,
-                cfg: SecAggConfig) -> np.ndarray:
+                cfg: SecAggConfig, peers=None) -> np.ndarray:
     """Quantize ``vec * weight`` into GF(p) and add this slot's self +
     pairwise masks. Returns the int64 wire payload — the only thing a
     client ever uploads about its update. Enforces the capacity promise
@@ -271,7 +280,7 @@ def mask_update(vec, weight: float, slot: int, seed: int, round_idx: int,
         q = jnp.asarray(
             ff.field_encode(jnp.asarray(scaled, jnp.float64),
                             cfg.quant_scale, cfg.p), jnp.int64)
-    seeds, signs = pair_masks_for(seed, round_idx, slot, cfg)
+    seeds, signs = pair_masks_for(seed, round_idx, slot, cfg, peers=peers)
     seeds = np.concatenate(
         [np.asarray([self_mask_seed(seed, round_idx, slot, cfg.p)],
                     np.uint64), seeds])
@@ -303,6 +312,27 @@ def fold_masked(acc, masked, p: int = P_DEFAULT):
     return (acc + masked) % p
 
 
+def _fold_masked_body(acc, masked, p: int):
+    return (acc + masked) % p
+
+
+_fold_masked_jit = jax.jit(_fold_masked_body, static_argnums=(2,))
+
+
+@_x64
+def fold_masked_device(acc, masked, p: int = P_DEFAULT):
+    """Device-resident twin of :func:`fold_masked` — the ``fused_agg``
+    treatment applied to masked ingest. The accumulator stays an int64
+    device array and each arrival is one jitted add mod p, so the host
+    never round-trips the vector per upload. Integer mod-p addition is
+    exact and associative, so the result is bitwise identical to the host
+    fold (the tests pin it)."""
+    masked = jnp.asarray(masked, jnp.int64)
+    if acc is None:
+        return masked % p
+    return _fold_masked_jit(acc, masked, p)
+
+
 def recover_self_seed(holder_slots, shares, t: int,
                       p: int = P_DEFAULT) -> int:
     """Reconstruct one self-mask seed from the shares the listed holder
@@ -318,11 +348,11 @@ def recover_self_seed(holder_slots, shares, t: int,
         return int(ff.shamir_decode(sh, alphas, t, p)[0])
 
 
-def unmask_sum(acc, survivors, dead, self_seeds: dict[int, int],
-               pair_seeds_by_survivor: dict[int, dict[int, int]],
-               cfg: SecAggConfig) -> np.ndarray:
-    """Strip the masks a partial (or full) cohort sum still carries and
-    decode to float:
+def unmask_partial(acc, survivors, dead, self_seeds: dict[int, int],
+                   pair_seeds_by_survivor: dict[int, dict[int, int]],
+                   cfg: SecAggConfig) -> np.ndarray:
+    """Strip the masks a partial (or full) sum still carries, staying in
+    GF(p):
 
     - every SURVIVOR's self-mask PRG(b_i) (seeds reconstructed from the
       revealed Shamir shares);
@@ -331,8 +361,9 @@ def unmask_sum(acc, survivors, dead, self_seeds: dict[int, int],
 
     ``pair_seeds_by_survivor[i][j]`` is survivor i's revealed s_ij; a
     full round passes ``dead=[]`` and ``{}``.
-    Returns the float64 decoded weighted SUM over the survivors.
-    """
+    Returns the int64 FIELD vector — still additive, so edge partials
+    unmasked here fold mod p at the root before one final decode (the
+    hierarchical tier's whole trick: decode once, at the top)."""
     survivors, dead = sorted(int(s) for s in survivors), sorted(
         int(d) for d in dead)
     seeds, signs = [], []
@@ -343,9 +374,27 @@ def unmask_sum(acc, survivors, dead, self_seeds: dict[int, int],
         for j in dead:
             seeds.append(pair_seeds_by_survivor[i][j])
             signs.append(-1 if i < j else 1)  # undo i's + / - side
-    y = apply_masks(np.asarray(acc, np.int64),
+    return np.asarray(
+        apply_masks(np.asarray(acc, np.int64),
                     np.asarray(seeds, np.uint64),
-                    np.asarray(signs, np.int64), cfg.p)
+                    np.asarray(signs, np.int64), cfg.p), np.int64)
+
+
+def field_decode_sum(acc, cfg: SecAggConfig) -> np.ndarray:
+    """Decode an unmasked GF(p) sum to float64 (the one decode a round
+    performs, flat or tree)."""
     with jax.enable_x64():
-        return np.asarray(ff.field_decode(y, cfg.quant_scale, cfg.p),
-                          np.float64)
+        return np.asarray(
+            ff.field_decode(jnp.asarray(acc, jnp.int64), cfg.quant_scale,
+                            cfg.p), np.float64)
+
+
+def unmask_sum(acc, survivors, dead, self_seeds: dict[int, int],
+               pair_seeds_by_survivor: dict[int, dict[int, int]],
+               cfg: SecAggConfig) -> np.ndarray:
+    """:func:`unmask_partial` + :func:`field_decode_sum`: the flat-cohort
+    path — strip every mask, decode once, return the float64 weighted SUM
+    over the survivors."""
+    return field_decode_sum(
+        unmask_partial(acc, survivors, dead, self_seeds,
+                       pair_seeds_by_survivor, cfg), cfg)

@@ -23,7 +23,12 @@ the in-flight state with exactly-once round semantics:
 - **ε never under-reported** — the DP pre-charge record is fsync'd
   *before* the noise key is drawn, so a crash between charge and noise
   replays the charge (the conservative direction: the accountant may
-  over-count by one round, never under-count).
+  over-count by one round, never under-count). The same record carries
+  the round's surviving client ids (``clients=[...]``), extending the
+  contract to CLIENT granularity: the per-client privacy ledgers
+  (core/privacy.ClientPrivacyLedger) ride no checkpoint — recovery
+  rebuilds them by replaying every pre-charge record, so per-user ε
+  survives a SIGKILL under the same never-under-report guarantee;
 
 Record framing: the file opens with an 8-byte magic, then each record is
 ``[u32 length][u32 crc32(payload)][payload]`` with a canonical-JSON
@@ -32,10 +37,11 @@ mid-append must cost the tail, never a misparse) and everything before it
 is intact by CRC.
 
 The durable-write helpers at the bottom are the ONLY sanctioned way this
-module and ``core/checkpoint.py`` open files for writing — the fedlint
-``fsync-discipline`` rule flags any bare ``open(..., 'w')`` in the two
-modules, because a commit point that skips the fsync turns "crash-safe"
-into "crash-safe until the page cache says otherwise".
+module, ``core/checkpoint.py``, and ``core/privacy.py`` open files for
+writing — the fedlint ``fsync-discipline`` rule flags any bare
+``open(..., 'w')`` in those modules, because a commit point that skips
+the fsync turns "crash-safe" into "crash-safe until the page cache says
+otherwise".
 """
 
 from __future__ import annotations

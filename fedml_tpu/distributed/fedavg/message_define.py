@@ -106,6 +106,32 @@ class MyMessage:
     MSG_TYPE_C2S_REVEAL_SHARES = "c2s_reveal"
     MSG_ARG_KEY_SECAGG_DEAD = "secagg_dead"
     MSG_ARG_KEY_SECAGG_PAIR_SEEDS = "secagg_pair_seeds"
+    # hierarchical masked secure aggregation (docs/ROBUSTNESS.md
+    # §Hierarchical secure aggregation): with --edges each worker's
+    # pairwise masks are drawn WITHIN its edge block (seeds/keys stay
+    # cohort-global, partners restricted), so the masks cancel at the
+    # edge. The edge folds its block's masked uploads mod p, runs the
+    # tiered reveal locally for in-block dead slots (s2c_reveal /
+    # c2s_reveal between edge and its workers, same frames as the flat
+    # tier), strips the masks, and forwards ONE e2s_masked_agg frame per
+    # round: the UNMASKED int64 field partial (EDGE_FIELD_SUM — still
+    # additive mod p; the root folds E partials and decodes ONCE), the
+    # block's survivor/dead GLOBAL slot ids (EDGE_SURVIVORS / EDGE_DEAD),
+    # per-surviving-slot sample counts keyed by global slot
+    # (EDGE_SLOT_SAMPLES), the block's plaintext extra-state pytrees
+    # (EDGE_EXTRAS, one per survivor, slot order), and how the block
+    # decoded (SECAGG_OUTCOME full|recovered|shed + SECAGG_RECOVERY_S).
+    # A whole edge lost inside round_timeout_s is the only case the root
+    # handles: it sheds exactly that block's slots — no cross-block mask
+    # ever needs repair. Root ingress stays O(edges) frames.
+    MSG_TYPE_E2S_SEND_MASKED_AGG_TO_SERVER = "e2s_masked_agg"
+    MSG_ARG_KEY_EDGE_FIELD_SUM = "edge_field_sum"
+    MSG_ARG_KEY_EDGE_SURVIVORS = "edge_survivors"
+    MSG_ARG_KEY_EDGE_DEAD = "edge_dead"
+    MSG_ARG_KEY_EDGE_SLOT_SAMPLES = "edge_slot_samples"
+    MSG_ARG_KEY_EDGE_EXTRAS = "edge_extras"
+    MSG_ARG_KEY_SECAGG_OUTCOME = "secagg_outcome"
+    MSG_ARG_KEY_SECAGG_RECOVERY_S = "secagg_recovery_s"
     # server crash recovery (docs/ROBUSTNESS.md §Server crash recovery):
     # after a restart every s2c frame carries the server's RESTART_EPOCH
     # (absent on epoch-0 runs — the wire is unchanged until a crash

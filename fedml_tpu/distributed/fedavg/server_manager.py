@@ -474,6 +474,35 @@ class FedAvgServerManager(ServerManager):
                                 "the pre-crash charge of round %d "
                                 "(q=%.6f, z=%.3f)", rec["round"],
                                 rec["q"], rec["z"])
+        # per-client ledgers rebuild from EVERY precharge record (the WAL
+        # is append-only for the run): unlike the accountant's cumulative
+        # RDP, the variable-key {client: rdp} map rides no checkpoint —
+        # the journaled client ids ARE its durable form. The in-flight
+        # round's record re-charges too (its noise may have been released
+        # pre-crash), so per-client ε can over-count by one round per
+        # crash but never under-report — the precharge contract at
+        # client granularity.
+        ledger = getattr(self.aggregator, "client_ledger", None)
+        if ledger is not None:
+            recharged = 0
+            for rec in replay.of_kind("precharge"):
+                clients = rec.get("clients")
+                if clients:
+                    ledger.charge([int(c) for c in clients],
+                                  float(rec["z"]))
+                    recharged += 1
+            if recharged:
+                from fedml_tpu.obs import perf_instrument as _perf
+
+                s = ledger.summary()
+                _perf.set_client_epsilon(s["eps_client_max"],
+                                         s["eps_client_mean"],
+                                         s["clients_charged"])
+                log.warning("recovery: rebuilt per-client privacy "
+                            "ledgers from %d precharge record(s) — "
+                            "eps_client_max=%.6f over %d client(s)",
+                            recharged, s["eps_client_max"],
+                            s["clients_charged"])
         if self._async:
             for rank, w in replay.dispatch_waves().items():
                 self._dispatch_wave[rank] = w + 1

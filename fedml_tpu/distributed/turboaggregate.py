@@ -32,6 +32,25 @@ Replay is bit-for-bit: every mask seed derives from the session seed via
 sha256 (core/secure_agg.derive_secret — the fedlint determinism
 discipline), so a chaos run's masked aggregates, ledger, and recovery
 frames replay exactly.
+
+Hierarchical tier (docs/ROBUSTNESS.md §Hierarchical secure aggregation;
+``run_simulated(edges=E)``): pairwise masks are drawn WITHIN each edge
+block (seeds/keys stay cohort-global, partners restricted — masks cancel
+at the edge), so every ``TASecureEdgeManager`` folds its block's masked
+uploads mod p, runs the reveal recovery LOCALLY for in-block dead slots,
+and forwards one unmasked int64 field partial; the root
+(``HierTASecureServerManager``/``HierTAAggregator``) folds E partials
+mod p and decodes ONCE — mod-p addition is exact and associative, so the
+tree aggregate is BITWISE the flat masked aggregate over the same cohort.
+Root ingress stays O(edges) frames; a whole edge lost inside
+``round_timeout_s`` sheds exactly that block's slots (no cross-block mask
+ever needs repair). ``fused_ingest=True`` keeps the fold accumulator
+device-resident (one jitted add mod p per arrival — the fused_agg
+treatment on the masked path, bitwise identical to the host fold).
+``defense_type='dp'`` additionally charges a per-client privacy ledger
+(core/privacy.ClientPrivacyLedger): the WAL precharge record carries the
+surviving client ids, so per-user ε survives a server SIGKILL and is
+never under-reported.
 """
 
 from __future__ import annotations
@@ -50,6 +69,8 @@ from fedml_tpu.core import secure_agg as sa
 from fedml_tpu.core.local import NetState
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.client_manager import FedAvgClientManager
+from fedml_tpu.distributed.fedavg.hierarchy import (EdgeTopology,
+                                                    FedAvgEdgeManager)
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.distributed.fedavg.server_manager import FedAvgServerManager
 from fedml_tpu.distributed.fedavg.trainer import DistributedTrainer
@@ -113,7 +134,7 @@ class SecureTrainer(DistributedTrainer):
     def __init__(self, client_rank, dataset, task, cfg, threshold_t=None,
                  quant_scale=2**16, defense_type: str = "none",
                  norm_bound: float = 30.0, secagg_max_abs: float = 4.0,
-                 n_shares=None):
+                 n_shares=None, slot: int | None = None, peers=None):
         from fedml_tpu.core.client_source import ClientDataSource
 
         if isinstance(dataset, ClientDataSource):
@@ -127,8 +148,13 @@ class SecureTrainer(DistributedTrainer):
             log.debug("SecureTrainer: n_shares is ignored — self-mask "
                       "seeds are Shamir-shared across the whole cohort")
         # cohort SLOT (stable per rank) — not the per-round dataset client
-        # id the server re-assigns via CLIENT_INDEX
-        self.slot = client_rank - 1
+        # id the server re-assigns via CLIENT_INDEX. The hierarchical tier
+        # passes it explicitly (worker rank = 1 + edges + slot, so rank-1
+        # would be wrong there) plus the slot's edge-block ``peers``: pair
+        # masks drawn only against block partners cancel AT THE EDGE.
+        self.slot = (client_rank - 1) if slot is None else int(slot)
+        self.peers = None if peers is None \
+            else sorted(int(j) for j in peers)
         self.defense_type = defense_type
         self.norm_bound = float(norm_bound)
         self.secagg = _secagg_config(cfg, threshold_t, quant_scale,
@@ -179,7 +205,7 @@ class SecureTrainer(DistributedTrainer):
         # mask_update enforces the capacity promise (max_abs) for every
         # engine — a coordinate past it would wrap the cohort sum
         masked = sa.mask_update(vec, weight, self.slot, self.cfg.seed,
-                                round_idx, self.secagg)
+                                round_idx, self.secagg, peers=self.peers)
         b_shares = sa.self_mask_shares(self.cfg.seed, round_idx, self.slot,
                                        self.secagg)
         extras = pack_pytree(self.net.extra)
@@ -198,7 +224,8 @@ class TAAggregator(FedAvgAggregator):
                  threshold_t=None, quant_scale=2**16,
                  defense_type: str = "none",  # 'none' | 'dp'
                  norm_bound: float = 30.0, noise_multiplier: float = 1.0,
-                 secagg_max_abs: float = 4.0, n_shares=None):
+                 secagg_max_abs: float = 4.0, n_shares=None,
+                 fused_ingest: bool = False):
         from fedml_tpu.core.client_source import ClientDataSource
 
         if isinstance(dataset, ClientDataSource):
@@ -217,17 +244,28 @@ class TAAggregator(FedAvgAggregator):
                                      secagg_max_abs)
         self.quant_scale = float(quant_scale)
         self.defense_type = defense_type
+        # NOT named fused_agg: that attribute routes the base server
+        # manager through _stage_fused/add_fused_result (the dense device
+        # path), which would bypass the masked fold entirely. fused_ingest
+        # keeps the mod-p accumulator device-resident inside OUR fold.
+        self.fused_ingest = bool(fused_ingest)
+        self._fold = sa.fold_masked_device if fused_ingest \
+            else sa.fold_masked
         self.accountant = None
+        self.client_ledger = None
         self._privacy_cache = None
         if defense_type == "dp":
-            from fedml_tpu.core.privacy import DPAccountant
+            from fedml_tpu.core.privacy import (ClientPrivacyLedger,
+                                                DPAccountant)
 
             if noise_multiplier <= 0:
                 raise ValueError("defense_type='dp' needs noise_multiplier"
                                  f" > 0, got {noise_multiplier}")
             self.accountant = DPAccountant()
+            self.client_ledger = ClientPrivacyLedger()
             self._dp_z, self._dp_C = float(noise_multiplier), float(norm_bound)
             self._noise_rng = jax.random.PRNGKey(cfg.seed + 7)
+            _perf.ensure_client_privacy_family()
         _perf.ensure_secagg_families()
         # per-round masked-fold state (begin_round resets; _frozen parks
         # the fold while a recovery phase is in flight so a late upload
@@ -272,7 +310,7 @@ class TAAggregator(FedAvgAggregator):
                         index)
             return
         masked, b_shares = wire_leaves[0], wire_leaves[1]
-        self._acc = sa.fold_masked(self._acc, masked, self.secagg.p)
+        self._acc = self._fold(self._acc, masked, self.secagg.p)
         self._round_slots.add(index)
         self._b_shares[index] = np.asarray(b_shares, np.int64)
         self._extras[index] = list(wire_leaves[2:])
@@ -317,6 +355,15 @@ class TAAggregator(FedAvgAggregator):
             for i in survivors}
         vec_sum = sa.unmask_sum(self._acc, survivors, dead, self_seeds,
                                 reveals, self.secagg)
+        return self._finish_aggregate(vec_sum, survivors, t0)
+
+    def _finish_aggregate(self, vec_sum, survivors, t0):
+        """The decode-side tail both tiers share once a round's unmasked
+        float64 survivor SUM exists (flat: after unmask_sum; tree: after
+        the root folds the edges' field partials and decodes once): the
+        DP noise/charge path — including the per-client precharge journal
+        — or the elastic survivor reweighting, the extras mean, and the
+        fold-state reset."""
         nsamp = np.asarray([self.sample_num_dict[i] for i in survivors],
                            np.float64)
         if self.defense_type == "dp":
@@ -326,17 +373,22 @@ class TAAggregator(FedAvgAggregator):
             m = len(survivors)
             delta = vec_sum / m
             sd = self._dp_z * self._dp_C / m
+            ids = self.client_sampling(self.current_round)
+            client_ids = [int(ids[i]) for i in survivors]
             wal = getattr(self, "wal", None)
             if wal is not None:
                 # WAL pre-charge, fsync'd BEFORE the noise key is drawn
                 # (docs/ROBUSTNESS.md §Server crash recovery): a restarted
                 # accountant replays this record, so the reported ε can
-                # never be lower than the charges actually incurred
+                # never be lower than the charges actually incurred. The
+                # surviving CLIENT ids ride the record, so the per-client
+                # ledgers replay under the same never-under-report
+                # contract (clients= is what _recover_in_flight re-charges)
                 wal.append("precharge", sync=True,
                            round=int(self.current_round),
                            q=float(m / self.cfg.client_num_in_total),
                            z=float(self._dp_z), clip=float(self._dp_C),
-                           m=int(m))
+                           m=int(m), clients=client_ids)
             self._noise_rng, k = jax.random.split(self._noise_rng)
             noise = np.asarray(
                 jax.random.normal(k, np.shape(delta), jnp.float32),
@@ -348,7 +400,8 @@ class TAAggregator(FedAvgAggregator):
 
             self._privacy_cache = charge_and_record(
                 self.accountant, m / self.cfg.client_num_in_total,
-                self._dp_z, self._dp_C, realized_m=m)
+                self._dp_z, self._dp_C, realized_m=m,
+                client_ledger=self.client_ledger, client_ids=client_ids)
         else:
             # clients pre-normalized by the FULL cohort total T; the
             # decoded sum is sum_S (n_i/T) x_i — rescale by T / sum_S n_i
@@ -391,7 +444,13 @@ class TAAggregator(FedAvgAggregator):
 
 
 class TASecureClientManager(FedAvgClientManager):
-    """FedAvgClientManager that answers mask-recovery reveal requests."""
+    """FedAvgClientManager that answers mask-recovery reveal requests.
+
+    Reveal requests are retried by the server watchdog (one deterministic
+    re-send at the watchdog cadence), so the handler dedupes on
+    (round, dead-set): a retry that finds the reveal already computed
+    retransmits the SAME seeds verbatim — the server's exactly-once fold
+    drops the duplicate, and a retry can never desync the seed values."""
 
     def register_message_receive_handlers(self):
         super().register_message_receive_handlers()
@@ -403,7 +462,20 @@ class TASecureClientManager(FedAvgClientManager):
         round_idx = int(msg_params[MyMessage.MSG_ARG_KEY_ROUND])
         dead = [int(d) for d in
                 np.asarray(msg_params[MyMessage.MSG_ARG_KEY_SECAGG_DEAD])]
-        seeds = self.trainer.reveal_pair_seeds(round_idx, dead)
+        key = (round_idx, tuple(dead))
+        cache = getattr(self, "_reveal_cache", None)
+        if cache is None:
+            cache = self._reveal_cache = {}
+        seeds = cache.get(key)
+        if seeds is None:
+            seeds = self.trainer.reveal_pair_seeds(round_idx, dead)
+            # one recovery in flight at a time: the previous round's (or
+            # dead-set's) entry can never be legitimately re-requested
+            cache.clear()
+            cache[key] = seeds
+        else:
+            log.info("secagg: duplicate reveal request for round %d — "
+                     "retransmitting the cached reply verbatim", round_idx)
         msg = Message(MyMessage.MSG_TYPE_C2S_REVEAL_SHARES, self.rank,
                       self.server_rank)
         msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DEAD,
@@ -524,9 +596,16 @@ class TASecureServerManager(FedAvgServerManager):
         self._maybe_crash("reveal")
         self._reveal = {"survivors": survivors, "dead": dead,
                         "seeds": {}, "t0": time.perf_counter()}
+        self._reveal_retried = False
         log.warning("secagg round %d: slots %s dropped — asking %d "
                     "survivors to reveal their pairwise seeds",
                     self.round_idx, dead, len(survivors))
+        self._send_reveal_requests(survivors, dead)
+
+    def _send_reveal_requests(self, survivors, dead) -> None:
+        """Fan s2c_reveal to the listed survivors. Deterministic frames
+        (round + dead set), so the watchdog retry re-sends byte-identical
+        requests and the client cache answers them verbatim."""
         for slot in survivors:
             msg = Message(MyMessage.MSG_TYPE_S2C_REVEAL_REQUEST, self.rank,
                           slot + 1)
@@ -606,15 +685,533 @@ class TASecureServerManager(FedAvgServerManager):
                                       "seeds": {}}
                 missing = [s for s in rv["survivors"]
                            if s not in rv["seeds"]]
+                if missing and not getattr(self, "_reveal_retried", True):
+                    # one deterministic retry before shedding: the backoff
+                    # IS the watchdog cadence (first fire retries, second
+                    # sheds), the frames are byte-identical, and the
+                    # client cache retransmits the same seeds verbatim
+                    self._reveal_retried = True
+                    log.warning(
+                        "secagg round %d: reveal frames missing from "
+                        "slots %s after %.1fs — retrying once",
+                        self.round_idx, missing, idle_s)
+                    self._send_reveal_requests(missing, rv["dead"])
+                    return
                 self._shed_round(
                     rv["survivors"], rv["dead"],
                     f"reveal frames lost from slots {missing} after "
-                    f"{idle_s:.1f}s")
+                    f"{idle_s:.1f}s (post-retry)")
                 return
         super().on_timeout(idle_s)
 
     def _round_record_extra(self) -> dict:
         extra = super()._round_record_extra()
+        if self._last_secagg is not None:
+            extra["secagg"] = dict(self._last_secagg)
+        return extra
+
+
+class TASecureEdgeManager(FedAvgEdgeManager):
+    """Edge rank of the hierarchical masked tier (module docstring):
+    folds its block's masked uploads mod p (the block's pair masks cancel
+    HERE — workers drew them against block peers only), runs the tiered
+    reveal recovery locally for in-block dead slots, and forwards ONE
+    e2s_masked_agg frame carrying the unmasked int64 field partial.
+
+    The edge watchdog arms at HALF the root deadline (the tiered
+    contract): in-block recovery — including one deterministic reveal
+    retry — resolves strictly before the root's own timeout would shed
+    the whole block. Below ``recovery_min`` block survivors (or a reveal
+    lost past the retry) the edge sheds its OWN block loudly: an empty
+    partial whose dead list names every block slot, which the root
+    ledgers ``secagg_shed`` while the other blocks' round proceeds."""
+
+    def __init__(self, rank: int, topology, cfg: FedAvgConfig,
+                 threshold_t=None, quant_scale=2**16,
+                 defense_type: str = "none", norm_bound: float = 30.0,
+                 secagg_max_abs: float = 4.0, backend: str = "LOOPBACK",
+                 round_timeout_s: float | None = None, **kw):
+        super().__init__(rank, topology, backend=backend,
+                         round_timeout_s=round_timeout_s, robust=False,
+                         **kw)
+        self.cfg = cfg
+        self.secagg = _secagg_config(cfg, threshold_t, quant_scale,
+                                     defense_type, norm_bound,
+                                     secagg_max_abs)
+        if self.secagg.recovery_min > topology.block:
+            raise ValueError(
+                f"secagg recovery needs >= {self.secagg.recovery_min} "
+                f"survivors, but an edge block holds only "
+                f"{topology.block} slots — edge-local reveal could never "
+                "succeed; lower threshold_t or enlarge the block")
+        # masked block state (under self._lock; reset on every downlink)
+        self._macc = None
+        self._mslots: set[int] = set()
+        self._mb_shares: dict[int, np.ndarray] = {}
+        self._mextras: dict[int, list] = {}
+        self._msamples: dict[int, float] = {}
+        self._mreveal: dict | None = None
+
+    def register_message_receive_handlers(self):
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_REVEAL_SHARES,
+            self.handle_message_reveal_shares)
+
+    def _handle_downlink(self, msg_type: str, msg_params) -> None:
+        with self._lock:
+            self._macc = None
+            self._mslots = set()
+            self._mb_shares = {}
+            self._mextras = {}
+            self._msamples = {}
+            self._mreveal = None
+        super()._handle_downlink(msg_type, msg_params)
+
+    def _handle_child_upload(self, msg_params) -> None:
+        """Fold one worker's [masked, b_shares, *extras] upload — the
+        edge-tier twin of TAAggregator.add_local_trained_result, keyed by
+        GLOBAL cohort slot so the forwarded frame needs no translation."""
+        sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+        slot = self.topology.slot_of(sender)
+        with self._lock:
+            if self._round is None:
+                return
+            tag = msg_params.get(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            if int(tag) != self._round:
+                _obs.record_stale_upload("stale")
+                log.warning("edge %d: drop stale masked upload from rank "
+                            "%d (round %s, now %d)", self.edge_idx,
+                            sender, tag, self._round)
+                return
+            if slot not in self._slots:
+                _obs.record_stale_upload("unknown_rank")
+                log.warning("edge %d: masked upload from rank %d outside "
+                            "this block (slots %s)", self.edge_idx,
+                            sender, self._slots)
+                return
+            if self._forwarded or slot in self._mslots:
+                _obs.record_stale_upload("stale")
+                return  # chaos duplicate / late: exactly-once folding
+            if self._mreveal is not None:
+                # recovery in flight: the block's survivor set (and the
+                # reveal requests out for it) is FIXED — same freeze rule
+                # as the flat aggregator's _frozen
+                _obs.record_stale_upload("stale")
+                log.warning("edge %d: dropping late upload from slot %d "
+                            "— block mask recovery already in flight",
+                            self.edge_idx, slot)
+                return
+            leaves = list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS])
+            self._macc = sa.fold_masked(self._macc, leaves[0],
+                                        self.secagg.p)
+            self._mslots.add(slot)
+            self._mb_shares[slot] = np.asarray(leaves[1], np.int64)
+            self._mextras[slot] = list(leaves[2:])
+            self._msamples[slot] = float(
+                msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES])
+            if len(self._mslots) == len(self._slots):
+                self._finish_block()
+
+    # ------------------------------------------------------ block recovery
+    def _finish_block(self) -> None:
+        """Full block -> unmask and forward; dead slots -> edge-local
+        reveal (or shed below threshold). Caller holds _lock."""
+        survivors = sorted(self._mslots)
+        dead = [s for s in self._slots if s not in self._mslots]
+        if not dead:
+            field = self._unmask_block(survivors, [], {})
+            self._send_masked_frame(field, survivors, [], "full", None)
+            return
+        if len(survivors) < self.secagg.recovery_min:
+            self._shed_block(
+                f"{len(survivors)} block survivors < recovery threshold "
+                f"{self.secagg.recovery_min}")
+            return
+        self._begin_block_recovery(survivors, dead)
+
+    def _unmask_block(self, survivors, dead, reveals) -> np.ndarray:
+        """Strip the block's masks, staying in GF(p): self-mask seeds
+        reconstructed from the BLOCK survivors' share entries (>= t+1 by
+        the constructor guard), orphaned pairs from the reveals — every
+        pair in a block-scoped upload is in-block, so block-local reveals
+        cover every orphan. Caller holds _lock."""
+        self_seeds = {
+            i: sa.recover_self_seed(
+                survivors, self._mb_shares[i][survivors],
+                self.secagg.threshold_t, self.secagg.p)
+            for i in survivors}
+        return sa.unmask_partial(self._macc, survivors, dead, self_seeds,
+                                 reveals, self.secagg)
+
+    def _begin_block_recovery(self, survivors, dead) -> None:
+        self._mreveal = {"survivors": list(survivors), "dead": list(dead),
+                         "seeds": {}, "t0": time.perf_counter(),
+                         "retried": False}
+        log.warning("edge %d round %d: block slots %s dropped — asking "
+                    "%d block survivors to reveal their pairwise seeds",
+                    self.edge_idx, self._round, dead, len(survivors))
+        self._send_block_reveals(survivors, dead)
+
+    def _send_block_reveals(self, survivors, dead) -> None:
+        """s2c_reveal to the listed block survivors' worker ranks, naming
+        GLOBAL dead slot ids — byte-identical on retry, so the client
+        reveal cache retransmits the same seeds verbatim."""
+        for slot in survivors:
+            msg = Message(MyMessage.MSG_TYPE_S2C_REVEAL_REQUEST, self.rank,
+                          self.topology.worker_rank(slot))
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_DEAD,
+                           np.asarray(dead, np.int64))
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+            self.send_message(msg)
+
+    def handle_message_reveal_shares(self, msg_params) -> None:
+        with self._lock:
+            rv = self._mreveal
+            if rv is None or self._forwarded:
+                _obs.record_stale_upload("stale")
+                return
+            if int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                  self._round)) != self._round:
+                _obs.record_stale_upload("stale")
+                return
+            slot = self.topology.slot_of(
+                int(msg_params[Message.MSG_ARG_KEY_SENDER]))
+            if slot not in rv["survivors"] or slot in rv["seeds"]:
+                return  # unknown or duplicate reveal: exactly-once fold
+            dead = [int(d) for d in np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_SECAGG_DEAD])]
+            seeds = np.asarray(
+                msg_params[MyMessage.MSG_ARG_KEY_SECAGG_PAIR_SEEDS],
+                np.int64)
+            if dead != rv["dead"] or len(seeds) != len(dead):
+                log.warning("edge %d: reveal from slot %d names dead set "
+                            "%s != %s — dropped", self.edge_idx, slot,
+                            dead, rv["dead"])
+                return
+            rv["seeds"][slot] = {j: int(s) for j, s in zip(dead, seeds)}
+            if len(rv["seeds"]) < len(rv["survivors"]):
+                return
+            dt = time.perf_counter() - rv["t0"]
+            field = self._unmask_block(rv["survivors"], rv["dead"],
+                                       rv["seeds"])
+            self._mreveal = None
+            self._send_masked_frame(field, rv["survivors"], rv["dead"],
+                                    "recovered", dt)
+
+    def _shed_block(self, why: str) -> None:
+        """Below-threshold / reveal-lost: forward an EMPTY partial whose
+        dead list names every block slot — the root sheds exactly this
+        block (ledgered secagg_shed there) and the other blocks' round
+        proceeds. Caller holds _lock."""
+        log.error("edge %d round %d block SHED (%s): forwarding an empty "
+                  "partial — the root ledgers slots %s secagg_shed",
+                  self.edge_idx, self._round, why, list(self._slots))
+        self._mreveal = None
+        self._send_masked_frame(None, [], list(self._slots), "shed", None)
+
+    def _send_masked_frame(self, field, survivors, dead, outcome,
+                           recovery_s) -> None:
+        """The ONE per-round uplink (root ingress stays O(edges)): the
+        unmasked field partial + the block's survivor/dead slots, sample
+        counts, plaintext extras, and how the block decoded. Caller holds
+        _lock."""
+        msg = Message(MyMessage.MSG_TYPE_E2S_SEND_MASKED_AGG_TO_SERVER,
+                      self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_FIELD_SUM,
+                       np.zeros(0, np.int64) if field is None
+                       else np.asarray(field, np.int64))
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SURVIVORS,
+                       [int(s) for s in survivors])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_DEAD,
+                       [int(d) for d in dead])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_SLOT_SAMPLES,
+                       [float(self._msamples[s]) for s in survivors])
+        msg.add_params(MyMessage.MSG_ARG_KEY_EDGE_EXTRAS,
+                       [self._mextras[s] for s in survivors])
+        msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_OUTCOME, str(outcome))
+        if recovery_s is not None:
+            msg.add_params(MyMessage.MSG_ARG_KEY_SECAGG_RECOVERY_S,
+                           float(recovery_s))
+        msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self._round)
+        self._forwarded = True
+        self.send_message(msg)
+
+    def on_timeout(self, idle_s: float) -> None:
+        """Tiered recovery clock: uploads stalled -> run the block
+        decision (reveal or shed); a reveal stalled -> one deterministic
+        retry (the watchdog cadence IS the backoff), then shed. A block
+        with NO uploads waits — the root watchdog owns that recovery."""
+        with self._lock:
+            if (self._round is None or self._forwarded
+                    or self.round_timeout_s is None):
+                return
+            rv = self._mreveal
+            if rv is not None:
+                missing = [s for s in rv["survivors"]
+                           if s not in rv["seeds"]]
+                if missing and not rv["retried"]:
+                    rv["retried"] = True
+                    log.warning("edge %d round %d: reveal frames missing "
+                                "from slots %s after %.1fs — retrying "
+                                "once", self.edge_idx, self._round,
+                                missing, idle_s)
+                    self._send_block_reveals(missing, rv["dead"])
+                    return
+                self._shed_block(f"reveal frames lost from slots "
+                                 f"{missing} after {idle_s:.1f}s "
+                                 "(post-retry)")
+                return
+            if not self._mslots:
+                log.error("edge %d: round %s stalled %.1fs with no masked "
+                          "uploads — waiting (root watchdog owns "
+                          "recovery)", self.edge_idx, self._round, idle_s)
+                return
+            self._finish_block()
+
+
+class HierTAAggregator(TAAggregator):
+    """Root-side aggregator of the hierarchical masked tier: slots are
+    EDGES (the barrier counts E frames), but the fold state stays keyed
+    by GLOBAL cohort slot — each e2s_masked_agg frame's unmasked field
+    partial is one more streaming add mod p, and ``aggregate`` decodes
+    ONCE over the union of surviving slots. Mod-p addition is exact and
+    associative, so the result is bitwise the flat masked aggregate."""
+
+    def __init__(self, dataset, task, cfg: FedAvgConfig, topology,
+                 threshold_t=None, quant_scale=2**16,
+                 defense_type: str = "none", norm_bound: float = 30.0,
+                 noise_multiplier: float = 1.0,
+                 secagg_max_abs: float = 4.0, fused_ingest: bool = False):
+        if cfg.client_num_per_round != topology.workers:
+            raise ValueError(
+                f"client_num_per_round={cfg.client_num_per_round} != "
+                f"topology workers={topology.workers}")
+        super().__init__(dataset, task, cfg, worker_num=topology.edges,
+                         threshold_t=threshold_t, quant_scale=quant_scale,
+                         defense_type=defense_type, norm_bound=norm_bound,
+                         noise_multiplier=noise_multiplier,
+                         secagg_max_abs=secagg_max_abs,
+                         fused_ingest=fused_ingest)
+        self.topology = topology
+        if self.secagg.recovery_min > topology.block:
+            raise ValueError(
+                f"secagg recovery needs >= {self.secagg.recovery_min} "
+                f"survivors, but an edge block holds only "
+                f"{topology.block} slots — edge-local reveal could never "
+                "succeed; lower threshold_t or enlarge the block")
+        self.fanin_history: list[int] = []
+        # edge idx -> {survivors, dead, outcome, recovery_s} for the
+        # round's secagg record + the tiered ledger attribution
+        self._edge_frames: dict[int, dict] = {}
+
+    def begin_round(self, round_idx: int) -> None:
+        super().begin_round(round_idx)
+        self._edge_frames = {}
+
+    def add_edge_masked_result(self, edge_idx: int, field_sum, survivors,
+                               dead, slot_samples, extras, outcome: str,
+                               recovery_s=None,
+                               round_idx: int | None = None) -> None:
+        """Slot one edge's e2s_masked_agg frame: fold the unmasked field
+        partial mod p, stage the block's per-slot samples/extras under
+        their GLOBAL slot ids. Same stale/unknown/duplicate rejection
+        semantics as the per-worker path."""
+        edge_idx = int(edge_idx)
+        if edge_idx not in self.flag_client_model_uploaded:
+            _obs.record_stale_upload("unknown_rank")
+            log.warning("reject masked partial for unknown edge index %s "
+                        "(edges 0..%d)", edge_idx, self.worker_num - 1)
+            return
+        if round_idx is not None and int(round_idx) != self.current_round:
+            _obs.record_stale_upload("stale")
+            log.warning("reject out-of-round masked partial from edge %s "
+                        "(tagged round %s, current %d)", edge_idx,
+                        round_idx, self.current_round)
+            return
+        if self.flag_client_model_uploaded.get(edge_idx):
+            _obs.record_stale_upload("stale")
+            log.warning("drop duplicate masked partial from edge %s",
+                        edge_idx)
+            return
+        survivors = [int(s) for s in survivors]
+        if survivors:
+            self._acc = self._fold(self._acc,
+                                   np.asarray(field_sum, np.int64),
+                                   self.secagg.p)
+            for s, n, ex in zip(survivors, slot_samples, extras):
+                self._round_slots.add(s)
+                self.sample_num_dict[s] = float(n)
+                self._extras[s] = list(ex)
+        self._edge_frames[edge_idx] = {
+            "survivors": survivors, "dead": [int(d) for d in dead],
+            "outcome": str(outcome),
+            "recovery_s": None if recovery_s is None else float(recovery_s)}
+        self.flag_client_model_uploaded[edge_idx] = True
+
+    def aggregate(self):
+        """Ledger the tiered outcomes (a missing/shed edge's whole block
+        -> secagg_shed; an edge-recovered block's dead slots ->
+        secagg_dropout — the SAME verdicts the flat tier records for the
+        same fates), then decode the folded field partials ONCE and run
+        the shared decode-side tail."""
+        t0 = time.perf_counter()
+        ids = self.client_sampling(self.current_round)
+        missing = [e for e in range(self.topology.edges)
+                   if e not in self._edge_frames]
+        shed_slots: list[int] = []
+        drop_slots: list[int] = []
+        for e in missing:
+            shed_slots.extend(self.topology.slots_of_edge(e))
+        for fr in self._edge_frames.values():
+            (shed_slots if fr["outcome"] == "shed"
+             else drop_slots).extend(fr["dead"])
+        for s in sorted(shed_slots):
+            self.quarantine.record(self.current_round, s + 1,
+                                   "secagg_shed", client=int(ids[s]))
+            _obs.record_update_rejected("secagg_shed")
+        for s in sorted(drop_slots):
+            self.quarantine.record(self.current_round, s + 1,
+                                   "secagg_dropout", client=int(ids[s]))
+            _obs.record_update_rejected("secagg_dropout")
+        if shed_slots or drop_slots:
+            _perf.record_secagg_dropped(len(shed_slots) + len(drop_slots))
+        if missing:
+            log.warning("hier secagg round %d: edge frame(s) %s lost — "
+                        "their blocks shed (ledgered secagg_shed)",
+                        self.current_round, missing)
+        self.fanin_history.append(len(self._edge_frames))
+        survivors = sorted(self._round_slots)
+        if not survivors:
+            log.warning("hier secagg round %d: every block lost — "
+                        "keeping the current global model",
+                        self.current_round)
+            self._acc, self._recovery = None, None
+            self._round_slots, self._b_shares, self._extras = set(), {}, {}
+            self.sample_num_dict.clear()
+            return pack_pytree(self.net)
+        vec_sum = sa.field_decode_sum(self._acc, self.secagg)
+        return self._finish_aggregate(vec_sum, survivors, t0)
+
+
+class HierTASecureServerManager(FedAvgServerManager):
+    """Root manager of the hierarchical masked tier: broadcasts one frame
+    per EDGE, advances on E e2s_masked_agg frames. The tiered recovery
+    lives at the edges — the root never sees a reveal; its only dropout
+    duty is the base elastic watchdog, whose partial advance sheds a
+    whole lost edge's block (HierTAAggregator ledgers it). Cannot subclass
+    HierFedAvgServerManager (its type check demands the dense hier
+    aggregator); the shared behavior is all in FedAvgServerManager."""
+
+    def __init__(self, aggregator: HierTAAggregator, topology=None, **kw):
+        if not isinstance(aggregator, HierTAAggregator):
+            raise TypeError("HierTASecureServerManager needs a "
+                            "HierTAAggregator")
+        self.topology = topology or aggregator.topology
+        for flag, name in ((kw.get("async_buffer_k"), "async_buffer_k"),
+                           (kw.get("delta_broadcast"), "delta_broadcast"),
+                           (kw.get("heartbeat_max_age_s"),
+                            "heartbeat_max_age_s")):
+            if flag:
+                raise ValueError(
+                    f"{name} is not wired through the masked edge tier — "
+                    "run the flat topology for that mode")
+        super().__init__(aggregator, **kw)
+        if not hasattr(self, "_last_secagg"):
+            self._last_secagg: dict | None = None
+
+    def _validate_world_size(self, size: int) -> None:
+        if size != self.topology.world_size:
+            raise ValueError(
+                f"world size {size} != 1 + {self.topology.edges} edges + "
+                f"{self.topology.workers} workers")
+
+    def register_message_receive_handlers(self):
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_E2S_SEND_MASKED_AGG_TO_SERVER,
+            self.handle_message_masked_partial)
+
+    def _broadcast_model(self, msg_type: str, global_params) -> None:
+        """One frame per EDGE (fan-out O(edges)), mirroring the dense
+        hier root: model + the edge block's client assignments + round."""
+        from fedml_tpu.comm.message import codec_roundtrip
+
+        topo = self.topology
+        client_indexes = self.aggregator.client_sampling(self.round_idx)
+        self._round_ids = [int(c) for c in client_indexes]
+        self.aggregator.begin_round(self.round_idx)
+        self._bcast_leaves = codec_roundtrip(global_params)
+        self._stash_version(self.round_idx, self._bcast_leaves)
+        for e in range(topo.edges):
+            msg = Message(msg_type, self.rank, topo.edge_rank(e))
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           global_params)
+            msg.add_params(
+                MyMessage.MSG_ARG_KEY_CHILD_CLIENTS,
+                [int(client_indexes[s]) for s in topo.slots_of_edge(e)])
+            msg.add_params(MyMessage.MSG_ARG_KEY_ROUND, self.round_idx)
+            self.send_message(msg)
+
+    def handle_message_masked_partial(self, msg_params) -> None:
+        with self._round_lock:
+            sender = int(msg_params[Message.MSG_ARG_KEY_SENDER])
+            msg_round = int(msg_params.get(MyMessage.MSG_ARG_KEY_ROUND,
+                                           self.round_idx))
+            if msg_round != self.round_idx:
+                _obs.record_stale_upload("stale")
+                log.warning("drop stale masked partial from rank %d "
+                            "(round %s, now %d)", sender, msg_round,
+                            self.round_idx)
+                return
+            rs = msg_params.get(MyMessage.MSG_ARG_KEY_SECAGG_RECOVERY_S)
+            self.aggregator.add_edge_masked_result(
+                sender - 1,
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_FIELD_SUM],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_SURVIVORS],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_DEAD],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_SLOT_SAMPLES],
+                msg_params[MyMessage.MSG_ARG_KEY_EDGE_EXTRAS],
+                str(msg_params[MyMessage.MSG_ARG_KEY_SECAGG_OUTCOME]),
+                recovery_s=None if rs is None else float(rs),
+                round_idx=msg_round)
+            if self.aggregator.check_whether_all_receive():
+                self._advance_round()
+
+    def _advance_round(self):
+        """Fix the round's secagg verdict (for the metric + the round
+        record) from the edge frames BEFORE the base advance consumes
+        them: any missing or shed block makes the round a shed; recovered
+        blocks alone make it recovered. Caller holds _round_lock."""
+        agg: HierTAAggregator = self.aggregator
+        frames = agg._edge_frames
+        missing = [e for e in range(self.topology.edges)
+                   if e not in frames]
+        dead = sorted(
+            {s for e in missing for s in self.topology.slots_of_edge(e)}
+            | {int(d) for fr in frames.values() for d in fr["dead"]})
+        outcomes = [fr["outcome"] for fr in frames.values()]
+        if missing or "shed" in outcomes:
+            outcome = "shed"
+        elif dead:
+            outcome = "recovered"
+        else:
+            outcome = "full"
+        _perf.record_secagg_round(outcome)
+        self._last_secagg = {"outcome": outcome, "dead": dead}
+        rts = [fr["recovery_s"] for fr in frames.values()
+               if fr["recovery_s"] is not None]
+        if rts:
+            self._last_secagg["recovery_s"] = round(max(rts), 6)
+            _perf.record_secagg_recovery_seconds(max(rts))
+        super()._advance_round()
+
+    def _round_record_extra(self) -> dict:
+        extra = super()._round_record_extra()
+        hist = self.aggregator.fanin_history
+        extra["hier"] = {"edges": self.topology.edges,
+                         "block": self.topology.block,
+                         "fan_in": hist[-1] if hist else 0}
         if self._last_secagg is not None:
             extra["secagg"] = dict(self._last_secagg)
         return extra
@@ -626,12 +1223,21 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
                   norm_bound: float = 30.0, noise_multiplier: float = 1.0,
                   secagg_max_abs: float = 4.0, chaos_plan=None,
                   round_timeout_s: float | None = None, telemetry=None,
-                  ckpt_dir: str | None = None, n_shares=None):
+                  ckpt_dir: str | None = None, n_shares=None,
+                  edges: int | None = None, fused_ingest: bool = False):
     """All ranks as threads (mpirun-on-localhost analogue); returns the
     aggregator with .net/.history. ``chaos_plan`` + ``round_timeout_s``
     arm the dropout-recovery scenario deterministically; ``defense_type=
     'dp'`` runs accounted DP on the masked path (privacy block on every
-    round record)."""
+    round record). ``edges=E`` runs the hierarchical masked tier (module
+    docstring) — bitwise the flat aggregate; ``fused_ingest`` keeps the
+    fold accumulator device-resident (also bitwise)."""
+    if edges:
+        return _run_simulated_tree(
+            dataset, task, cfg, backend, job_id, base_port, threshold_t,
+            quant_scale, defense_type, norm_bound, noise_multiplier,
+            secagg_max_abs, chaos_plan, round_timeout_s, telemetry,
+            ckpt_dir, int(edges), fused_ingest)
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port)
     from fedml_tpu import chaos as _chaos
@@ -658,7 +1264,8 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
                 threshold_t=threshold_t, quant_scale=quant_scale,
                 defense_type=defense_type, norm_bound=norm_bound,
                 noise_multiplier=noise_multiplier,
-                secagg_max_abs=secagg_max_abs, n_shares=n_shares)
+                secagg_max_abs=secagg_max_abs, n_shares=n_shares,
+                fused_ingest=fused_ingest)
             return TASecureServerManager(
                 agg, rank=0, size=size, backend=backend,
                 round_timeout_s=round_timeout_s, telemetry=telemetry,
@@ -684,6 +1291,84 @@ def run_simulated(dataset, task, cfg: FedAvgConfig, backend="LOOPBACK",
             aggregator = server.aggregator
         else:
             launch_simulated(server, clients)
+    finally:
+        if chaos_plan is not None:
+            _chaos.install_plan(None)
+    return aggregator
+
+
+def _run_simulated_tree(dataset, task, cfg: FedAvgConfig, backend, job_id,
+                        base_port, threshold_t, quant_scale, defense_type,
+                        norm_bound, noise_multiplier, secagg_max_abs,
+                        chaos_plan, round_timeout_s, telemetry, ckpt_dir,
+                        edges: int, fused_ingest: bool):
+    """The 2-tier masked runtime: 1 root + E edges + W workers as
+    threads. Workers mask against their edge block's peers (global slot
+    ids — masks cancel at the edge); cohort/slot/client assignments
+    coincide with the flat runtime round-for-round, so tree ≡ flat is
+    bitwise (model bits AND ledger — the tests pin it)."""
+    topo = EdgeTopology(edges=edges, workers=cfg.client_num_per_round)
+    kw = backend_kwargs(backend, job_id, base_port)
+    from fedml_tpu import chaos as _chaos
+
+    if chaos_plan is not None:
+        _chaos.install_plan(chaos_plan)
+    try:
+        active = _chaos.active_plan()
+        crash_points = (active.server_crash_points()
+                        if active is not None else [])
+        if crash_points and ckpt_dir is None:
+            raise ValueError(
+                "a chaos crash rule naming rank 0 (server restart) needs "
+                "ckpt_dir= — recovery replays checkpoint + WAL")
+
+        def build_server():
+            agg = HierTAAggregator(
+                dataset, task, cfg, topo, threshold_t=threshold_t,
+                quant_scale=quant_scale, defense_type=defense_type,
+                norm_bound=norm_bound, noise_multiplier=noise_multiplier,
+                secagg_max_abs=secagg_max_abs, fused_ingest=fused_ingest)
+            return HierTASecureServerManager(
+                agg, rank=0, size=topo.world_size, backend=backend,
+                round_timeout_s=round_timeout_s, telemetry=telemetry,
+                ckpt_dir=ckpt_dir, **kw)
+
+        server = build_server()
+        aggregator = server.aggregator
+        # edge watchdogs at HALF the root deadline (the tiered contract:
+        # in-block reveal recovery — including its one retry — resolves
+        # strictly before the root's own timeout sheds the whole block)
+        edge_timeout = (round_timeout_s / 2.0
+                        if round_timeout_s is not None else None)
+        peers = [
+            TASecureEdgeManager(
+                topo.edge_rank(e), topo, cfg, threshold_t=threshold_t,
+                quant_scale=quant_scale, defense_type=defense_type,
+                norm_bound=norm_bound, secagg_max_abs=secagg_max_abs,
+                backend=backend, round_timeout_s=edge_timeout, **kw)
+            for e in range(topo.edges)
+        ]
+        for slot in range(topo.workers):
+            rank = topo.worker_rank(slot)
+            trainer = SecureTrainer(
+                rank, dataset, task, cfg, threshold_t=threshold_t,
+                quant_scale=quant_scale, defense_type=defense_type,
+                norm_bound=norm_bound, secagg_max_abs=secagg_max_abs,
+                slot=slot,
+                peers=list(topo.slots_of_edge(topo.edge_of_slot(slot))))
+            peers.append(TASecureClientManager(
+                trainer, rank=rank, size=topo.world_size, backend=backend,
+                server_rank=topo.edge_rank(topo.edge_of_slot(slot)), **kw))
+        if crash_points:
+            from fedml_tpu.distributed.fedavg.api import (
+                run_supervised_simulated,
+            )
+
+            server = run_supervised_simulated(server, peers, crash_points,
+                                              build_server)
+            aggregator = server.aggregator
+        else:
+            launch_simulated(server, peers)
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)

@@ -80,6 +80,12 @@ def add_args(p: argparse.ArgumentParser):
                         "forward per-client evidence, the root returns "
                         "verdict frames, edges fold only survivors — "
                         "docs/ROBUSTNESS.md §Cross-tier robust gating). "
+                        "With --algo turboaggregate the tree runs the "
+                        "hierarchical MASKED tier instead: per-block "
+                        "pairwise masks, edge-local dropout reveal, one "
+                        "unmasked field partial per edge "
+                        "(docs/ROBUSTNESS.md §Hierarchical secure "
+                        "aggregation). "
                         "Workers are ranks E+1..world_size-1; the "
                         "per-edge block size (workers/edges) must be a "
                         "power of two. 0 = flat (default)")
@@ -344,8 +350,11 @@ def add_args(p: argparse.ArgumentParser):
                         "materializes per-client f32 trees on host. "
                         "Implies pairwise summation; refuses "
                         "--aggregator / --shard_server_state / "
-                        "--async_buffer_k / --edges (those keep the "
-                        "stacked route)")
+                        "--async_buffer_k / dense --edges (those keep "
+                        "the stacked route). Under --algo turboaggregate "
+                        "it selects the device-resident mod-p fold for "
+                        "masked ingest (flat or --edges), bitwise equal "
+                        "to the host fold")
     p.add_argument("--precision", type=str, default="f32",
                    choices=["f32", "bf16"],
                    help="client-compute precision policy (docs/"
@@ -379,10 +388,13 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         # composition is a LOUD error on every rank (the former
         # warn-and-ignore for --shard_server_state included; ranks share
         # argv, so client and server refuse identically, test-pinned)
+        # --fused_agg and --edges used to sit in this matrix; they are
+        # compositions now (fused masked ingest folds arrivals mod p on
+        # device; --edges runs the hierarchical masked tier —
+        # docs/ROBUSTNESS.md §Hierarchical secure aggregation)
         incompatible = [name for name, v in (
             ("--shard_server_state",
              getattr(args, "shard_server_state", 0) or None),
-            ("--fused_agg", getattr(args, "fused_agg", 0) or None),
             ("--async_buffer_k", getattr(args, "async_buffer_k", None)),
             ("--update_codec", getattr(args, "update_codec", None)),
             ("--sparsify_ratio", getattr(args, "sparsify_ratio", None)),
@@ -394,7 +406,6 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
              getattr(args, "heartbeat_max_age_s", None)),
             ("--sum_assoc", None if getattr(args, "sum_assoc", "auto")
              == "auto" else args.sum_assoc),
-            ("--edges", getattr(args, "edges", 0) or None),
             # a masked upload carries no model-space structure an
             # adversary plan could perturb meaningfully — silently
             # running it would fake a Byzantine-robustness result
@@ -404,11 +415,65 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
             raise ValueError(
                 f"--algo turboaggregate (masked secure aggregation) does "
                 f"not compose with {incompatible}: masked field vectors "
-                "aggregate host-side mod p — there is no device-resident "
-                "server plane to shard/fuse, no per-update structure for "
-                "codecs or robust estimators, and the synchronous cohort "
-                "is the protocol (docs/ROBUSTNESS.md §Secure aggregation)")
+                "aggregate mod p — there is no server plane to shard, no "
+                "per-update structure for codecs or robust estimators, "
+                "and the synchronous cohort is the protocol "
+                "(docs/ROBUSTNESS.md §Secure aggregation)")
     edges = int(getattr(args, "edges", 0) or 0)
+    if edges and args.algo == "turboaggregate":
+        # hierarchical masked secure aggregation (docs/ROBUSTNESS.md
+        # §Hierarchical secure aggregation): pairwise masks are drawn
+        # within each edge block, so every edge strips its block's masks
+        # locally (tiered reveal for in-block dropouts) and forwards ONE
+        # unmasked field partial — root ingress stays O(edges) frames and
+        # tree ≡ flat stays bitwise (mod-p addition is associative).
+        from fedml_tpu.distributed.fedavg.hierarchy import EdgeTopology
+        from fedml_tpu.distributed.turboaggregate import (
+            HierTAAggregator,
+            HierTASecureServerManager,
+            SecureTrainer,
+            TASecureClientManager,
+            TASecureEdgeManager,
+        )
+
+        topo = EdgeTopology(edges=edges,
+                            workers=args.world_size - 1 - edges)
+        secagg_kw = dict(
+            threshold_t=args.secagg_threshold_t,
+            quant_scale=args.secagg_quant_scale,
+            defense_type=("dp" if args.defense_type == "dp" else "none"),
+            norm_bound=args.norm_bound,
+            secagg_max_abs=args.secagg_max_abs)
+        if args.rank == 0:
+            agg = HierTAAggregator(
+                data, task, cfg, topo,
+                noise_multiplier=args.noise_multiplier,
+                fused_ingest=bool(getattr(args, "fused_agg", 0)),
+                **secagg_kw)
+            return HierTASecureServerManager(
+                agg, rank=0, size=args.world_size, backend=backend,
+                ckpt_dir=args.ckpt_dir,
+                round_timeout_s=args.round_timeout_s,
+                telemetry=telemetry, **backend_kw)
+        if args.rank <= edges:
+            # edge watchdog at HALF the root deadline, same rationale as
+            # the dense tier: block-local reveal/shed resolves before the
+            # root's whole-edge elasticity (replay determinism)
+            return TASecureEdgeManager(
+                args.rank, topo, cfg, backend=backend,
+                round_timeout_s=(args.round_timeout_s / 2.0
+                                 if args.round_timeout_s else None),
+                **secagg_kw, **backend_kw)
+        slot = topo.slot_of(args.rank)
+        trainer = SecureTrainer(
+            args.rank, data, task, cfg, slot=slot,
+            peers=list(topo.slots_of_edge(topo.edge_of_slot(slot))),
+            **secagg_kw)
+        return TASecureClientManager(
+            trainer, rank=args.rank, size=args.world_size,
+            backend=backend,
+            server_rank=topo.edge_rank(topo.edge_of_slot(slot)),
+            **backend_kw)
     if edges:
         # hierarchical 2-tier topology: rank 0 root, 1..E edges, rest
         # workers. Dense synchronous protocol; --aggregator (+ the
@@ -416,7 +481,9 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
         # protocol (docs/ROBUSTNESS.md §Cross-tier robust gating).
         if args.algo not in ("fedavg", "fedprox"):
             raise ValueError(f"--edges is wired for fedavg/fedprox only "
-                             f"(got --algo {args.algo})")
+                             f"(got --algo {args.algo}; "
+                             f"--algo turboaggregate takes the masked "
+                             f"tree route above)")
         incompatible = [name for name, v in (
             ("--async_buffer_k", getattr(args, "async_buffer_k", None)),
             ("--sparsify_ratio", getattr(args, "sparsify_ratio", None)),
@@ -544,7 +611,8 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                               else "none"),
                 norm_bound=args.norm_bound,
                 noise_multiplier=args.noise_multiplier,
-                secagg_max_abs=args.secagg_max_abs)
+                secagg_max_abs=args.secagg_max_abs,
+                fused_ingest=bool(getattr(args, "fused_agg", 0)))
             return TASecureServerManager(
                 agg, rank=0, size=args.world_size, backend=backend,
                 ckpt_dir=args.ckpt_dir,

@@ -163,6 +163,10 @@ class HealthMonitor:
         # cumulative DP ε from the newest round record's privacy block
         # (None = not a DP run; the privacy_budget rule stays quiet)
         self._privacy_eps: float | None = None
+        # worst per-client ε (docs/ROBUSTNESS.md §Hierarchical secure
+        # aggregation: per-client ledger) — None until a round record
+        # carries the client-granular summary
+        self._privacy_eps_client: float | None = None
         self._last_quar = self.registry.total("fed_updates_rejected_total")
         self._last_shed = self.registry.total("fed_async_shed_total")
         # edge-trigger state + the full fired/resolved ledger
@@ -204,6 +208,9 @@ class HealthMonitor:
             eps = (rec.get("privacy") or {}).get("eps")
             if isinstance(eps, (int, float)):
                 self._privacy_eps = float(eps)
+            eps_cli = (rec.get("privacy") or {}).get("eps_client_max")
+            if isinstance(eps_cli, (int, float)):
+                self._privacy_eps_client = float(eps_cli)
             if rec.get("eval"):
                 self._fold_eval(rec["eval"])
             # per-round quarantine/shed movement from the registry totals
@@ -438,6 +445,9 @@ class HealthMonitor:
                 # cumulative DP ε (null outside DP runs) — the live twin
                 # of the round records' privacy block / fed_privacy_epsilon
                 "privacy_epsilon": self._privacy_eps,
+                # worst per-client ε (null until a per-client ledger run
+                # reports) — live twin of fed_privacy_client_epsilon
+                "eps_client_max": self._privacy_eps_client,
                 # server crash recovery (docs/ROBUSTNESS.md §Server crash
                 # recovery): the WAL's restart epoch (0 = never crashed)
                 "restart_epoch": int(self.registry.total(

@@ -310,12 +310,40 @@ def set_privacy_epsilon(eps: float) -> None:
     REGISTRY.gauge("fed_privacy_epsilon").set(float(eps))
 
 
+#     fed_privacy_client_epsilon{stat}    per-client ε rollup at the
+#                                         ledger's reporting δ: stat=max
+#                                         (worst single client — the
+#                                         never-under-report figure),
+#                                         stat=mean, stat=count (clients
+#                                         with any charge). Fed by
+#                                         core/privacy.charge_and_record
+#                                         when a ClientPrivacyLedger rides
+#                                         the round.
+@lru_cache(maxsize=4)
+def _client_eps(stat: str):
+    return REGISTRY.gauge("fed_privacy_client_epsilon", stat=stat)
+
+
+def set_client_epsilon(eps_max: float, eps_mean: float, count: int) -> None:
+    _client_eps("max").set(float(eps_max))
+    _client_eps("mean").set(float(eps_mean))
+    _client_eps("count").set(float(count))
+
+
 def ensure_secagg_families() -> None:
     """Pre-register the secure-aggregation outcome children at zero so a
     masked run's Prometheus export always carries the full family."""
     for outcome in ("full", "recovered", "shed"):
         _secagg_rounds(outcome)
     _secagg_dropped()
+
+
+def ensure_client_privacy_family() -> None:
+    """Pre-register the per-client ε gauge children at zero so a DP
+    masked run's export always carries the family (even before the first
+    charge lands)."""
+    for stat in ("max", "mean", "count"):
+        _client_eps(stat)
 
 
 # ---------------------------------------------------- server crash recovery

@@ -77,7 +77,8 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              update_codec: str | None = None,
              sparsify_ratio: float | None = None,
              edges: int | None = None,
-             sum_assoc: str = "auto", fleet: bool = False) -> dict:
+             sum_assoc: str = "auto", fleet: bool = False,
+             secagg: bool = False) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
@@ -99,7 +100,15 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     edge aggregators, the rest workers; docs/ROBUSTNESS.md §Cross-tier
     robust gating) — chaos then lands on BOTH tiers, a crashed edge rank
     exercises the edge_lost elastic path, and the record gains per-tier
-    fan-in stats."""
+    fan-in stats.
+
+    ``secagg`` runs the trial on the MASKED secure-aggregation tier
+    (docs/ROBUSTNESS.md §Secure aggregation; with ``edges`` the
+    hierarchical composition of §Hierarchical secure aggregation) —
+    chaos then exercises the dropout-recovery state machine: a lossy or
+    crashed worker heals via the reveal round-trip (edge-local in tree
+    mode), a crashed EDGE sheds exactly its block, and the round
+    outcomes land in the quarantine counts the record carries."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.distributed.fedavg import run_simulated
     from fedml_tpu.obs import Telemetry
@@ -132,16 +141,31 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
                         staleness="poly:0.5",
                         buffer_deadline_s=round_timeout_s)
     try:
-        agg = run_simulated(data, task, cfg, backend="LOOPBACK",
-                            job_id=f"soak-{plan.seed}-{time.time_ns()}",
-                            chaos_plan=plan, round_timeout_s=round_timeout_s,
-                            adversary_plan=adversary_plan,
-                            aggregator=aggregator,
-                            aggregator_params=agg_params,
-                            update_codec=update_codec,
-                            sparsify_ratio=sparsify_ratio,
-                            edges=edges, sum_assoc=sum_assoc,
-                            telemetry=tel, **async_kw)
+        if secagg:
+            from fedml_tpu.distributed import turboaggregate as ta
+
+            # threshold_t=1: recovery needs t+1 survivors WITHIN the
+            # block, and the soak's tree blocks can be as small as 2
+            # slots — the default t=2 would refuse at construction
+            agg = ta.run_simulated(data, task, cfg, backend="LOOPBACK",
+                                   job_id=f"soak-{plan.seed}-"
+                                          f"{time.time_ns()}",
+                                   chaos_plan=plan,
+                                   round_timeout_s=round_timeout_s,
+                                   threshold_t=1, edges=edges,
+                                   telemetry=tel)
+        else:
+            agg = run_simulated(data, task, cfg, backend="LOOPBACK",
+                                job_id=f"soak-{plan.seed}-{time.time_ns()}",
+                                chaos_plan=plan,
+                                round_timeout_s=round_timeout_s,
+                                adversary_plan=adversary_plan,
+                                aggregator=aggregator,
+                                aggregator_params=agg_params,
+                                update_codec=update_codec,
+                                sparsify_ratio=sparsify_ratio,
+                                edges=edges, sum_assoc=sum_assoc,
+                                telemetry=tel, **async_kw)
     except Exception as e:  # noqa: BLE001 — a soak trial failing IS the data
         err = repr(e)
     finally:
@@ -208,7 +232,8 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
         "faults": plan.ledger.counts(),
         "n_faults": len(plan.ledger),
         "quarantine": (agg.quarantine.counts()
-                       if agg is not None and adversary_plan is not None
+                       if agg is not None and (adversary_plan is not None
+                                               or secagg)
                        else None),
         "final_eval": (agg.history[-1] if agg and agg.history else None),
         "seconds": round(time.perf_counter() - t0, 2),
@@ -404,6 +429,13 @@ def main(argv=None) -> int:
                          "lost slot ledgered server_restart). Recovery "
                          "runs the real checkpoint + WAL + resume-probe "
                          "path per trial; excludes the other tiers")
+    ap.add_argument("--secagg", action="store_true",
+                    help="run every trial on the masked secure-aggregation "
+                         "tier (docs/ROBUSTNESS.md §Secure aggregation; "
+                         "composes with --edges into the hierarchical "
+                         "masked tree of §Hierarchical secure aggregation "
+                         "— in-block dropout heals via the edge-local "
+                         "reveal, a crashed edge sheds exactly its block)")
     ap.add_argument("--fleet", action="store_true",
                     help="arm the fleet observability plane on every trial "
                          "(docs/OBSERVABILITY.md §Fleet rollup): uplinks "
@@ -413,9 +445,15 @@ def main(argv=None) -> int:
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args(argv)
     if args.server_crash and (args.edges or args.async_buffer_k
-                              or args.adversary_plan or args.compression):
+                              or args.adversary_plan or args.compression
+                              or args.secagg):
         ap.error("--server-crash is its own tier — drop --edges/"
-                 "--async-buffer-k/--adversary-plan/--compression")
+                 "--async-buffer-k/--adversary-plan/--compression/--secagg")
+    if args.secagg and (args.async_buffer_k or args.adversary_plan
+                        or args.compression):
+        ap.error("--secagg composes only with --edges — the masked tier "
+                 "is synchronous and uploads ride the field codec, not "
+                 "the dense adversary/compression paths")
     if args.edges:
         if args.async_buffer_k:
             ap.error("--edges does not compose with --async-buffer-k "
@@ -509,7 +547,7 @@ def main(argv=None) -> int:
                        world_size=args.world_size, adversary_plan=adv(),
                        aggregator=aggregator, edges=args.edges,
                        async_buffer_k=args.async_buffer_k,
-                       fleet=args.fleet, **codec_kw)
+                       fleet=args.fleet, secagg=args.secagg, **codec_kw)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
@@ -520,8 +558,9 @@ def main(argv=None) -> int:
                             adversary_plan=adv(), aggregator=aggregator,
                             edges=args.edges,
                             async_buffer_k=args.async_buffer_k,
-                            fleet=args.fleet, **codec_kw)
-            if args.async_buffer_k or args.edges:
+                            fleet=args.fleet, secagg=args.secagg,
+                            **codec_kw)
+            if args.async_buffer_k or args.edges or args.secagg:
                 # async dispatch counts and arrival order are
                 # thread-scheduled, so even per-link fault draws shift
                 # between runs: the replay invariant is LIVENESS — the
@@ -538,7 +577,11 @@ def main(argv=None) -> int:
                 # (tests/test_hierarchy_robust.py, single-fault plans
                 # with wide margins); HERE the tree's determinism
                 # evidence is the chaos-free tree-vs-flat bitwise spot
-                # check below.
+                # check below. Masked trials (--secagg) share it too:
+                # under a multi-fault plan, WHICH watchdog tick races
+                # which reveal frame decides recovered-vs-shed on the
+                # wall clock (the seeded bit-for-bit masked replays are
+                # tier-1's, tests/test_hierarchy_secagg.py).
                 replay_ok = (rec2["completed_rounds"]
                              == rec["completed_rounds"] == args.rounds)
             else:
@@ -566,12 +609,12 @@ def main(argv=None) -> int:
                                  world_size=args.world_size,
                                  adversary_plan=adv(),
                                  aggregator=aggregator, edges=args.edges,
-                                 **codec_kw)
+                                 secagg=args.secagg, **codec_kw)
                 f_rec = run_plan(
                     data, task, empty(), rounds=args.rounds,
                     world_size=args.world_size - args.edges,
                     adversary_plan=adv(), aggregator=aggregator,
-                    sum_assoc="pairwise", **codec_kw)
+                    sum_assoc="pairwise", secagg=args.secagg, **codec_kw)
                 tf_ok = (t_rec["qledger"] == f_rec["qledger"]
                          and t_rec["net"] is not None and all(
                              np.array_equal(np.asarray(a), np.asarray(b))
@@ -618,6 +661,13 @@ def main(argv=None) -> int:
                 k = a["rule"]
                 summary["alerts_fired_total"][k] = \
                     summary["alerts_fired_total"].get(k, 0) + 1
+    if args.secagg:
+        # masked-tier roll-up: how chaos landed on the recovery machine
+        # (secagg_dropout = healed via reveal, secagg_shed = block lost)
+        summary["secagg"] = True
+        summary["secagg_slots_total"] = {
+            k: sum((t.get("quarantine") or {}).get(k, 0) for t in trials)
+            for k in ("secagg_dropout", "secagg_shed")}
     if args.async_buffer_k:
         summary["async_buffer_k"] = args.async_buffer_k
     if args.compression:

@@ -520,6 +520,69 @@ PY
   # within spec (accounting math + monotonicity + bounded accuracy cost)
   python scripts/bench_gate.py BENCH_DP_r01.json \
     --gate scripts/ci_dp_gate.json
+  echo "== hierarchical masked secagg smoke (2 edges x 4 workers; seeded in-block dropout -> edge-local reveal; per-client eps family exported; report renders eps_cli) =="
+  # the masked tier composed with the tree (docs/ROBUSTNESS.md
+  # §Hierarchical secure aggregation) must (a) run a dp 2-tier masked
+  # campaign where a seeded in-block crash recovers via the EDGE-LOCAL
+  # reveal (secagg_dropout ledgered at cohort rank, outcome=recovered,
+  # root ingress O(edges) through the recovery), and (b) carry the
+  # per-client privacy ledger end to end: eps_client_max on the round
+  # records, the fed_privacy_client_epsilon{stat} family next to
+  # fed_privacy_epsilon in the Prometheus export, and report.py's
+  # eps_cli column (hidden on pre-ledger logs)
+  HSA_DIR=./tmp/ci_hier_secagg; rm -rf "$HSA_DIR"
+  python - "$HSA_DIR" <<'PY'
+import sys
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.chaos import FaultPlan
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed import turboaggregate as ta
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+data = synthetic_images(num_clients=8, image_shape=(6, 6, 1), num_classes=3,
+                        samples_per_client=12, test_samples=24, seed=0)
+task = classification_task(LogisticRegression(num_classes=3))
+cfg = FedAvgConfig(comm_round=2, client_num_in_total=8,
+                   client_num_per_round=8, batch_size=6, lr=0.1,
+                   frequency_of_the_test=1)
+# worker rank 4 = slot 1 (edge 0's block) dark in round 1: the edge
+# strips its orphaned masks locally and forwards a recovered partial
+plan = FaultPlan.from_json({"seed": 7, "rules": [
+    {"fault": "crash", "ranks": [4], "rounds": [1, 2]}]})
+tel = Telemetry(log_dir=d)
+agg = ta.run_simulated(data, task, cfg, job_id="ci-hsa", edges=2,
+                       defense_type="dp", noise_multiplier=1.0,
+                       norm_bound=0.5, chaos_plan=plan,
+                       round_timeout_s=3.0, telemetry=tel)
+tel.close()
+assert agg.history and agg.history[-1]["round"] == 1, agg.history[-1:]
+led = agg.quarantine.canonical()
+drops = [e for e in led if e[2] == "secagg_dropout"]
+assert drops and {(e[0], e[1]) for e in drops} == {(1, 2)}, led
+assert not any(e[2] == "secagg_shed" for e in led), led  # edge-LOCAL heal
+assert agg.fanin_history == [2, 2], agg.fanin_history  # O(edges) ingress
+block = agg.privacy_record()
+assert block and block["eps_client_max"] > 0 \
+    and block["clients_charged"] >= 7, block
+import os
+prom = open(os.path.join(d, "metrics.prom")).read()
+assert "fed_privacy_epsilon" in prom, "cohort eps gauge missing"
+for stat in ("max", "mean", "count"):
+    assert f'fed_privacy_client_epsilon{{stat="{stat}"}}' in prom, \
+        f"per-client eps stat={stat} missing from the export"
+assert 'fed_secagg_rounds_total{outcome="recovered"}' in prom
+print(f"hierarchical masked secagg smoke ok: in-block dropout recovered "
+      f"edge-locally (ledger {led}), fan-in {agg.fanin_history}, "
+      f"eps_client_max={block['eps_client_max']} over "
+      f"{block['clients_charged']} clients")
+PY
+  python scripts/report.py "$HSA_DIR/events.jsonl" | tee ./tmp/ci_hsa_report.txt
+  grep -q "eps_cli" ./tmp/ci_hsa_report.txt \
+    || { echo "report.py did not render the eps_cli column"; exit 1; }
   echo "== flat-memory streamed smoke (100k-virtual-client PackedNpySource run; fed_host_rss_bytes flat across rounds, gated via bench_gate.py) =="
   # the streamed data plane (docs/PERFORMANCE.md §Streaming & cohort
   # bucketing) must hold host RSS FLAT in population size: a 100k-client
@@ -953,6 +1016,14 @@ python scripts/chaos_soak.py --trials 3 --rounds 3 --compression delta-int8 \
 python scripts/chaos_soak.py --trials 3 --rounds 3 --world_size 7 --edges 2 \
   --adversary-plan '{"seed": 5, "rules": [{"attack": "sign_flip", "ranks": [1], "factor": 10.0}]}' \
   --out ./tmp/chaos_soak_edges.json
+# hierarchical masked secure-aggregation tier (docs/ROBUSTNESS.md
+# §Hierarchical secure aggregation): the same seeded wire faults over the
+# 2-tier MASKED tree — in-block dropout heals via the edge-local reveal,
+# a crashed edge sheds exactly its block, replays assert liveness, and
+# the chaos-free spot check pins masked tree == masked flat bitwise
+# (model bits AND quarantine ledger)
+python scripts/chaos_soak.py --secagg --trials 3 --rounds 3 --world_size 7 \
+  --edges 2 --out ./tmp/chaos_soak_secagg.json
 # server-crash tier (docs/ROBUSTNESS.md §Server crash recovery): seeded
 # rank-0 kills through checkpoint + WAL recovery — even trials between
 # commits must land bitwise on an uninterrupted oracle (model AND

@@ -116,6 +116,10 @@ def render_table(records: list[dict]) -> str:
             # ε@δ — both hide on logs that predate the blocks
             "secagg": (r.get("secagg") or {}).get("outcome"),
             "eps": (r.get("privacy") or {}).get("eps"),
+            # per-client privacy ledger (docs/ROBUSTNESS.md §Hierarchical
+            # secure aggregation): the worst single client's ε@δ — hides
+            # on logs that predate the per-client ledger
+            "eps_cli": (r.get("privacy") or {}).get("eps_client_max"),
             # server crash recovery (docs/ROBUSTNESS.md §Server crash
             # recovery): cumulative supervised restarts behind this round
             # — the column hides on runs (and pre-WAL logs) that never

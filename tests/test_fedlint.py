@@ -763,10 +763,17 @@ def test_fsync_discipline_flags_bare_write_in_scoped_modules(tmp_path):
     out = lint(tmp_path, "core/wal.py", FSYNC_BAD,
                rules=["fsync-discipline"])
     assert rules_hit(out) == {"fsync-discipline"}
+    # per-client ε ledgers (core/privacy.py) carry the never-under-report
+    # promise — any persistence they grow must route through durable_*
+    out = lint(tmp_path, "core/privacy.py", FSYNC_BAD,
+               rules=["fsync-discipline"])
+    assert rules_hit(out) == {"fsync-discipline"}
 
 
 def test_fsync_discipline_clean_fixture_and_scope(tmp_path):
     assert lint(tmp_path, "core/wal.py", FSYNC_CLEAN,
+                rules=["fsync-discipline"]) == []
+    assert lint(tmp_path, "core/privacy.py", FSYNC_CLEAN,
                 rules=["fsync-discipline"]) == []
     # out of scope: any other module may open-for-write freely (their
     # durability story is their own), including a checkpoint.py OUTSIDE
@@ -774,6 +781,8 @@ def test_fsync_discipline_clean_fixture_and_scope(tmp_path):
     assert lint(tmp_path, "obs/events.py", FSYNC_BAD,
                 rules=["fsync-discipline"]) == []
     assert lint(tmp_path, "data/checkpoint.py", FSYNC_BAD,
+                rules=["fsync-discipline"]) == []
+    assert lint(tmp_path, "obs/privacy.py", FSYNC_BAD,
                 rules=["fsync-discipline"]) == []
 
 

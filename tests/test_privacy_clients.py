@@ -1,0 +1,288 @@
+"""Per-client privacy accounting (docs/ROBUSTNESS.md §Hierarchical
+secure aggregation, per-client ledger): ε budgets at CLIENT granularity,
+charged at the unamplified Gaussian bound only on the rounds a client
+actually participated in, journaled through the WAL ``precharge``
+record's ``clients`` field, and rebuilt from replay on ANY server boot —
+so per-user ε survives a SIGKILL under the same never-under-report
+guarantee the cohort accountant already carries.
+
+Battery:
+- ledger math pinned against the RDP oracle (participation-count scaled,
+  unknown clients read 0, non-positive z refused, summary rollup shape);
+- ``charge_and_record`` merges the rollup into the round's privacy block
+  and mirrors it onto the ``fed_privacy_client_epsilon`` gauge family;
+- a DP masked run journals ``clients=[...]`` on every precharge;
+- WAL-replay rebuild in isolation (forged precharges → booted server);
+- the SIGKILL contract end-to-end: between-commits kill lands exactly on
+  the oracle's per-client ledgers; a mid-round kill never under-reports
+  any client;
+- HealthMonitor snapshot carries ``eps_client_max`` (the /healthz twin).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+# ------------------------------------------------------------------ fixtures
+
+
+@pytest.fixture(scope="module")
+def lr_setup():
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=24, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    return data, task
+
+
+def _cfg(rounds=3, per_round=4, seed=0, **kw):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=per_round, epochs=1,
+                        batch_size=6, lr=0.1, frequency_of_the_test=1,
+                        seed=seed, **kw)
+
+
+def _crash_plan(round_idx, after_uploads=None):
+    from fedml_tpu.chaos import FaultPlan
+
+    rule = {"fault": "crash", "ranks": [0],
+            "rounds": [round_idx, round_idx + 1]}
+    if after_uploads is not None:
+        rule["after_uploads"] = after_uploads
+    return FaultPlan.from_json({"seed": 1, "rules": [rule]})
+
+
+def _oracle_eps(z, rounds=1):
+    """Ground-truth per-client ε for ``rounds`` participations at noise
+    multiplier ``z`` — the unamplified Gaussian RDP curve, optimized the
+    same way the ledger does."""
+    from fedml_tpu.core.privacy import (
+        DEFAULT_ALPHAS,
+        DEFAULT_DELTA,
+        gaussian_rdp,
+        rdp_to_epsilon,
+    )
+
+    rdp = rounds * np.array([gaussian_rdp(z, a) for a in DEFAULT_ALPHAS])
+    return rdp_to_epsilon(rdp, DEFAULT_ALPHAS, DEFAULT_DELTA)
+
+
+# -------------------------------------------------------------- ledger math
+def test_client_ledger_math_pins_rdp_oracle():
+    from fedml_tpu.core.privacy import ClientPrivacyLedger
+
+    led = ClientPrivacyLedger()
+    assert led.epsilon(7) == 0.0  # never charged = nothing spent
+    assert led.summary() == {"eps_client_max": 0.0, "eps_client_mean": 0.0,
+                             "clients_charged": 0}
+    led.charge([1, 2], noise_multiplier=1.0)
+    led.charge([2], noise_multiplier=1.0)
+    assert led.epsilon(1) == pytest.approx(_oracle_eps(1.0, 1), rel=1e-12)
+    assert led.epsilon(2) == pytest.approx(_oracle_eps(1.0, 2), rel=1e-12)
+    # ε only grows on participation: client 1 is flat while 2 climbs
+    assert led.epsilon(2) > led.epsilon(1) > led.epsilon(99) == 0.0
+    assert led.eps_max() == pytest.approx(led.epsilon(2), rel=1e-12)
+    s = led.summary()
+    assert s["clients_charged"] == 2
+    assert s["eps_client_max"] == pytest.approx(led.epsilon(2), abs=1e-6)
+    assert s["eps_client_mean"] == pytest.approx(
+        (led.epsilon(1) + led.epsilon(2)) / 2.0, abs=1e-6)
+    # the batched form (rounds=k) is exactly k single charges
+    led2 = ClientPrivacyLedger()
+    led2.charge([2], noise_multiplier=1.0, rounds=2)
+    assert led2.epsilon(2) == pytest.approx(led.epsilon(2), rel=1e-12)
+    with pytest.raises(ValueError):
+        led.charge([1], noise_multiplier=0.0)
+
+
+def test_charge_and_record_rollup_and_prometheus_family():
+    """charge_and_record with a client ledger: the privacy block gains
+    the per-client rollup and the ``fed_privacy_client_epsilon`` gauge
+    family mirrors it in the Prometheus export."""
+    from fedml_tpu.core.privacy import (
+        ClientPrivacyLedger,
+        DPAccountant,
+        charge_and_record,
+    )
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    acct, led = DPAccountant(), ClientPrivacyLedger()
+    block = charge_and_record(acct, q=0.5, noise_multiplier=1.0, clip=5.0,
+                              realized_m=2, client_ledger=led,
+                              client_ids=[3, 5])
+    assert block["clients_charged"] == 2
+    assert block["eps_client_max"] == pytest.approx(
+        _oracle_eps(1.0, 1), abs=1e-6)
+    assert block["eps_client_max"] == block["eps_client_mean"]
+    assert block["eps"] > 0.0  # the cohort figure still rides alongside
+    text = REGISTRY.to_prometheus()
+    assert 'fed_privacy_client_epsilon{stat="max"}' in text
+    assert 'fed_privacy_client_epsilon{stat="count"} 2' in text
+    # without a ledger the block stays cohort-only (no phantom zeros)
+    plain = charge_and_record(DPAccountant(), q=0.5, noise_multiplier=1.0,
+                              clip=5.0)
+    assert "eps_client_max" not in plain
+
+
+def test_health_snapshot_carries_eps_client_max():
+    """The /healthz surface: HealthMonitor folds ``eps_client_max`` off
+    the round record's privacy block into its snapshot (sticky across
+    rounds that carry no privacy block)."""
+    from fedml_tpu.obs.health import HealthMonitor
+
+    mon = HealthMonitor()
+    assert mon.snapshot()["eps_client_max"] is None
+    mon.on_round({"round": 0, "privacy": {"eps": 0.5, "delta": 1e-5,
+                                          "eps_client_max": 0.875}})
+    assert mon.snapshot()["eps_client_max"] == 0.875
+    mon.on_round({"round": 1})  # no privacy block — figure is sticky
+    assert mon.snapshot()["eps_client_max"] == 0.875
+
+
+# -------------------------------------------------------------- WAL journal
+def test_dp_masked_run_journals_clients_on_precharge(lr_setup, tmp_path):
+    """Every DP round's precharge record carries the surviving client
+    ids — the durable form of the per-client ledgers."""
+    from fedml_tpu.core.wal import RoundWAL
+    from fedml_tpu.distributed import turboaggregate as ta
+    from fedml_tpu.obs import Telemetry
+    from fedml_tpu.obs.events import read_jsonl
+
+    data, task = lr_setup
+    tel = Telemetry(log_dir=str(tmp_path / "tel"))
+    agg = ta.run_simulated(data, task, _cfg(rounds=2),
+                           job_id="t-pcl-wal", defense_type="dp",
+                           noise_multiplier=1.0, telemetry=tel,
+                           ckpt_dir=str(tmp_path / "ck"))
+    tel.close()
+    recs = RoundWAL.replay(str(tmp_path / "ck" / "wal")).of_kind("precharge")
+    assert len(recs) == 2
+    for r in recs:
+        clients = r["clients"]
+        assert len(clients) == 4 and all(isinstance(c, int)
+                                         for c in clients)
+    # and the live ledger agrees with replaying the journal
+    from fedml_tpu.core.privacy import ClientPrivacyLedger
+
+    replayed = ClientPrivacyLedger()
+    for r in recs:
+        replayed.charge(r["clients"], float(r["z"]))
+    assert replayed.summary() == agg.client_ledger.summary()
+    # the round records surfaced the rollup (report.py's eps_cli column,
+    # the health snapshot's eps_client_max)
+    rounds = [r for r in read_jsonl(str(tmp_path / "tel" / "events.jsonl"))
+              if r.get("kind") == "round"]
+    assert rounds and rounds[-1]["privacy"]["eps_client_max"] == \
+        agg.client_ledger.summary()["eps_client_max"]
+
+
+def test_precharge_replay_rebuilds_client_ledger_unit(lr_setup, tmp_path):
+    """The rebuild path in isolation: a WAL whose precharges carry
+    ``clients`` boots a server whose per-client ledgers match replaying
+    every record — the ledgers ride NO checkpoint; the journal is their
+    only durable form (and the rebuild runs on ANY resume, clean or
+    crashed)."""
+    from fedml_tpu.core.wal import RoundWAL
+    from fedml_tpu.distributed.turboaggregate import (
+        TAAggregator,
+        TASecureServerManager,
+    )
+    from fedml_tpu.distributed.utils import backend_kwargs
+
+    data, task = lr_setup
+    ckpt = str(tmp_path / "ck")
+    wal = RoundWAL(os.path.join(ckpt, "wal"))
+    wal.append("broadcast", sync=True, round=0)
+    wal.append("precharge", sync=True, round=0, q=0.5, z=1.0, clip=5.0,
+               m=2, clients=[1, 2])
+    wal.append("commit", sync=True, round=0)
+    wal.append("broadcast", sync=True, round=1)
+    wal.append("precharge", sync=True, round=1, q=0.5, z=1.0, clip=5.0,
+               m=2, clients=[2, 3])
+    wal.close()
+
+    agg = TAAggregator(data, task, _cfg(rounds=3), worker_num=4,
+                       defense_type="dp", norm_bound=5.0,
+                       noise_multiplier=1.0)
+    kw = backend_kwargs("LOOPBACK", "t-pcl-unit", 50000, "127.0.0.1", 1883)
+    server = TASecureServerManager(agg, rank=0, size=5, backend="LOOPBACK",
+                                   ckpt_dir=ckpt, round_timeout_s=2.0, **kw)
+    try:
+        led = agg.client_ledger
+        assert led.epsilon(1) == pytest.approx(_oracle_eps(1.0, 1),
+                                               rel=1e-12)
+        assert led.epsilon(2) == pytest.approx(_oracle_eps(1.0, 2),
+                                               rel=1e-12)
+        assert led.epsilon(3) == pytest.approx(_oracle_eps(1.0, 1),
+                                               rel=1e-12)
+        assert led.summary()["clients_charged"] == 3
+    finally:
+        server.com_manager.stop_receive_message()
+
+
+# ------------------------------------------------------------------ SIGKILL
+def test_client_eps_exact_across_server_sigkill(lr_setup, tmp_path):
+    """The acceptance contract: a server killed BETWEEN commits recovers
+    per-client ledgers bitwise equal to the uninterrupted oracle; a kill
+    MID-ROUND (after the precharge, before the commit) re-charges the
+    open round on replay — every client's ε is >= the oracle's, never
+    below (over-count by at most one round, never under-report)."""
+    from fedml_tpu.distributed import turboaggregate as ta
+
+    data, task = lr_setup
+
+    def run(job, ckpt, plan=None):
+        return ta.run_simulated(data, task, _cfg(rounds=3), job_id=job,
+                                defense_type="dp", noise_multiplier=1.0,
+                                chaos_plan=plan, round_timeout_s=2.0,
+                                ckpt_dir=str(tmp_path / ckpt))
+
+    oracle = run("t-pcl-oracle", "o")
+    ids = sorted(oracle.client_ledger._rdp)
+    assert ids  # dp rounds actually charged clients
+
+    bc = run("t-pcl-bc", "b", plan=_crash_plan(2))
+    assert bc.client_ledger.summary() == oracle.client_ledger.summary()
+    for cid in ids:
+        assert bc.client_ledger.epsilon(cid) == pytest.approx(
+            oracle.client_ledger.epsilon(cid), rel=1e-12)
+
+    mid = run("t-pcl-mid", "m", plan=_crash_plan(1, after_uploads=2))
+    for cid in ids:
+        assert mid.client_ledger.epsilon(cid) >= \
+            oracle.client_ledger.epsilon(cid) - 1e-12
+    s_mid, s_orc = (mid.client_ledger.summary(),
+                    oracle.client_ledger.summary())
+    assert s_mid["eps_client_max"] >= s_orc["eps_client_max"]
+    assert s_mid["clients_charged"] >= s_orc["clients_charged"]
+    # (equality is allowed: an after_uploads crash point fires before the
+    # round's precharge lands, so replay may recharge nothing extra —
+    # the contract is ONLY never-under-report)
+
+
+def test_client_eps_exact_in_hierarchical_dp_run(lr_setup, tmp_path):
+    """The tree charges the same per-client ledgers as the flat masked
+    run — survivor attribution is by GLOBAL cohort slot, so edge-local
+    folding changes nothing about who gets charged."""
+    from fedml_tpu.distributed import turboaggregate as ta
+
+    data, task = lr_setup
+    flat = ta.run_simulated(data, task, _cfg(rounds=2, per_round=8),
+                            job_id="t-pcl-flat", defense_type="dp",
+                            noise_multiplier=1.0,
+                            ckpt_dir=str(tmp_path / "f"))
+    tree = ta.run_simulated(data, task, _cfg(rounds=2, per_round=8),
+                            job_id="t-pcl-tree", defense_type="dp",
+                            noise_multiplier=1.0, edges=2,
+                            ckpt_dir=str(tmp_path / "t"))
+    assert tree.client_ledger.summary() == flat.client_ledger.summary()
+    for cid in sorted(flat.client_ledger._rdp):
+        assert tree.client_ledger.epsilon(cid) == pytest.approx(
+            flat.client_ledger.epsilon(cid), rel=1e-12)

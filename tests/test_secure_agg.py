@@ -486,20 +486,25 @@ def test_report_renders_privacy_and_secagg_columns():
     spec.loader.exec_module(report)
     new = [{"kind": "round", "round": 0, "clients": [1],
             "metrics": {"update_norm": 1.0},
-            "privacy": {"eps": 1.25, "z": 1.0},
+            "privacy": {"eps": 1.25, "z": 1.0, "eps_client_max": 0.875},
             "secagg": {"outcome": "recovered", "dead": [2]}}]
     old = [{"kind": "round", "round": 0, "clients": [1],
             "metrics": {"update_norm": 1.0}}]
     table = report.render_table(new)
     assert "eps" in table and "1.25" in table and "recovered" in table
+    assert "eps_cli" in table and "0.875" in table
     stale = report.render_table(old)
     assert "eps" not in stale and "secagg" not in stale
+    # pre-per-client-ledger logs: the eps_cli column hides
+    no_cli = [{"kind": "round", "round": 0, "clients": [1],
+               "metrics": {"update_norm": 1.0},
+               "privacy": {"eps": 1.25, "z": 1.0}}]
+    assert "eps_cli" not in report.render_table(no_cli)
 
 
 # --------------------------------------------------------- launcher matrix
 @pytest.mark.parametrize("flags", [
     ["--shard_server_state", "1"],
-    ["--fused_agg", "1"],
     ["--async_buffer_k", "2"],
     ["--update_codec", "delta-int8"],
     ["--sparsify_ratio", "0.1"],
@@ -508,13 +513,15 @@ def test_report_renders_privacy_and_secagg_columns():
     ["--delta_broadcast", "1"],
     ["--heartbeat_max_age_s", "5"],
     ["--sum_assoc", "pairwise"],
-    ["--edges", "2"],
     ["--adversary_plan", '{"seed": 1, "rules": []}'],
 ])
 def test_launcher_turboaggregate_refusal_matrix(flags):
     """Every unsupported composition refuses LOUDLY (the former
     --shard_server_state warn-and-ignore included), on server and client
-    ranks alike — ranks share argv."""
+    ranks alike — ranks share argv. --fused_agg and --edges are NOT in
+    this matrix anymore: fused masked ingest and the hierarchical masked
+    tier are compositions (docs/ROBUSTNESS.md §Hierarchical secure
+    aggregation)."""
     import argparse
 
     from fedml_tpu.experiments.distributed_launch import add_args, init_role
@@ -525,6 +532,59 @@ def test_launcher_turboaggregate_refusal_matrix(flags):
              "--algo", "turboaggregate", *flags])
         with pytest.raises(ValueError, match="does not compose"):
             init_role(args, None, None, None, {})
+
+
+def test_launcher_turboaggregate_lifted_compositions(lr_setup):
+    """The two lifted cells construct real roles past the matrix:
+    --fused_agg selects the device fold on the flat TAAggregator, and
+    --edges builds the hierarchical masked tier on every rank class."""
+    import argparse
+
+    from fedml_tpu.distributed.turboaggregate import (
+        HierTASecureServerManager,
+        TASecureClientManager,
+        TASecureEdgeManager,
+        TASecureServerManager,
+    )
+    from fedml_tpu.experiments.distributed_launch import add_args, init_role
+
+    data, task = lr_setup
+    cfg = _cfg(per_round=3)
+
+    def role(rank, extra):
+        args = add_args(argparse.ArgumentParser()).parse_args(
+            ["--rank", str(rank), "--algo", "turboaggregate",
+             "--backend", "loopback", *extra])
+        return init_role(args, data, task, cfg, {"job_id": f"t-lift-{rank}"})
+
+    srv = role(0, ["--world_size", "4", "--fused_agg", "1"])
+    try:
+        assert isinstance(srv, TASecureServerManager)
+        assert srv.aggregator.fused_ingest is True
+    finally:
+        srv.finish()
+
+    # --edges 2 with 4 workers: rank 0 root, 1-2 edges, 3-6 workers.
+    # t=1 so recovery_min (t+1 = 2) fits the 2-slot block
+    cfg_tree = _cfg(per_round=4)
+    argv = ["--world_size", "7", "--edges", "2",
+            "--secagg_threshold_t", "1"]
+
+    def tree_role(rank):
+        args = add_args(argparse.ArgumentParser()).parse_args(
+            ["--rank", str(rank), "--algo", "turboaggregate",
+             "--backend", "loopback", *argv])
+        return init_role(args, data, task, cfg_tree,
+                         {"job_id": f"t-lift-tree-{rank}"})
+
+    for rank, klass in ((0, HierTASecureServerManager),
+                        (1, TASecureEdgeManager),
+                        (3, TASecureClientManager)):
+        mgr = tree_role(rank)
+        try:
+            assert isinstance(mgr, klass)
+        finally:
+            mgr.finish()
 
 
 def test_run_simulated_refuses_unwired_server_modes(lr_setup):
