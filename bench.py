@@ -124,6 +124,20 @@ def _mark(t0: float, msg: str) -> None:
           flush=True)
 
 
+def _stamped(rec: dict) -> dict:
+    """Provenance header (obs/provenance.py) on every child-printed blob:
+    git sha, jax/jaxlib versions, device kind+count, and the wall-clock
+    date — stamped HERE (the child already imports jax) and never in the
+    parent ``_emit`` relay, which must stay jax-free. ``stamp`` never
+    overwrites, so re-stamping a relayed blob is a no-op."""
+    try:
+        from fedml_tpu.obs.provenance import stamp
+        stamp(rec, date=time.strftime("%Y-%m-%d"))
+    except Exception:  # noqa: BLE001 — provenance must never sink a bench
+        pass
+    return rec
+
+
 def _measure(mode: str) -> None:
     """Build the flagship workload and time it; prints one JSON line."""
     t0 = time.perf_counter()
@@ -329,7 +343,7 @@ def _measure(mode: str) -> None:
             head_n = n_cheap - 2
             early = _result(2 / dt, "per_round", ns / dt, n_chips, platform)
             early["pipeline"] = int(head_pipe)
-            print(json.dumps(early), flush=True)
+            print(json.dumps(_stamped(early)), flush=True)
             _mark(t0, "early 2-round salvage line printed")
         dt, ns = timed_rounds(r_next, head_n, head_pipe)
         r_next += head_n
@@ -344,7 +358,7 @@ def _measure(mode: str) -> None:
             # spending budget on the A/B other half, so a timeout during
             # the alt rounds salvages the full-precision number instead of
             # falling back to the coarse 2-round line
-            print(json.dumps(rec), flush=True)
+            print(json.dumps(_stamped(rec)), flush=True)
             _mark(t0, f"{head_n}-round headline printed (A/B half next)")
             # the A/B other half — skipped on degraded budgets (a 1-core
             # CPU box can barely afford the headline rounds)
@@ -358,7 +372,7 @@ def _measure(mode: str) -> None:
             _mark(t0, f"pipeline A/B pair measured: {ab}")
         rec["pipeline_ab"] = ab
         _mark(t0, f"{head_n} timed rounds done")
-        print(json.dumps(rec))
+        print(json.dumps(_stamped(rec)))
         return
 
     # flagship path: rounds run in fixed-size blocks; jit caches by shape so
@@ -388,8 +402,8 @@ def _measure(mode: str) -> None:
         if i == 0 and n_timed > block:
             jax.block_until_ready(api.net.params)
             dt = time.perf_counter() - tm
-            print(json.dumps(_result(block / dt, "block", n_samples / dt,
-                                     n_chips, platform)), flush=True)
+            print(json.dumps(_stamped(_result(block / dt, "block", n_samples / dt,
+                                              n_chips, platform))), flush=True)
             _mark(t0, "early 1-block salvage line printed")
             # restart the clock (same reason as the per_round salvage): the
             # final number must not include the salvage sync/print
@@ -399,7 +413,7 @@ def _measure(mode: str) -> None:
     _mark(t0, f"{timed} timed rounds done")
     rec = _result(timed / dt, "block", n_samples / dt, n_chips, platform)
     rec["compile_seconds"] = round(compile_seconds, 2)
-    print(json.dumps(rec))
+    print(json.dumps(_stamped(rec)))
 
 
 # -------------------------------------------------------------------- parent
@@ -584,7 +598,7 @@ def _measure_async() -> None:
             ab["off"]["seconds"] / max(ab["on"]["seconds"], 1e-9), 2),
         "platform": "cpu",
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def _measure_dp() -> None:
@@ -654,7 +668,7 @@ def _measure_dp() -> None:
             legs["plain"]["final_acc"] - legs["z0.6"]["final_acc"], 4),
         "platform": "cpu",
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def _measure_codec() -> None:
@@ -735,7 +749,7 @@ def _measure_codec() -> None:
         "tiers": out,
         "platform": "cpu",
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def _measure_fused_agg() -> None:
@@ -881,7 +895,7 @@ def _measure_fused_agg() -> None:
         "rounds": rounds,
         "platform": "cpu",
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def _bf16_dataset_dir() -> tuple[str, int]:
@@ -950,7 +964,7 @@ def _measure_bf16(leg: str) -> None:
         "seconds": round(dt, 3),
         "rounds_per_sec": round((rounds - 2) / dt, 3),
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def _stream_dataset_dir() -> tuple[str, int]:
@@ -1032,7 +1046,7 @@ def _measure_stream(leg: str) -> None:
         "seconds": round(dt, 3),
         "rounds_per_sec": round((rounds - 2) / dt, 3),
     }
-    print(json.dumps(rec), flush=True)
+    print(json.dumps(_stamped(rec)), flush=True)
 
 
 def main() -> None:

@@ -154,6 +154,13 @@ def _one_point(args, data, task, k):
             "host_pack": round(pack, 3),
             "device_plus_dispatch": round(max(0.0, dt - pack), 3),
         }
+    try:
+        # provenance header (obs/provenance.py): git sha, versions, device
+        # kind/count, date — consumers tolerate absence on historical blobs
+        from fedml_tpu.obs.provenance import stamp
+        stamp(rec, date=time.strftime("%Y-%m-%d"))
+    except Exception:  # noqa: BLE001 — provenance must never sink a point
+        pass
     print(json.dumps(rec), flush=True)
 
 

@@ -64,6 +64,7 @@ from fedml_tpu.core.robust_agg import (
     make_robust_aggregator,
 )
 from fedml_tpu.core.sampling import prepare_sampling, sample_for
+from fedml_tpu.obs import goodput as _goodput
 from fedml_tpu.obs import perf_instrument as _perf
 from fedml_tpu.obs.tracing import RoundTracer
 from fedml_tpu.utils.tree import tree_weighted_mean
@@ -1160,6 +1161,7 @@ class FedAvgAPI:
         if not hasattr(self, "_block_fn"):
             self._block_fn = self._build_block_fn()
         if self.telemetry is not None:
+            t_wall = time.perf_counter()
             spans_before = dict(self.tracer.rounds[-1])
             if self.telemetry.tracer is not None:
                 # one trace per scanned block (its spans are amortized
@@ -1177,8 +1179,11 @@ class FedAvgAPI:
             # (one sync for the whole block); the block's host spans
             # (pack + one dispatch) ride on a separate 'block' event since
             # they are amortized over the R rounds, not per-round
+            wait = self._goodput_wait(ms)
             self._emit_block_records(start_round, num_rounds, ids_l, ms,
-                                     spans=self._span_delta(spans_before))
+                                     spans=self._span_delta(spans_before),
+                                     wall_s=time.perf_counter() - t_wall,
+                                     compute_wait_s=wait)
             if self.telemetry.tracer is not None:
                 self.telemetry.tracer.finish_round()  # see run_round
         return ms
@@ -1242,18 +1247,31 @@ class FedAvgAPI:
         return ms
 
     def _emit_block_records(self, start_round: int, num_rounds: int, ids_l,
-                            ms, spans=None, pipeline=None):
+                            ms, spans=None, pipeline=None, wall_s=None,
+                            compute_wait_s: float = 0.0,
+                            pipelined: bool = False):
         ms_host = {k: np.asarray(v) for k, v in ms.items()}
         self.telemetry.events.emit(
             "block", start=int(start_round), rounds=int(num_rounds),
             spans=spans or {},
             **({"pipeline": pipeline} if pipeline else {}))
+        # the block's wall/spans/wait are amortized over its R rounds so
+        # each per-round record carries a comparable goodput block (the
+        # block variant's cost analysis covers R rounds -> cost_rounds=R)
+        R = max(int(num_rounds), 1)
+        per_spans = {k: v / R for k, v in (spans or {}).items()}
         for i in range(num_rounds):
+            pack_extra = self._pack_extra(start_round + i)
+            gp = ({} if wall_s is None else self._goodput_extra(
+                wall_s / R, per_spans, pipelined=pipelined,
+                compute_wait_s=compute_wait_s / R, pack_extra=pack_extra,
+                block_rounds=R))
             self.telemetry.emit_round(
                 start_round + i, clients=ids_l[i].tolist(),
                 metrics={k: float(v[i]) for k, v in ms_host.items()},
                 block=True, agg=self._agg_record,
-                **self._pack_extra(start_round + i),
+                **gp,
+                **pack_extra,
                 **self._quarantine_extra(start_round + i),
                 **self._privacy_extra())
 
@@ -1261,11 +1279,17 @@ class FedAvgAPI:
         """Block analogue of _drain_round_entry: the only sync, one block
         behind dispatch; ledger + telemetry flushed in block order."""
         num_rounds, ids_l, spans, pipeline, ms = entry
+        wall = wait = None
+        if self.telemetry is not None:
+            wait = self._goodput_wait(ms)
+            wall = self._goodput_interval()
         ms = self._drain_quarantine_block(ms, start_round, ids_l)
         ms_host = {k: np.asarray(v) for k, v in ms.items()}
         if self.telemetry is not None:
             self._emit_block_records(start_round, num_rounds, ids_l, ms_host,
-                                     spans=spans, pipeline=pipeline)
+                                     spans=spans, pipeline=pipeline,
+                                     wall_s=wall, compute_wait_s=wait or 0.0,
+                                     pipelined=True)
         return start_round, ms_host
 
     def run_blocks_pipelined(self, start_round: int, num_blocks: int,
@@ -1307,6 +1331,7 @@ class FedAvgAPI:
         # metrics" escape hatch) must still mean drain-immediately here
         ring = InflightRing(min(self.drain_lag, 1), self._drain_block_entry,
                             on_event=self._pipe_on_event)
+        self._gp_prev_drain_t = time.perf_counter()
         out = []
         try:
             for s in starts:
@@ -1417,6 +1442,17 @@ class FedAvgAPI:
         log.info("warmup: %d variant(s) in %.2fs (%d fresh compiles, "
                  "%d persistent-cache hits)", len(rep["variants"]),
                  rep["seconds"], rep["fresh_compiles"], rep["cache_hits"])
+        if self.telemetry is not None:
+            # the compile observatory's event record: per-variant wall from
+            # the AOT pass plus the registry's per-variant attribution
+            # (hits/misses/backend seconds) — report.py --compiles renders it
+            self.telemetry.events.emit(
+                "compiles", variants=rep.get("per_variant") or {},
+                seconds=rep["seconds"], fresh=rep["fresh_compiles"],
+                cache_hits=rep["cache_hits"],
+                cache_misses=rep["cache_misses"],
+                instrumented=rep["instrumented"],
+                attribution=_perf.variant_compile_stats())
         return rep
 
     _WORKING_SET_BUCKET = 8192  # rows; pad-to-bucket keeps ONE compiled block
@@ -1511,6 +1547,63 @@ class FedAvgAPI:
         FedAvgRobustAPI overrides with its accountant's cumulative ε."""
         return {}
 
+    # ------------------------------------------------------ round economics
+    def _variant_name(self, B=None, block_rounds: int | None = None) -> str:
+        """The jit variant name this dispatch selects — the same
+        ``round{prec}_b{B}`` / ``block{prec}_r{R}_b{B}`` scheme warmup()
+        compiles under, so the goodput block finds the variant's cached
+        XLA cost analysis (docs/PERFORMANCE.md §Round economics)."""
+        prec = ("" if self.local_spec.compute_dtype in ("f32", "float32")
+                else f"_{self.local_spec.compute_dtype}")
+        if B is None:
+            B = self.num_batches
+        if block_rounds:
+            return f"block{prec}_r{int(block_rounds)}_b{int(B)}"
+        return f"round{prec}_b{int(B)}"
+
+    def _goodput_wait(self, metrics) -> float:
+        """Block until this round's device outputs are ready and return the
+        wait — the device-compute backpressure the driver pays. Only called
+        on telemetry paths that were about to sync on the same arrays
+        anyway (emit floats them / drain np.asarray's them), so the off
+        path stays bit-identical and sync-free."""
+        t0 = time.perf_counter()
+        try:
+            jax.block_until_ready(metrics)
+        except Exception:  # noqa: BLE001 — non-array metrics: nothing to wait
+            pass
+        return time.perf_counter() - t0
+
+    def _goodput_extra(self, wall_s, spans, *, pipelined: bool = False,
+                       compute_wait_s: float = 0.0, pack_extra=None,
+                       block_rounds: int | None = None) -> dict:
+        """The ``goodput`` block one round record carries (obs/goodput.py):
+        exclusive duty-cycle buckets of this round's wall plus FLOPs/s and
+        MFU when the dispatched variant's cost analysis is cached. {} when
+        the wall was not measured."""
+        if wall_s is None:
+            return {}
+        B = ((pack_extra or {}).get("pack") or {}).get("bucket_B")
+        variant = self._variant_name(B=B, block_rounds=block_rounds)
+        buckets = _goodput.buckets_from_spans(
+            wall_s, spans, pipelined=pipelined,
+            compute_wait_s=compute_wait_s)
+        return {"goodput": _goodput.round_goodput(
+            wall_s, buckets, variant=variant,
+            cost_rounds=block_rounds or 1,
+            n_devices=(self.mesh.size if self.mesh is not None else 1))}
+
+    def _goodput_interval(self) -> float:
+        """Per-round wall in pipelined mode: time since the previous drain
+        (one drain per dispatch in steady state, so inter-drain time IS
+        the per-round wall — docs/PERFORMANCE.md §Round economics)."""
+        now = time.perf_counter()
+        prev = getattr(self, "_gp_prev_drain_t", None)
+        self._gp_prev_drain_t = now
+        # None (no goodput block) when the interval base is missing — the
+        # pipelined drivers seed the stamp at loop entry
+        return (now - prev) if prev is not None else None
+
     # ------------------------------------------------------------------ train
     def _dispatch_round(self, round_idx: int, ids, cb):
         """Advance the rng chain and dispatch one round program — the ONE
@@ -1528,6 +1621,7 @@ class FedAvgAPI:
 
     def run_round(self, round_idx: int):
         if self.telemetry is not None:
+            t_wall = time.perf_counter()
             spans_before = dict(self.tracer.rounds[-1])
             if self.telemetry.tracer is not None:
                 self.telemetry.tracer.begin_round(round_idx)
@@ -1540,12 +1634,18 @@ class FedAvgAPI:
             # floating the metrics syncs on the round's outputs — a cost the
             # caller opted into by passing telemetry; the off path returns
             # the device arrays untouched (no sync, dispatch still overlaps)
+            wait = self._goodput_wait(metrics)
+            spans = self._span_delta(spans_before)
+            pack_extra = self._pack_extra(round_idx)
             self.telemetry.emit_round(
                 round_idx, clients=np.asarray(ids).tolist(),
-                spans=self._span_delta(spans_before),
+                spans=spans,
                 metrics={k: float(v) for k, v in metrics.items()},
                 agg=self._agg_record,
-                **self._pack_extra(round_idx),
+                **self._goodput_extra(
+                    time.perf_counter() - t_wall, spans,
+                    compute_wait_s=wait, pack_extra=pack_extra),
+                **pack_extra,
                 **self._quarantine_extra(round_idx),
                 **self._privacy_extra())
             if self.telemetry.tracer is not None:
@@ -1596,15 +1696,26 @@ class FedAvgAPI:
         all in dispatch order, so ledgers and event logs are bit-identical
         to the synchronous driver's."""
         ids, spans, pipeline, metrics = entry
+        if self.telemetry is not None:
+            # the drain is the pipeline's one sync point: the wait here IS
+            # the device-compute backpressure this round cost the driver
+            # (goodput's compute bucket); inter-drain time is the per-round
+            # wall. Off path syncs implicitly at np.asarray — unchanged.
+            wait = self._goodput_wait(metrics)
+            wall = self._goodput_interval()
         metrics = self._drain_quarantine(metrics, round_idx, ids)
         host = {k: np.asarray(v) for k, v in metrics.items()}
         if self.telemetry is not None:
+            pack_extra = self._pack_extra(round_idx)
             self.telemetry.emit_round(
                 round_idx, clients=np.asarray(ids).tolist(),
                 spans=spans, pipeline=pipeline,
                 metrics={k: float(v) for k, v in host.items()},
                 agg=self._agg_record,
-                **self._pack_extra(round_idx),
+                **self._goodput_extra(
+                    wall, spans, pipelined=True, compute_wait_s=wait,
+                    pack_extra=pack_extra),
+                **pack_extra,
                 **self._quarantine_extra(round_idx),
                 **self._privacy_extra())
         return round_idx, host
@@ -1637,6 +1748,7 @@ class FedAvgAPI:
                         depth=depth, on_event=self._pipe_on_event)
         ring = InflightRing(self.drain_lag, self._drain_round_entry,
                             on_event=self._pipe_on_event)
+        self._gp_prev_drain_t = time.perf_counter()
         out = []
         try:
             for r in range(start_round, start_round + num_rounds):
@@ -1662,6 +1774,7 @@ class FedAvgAPI:
                         on_event=self._pipe_on_event)
         ring = InflightRing(self.drain_lag, self._drain_round_entry,
                             on_event=self._pipe_on_event)
+        self._gp_prev_drain_t = time.perf_counter()
         pending: dict[int, dict] = {}
         try:
             for r in range(rounds):

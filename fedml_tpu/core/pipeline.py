@@ -263,11 +263,22 @@ def compile_concurrently(lowered: dict, max_workers: int | None = None) -> dict:
     clock of the whole pass), ``fresh_compiles`` (persistent-cache misses
     when the cache was consulted, raw backend passes otherwise — a repeat
     run against a warm cache must show 0; the acceptance tests assert it),
-    ``cache_hits``/``cache_misses`` deltas, and ``instrumented`` (False
-    when jax.monitoring is unavailable, in which case every delta reads 0
+    ``cache_hits``/``cache_misses`` deltas, ``per_variant`` ({name:
+    {"seconds": wall}} — locally timed, so it reports even when
+    jax.monitoring is absent), and ``instrumented`` (False when
+    jax.monitoring is unavailable, in which case every delta reads 0
     vacuously).
+
+    Each variant compiles inside ``_perf.attribute_compiles(name)`` (the
+    compile observatory's per-jit-name attribution) and its executable's
+    XLA cost analysis is cached under the same name
+    (``goodput.record_variant_cost``) — which is how a warmed-up engine's
+    round records later carry FLOPs/s without re-deriving cost at
+    dispatch time.
     """
     from concurrent.futures import ThreadPoolExecutor
+
+    from fedml_tpu.obs import goodput as _goodput
 
     instrumented = _perf.install()
     c0, h0, m0, r0 = (_perf.compiles_total(), _perf.cache_hits_total(),
@@ -275,10 +286,20 @@ def compile_concurrently(lowered: dict, max_workers: int | None = None) -> dict:
                       _perf.cache_requests_total())
     t0 = time.perf_counter()
     names = list(lowered)
+    per_variant: dict = {}
+
+    def _one(n):
+        tv = time.perf_counter()
+        with _perf.attribute_compiles(n):
+            exe = lowered[n].compile()
+        per_variant[n] = {"seconds": time.perf_counter() - tv}
+        _goodput.record_variant_cost(n, exe)
+        return exe
+
     if names:
         with ThreadPoolExecutor(
                 max_workers=max_workers or min(len(names), 8)) as ex:
-            compiled = list(ex.map(lambda n: lowered[n].compile(), names))
+            compiled = list(ex.map(_one, names))
     else:
         compiled = []
     requests = int(_perf.cache_requests_total() - r0)
@@ -288,6 +309,7 @@ def compile_concurrently(lowered: dict, max_workers: int | None = None) -> dict:
         "variants": names,
         "executables": dict(zip(names, compiled)),
         "seconds": time.perf_counter() - t0,
+        "per_variant": per_variant,
         # with the persistent cache consulted, a cache HIT deserializes —
         # only a MISS pays XLA; without it every backend pass is fresh
         "fresh_compiles": misses if requests else passes,

@@ -40,6 +40,7 @@ from fedml_tpu.comm.message import Message, codec_roundtrip
 from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 from fedml_tpu.distributed.fedavg.message_define import MyMessage
 from fedml_tpu.obs import comm_instrument as _obs
+from fedml_tpu.obs import goodput as _goodput
 from fedml_tpu.obs.tracing import TRACE_KEY
 
 log = logging.getLogger("fedml_tpu.distributed.fedavg")
@@ -610,6 +611,13 @@ class FedAvgServerManager(ServerManager):
         and the round-advance sync (they must not diverge). Starts the
         round's trace and rides its context on each frame when tracing."""
         self._maybe_crash("broadcast")
+        if self.telemetry is not None:
+            # round-economics stamps (obs/goodput.py): the round's wall
+            # starts here; wire_wait is bcast-done -> last counted arrival
+            import time as _time
+
+            self._gp_bcast_start_t = _time.monotonic()
+            self._gp_last_arrival_t = None
         if self.wal is not None:
             # journal the round opening BEFORE any frame leaves: recovery
             # must know round r was in flight even if the crash lands
@@ -713,6 +721,10 @@ class FedAvgServerManager(ServerManager):
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
+        if self.telemetry is not None:
+            import time as _time
+
+            self._gp_bcast_end_t = _time.monotonic()
         # after_uploads=0: mid-round with the broadcast OUT but zero
         # uploads accepted — distinct from None (between commits, before
         # any frame of the round leaves)
@@ -1124,12 +1136,21 @@ class FedAvgServerManager(ServerManager):
                              for n, o in zip(global_params, old_leaves))
                 hist = self.aggregator.history
                 q = self.aggregator.quarantine.for_round(version)
+                spans = dict(self._tracer.rounds[-1])
+                # async round economics: per-flush wall = time since the
+                # previous flush (event-driven — there is no broadcast
+                # barrier); the buffer-fill window IS the wire wait
+                prev_flush = getattr(self, "_gp_prev_flush_t", None)
+                self._gp_prev_flush_t = _time.monotonic()
                 tel.emit_round(
                     version, clients=[e.client for e in entries],
-                    spans=dict(self._tracer.rounds[-1]),
+                    spans=spans,
                     metrics={"update_norm": float(np.sqrt(upd_sq)),
                              "num_samples": float(sum(e.nsamp
                                                       for e in entries))},
+                    **({} if prev_flush is None else self._goodput_extra(
+                        spans, wire_wait_s=fill_s,
+                        wall_s=self._gp_prev_flush_t - prev_flush)),
                     evals=(hist[-1] if hist
                            and hist[-1].get("round") == version else None),
                     **{"async": {
@@ -1474,6 +1495,11 @@ class FedAvgServerManager(ServerManager):
                 log.warning("drop stale upload from rank %d (round %s, now %d)",
                             sender, msg_round, self.round_idx)
                 return
+            if self.telemetry is not None:
+                # last counted arrival for this round's wire_wait bucket
+                import time as _time
+
+                self._gp_last_arrival_t = _time.monotonic()
             if self._dtracer is not None:
                 # arrival time + clock sample + the piggybacked client
                 # span buffer (None from a stock/untraced peer is fine —
@@ -1547,6 +1573,34 @@ class FedAvgServerManager(ServerManager):
                 return
             self._advance_round()
 
+    def _goodput_extra(self, spans: dict, wire_wait_s=None,
+                       wall_s=None) -> dict:
+        """The server round's ``goodput`` block (obs/goodput.py): wall from
+        the broadcast stamp (sync) or the caller (async flush), wire_wait
+        from bcast-done -> last counted arrival unless given, agg_flush
+        from the aggregate span + the aggregator's fused flush latency.
+        The server dispatches no jitted round variant, so the block is
+        duty-cycle-only (relative goodput) — the device-side figures live
+        on the engine ranks. {} when the stamps are missing (restart
+        mid-round, init round)."""
+        import time as _time
+
+        if wall_s is None:
+            t0 = getattr(self, "_gp_bcast_start_t", None)
+            if t0 is None:
+                return {}
+            wall_s = _time.monotonic() - t0
+        if wire_wait_s is None:
+            bce = getattr(self, "_gp_bcast_end_t", None)
+            arr = getattr(self, "_gp_last_arrival_t", None)
+            wire_wait_s = (max(0.0, arr - bce)
+                           if bce is not None and arr is not None else 0.0)
+        # NOTE: the fused flush_s rides inside the aggregate span, so the
+        # agg_flush bucket reads the span alone (no double count)
+        buckets = _goodput.buckets_from_spans(
+            wall_s, spans, wire_wait_s=wire_wait_s)
+        return {"goodput": _goodput.round_goodput(wall_s, buckets)}
+
     def _round_record_extra(self) -> dict:
         """Extra blocks a subclass rides on the telemetry round record
         (the hierarchical server adds its ``hier`` fan-in block). The
@@ -1594,11 +1648,13 @@ class FedAvgServerManager(ServerManager):
                   if self._dtracer is not None else None)
             q = self.aggregator.quarantine.for_round(self.round_idx) \
                 if hasattr(self.aggregator, "quarantine") else []
+            spans = dict(self._tracer.rounds[-1])
             tel.emit_round(
                 self.round_idx, clients=self._round_ids,
-                spans=dict(self._tracer.rounds[-1]),
+                spans=spans,
                 metrics={"update_norm": float(np.sqrt(upd_sq)),
                          "num_samples": n_samples},
+                **self._goodput_extra(spans),
                 evals=(hist[-1] if hist
                        and hist[-1].get("round") == self.round_idx else None),
                 **({"critical_path": cp} if cp else {}),

@@ -103,6 +103,12 @@ class DigestEmitter:
         self._phases: dict[str, deque] = {}
         self._max_samples = int(max_phase_samples)
         self._last_ctr: dict[str, float] = {}
+        # duty-cycle accounting (docs/PERFORMANCE.md §Round economics):
+        # phase() accumulates busy seconds; digest() divides by the
+        # inter-digest interval — one float per digest, well inside the
+        # byte budget
+        self._busy = 0.0
+        self._last_digest_t: float | None = None
         self._lock = threading.Lock()
 
     def on_downlink(self, marker: dict) -> None:
@@ -132,6 +138,7 @@ class DigestEmitter:
                     buf = deque(maxlen=self._em._max_samples)
                     self._em._phases[self._name] = buf
                 buf.append(dt)
+                self._em._busy += dt
             return False
 
     def phase(self, name: str):
@@ -141,22 +148,36 @@ class DigestEmitter:
         return self._Phase(self, name)
 
     # --------------------------------------------------------------- the blob
-    def digest(self, round_idx: int, wave=None, eps=None) -> dict:
+    def digest(self, round_idx: int, wave=None, eps=None,
+               gflops=None) -> dict:
         """The compact uplink blob: round/wave progress, comm counter
         deltas since this rank's previous digest, per-phase [p50,p95,p99],
-        ε when the caller knows one, and host/device memory. Also drops a
-        ``digest`` record into the flight ring — in a crash timeline these
-        are the 'what was this rank doing' breadcrumbs."""
+        duty cycle (phase-busy seconds over the inter-digest interval),
+        GFLOPs/s when the caller knows one, ε when the caller knows one,
+        and host/device memory. Also drops a ``digest`` record into the
+        flight ring — in a crash timeline these are the 'what was this
+        rank doing' breadcrumbs."""
         from fedml_tpu.obs.comm_instrument import comm_counters
 
         now = comm_counters(self.registry)
+        t = self._clock()
         with self._lock:
             ctr = {k: int(now.get(k, 0.0) - self._last_ctr.get(k, 0.0))
                    for k in _CTR_KEYS}
             self._last_ctr = {k: now.get(k, 0.0) for k in _CTR_KEYS}
             spans = {name: _quantiles(buf)
                      for name, buf in self._phases.items() if buf}
+            interval = (t - self._last_digest_t
+                        if self._last_digest_t is not None else None)
+            busy, self._busy = self._busy, 0.0
+            self._last_digest_t = t
+        duty = (min(busy / interval, 1.0)
+                if interval and interval > 0 else None)
         blob: dict = {"rank": self.rank, "round": int(round_idx)}
+        if duty is not None:
+            blob["duty"] = round(duty, 3)
+        if gflops is not None:
+            blob["gf"] = round(float(gflops), 3)
         if self.run_id:
             blob["run"] = self.run_id
         if wave is not None:
@@ -269,7 +290,8 @@ class FleetCollector:
             ctr = d.get("ctr") or {}
             row["bytes_uplink"] += int(ctr.get("bytes_uplink", 0))
             row["bytes_downlink"] += int(ctr.get("bytes_downlink", 0))
-            for k in ("round", "wave", "eps", "rss", "dev", "spans", "run"):
+            for k in ("round", "wave", "eps", "rss", "dev", "spans", "run",
+                      "duty", "gf"):
                 if d.get(k) is not None:
                     row[k] = d[k]
             row["seen_ts"] = now
@@ -277,10 +299,11 @@ class FleetCollector:
         self._counter("fed_fleet_digests_total").inc()
         flight_record("fleet_ingest", rank=rank, round=d.get("round"))
 
-    def note_server(self, round_idx: int, eps=None) -> None:
+    def note_server(self, round_idx: int, eps=None, duty=None,
+                    gflops=None) -> None:
         """Rank 0's own row — fed from ``Telemetry.emit_round`` (every
         engine that emits round records updates the server line, including
-        its ε, without a wire hop)."""
+        its ε and round-economics figures, without a wire hop)."""
         now = self._clock()
         with self._lock:
             row = self._ranks.setdefault(0, {"bytes_uplink": 0,
@@ -288,6 +311,10 @@ class FleetCollector:
             row["round"] = int(round_idx)
             if eps is not None:
                 row["eps"] = round(float(eps), 6)
+            if duty is not None:
+                row["duty"] = round(float(duty), 3)
+            if gflops is not None:
+                row["gf"] = round(float(gflops), 3)
             rss = host_rss_bytes()
             if rss is not None:
                 row["rss"] = int(rss)
@@ -347,6 +374,8 @@ class FleetCollector:
                 "rss_bytes": row.get("rss"),
                 "device_bytes": row.get("dev"),
                 "spans": row.get("spans"),
+                "duty": row.get("duty"),
+                "gflops": row.get("gf"),
                 "status": "stale" if stale else "ok",
             }
         rounds = [v["round"] for v in out_ranks.values()

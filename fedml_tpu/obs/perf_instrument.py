@@ -57,6 +57,7 @@ children, same pattern as obs/comm_instrument.py).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from functools import lru_cache
@@ -67,6 +68,7 @@ log = logging.getLogger("fedml_tpu.obs.perf")
 
 _install_lock = threading.Lock()
 _installed = False
+_tls = threading.local()
 
 
 @lru_cache(maxsize=8)
@@ -89,12 +91,84 @@ def _span_hist(name: str):
     return REGISTRY.histogram("fed_span_seconds", span=name)
 
 
+# ---------------------------------------------- per-variant attribution
+# The compile observatory (docs/OBSERVABILITY.md §Compile observatory):
+# jax.monitoring events fire ON THE COMPILING THREAD, so a thread-local
+# variant tag set around a ``.compile()`` call attributes that thread's
+# compile/cache events to the jit variant being built. Everything outside
+# an :func:`attribute_compiles` scope (first-dispatch jit compiles, eval
+# fns, ...) lands under the reserved ``variant="_other"`` child — which
+# also gives the families a pre-registerable zero child.
+#
+#     fed_xla_variant_compile_seconds_total{variant}   backend compile wall
+#     fed_xla_variant_compiles_total{variant}          compile passes
+#     fed_xla_variant_cache_hits_total{variant}        persistent-cache hits
+#     fed_xla_variant_cache_misses_total{variant}      fresh compiles
+UNATTRIBUTED_VARIANT = "_other"
+
+
+@lru_cache(maxsize=256)
+def _variant_counter(name: str, variant: str):
+    # lru_cache indirection; every call site passes a fed_* literal
+    return REGISTRY.counter(name, variant=variant)  # fedlint: disable=metric-discipline
+
+
+def _compile_variant() -> str:
+    return getattr(_tls, "compile_variant", None) or UNATTRIBUTED_VARIANT
+
+
+@contextlib.contextmanager
+def attribute_compiles(variant: str):
+    """Attribute this thread's jax.monitoring compile events to ``variant``
+    for the duration of the scope (reentrant; inner scope wins)."""
+    prev = getattr(_tls, "compile_variant", None)
+    _tls.compile_variant = str(variant)
+    try:
+        yield
+    finally:
+        _tls.compile_variant = prev
+
+
+def variant_compile_stats() -> dict:
+    """{variant: {seconds, compiles, cache_hits, cache_misses}} from the
+    live registry — the compile observatory's read side (warmup reports,
+    report.py --compiles via the warmup event record, tests)."""
+    out: dict[str, dict] = {}
+    fams = {"fed_xla_variant_compile_seconds_total": "seconds",
+            "fed_xla_variant_compiles_total": "compiles",
+            "fed_xla_variant_cache_hits_total": "cache_hits",
+            "fed_xla_variant_cache_misses_total": "cache_misses"}
+    snap = REGISTRY.snapshot()
+    for fam_name, key in fams.items():
+        for label_s, value in (snap.get(fam_name) or {}).items():
+            # snapshot() keys children as "k=v" strings (jsonable contract)
+            if not label_s.startswith("variant="):
+                continue
+            variant = label_s.split("=", 1)[1]
+            out.setdefault(variant, {})[key] = value
+    return out
+
+
+def ensure_compile_attr_families() -> None:
+    """Pre-register the per-variant compile families at zero (under the
+    reserved ``_other`` child) so a clean run's export carries them."""
+    for fam in ("fed_xla_variant_compile_seconds_total",
+                "fed_xla_variant_compiles_total",
+                "fed_xla_variant_cache_hits_total",
+                "fed_xla_variant_cache_misses_total"):
+        _variant_counter(fam, UNATTRIBUTED_VARIANT)
+
+
 # ------------------------------------------------------ compile accounting
 def _on_event(name: str, **kw) -> None:
     if name == "/jax/compilation_cache/cache_hits":
         _counter("fed_xla_cache_hits_total").inc()
+        _variant_counter("fed_xla_variant_cache_hits_total",
+                         _compile_variant()).inc()
     elif name == "/jax/compilation_cache/cache_misses":
         _counter("fed_xla_cache_misses_total").inc()
+        _variant_counter("fed_xla_variant_cache_misses_total",
+                         _compile_variant()).inc()
     elif name == "/jax/compilation_cache/compile_requests_use_cache":
         _counter("fed_xla_cache_requests_total").inc()
 
@@ -103,6 +177,10 @@ def _on_duration(name: str, secs: float, **kw) -> None:
     if name.endswith("/backend_compile_duration"):
         _counter("fed_xla_compiles_total").inc()
         _hist("fed_xla_compile_seconds").observe(secs)
+        variant = _compile_variant()
+        _variant_counter("fed_xla_variant_compiles_total", variant).inc()
+        _variant_counter("fed_xla_variant_compile_seconds_total",
+                         variant).inc(secs)
 
 
 def install() -> bool:

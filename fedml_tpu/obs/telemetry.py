@@ -146,6 +146,14 @@ class Telemetry:
         from fedml_tpu.obs import flightrec as _flightrec
 
         self.events.add_observer(_flightrec.on_event)
+        # round-economics families (obs/goodput.py, obs/perf_instrument.py
+        # §compile observatory) pre-register at zero the moment a run arms
+        # telemetry — a clean export must carry them, not omit them
+        from fedml_tpu.obs import goodput as _goodput
+        from fedml_tpu.obs import perf_instrument as _perf_instr
+
+        _goodput.ensure_goodput_families()
+        _perf_instr.ensure_compile_attr_families()
         self._header_emitted = False
         self._last_comm = comm_counters(REGISTRY)
 
@@ -215,10 +223,15 @@ class Telemetry:
         rec.update(extra)
         out = self.events.emit("round", **rec)
         if self.fleet is not None:
-            # rank 0's own /fleetz row: round progress + the DP ε the
-            # round record already carries (no wire hop for the server)
-            self.fleet.note_server(round_idx,
-                                   eps=(rec.get("privacy") or {}).get("eps"))
+            # rank 0's own /fleetz row: round progress + the DP ε and the
+            # round-economics figures the record already carries (no wire
+            # hop for the server)
+            gp = rec.get("goodput") or {}
+            fps = gp.get("flops_per_s")
+            self.fleet.note_server(
+                round_idx, eps=(rec.get("privacy") or {}).get("eps"),
+                duty=(gp.get("duty") or {}).get("compute"),
+                gflops=(fps / 1e9 if fps else None))
         if self.health is not None:
             # the per-round health hook: every engine that emits a round
             # record (standalone, pipelined drain, sync server, async

@@ -33,6 +33,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _stamp_summary(summary: dict) -> dict:
+    """Provenance header (obs/provenance.py) on the soak summary blob:
+    git sha, jax/jaxlib versions, device kind+count, date. Consumers
+    (runstore, bench_gate) tolerate absence on historical blobs."""
+    try:
+        from fedml_tpu.obs.provenance import stamp
+        stamp(summary, date=time.strftime("%Y-%m-%d"))
+    except Exception:  # noqa: BLE001 — provenance must never sink a soak
+        pass
+    return summary
+
+
 def random_plan(seed: int, world_size: int, elastic: bool = True):
     """A seeded plan over client ranks 1..world_size-1: every field comes
     from sha256 draws on the seed, so the plan IS the seed."""
@@ -498,7 +510,7 @@ def main(argv=None) -> int:
             "rounds_per_trial": max(args.rounds, 3),
             "records": trials,
         }
-        out = json.dumps(summary, indent=1, default=str)
+        out = json.dumps(_stamp_summary(summary), indent=1, default=str)
         if args.out:
             with open(args.out, "w") as f:
                 f.write(out)
@@ -696,7 +708,7 @@ def main(argv=None) -> int:
         # backdoor failed to implant)
         summary["backdoor_defense"] = backdoor_defense_trial(
             rounds=args.rounds, aggregator=aggregator)
-    out = json.dumps(summary, indent=1, default=str)
+    out = json.dumps(_stamp_summary(summary), indent=1, default=str)
     if args.out:
         with open(args.out, "w") as f:
             f.write(out)

@@ -193,6 +193,74 @@ print("pipeline smoke ok: 3 pipelined rounds bit-identical, "
       "metric families exported")
 PY
   python scripts/report.py "$PIPE_DIR/events.jsonl"
+  echo "== goodput + run-store smoke (pipeline A/B; fed_goodput_* families; runstore diff names the moved bucket; committed gate) =="
+  # the round-economics plane (docs/PERFORMANCE.md §Round economics) must
+  # (a) decompose every telemetry round into exclusive buckets that sum to
+  # the round wall, (b) export the fed_goodput_*/fed_duty_cycle families
+  # through the Prometheus text, and (c) attribute a pipeline on/off A/B
+  # to the bucket pipelining actually moves: the sync driver's serial pack
+  # IS its prefetch stall, so `runstore diff` must name prefetch_stall as
+  # the moved bucket — and the pipelined leg must pass the committed
+  # tolerance file (docs/OBSERVABILITY.md §Run-store)
+  GOOD_DIR=./tmp/ci_goodput; rm -rf "$GOOD_DIR" ./tmp/ci_goodput_index.jsonl
+  python - "$GOOD_DIR" <<'PY'
+import json, os, sys
+
+from fedml_tpu.algorithms.fedavg import FedAvgAPI, FedAvgConfig
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import Telemetry
+
+d = sys.argv[1]
+# pack-heavy workload: 4x512 CIFAR-shaped clients -> ~25 MB packed per
+# round, so the sync pack (= prefetch stall) sits far above compute noise
+data = synthetic_images(num_clients=4, image_shape=(32, 32, 3),
+                        num_classes=5, samples_per_client=512,
+                        test_samples=32, seed=0)
+task = classification_task(LogisticRegression(num_classes=5))
+cfg = FedAvgConfig(comm_round=8, client_num_in_total=4,
+                   client_num_per_round=4, batch_size=64, lr=0.1,
+                   epochs=2, frequency_of_the_test=100)
+# A: synchronous rounds — the serial pack IS the prefetch stall
+tel_a = Telemetry(log_dir=os.path.join(d, "a"))
+a = FedAvgAPI(data, task, cfg, telemetry=tel_a)
+a.warmup()
+for r in range(8):
+    a.run_round(r)
+tel_a.close()
+# B: pipelined — pack overlaps on the prefetch thread, the stall shrinks
+tel_b = Telemetry(log_dir=os.path.join(d, "b"))
+b = FedAvgAPI(data, task, cfg, prefetch=2, telemetry=tel_b)
+b.warmup()
+b.run_pipelined(0, 8)
+tel_b.close()
+prom = open(os.path.join(d, "b", "metrics.prom")).read()
+for fam in ("fed_duty_cycle", "fed_goodput_flops_per_sec",
+            "fed_goodput_rounds_total", "fed_xla_variant_compiles_total"):
+    assert fam in prom, f"{fam} missing from the Prometheus export"
+recs = [json.loads(line)
+        for line in open(os.path.join(d, "a", "events.jsonl"))]
+gp = [r["goodput"] for r in recs
+      if r.get("kind") == "round" and r.get("goodput")]
+assert gp, "sync rounds carry no goodput block"
+for g in gp:
+    s = sum(g["buckets"].values())
+    assert abs(s - g["wall_s"]) < 1e-6 + 1e-3 * g["wall_s"], (s, g["wall_s"])
+print("goodput smoke ok: buckets sum to wall on all "
+      f"{len(gp)} sync rounds, families exported")
+PY
+  python scripts/report.py "$GOOD_DIR/b/events.jsonl" --compiles
+  python scripts/runstore.py --index ./tmp/ci_goodput_index.jsonl ingest \
+    "$GOOD_DIR/a/events.jsonl" "$GOOD_DIR/b/events.jsonl"
+  python scripts/runstore.py --index ./tmp/ci_goodput_index.jsonl \
+    diff a/events.jsonl b/events.jsonl | tee ./tmp/ci_goodput_diff.txt
+  grep -q "moved bucket: prefetch_stall" ./tmp/ci_goodput_diff.txt || {
+    echo "goodput A/B did not attribute the pipeline delta to prefetch_stall"
+    exit 1
+  }
+  python scripts/runstore.py --index ./tmp/ci_goodput_index.jsonl \
+    gate b/events.jsonl --gate scripts/ci_goodput_gate.json
   echo "== sharded-aggregation smoke (forced 4-device mesh: sharded ≡ replicated; fed_agg_bytes/fed_server_state_bytes exported) =="
   # the partitioned server state (docs/PERFORMANCE.md §Partitioned server
   # state) must (a) reproduce the replicated mesh path's model bits AND

@@ -147,6 +147,18 @@ def render_table(records: list[dict]) -> str:
             # columns hide on logs that predate the mem block
             "rss_B": (r.get("mem") or {}).get("host_rss_bytes"),
             "dev_B": (r.get("mem") or {}).get("device_bytes_in_use"),
+            # round economics (obs/goodput.py, docs/PERFORMANCE.md §Round
+            # economics): duty fractions of the headline buckets, useful
+            # device throughput, and MFU when the device kind resolved —
+            # columns hide on logs that predate the goodput block
+            "duty_cmp": ((r.get("goodput") or {}).get("duty")
+                         or {}).get("compute"),
+            "duty_stall": ((r.get("goodput") or {}).get("duty")
+                           or {}).get("prefetch_stall"),
+            "gflops": ((r.get("goodput") or {}).get("flops_per_s") / 1e9
+                       if (r.get("goodput") or {}).get("flops_per_s")
+                       is not None else None),
+            "mfu": (r.get("goodput") or {}).get("mfu"),
         })
     if not rows:
         return "(no round records)"
@@ -157,6 +169,50 @@ def render_table(records: list[dict]) -> str:
     lines.append("  ".join("-" * widths[c] for c in cols))
     for row in rows:
         lines.append("  ".join(_fmt(row[c], widths[c]) for c in cols))
+    return "\n".join(lines)
+
+
+def render_compiles(records: list[dict]) -> str:
+    """The compile observatory (obs/perf_instrument.py per-variant
+    attribution + the warmup report's per-variant wall): one line per
+    compiled variant with AOT wall, backend compile seconds, and
+    hit/miss counts. Logs that predate the observatory degrade to a
+    notice — same contract as the goodput columns."""
+    recs = [r for r in records if r.get("kind") == "compiles"]
+    if not recs:
+        return ("(no compile records — run predates the compile "
+                "observatory, or warmup was skipped)")
+    lines = []
+    for rec in recs:
+        lines.append(f"compiles: total={rec.get('seconds', 0):.2f}s  "
+                     f"fresh={rec.get('fresh')}  "
+                     f"cache_hits={rec.get('cache_hits')}  "
+                     f"cache_misses={rec.get('cache_misses')}  "
+                     f"instrumented={rec.get('instrumented')}")
+        attr = rec.get("attribution") or {}
+        names = sorted(set(rec.get("variants") or {}) | set(attr))
+        if not names:
+            continue
+        rows = []
+        for name in names:
+            a = attr.get(name) or {}
+            v = (rec.get("variants") or {}).get(name)
+            aot = v.get("seconds") if isinstance(v, dict) else v
+            rows.append((name,
+                         _fmt(aot, 0),
+                         _fmt(a.get("seconds"), 0),
+                         _fmt(a.get("compiles"), 0),
+                         _fmt(a.get("cache_hits"), 0),
+                         _fmt(a.get("cache_misses"), 0)))
+        cols = ("variant", "aot_s", "backend_s", "compiles", "hits",
+                "misses")
+        widths = [max(len(cols[i]), *(len(r[i].strip()) for r in rows))
+                  for i in range(len(cols))]
+        lines.append("  " + "  ".join(c.rjust(w)
+                                      for c, w in zip(cols, widths)))
+        lines.extend("  " + "  ".join(v.strip().rjust(w)
+                                      for v, w in zip(r, widths))
+                     for r in rows)
     return "\n".join(lines)
 
 
@@ -194,6 +250,12 @@ def main(argv=None) -> int:
                         "severity, fired/resolved round, value vs "
                         "threshold — obs/health.py); logs that predate "
                         "the health monitor degrade to a notice")
+    p.add_argument("--compiles", action="store_true",
+                   help="render the compile observatory: per-variant AOT "
+                        "wall, backend compile seconds, and cache hit/"
+                        "miss attribution from warmup's 'compiles' event "
+                        "record (obs/perf_instrument.py); logs that "
+                        "predate the observatory degrade to a notice")
     p.add_argument("--critical-path", action="store_true",
                    help="render the per-round critical-path/straggler "
                         "attribution (straggler rank, phase breakdown, "
@@ -231,6 +293,9 @@ def main(argv=None) -> int:
         h = headers[0]
         print(f"run: {h.get('run')}  engine: {h.get('engine', '?')}")
     print(render_table(records))
+    if args.compiles:
+        print()
+        print(render_compiles(records))
     if args.alerts:
         print()
         print(render_alerts(records))

@@ -1,0 +1,432 @@
+#!/usr/bin/env python
+"""runstore — longitudinal run-store with regression forensics.
+
+Every run in this repo leaves artifacts behind — a telemetry
+``events.jsonl`` and/or a ``BENCH_*.json`` summary blob — but until now
+they were write-only: nothing indexed them, so "did the prefetch stall
+grow since last month" meant spelunking raw logs by hand. The run-store
+is the index:
+
+    python scripts/runstore.py ingest runs/mnist/events.jsonl BENCH.json
+    python scripts/runstore.py list
+    python scripts/runstore.py diff <a> <b>       # names the moved bucket
+    python scripts/runstore.py trend
+    python scripts/runstore.py gate <id> --gate scripts/ci_goodput_gate.json
+
+``ingest`` folds each artifact into an append-only ``runs/index.jsonl``
+(override with ``--index``): one entry per distinct artifact (sha256
+dedupe — re-ingesting is idempotent), carrying the provenance header
+when the blob has one (``obs/provenance.py``; historical blobs without
+one index fine with ``provenance: null``) and a compact summary —
+round count, rounds/s from the event timestamp span, per-round goodput
+bucket means and duty fractions (hidden on pre-goodput logs), wire
+bytes, final ε, and the headline metric.
+
+``diff`` is the forensics: phase-by-phase comparison of two entries
+(goodput buckets, duty, bytes, ε, rounds/s) that **names the bucket
+that moved** — the largest absolute per-round seconds delta — so a
+regression report says "prefetch_stall grew 42 ms/round", not "it got
+slower". ``trend`` renders the longitudinal table across every indexed
+entry. ``gate`` flattens an entry's summary into a BENCH-shaped blob
+and runs it through ``bench_gate.run_gate`` against a committed gate
+file — the CI hook (``ci.sh`` goodput leg, ``ci_goodput_gate.json``).
+
+stdlib only (no jax import — safe on bare CI runners and over
+historical artifacts). Schema: docs/OBSERVABILITY.md §Run-store.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_INDEX = "runs/index.jsonl"
+BUCKETS = ("compute", "h2d", "prefetch_stall", "wire_wait", "agg_flush",
+           "drain")
+
+
+# --------------------------------------------------------------------------
+# artifact loading (local JSONL fold — mirrors obs/events.read_jsonl without
+# importing fedml_tpu, which would drag jax onto bare runners)
+
+def _read_events(path: str) -> list[dict]:
+    paths = []
+    i = 1
+    while os.path.exists(f"{path}.{i}"):
+        paths.append(f"{path}.{i}")
+        i += 1
+    paths.reverse()  # .N is oldest
+    if os.path.exists(path):
+        paths.append(path)
+    out = []
+    for p in paths:
+        with open(p, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    return out
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 16), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _classify(path: str):
+    """-> ('events', records) | ('bench', blob). Shape-sniffed, not
+    name-sniffed: a .json holding one object is a bench blob, a .jsonl
+    stream of kind-records is an event log."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if isinstance(doc, dict):
+            return "bench", doc
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        pass
+    records = _read_events(path)
+    if records:
+        return "events", records
+    raise ValueError(f"{path}: neither a JSON blob nor a JSONL event log")
+
+
+# --------------------------------------------------------------------------
+# summarisation
+
+def _mean(vals):
+    vals = [v for v in vals if v is not None]
+    return (sum(vals) / len(vals)) if vals else None
+
+
+def _median(vals):
+    """Bucket seconds summarize by MEDIAN, not mean: round 0 routinely
+    carries a first-dispatch outlier (trace + compile-cache hit) that
+    would otherwise dominate a short run's forensics."""
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def _summarize_events(records: list[dict]) -> dict:
+    rounds = [r for r in records if r.get("kind") == "round"]
+    summary: dict = {"rounds": len(rounds)}
+    ts = [r.get("ts") for r in rounds if isinstance(r.get("ts"), (int, float))]
+    if len(ts) >= 2 and max(ts) > min(ts):
+        summary["rounds_per_sec"] = round((len(ts) - 1) / (max(ts) - min(ts)),
+                                          6)
+    gp = [r["goodput"] for r in rounds if isinstance(r.get("goodput"), dict)]
+    if gp:
+        summary["goodput_rounds"] = len(gp)
+        buckets = {b: _median([(g.get("buckets") or {}).get(b) for g in gp])
+                   for b in BUCKETS}
+        summary["bucket_s"] = {b: round(v, 6)
+                               for b, v in buckets.items() if v is not None}
+        duty = {b: _median([(g.get("duty") or {}).get(b) for g in gp])
+                for b in BUCKETS}
+        summary["duty"] = {b: round(v, 4)
+                           for b, v in duty.items() if v is not None}
+        for key in ("flops_per_s", "bytes_per_s", "mfu"):
+            v = _mean([g.get(key) for g in gp])
+            if v is not None:
+                summary[key] = v
+    comm = [r.get("comm") for r in rounds if isinstance(r.get("comm"), dict)]
+    if comm:
+        last = comm[-1]
+        for src, dst in (("bytes_uplink", "bytes_uplink"),
+                         ("bytes_downlink", "bytes_downlink"),
+                         ("bytes_sent", "bytes_sent")):
+            if last.get(src) is not None:
+                summary[dst] = last[src]
+    eps = [(r.get("privacy") or {}).get("eps") for r in rounds]
+    eps = [e for e in eps if e is not None]
+    if eps:
+        summary["eps"] = eps[-1]
+    evals = [r.get("eval") for r in records if r.get("eval")]
+    accs = [e.get("test_acc") for e in evals if e.get("test_acc") is not None]
+    if accs:
+        summary["final_test_acc"] = accs[-1]
+    return summary
+
+
+def _summarize_bench(blob: dict) -> dict:
+    summary = {}
+    for key in ("metric", "value", "rounds", "final_test_acc",
+                "rounds_per_sec", "bytes_uplink", "bytes_downlink", "eps"):
+        if isinstance(blob.get(key), (int, float, str)):
+            summary[key] = blob[key]
+    return summary
+
+
+def _entry_for(path: str, date: str) -> dict:
+    kind, payload = _classify(path)
+    sha = _sha256(path)
+    if kind == "events":
+        headers = [r for r in payload if r.get("kind") == "run"]
+        prov = next((r.get("provenance") for r in payload
+                     if isinstance(r.get("provenance"), dict)), None)
+        summary = _summarize_events(payload)
+        run = headers[0].get("run") if headers else None
+    else:
+        prov = payload.get("provenance") \
+            if isinstance(payload.get("provenance"), dict) else None
+        summary = _summarize_bench(payload)
+        run = payload.get("run") or payload.get("name")
+    return {"id": f"{os.path.basename(path)}@{sha[:10]}",
+            "kind": kind, "source": os.path.abspath(path), "sha256": sha,
+            "run": run, "ingested_at": date,
+            "provenance": prov, "summary": summary}
+
+
+# --------------------------------------------------------------------------
+# index I/O
+
+def _load_index(index: str) -> list[dict]:
+    if not os.path.exists(index):
+        return []
+    out = []
+    with open(index) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+def _resolve(entries: list[dict], ref: str) -> dict:
+    """An entry by exact id, id prefix, or source-path suffix — newest
+    wins on ambiguity (the natural 'diff against my latest' reading)."""
+    for probe in (lambda e: e.get("id") == ref,
+                  lambda e: str(e.get("id", "")).startswith(ref),
+                  lambda e: str(e.get("source", "")).endswith(ref)):
+        hits = [e for e in entries if probe(e)]
+        if hits:
+            return hits[-1]
+    raise KeyError(f"no index entry matches {ref!r}")
+
+
+# --------------------------------------------------------------------------
+# subcommands
+
+def cmd_ingest(args) -> int:
+    entries = _load_index(args.index)
+    seen = {e.get("sha256") for e in entries}
+    date = args.date or time.strftime("%Y-%m-%d")
+    os.makedirs(os.path.dirname(os.path.abspath(args.index)), exist_ok=True)
+    added = 0
+    with open(args.index, "a") as f:
+        for path in args.paths:
+            try:
+                entry = _entry_for(path, date)
+            except (OSError, ValueError) as e:
+                print(f"runstore: skip {path}: {e}", file=sys.stderr)
+                continue
+            if entry["sha256"] in seen:
+                print(f"runstore: {path} already indexed "
+                      f"({entry['id']})", file=sys.stderr)
+                continue
+            f.write(json.dumps(entry) + "\n")
+            seen.add(entry["sha256"])
+            added += 1
+            print(f"runstore: indexed {entry['id']} ({entry['kind']}, "
+                  f"{entry['summary'].get('rounds', '-')} rounds)")
+    print(f"runstore: {added} new entr{'y' if added == 1 else 'ies'} "
+          f"in {args.index}")
+    return 0
+
+
+def cmd_list(args) -> int:
+    entries = _load_index(args.index)
+    if not entries:
+        print(f"(index {args.index} is empty)")
+        return 0
+    for e in entries:
+        s = e.get("summary") or {}
+        prov = e.get("provenance") or {}
+        print(f"{e.get('id')}  kind={e.get('kind')}  "
+              f"date={e.get('ingested_at')}  "
+              f"sha={prov.get('git_sha') or '-'}  "
+              f"rounds={s.get('rounds', '-')}  "
+              f"r/s={_g(s.get('rounds_per_sec'))}")
+    return 0
+
+
+def _g(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}" if isinstance(v, float) else str(v)
+
+
+def diff_entries(a: dict, b: dict) -> tuple[list[str], str | None]:
+    """-> (report lines, name of the bucket that moved most — None when
+    neither side carries goodput buckets)."""
+    sa, sb = a.get("summary") or {}, b.get("summary") or {}
+    lines = [f"diff {a.get('id')} -> {b.get('id')}"]
+    for key, label in (("rounds_per_sec", "rounds/s"),
+                       ("flops_per_s", "flops/s"),
+                       ("bytes_per_s", "bytes/s"), ("mfu", "mfu"),
+                       ("bytes_uplink", "bytes_uplink"),
+                       ("bytes_downlink", "bytes_downlink"),
+                       ("eps", "eps"),
+                       ("final_test_acc", "final_test_acc")):
+        va, vb = sa.get(key), sb.get(key)
+        if va is None and vb is None:
+            continue
+        pct = ""
+        if isinstance(va, (int, float)) and isinstance(vb, (int, float)) \
+                and va:
+            pct = f"  ({(vb - va) / va * 100.0:+.1f}%)"
+        lines.append(f"  {label}: {_g(va)} -> {_g(vb)}{pct}")
+    ba, bb = sa.get("bucket_s") or {}, sb.get("bucket_s") or {}
+    moved = None
+    if ba or bb:
+        lines.append("  bucket seconds per round:")
+        deltas = {}
+        for bucket in BUCKETS:
+            va, vb = ba.get(bucket), bb.get(bucket)
+            if va is None and vb is None:
+                continue
+            d = (vb or 0.0) - (va or 0.0)
+            deltas[bucket] = d
+            lines.append(f"    {bucket}: {_g(va)} -> {_g(vb)} ({d:+.6f}s)")
+        if deltas:
+            moved = max(deltas, key=lambda k: abs(deltas[k]))
+            lines.append(f"  moved bucket: {moved} "
+                         f"({deltas[moved]:+.6f}s/round)")
+    else:
+        lines.append("  (no goodput buckets on either side — logs predate "
+                     "the goodput block)")
+    return lines, moved
+
+
+def cmd_diff(args) -> int:
+    entries = _load_index(args.index)
+    try:
+        a, b = _resolve(entries, args.a), _resolve(entries, args.b)
+    except KeyError as e:
+        print(f"runstore: {e.args[0]}", file=sys.stderr)
+        return 2
+    lines, _ = diff_entries(a, b)
+    print("\n".join(lines))
+    return 0
+
+
+def cmd_trend(args) -> int:
+    entries = _load_index(args.index)
+    if not entries:
+        print(f"(index {args.index} is empty)")
+        return 0
+    cols = ("id", "date", "sha", "rounds", "r/s", "duty_cmp", "stall_s",
+            "gflops", "eps", "acc")
+    rows = []
+    for e in entries:
+        s = e.get("summary") or {}
+        prov = e.get("provenance") or {}
+        fps = s.get("flops_per_s")
+        rows.append((str(e.get("id", "-")),
+                     str(e.get("ingested_at", "-")),
+                     str(prov.get("git_sha") or "-"),
+                     _g(s.get("rounds")), _g(s.get("rounds_per_sec")),
+                     _g((s.get("duty") or {}).get("compute")),
+                     _g((s.get("bucket_s") or {}).get("prefetch_stall")),
+                     _g(None if fps is None else fps / 1e9),
+                     _g(s.get("eps")), _g(s.get("final_test_acc"))))
+    widths = [max(len(cols[i]), *(len(r[i]) for r in rows))
+              for i in range(len(cols))]
+    print("  ".join(c.rjust(w) for c, w in zip(cols, widths)))
+    print("  ".join("-" * w for w in widths))
+    for r in rows:
+        print("  ".join(v.rjust(w) for v, w in zip(r, widths)))
+    return 0
+
+
+def flatten_summary(entry: dict) -> dict:
+    """An index entry's summary as a flat BENCH-shaped blob bench_gate
+    can resolve names against: buckets become ``bucket_<name>_s``, duty
+    fractions ``duty_<name>``, plus ``duty_total`` (structural ≈1)."""
+    s = dict(entry.get("summary") or {})
+    flat = {k: v for k, v in s.items()
+            if isinstance(v, (int, float, str))}
+    for bucket, v in (s.get("bucket_s") or {}).items():
+        flat[f"bucket_{bucket}_s"] = v
+    duty = s.get("duty") or {}
+    for bucket, v in duty.items():
+        flat[f"duty_{bucket}"] = v
+    if duty:
+        flat["duty_total"] = round(sum(duty.values()), 4)
+    return flat
+
+
+def cmd_gate(args) -> int:
+    import bench_gate
+
+    entries = _load_index(args.index)
+    try:
+        entry = _resolve(entries, args.ref)
+    except KeyError as e:
+        print(f"runstore: {e.args[0]}", file=sys.stderr)
+        return 2
+    try:
+        with open(args.gate) as f:
+            gate = json.load(f)
+        violations, lines = bench_gate.run_gate(flatten_summary(entry), gate)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"runstore: {e}", file=sys.stderr)
+        return 2
+    print("\n".join(lines))
+    if violations:
+        print(f"runstore gate: REGRESSION — {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("runstore gate: ok")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("runstore")
+    p.add_argument("--index", default=DEFAULT_INDEX,
+                   help=f"index file (default {DEFAULT_INDEX})")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    sp = sub.add_parser("ingest", help="index event logs / BENCH blobs")
+    sp.add_argument("paths", nargs="+")
+    sp.add_argument("--date", default=None,
+                    help="ingestion date stamp (default: today)")
+    sp.set_defaults(fn=cmd_ingest)
+    sp = sub.add_parser("list", help="list index entries")
+    sp.set_defaults(fn=cmd_list)
+    sp = sub.add_parser("diff", help="phase-by-phase A/B; names the moved "
+                                     "bucket")
+    sp.add_argument("a")
+    sp.add_argument("b")
+    sp.set_defaults(fn=cmd_diff)
+    sp = sub.add_parser("trend", help="longitudinal table across entries")
+    sp.set_defaults(fn=cmd_trend)
+    sp = sub.add_parser("gate", help="gate one entry via bench_gate")
+    sp.add_argument("ref")
+    sp.add_argument("--gate", required=True, metavar="PATH")
+    sp.set_defaults(fn=cmd_gate)
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -90,11 +90,20 @@ def test_digest_shape_and_byte_budget():
     for _ in range(5):
         with em.phase("local_fit"):
             time.sleep(0.001)
-    blob = em.digest(5, wave=2, eps=1.25)
+    em.digest(4)  # seed the inter-digest clock so the next one has a duty
+    for _ in range(3):
+        with em.phase("local_fit"):
+            time.sleep(0.001)
+    blob = em.digest(5, wave=2, eps=1.25, gflops=12.345)
     assert blob["rank"] == 3 and blob["round"] == 5 and blob["wave"] == 2
     assert blob["run"] == "r-unit" and blob["eps"] == 1.25
     p50, p95, p99 = blob["spans"]["local_fit"]
     assert 0.0 < p50 <= p95 <= p99
+    # round-economics fields (docs/PERFORMANCE.md §Round economics): the
+    # duty fraction is busy-span time over the inter-digest interval —
+    # present, bounded, and INSIDE the byte budget measured below
+    assert blob["gf"] == 12.345
+    assert 0.0 < blob["duty"] <= 1.0
     # the documented budget, measured exactly as attach_digest accounts it
     wire = len(json.dumps(blob, default=float).encode())
     assert wire <= DIGEST_BYTE_BUDGET
