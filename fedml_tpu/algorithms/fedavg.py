@@ -261,6 +261,13 @@ class FedAvgConfig:
     # test splits (natural partitions — where the weighting differs from a
     # shared global test set); 'on'/'off' force it.
     local_test_on_all_clients: str = "auto"
+    # scheduled client availability (chaos/churn.py ChurnTrace, or None):
+    # every engine's per-round cohort draw restricts to the trace's
+    # available clients for the round's window (core/sampling.sample_
+    # available). Orthogonal to chaos faults — scheduled-offline is the
+    # fleet's NORMAL state, not a failure. Recorded in the run header via
+    # asdict like every other flag, so a run replays from its header.
+    churn_trace: object | None = None
 
 
 def resolve_local_spec(local_spec: LocalSpec | None,
@@ -437,6 +444,13 @@ class FedAvgAPI:
         # is the unbiased pairing (sampling twice — by probability AND by
         # weight — would double-count data-rich clients).
         self.uniform_avg = uniform_avg or config.sampling == "size_weighted"
+        if getattr(config, "churn_trace", None) is not None \
+                and mesh is not None:
+            raise ValueError(
+                "churn_trace varies the per-round cohort size, which breaks "
+                "the mesh's static client-shard shapes — run churned "
+                "cohorts standalone or through the cross-process runtime "
+                "(rank-level scheduled availability)")
         self._client_sizes = prepare_sampling(config, dataset)
         self.rng = jax.random.PRNGKey(config.seed)
 
@@ -1149,6 +1163,11 @@ class FedAvgAPI:
         Returns per-round metrics stacked along axis 0."""
         if not self.device_data:
             raise ValueError("run_rounds needs device_data=True")
+        if getattr(self.cfg, "churn_trace", None) is not None:
+            raise ValueError(
+                "churn_trace varies the per-round cohort size — the scanned "
+                "round block needs one static K across its rounds; drive "
+                "churned runs through train()/run_round (per-round dispatch)")
         if self.mesh is not None and self._needs_stacked:
             # the mesh block scans INSIDE shard_map, where a robust
             # aggregator's full-stack sorts/distances cannot run — degrade

@@ -30,6 +30,14 @@ Usage::
 With no plan installed, ``maybe_wrap`` returns the manager unchanged — the
 no-chaos hot path costs nothing.
 
+Scheduled availability (``chaos/churn.py``) is the third axis: a seeded
+:class:`ChurnTrace` models the NORMAL state of a fleet — diurnal
+availability curves, arrival/dropout point processes, device-class skew —
+on a sha256 stream independent of FaultPlan's, so churn × chaos × byzantine
+replays bit-for-bit (a :class:`ScenarioPlan` bundles all three for
+``scripts/fleet_campaign.py`` profiles). See docs/ROBUSTNESS.md §Fleet
+campaigns & client churn for the offline-vs-dead semantics.
+
 Model-space adversaries (``chaos/adversary.py``) are the Byzantine-client
 sibling: an :class:`AdversaryPlan` schedules sign_flip/scale/gaussian/
 nan/shift uploads per (round-window, rank) with the same seeded
@@ -46,6 +54,7 @@ import threading
 from fedml_tpu.chaos.plan import FaultLedger, FaultPlan, FaultRule
 from fedml_tpu.chaos.inject import ChaosCommManager
 from fedml_tpu.chaos.adversary import AdversaryPlan, AdversaryRule
+from fedml_tpu.chaos.churn import ChurnTrace, DeviceClass, ScenarioPlan
 
 _active: FaultPlan | None = None
 _lock = threading.Lock()
@@ -86,5 +95,6 @@ def maybe_wrap(manager, rank: int):
 __all__ = [
     "FaultPlan", "FaultRule", "FaultLedger", "ChaosCommManager",
     "AdversaryPlan", "AdversaryRule",
+    "ChurnTrace", "DeviceClass", "ScenarioPlan",
     "install_plan", "active_plan", "installed", "maybe_wrap",
 ]

@@ -235,10 +235,14 @@ def make_in_graph_injector(plan: AdversaryPlan, num_slots: int):
 
             # floating leaves only, matching perturb_leaves (the host
             # path): integer leaves carry no gradient signal, and
-            # jax.random.normal cannot even draw in their dtype
+            # jax.random.normal cannot even draw in their dtype. The slot
+            # mask is sliced to the stacked leading dim: under a churn
+            # trace a round's cohort can be smaller than num_slots, and
+            # slot i keeps meaning cohort position i
             out = jax.tree.map(
                 lambda s, g: jnp.where(
-                    mask.reshape((num_slots,) + (1,) * (s.ndim - 1)) > 0,
+                    mask[: s.shape[0]].reshape(
+                        (s.shape[0],) + (1,) * (s.ndim - 1)) > 0,
                     attack(s, g).astype(s.dtype), s)
                 if jnp.issubdtype(s.dtype, jnp.floating) else s,
                 out, global_tree)

@@ -67,8 +67,12 @@ STALENESS_KINDS = ("constant", "polynomial", "exponential")
 # crash recovery): work that was in flight when the server died — the
 # WAL-journaled buffer entries lost with the process, and post-restart
 # arrivals whose echoed restart_epoch predates the recovery.
+# 'offline' is SCHEDULED unavailability (chaos/churn.py ChurnTrace): the
+# slot/rank is away by the trace, not dead — skipped silently with no
+# suspect bookkeeping or reprobe churn, counted here so the export still
+# shows where round capacity went.
 SHED_REASONS = ("stale", "overflow", "nonfinite", "crash", "suspect",
-                "undecodable", "server_restart")
+                "undecodable", "server_restart", "offline")
 
 
 # ------------------------------------------------------ staleness discounts
@@ -400,9 +404,20 @@ class VirtualClockAsyncRunner:
         dur = self.base_duration_s + straggle_delay_s(
             self.chaos_plan, slot + 1, wave)
         self._seq += 1
+        ids = self.engine._sampled_ids(wave)
+        if slot >= len(ids):
+            # scheduled-offline (churn trace): this wave's available
+            # cohort is smaller than the slot count — the slot idles
+            # through the wave and retries the next one. Deliberately NOT
+            # the dead path: no suspect bookkeeping, just the 'offline'
+            # shed counter so stats() show where wave capacity went
+            heapq.heappush(heap, (t + dur, self._seq, "arrival",
+                                  {"slot": slot, "wave": wave,
+                                   "offline": True}))
+            return
         item = {
             "slot": slot, "wave": wave,
-            "client": int(self.engine._sampled_ids(wave)[slot]),
+            "client": int(ids[slot]),
             "version": self.version,
             "net": self.engine.net,  # snapshot ref (immutable jax arrays)
             "dead": crashed_in_wave(self.chaos_plan, slot + 1, wave),
@@ -534,6 +549,11 @@ class VirtualClockAsyncRunner:
                         self._dispatch(heap, slot, t)
                 continue
             slot = item["slot"]
+            if item.get("offline"):
+                # scheduled-offline wave: retry at the next wave's cohort
+                self._shed("offline")
+                self._dispatch(heap, slot, t)
+                continue
             if item["dead"]:
                 # a crashed rank's dispatch produces nothing; the slot
                 # burns the wave and re-dispatches (rejoin after window)

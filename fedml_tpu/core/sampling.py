@@ -60,14 +60,44 @@ def sample_clients_weighted(
     fewer nonzero clients than the round needs still draws a full round;
     all-zero sizes fall back to uniform."""
     sizes = np.asarray(client_sizes, np.float64)
-    n = len(sizes)
+    return sample_clients(round_idx, len(sizes), client_num_per_round, seed,
+                          p=_size_probs(sizes))
+
+
+def _size_probs(sizes: np.ndarray):
+    """The size_weighted probability vector (None = uniform fallback) —
+    shared by the full-population and churn-restricted samplers."""
     if not np.any(sizes > 0):
-        p = None  # uniform fallback
-    else:
-        floor = sizes[sizes > 0].min() * 1e-9
-        p = np.maximum(sizes, floor)
-        p = p / p.sum()
-    return sample_clients(round_idx, n, client_num_per_round, seed, p=p)
+        return None
+    floor = sizes[sizes > 0].min() * 1e-9
+    p = np.maximum(sizes, floor)
+    return p / p.sum()
+
+
+def sample_available(cfg, round_idx: int, trace, client_sizes=None
+                     ) -> np.ndarray:
+    """Churn-aware per-round draw: restrict the population to the trace's
+    scheduled-available cohort for this round's window, then run the SAME
+    seeded RandomState stream over the restricted index space. Returns
+    ``min(client_num_per_round, available)`` sorted ids — under a diurnal
+    trough the cohort legitimately shrinks (the acceptance test asserts
+    cohort sizes follow the curve); the trace's min-one floor keeps it
+    nonempty. Deterministic: availability draws live on ChurnTrace's
+    sha256 stream, the subset draw on sample_clients' numpy stream, so
+    churn composes with chaos/adversary plans without draw coupling."""
+    avail = trace.available_clients(trace.window(round_idx),
+                                    cfg.client_num_in_total)
+    n = min(cfg.client_num_per_round, len(avail))
+    if n == len(avail):
+        return avail
+    p = None
+    if cfg.sampling == "size_weighted":
+        if client_sizes is None:
+            raise ValueError("size_weighted sampling needs the per-client "
+                             "sizes — pass prepare_sampling(cfg, data)")
+        p = _size_probs(np.asarray(client_sizes, np.float64)[avail])
+    idx = sample_clients(round_idx, len(avail), n, cfg.seed, p=p)
+    return np.sort(avail[idx]).astype(np.int64)
 
 
 def prepare_sampling(cfg, data) -> np.ndarray | None:
@@ -91,16 +121,20 @@ def sample_for(cfg, round_idx: int, client_sizes=None) -> np.ndarray:
     """Per-round half of the dispatch — the shared entry for every engine
     that honors the flag (uniform | size_weighted; the weighted scheme
     needs prepare_sampling's sizes and must pair with a uniform
-    aggregate)."""
+    aggregate). An active ``cfg.churn_trace`` restricts every draw to the
+    trace's scheduled-available cohort for the round's window."""
+    if cfg.sampling not in ("uniform", "size_weighted"):
+        raise ValueError(f"unknown sampling {cfg.sampling!r} "
+                         "(uniform | size_weighted)")
+    trace = getattr(cfg, "churn_trace", None)
+    if trace is not None:
+        return sample_available(cfg, round_idx, trace, client_sizes)
     if cfg.sampling == "size_weighted":
         if client_sizes is None:
             raise ValueError("size_weighted sampling needs the per-client "
                              "sizes — pass prepare_sampling(cfg, data)")
         return sample_clients_weighted(
             round_idx, client_sizes, cfg.client_num_per_round, cfg.seed)
-    if cfg.sampling != "uniform":
-        raise ValueError(f"unknown sampling {cfg.sampling!r} "
-                         "(uniform | size_weighted)")
     return sample_clients(round_idx, cfg.client_num_in_total,
                           cfg.client_num_per_round, cfg.seed)
 

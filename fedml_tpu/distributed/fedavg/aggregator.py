@@ -610,6 +610,21 @@ class FedAvgAggregator:
 
     # ------------------------------------------------------------ sampling
     def client_sampling(self, round_idx: int) -> np.ndarray:
+        trace = getattr(self.cfg, "churn_trace", None)
+        if trace is not None:
+            from fedml_tpu.core.sampling import sample_available
+
+            ids = sample_available(self.cfg, round_idx, trace)
+            k = self.cfg.client_num_per_round
+            if len(ids) < k:
+                # the cross-process cohort is one client per worker RANK —
+                # slots must stay fully populated. In a diurnal trough the
+                # available cohort legitimately re-assigns the same client
+                # to multiple ranks (cycle-pad, deterministic); rank-level
+                # scheduled-offline skipping is what actually shrinks the
+                # realized round
+                ids = np.resize(ids, k)
+            return ids
         return sample_clients(
             round_idx, self.cfg.client_num_in_total, self.cfg.client_num_per_round,
             self.cfg.seed,

@@ -84,6 +84,7 @@ def run_simulated(
     sum_assoc: str = "auto",
     edges: int | None = None,
     fused_agg: bool = False,
+    churn_trace=None,
 ) -> FedAvgAggregator:
     """All ranks as threads on one host — the mpirun-on-localhost analogue.
 
@@ -136,6 +137,15 @@ def run_simulated(
     broadcast the dispatch wave carried (the former dense-only refusal is
     lifted; only a genuinely unversioned base is an error).
 
+    ``churn_trace``: a ``fedml_tpu.chaos.ChurnTrace`` armed at the RANK
+    level (docs/ROBUSTNESS.md §Fleet campaigns & client churn) — worker
+    ranks the trace schedules offline for a round are skipped SILENTLY
+    (no suspect bookkeeping, no reprobe churn, quorum denominators
+    shrink) and re-invited the round the trace brings them back; a rank
+    that goes dark while the trace expects it present rides the existing
+    suspected-dead machinery. Orthogonal to ``cfg.churn_trace``, which
+    churns the CLIENT population the cohort is sampled from.
+
     ``fused_agg``: fused on-device server aggregation (docs/PERFORMANCE.md
     §Fused aggregation) — uploads stage as their raw quantized leaves and
     one jit per arrival runs decode → densify → non-finite gate → pairwise
@@ -168,16 +178,21 @@ def run_simulated(
                 f"edges={edges} (hierarchical topology) does not compose "
                 f"with {bad} — run the flat topology for those modes "
                 "(tree aggregation is pairwise by construction)")
-        if chaos_plan is not None and chaos_plan.server_crash_points():
+        if churn_trace is not None:
             raise ValueError(
-                "chaos crash rules naming rank 0 (supervised server "
-                "restart — docs/ROBUSTNESS.md §Server crash recovery) are "
-                "wired for the flat topology; the edge tier has no "
-                "session-resume protocol yet")
+                "churn_trace= here is RANK-level scheduled availability, "
+                "and the tree's edge/worker ranks are infrastructure "
+                "slots, not devices — drive client-level churn through "
+                "cfg.churn_trace (cohort sampling), which composes with "
+                "edges")
         from fedml_tpu.distributed.fedavg.hierarchy import (
             run_simulated_hierarchical,
         )
 
+        # chaos crash rules naming rank 0 ARE wired for the tree now: the
+        # hierarchical driver runs the same supervision loop as the flat
+        # path (kill at the scheduled point, recover through checkpoint +
+        # WAL, edges re-sync on the next downlink)
         return run_simulated_hierarchical(
             dataset, task, cfg, edges=edges, backend=backend,
             job_id=job_id, base_port=base_port, broker_host=broker_host,
@@ -227,6 +242,7 @@ def run_simulated(
                                        buffer_capacity=buffer_capacity,
                                        heartbeat_max_age_s=heartbeat_max_age_s,
                                        delta_broadcast=delta_broadcast,
+                                       churn_trace=churn_trace,
                                        **kw)
 
         server = build_server()

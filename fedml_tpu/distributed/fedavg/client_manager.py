@@ -149,8 +149,14 @@ class FedAvgClientManager(ClientManager):
         after that fit's (now epoch-stale) upload is queued."""
         self._restart_epoch = int(msg_params.get(
             MyMessage.MSG_ARG_KEY_RESTART_EPOCH, self._restart_epoch))
+        # answer the PROBE'S sender: probes always come straight from the
+        # root, and in the hierarchical topology self.server_rank is this
+        # worker's edge — which has no ack handler and must not be in the
+        # resume path (flat runs are unchanged: sender == server_rank == 0)
+        probe_src = int(msg_params.get(Message.MSG_ARG_KEY_SENDER,
+                                       self.server_rank))
         msg = Message(MyMessage.MSG_TYPE_C2S_RESUME_ACK, self.rank,
-                      self.server_rank)
+                      probe_src)
         msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_ROUND,
                        int(self.round_idx))
         msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_WAVE,

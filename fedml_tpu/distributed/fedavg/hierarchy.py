@@ -388,6 +388,9 @@ class FedAvgEdgeManager(DistributedManager):
             MyMessage.MSG_TYPE_S2E_SEND_VERDICT_TO_EDGE,
             self._handle_verdict)
         self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_RESUME_PROBE,
+            self._handle_resume_probe)
+        self.register_message_receive_handler(
             MyMessage.MSG_TYPE_S2C_FINISH, self._handle_finish)
 
     def _handle_downlink(self, msg_type: str, msg_params) -> None:
@@ -650,6 +653,18 @@ class FedAvgEdgeManager(DistributedManager):
             else:
                 self._forward_partial()
 
+    def _handle_resume_probe(self, msg_params) -> None:
+        """A recovered root probes EVERY rank (edges included — the root
+        can't tell tiers apart at probe time). Answer with this edge's
+        last-seen round; workers answer the same probe directly (their
+        ack goes to the probe's sender, rank 0, not through this edge)."""
+        with self._lock:
+            last = -1 if self._round is None else int(self._round)
+        msg = Message(MyMessage.MSG_TYPE_C2S_RESUME_ACK, self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_ROUND, last)
+        msg.add_params(MyMessage.MSG_ARG_KEY_LAST_SEEN_WAVE, -1)
+        self.send_message(msg)
+
     def _handle_finish(self, _msg) -> None:
         self.finish()
 
@@ -669,7 +684,12 @@ class HierFedAvgServerManager(FedAvgServerManager):
         for flag, name in ((kw.get("async_buffer_k"), "async_buffer_k"),
                            (kw.get("delta_broadcast"), "delta_broadcast"),
                            (kw.get("heartbeat_max_age_s"),
-                            "heartbeat_max_age_s")):
+                            "heartbeat_max_age_s"),
+                           # rank-level churn: the tree's edge/worker
+                           # ranks are infrastructure slots, not devices —
+                           # client-level churn (cfg.churn_trace, cohort
+                           # sampling) is the axis that composes with it
+                           (kw.get("churn_trace"), "churn_trace")):
             if flag:
                 raise ValueError(
                     f"{name} is not wired through edge aggregators — run "
@@ -723,6 +743,13 @@ class HierFedAvgServerManager(FedAvgServerManager):
         from fedml_tpu.comm.message import codec_roundtrip
         from fedml_tpu.obs.tracing import TRACE_KEY
 
+        # same crash/journal choreography as the flat broadcast: the
+        # between-commits point fires BEFORE any frame leaves, the round
+        # opening is journaled so recovery knows round r was in flight
+        self._maybe_crash("broadcast")
+        if self.wal is not None:
+            self.wal.append("broadcast", sync=True, round=self.round_idx)
+        self._uploads_this_round = 0
         topo = self.topology
         client_indexes = self.aggregator.client_sampling(self.round_idx)
         self._round_ids = [int(c) for c in client_indexes]
@@ -759,6 +786,8 @@ class HierFedAvgServerManager(FedAvgServerManager):
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
+        # broadcast out, zero partials accepted — the after_uploads=0 point
+        self._maybe_crash("post_broadcast")
 
     def handle_message_edge_evidence(self, msg_params) -> None:
         """Phase 2 intake: stage one edge's per-slot evidence; once every
@@ -871,6 +900,8 @@ class HierFedAvgServerManager(FedAvgServerManager):
                 self._fleet.ingest(
                     msg_params.get(MyMessage.MSG_ARG_KEY_TELEMETRY))
             samples = msg_params.get(MyMessage.MSG_ARG_KEY_EDGE_SAMPLES)
+            already = bool(self.aggregator.flag_client_model_uploaded.get(
+                sender - 1))
             self.aggregator.add_edge_result(
                 sender - 1,
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_WSUM],
@@ -880,6 +911,19 @@ class HierFedAvgServerManager(FedAvgServerManager):
                 msg_params[MyMessage.MSG_ARG_KEY_EDGE_CLIENTS],
                 round_idx=int(msg_round),
                 samples=None if samples is None else float(samples))
+            if (not already and self.aggregator
+                    .flag_client_model_uploaded.get(sender - 1)):
+                # the accepted partial is this tier's "upload": journal it
+                # (fsync'd) so a crash before the commit ledgers the edge's
+                # slot server_restart on recovery — and feed the
+                # after_uploads crash points, which count edge partials in
+                # tree mode (a verdict-retry retransmit stays dedup'd by
+                # the `already` flag)
+                self._uploads_this_round += 1
+                if self.wal is not None:
+                    self.wal.append("upload", sync=True,
+                                    round=int(msg_round), rank=int(sender))
+                self._maybe_crash("upload")
             if self._robust and self._verdict_t is not None:
                 import time as _time
 
@@ -963,13 +1007,30 @@ def run_simulated_hierarchical(
     if chaos_plan is not None:
         _chaos.install_plan(chaos_plan)
     try:
-        root_agg = HierFedAvgAggregator(
-            dataset, task, cfg, topo, aggregator=aggregator,
-            aggregator_params=aggregator_params, sanitize=sanitize)
-        server = HierFedAvgServerManager(
-            root_agg, rank=0, size=topo.world_size, backend=backend,
-            ckpt_dir=ckpt_dir, round_timeout_s=round_timeout_s,
-            telemetry=telemetry, **kw)
+        # chaos crash rules naming rank 0 are supervised server restarts,
+        # same contract as the flat driver: kill at the scheduled point
+        # (SimulatedServerCrash — no farewell frames), recover a FRESH
+        # root through checkpoint + WAL; edges reset their round state on
+        # the recovered root's next downlink, so the tree needs no extra
+        # resume protocol of its own
+        active = _chaos.active_plan()
+        crash_points = (active.server_crash_points()
+                        if active is not None else [])
+        if crash_points and ckpt_dir is None:
+            raise ValueError(
+                "a chaos crash rule naming rank 0 (server restart) needs "
+                "ckpt_dir= — recovery replays checkpoint + WAL")
+
+        def build_server():
+            root_agg = HierFedAvgAggregator(
+                dataset, task, cfg, topo, aggregator=aggregator,
+                aggregator_params=aggregator_params, sanitize=sanitize)
+            return HierFedAvgServerManager(
+                root_agg, rank=0, size=topo.world_size, backend=backend,
+                ckpt_dir=ckpt_dir, round_timeout_s=round_timeout_s,
+                telemetry=telemetry, **kw)
+
+        server = build_server()
         # the edge tier arms its elastic watchdog at HALF the root
         # deadline: tier-2 elasticity (a stalled block's evidence/partial)
         # resolves strictly before the root's own timeout acts, so the
@@ -980,8 +1041,9 @@ def run_simulated_hierarchical(
         edge_mgrs = [
             FedAvgEdgeManager(topo.edge_rank(e), topo, backend=backend,
                               round_timeout_s=edge_timeout,
-                              robust=root_agg.robust_mode,
-                              sketch_dim=root_agg.sketch_dim, **kw)
+                              robust=server.aggregator.robust_mode,
+                              sketch_dim=server.aggregator.sketch_dim,
+                              **kw)
             for e in range(topo.edges)
         ]
         clients = []
@@ -999,8 +1061,18 @@ def run_simulated_hierarchical(
             enable_compile_cache()
             # one rank compiles, every sibling deserializes from disk
             clients[0].warmup()
-        launch_simulated(server, edge_mgrs + clients)
+        if not crash_points:
+            launch_simulated(server, edge_mgrs + clients)
+        else:
+            # same supervision loop as the flat driver: edges and workers
+            # run ONCE, spanning every root generation
+            from fedml_tpu.distributed.fedavg.api import (
+                run_supervised_simulated,
+            )
+
+            server = run_supervised_simulated(
+                server, edge_mgrs + clients, crash_points, build_server)
     finally:
         if chaos_plan is not None:
             _chaos.install_plan(None)
-    return root_agg
+    return server.aggregator

@@ -72,8 +72,28 @@ class FedAvgServerManager(ServerManager):
                  buffer_deadline_s: float | None = None,
                  buffer_capacity: int | None = None,
                  heartbeat_max_age_s: float | None = None,
-                 delta_broadcast: bool = False, **kw):
+                 delta_broadcast: bool = False, churn_trace=None, **kw):
         self.aggregator = aggregator
+        # scheduled availability (chaos/churn.py ChurnTrace, or None): the
+        # trace's RANK stream decides which worker ranks are scheduled-
+        # offline each round's window. Offline ranks are skipped SILENTLY
+        # — no send, no suspect/undeliverable bookkeeping, no reprobe or
+        # backoff churn — and subtracted from the quorum denominators;
+        # only a rank the trace expects here rides the suspected-dead
+        # paths (docs/ROBUSTNESS.md §Fleet campaigns & client churn).
+        self.churn_trace = churn_trace
+        self._offline_now: set[int] = set()
+        # ranks whose dispatch was skipped for scheduled offline — the
+        # flush-time reprobe re-dispatches them the moment the trace
+        # brings them back (async mode's "resume on the next arrival")
+        self._offline_skipped: set[int] = set()
+        self._idle_rounds = 0
+        self._idle_logged_round: int | None = None
+        if churn_trace is not None:
+            # pre-register the churn families at zero so a churn-driven
+            # run's export always carries them; trace-less runs keep a
+            # byte-identical export
+            _obs.ensure_churn_families()
         self.round_num = aggregator.cfg.comm_round
         self.round_idx = 0
         self._bcast_leaves = None  # latest decoded broadcast (legacy alias)
@@ -310,10 +330,37 @@ class FedAvgServerManager(ServerManager):
     def _update_alive_gauge(self) -> None:
         """fed_ranks_alive from the undeliverable/reprobe bookkeeping —
         world size may be unknown on a partially-built instance (tests
-        drive the elastic send path without the comm stack)."""
+        drive the elastic send path without the comm stack). Scheduled-
+        offline ranks count as NOT alive alongside the undeliverable set,
+        so alive and the quorum rule's churn-shrunken expected
+        denominator move together through diurnal troughs (a trough never
+        looks like an outage; a genuine crash inside the available set
+        still dips alive below the shrunken expectation)."""
         size = getattr(self, "size", None)
         if size is not None:
-            _obs.set_ranks_alive(size - 1 - len(self._undeliverable))
+            dead = set(self._undeliverable) | self._offline_now
+            _obs.set_ranks_alive(size - 1 - len(dead))
+
+    def _scheduled_offline(self) -> set[int]:
+        """The churn trace's scheduled-offline rank set for the CURRENT
+        round's window (empty with no trace). Publishes the
+        fed_ranks_scheduled_offline gauge and refreshes fed_ranks_alive —
+        every skip/admission/watchdog path reads availability through
+        here so the health view can never drift from the decisions."""
+        if self.churn_trace is None:
+            return set()
+        off = self.churn_trace.scheduled_offline_ranks(
+            self.round_idx, self.size)
+        if off != self._offline_now:
+            self._offline_now = off
+            _obs.set_ranks_scheduled_offline(len(off))
+            self._update_alive_gauge()
+            if self._fleet is not None:
+                # fedtop's avail column: rank 0 owns the trace, so it
+                # stamps the fleet rows directly (an away rank sends no
+                # digests to say so itself)
+                self._fleet.note_avail(off, self.size)
+        return off
 
     @staticmethod
     def _is_transport_error(e: BaseException) -> bool:
@@ -639,7 +686,17 @@ class FedAvgServerManager(ServerManager):
         suspects = _obs.suspect_ranks(
             range(1, self.size), self.heartbeat_max_age_s, self.round_idx,
             self._DEAD_RANK_REPROBE_ROUNDS)
-        self.aggregator.excluded = {r - 1 for r in suspects}
+        # scheduled-offline vs suspected-dead: a rank the churn trace says
+        # is away is EXPECTED silent — it must never ride the suspect
+        # path (no reprobe/backoff churn, no alert pressure). It is still
+        # excluded from the cohort (no send, barrier does not wait).
+        offline = self._scheduled_offline()
+        suspects -= offline
+        self.aggregator.excluded = {r - 1 for r in suspects | offline}
+        if offline:
+            log.debug("round %d: %d rank(s) scheduled-offline by the churn "
+                      "trace — skipped silently", self.round_idx,
+                      len(offline))
         if (self.heartbeat_max_age_s is not None
                 and self.round_idx % self._DEAD_RANK_REPROBE_ROUNDS == 0):
             # reprobe round: force a REAL send attempt to every silent rank
@@ -687,7 +744,7 @@ class FedAvgServerManager(ServerManager):
         if tr is not None:
             tr.begin_round(self.round_idx)
         for rank in range(1, self.size):
-            if rank in suspects:
+            if rank in suspects or rank in offline:
                 continue
             msg = Message(msg_type, self.rank, rank)
             if delta is not None and self._rank_version.get(rank) == base_v:
@@ -715,9 +772,14 @@ class FedAvgServerManager(ServerManager):
             if self._fleet is not None:
                 # fleet enablement marker (obs/fleet.py): tells the rank
                 # to piggyback digests on its uploads; absent with the
-                # plane off, so the wire stays byte-identical
-                msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY,
-                               self._fleet.marker())
+                # plane off, so the wire stays byte-identical. A churn-
+                # armed server stamps avail so the rank's digests echo it
+                # (fedtop's avail column) — a frame only reaches
+                # scheduled-ONLINE ranks, hence the constant
+                marker = self._fleet.marker()
+                if self.churn_trace is not None:
+                    marker = {**marker, "avail": 1.0}
+                msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY, marker)
             self.send_message(msg)
         if tr is not None:
             tr.end_broadcast()
@@ -913,7 +975,17 @@ class FedAvgServerManager(ServerManager):
         (packed once per version) + the client its dispatch-wave counter
         samples. Heartbeat-suspect ranks are skipped (admission control) —
         the flush-time reprobe re-dispatches them once they may have
-        resumed."""
+        resumed. Scheduled-offline ranks (churn trace) are skipped
+        SILENTLY before the suspect check: the trace expects them away,
+        so they get no suspect bookkeeping and no reprobe churn — the
+        flush-time reprobe hands them fresh work the moment the trace
+        brings them back."""
+        if rank in self._scheduled_offline():
+            self._offline_skipped.add(rank)
+            self._record_shed("offline")
+            log.debug("async: rank %d scheduled-offline — dispatch skipped "
+                      "until the trace's next arrival", rank)
+            return
         suspects = _obs.suspect_ranks(
             range(1, self.size), self.heartbeat_max_age_s, self.round_idx,
             self._DEAD_RANK_REPROBE_ROUNDS)
@@ -957,6 +1029,15 @@ class FedAvgServerManager(ServerManager):
         # reconstructing it server-side from the counter would misattribute
         # a delayed upload once a reprobe puts two dispatches in flight
         msg.add_params(MyMessage.MSG_ARG_KEY_DISPATCH_WAVE, wave)
+        if self._fleet is not None:
+            # same enablement marker the sync broadcast carries — without
+            # it an async fleet would never fold a digest; avail constant
+            # for the same reason as the sync path (a dispatch only
+            # reaches scheduled-online ranks)
+            marker = self._fleet.marker()
+            if self.churn_trace is not None:
+                marker = {**marker, "avail": 1.0}
+            msg.add_params(MyMessage.MSG_ARG_KEY_TELEMETRY, marker)
         self._awaiting[rank] = wave
         self.send_message(msg)
         if rank in self._undeliverable:
@@ -1399,8 +1480,27 @@ class FedAvgServerManager(ServerManager):
         import time as _time
 
         now = _time.monotonic()
+        offline = self._scheduled_offline()
         for rank in range(1, self.size):
             if rank in self._parked:
+                continue
+            if rank in offline:
+                # scheduled-offline: the trace says it's away, not dead —
+                # zero reprobe churn; the arrival fast-path below picks it
+                # up the moment the trace brings it back
+                continue
+            if rank in self._offline_skipped:
+                # back from scheduled-offline: re-dispatch immediately,
+                # bypassing the age/grace checks — its silence was the
+                # trace's doing, not evidence of death
+                self._offline_skipped.discard(rank)
+                self._idle_logged_round = None  # an arrival ends the stretch
+                log.info("async: rank %d returned from scheduled-offline — "
+                         "re-dispatching", rank)
+                self._undeliverable.pop(rank, None)
+                self._update_alive_gauge()
+                self._awaiting.pop(rank, None)
+                self._dispatch_one(rank)
                 continue
             last = self._last_dispatch_version.get(rank)
             if not force and last is not None and \
@@ -1621,11 +1721,18 @@ class FedAvgServerManager(ServerManager):
             # logs) that never crashed
             extra["server"] = {"restarts": self._restart_epoch,
                                "restart_epoch": self._restart_epoch}
+        if self.churn_trace is not None:
+            # churn provenance: how many ranks the trace held out this
+            # round and how many idle (no-fold) rounds the run has taken —
+            # hidden on trace-less runs, so their records stay byte-stable
+            extra["churn"] = {"scheduled_offline": len(self._offline_now),
+                              "idle_rounds": self._idle_rounds}
         return extra
 
     def _advance_round(self):
         """Aggregate what's collected, eval, and start the next round (or
         finish). Caller holds _round_lock."""
+        self._idle_logged_round = None  # real progress ends an idle stretch
         tel = self.telemetry
         if tel is not None:
             import numpy as np
@@ -1706,6 +1813,32 @@ class FedAvgServerManager(ServerManager):
                                 len(self._buffer))
                     self._flush_buffer()
                 else:
+                    offline = self._scheduled_offline()
+                    if offline and all(r in offline
+                                       for r in range(1, self.size)):
+                        # the WHOLE fleet is scheduled-offline: an idle
+                        # trough, not a stall — log once per stretch,
+                        # count it, and advance round_idx without folding
+                        # (availability windows are round-indexed; a
+                        # static round would keep the trough's offline
+                        # set frozen and deadlock). The reprobe after the
+                        # advance hands fresh work to whoever the trace
+                        # brought back.
+                        if self._idle_logged_round is None:
+                            log.info(
+                                "async: fleet idle — every rank is "
+                                "scheduled-offline by the churn trace; "
+                                "advancing idle rounds until the next "
+                                "arrival")
+                            self._idle_logged_round = self.round_idx
+                        _obs.record_round_idle()
+                        self._idle_rounds += 1
+                        self.round_idx += 1
+                        if self.round_idx >= self.round_num:
+                            self._finish_async()
+                            return
+                        self._async_reprobe(force=True)
+                        return
                     log.error("async: fleet idle %.1fs with an empty "
                               "buffer — reprobing silent ranks", idle_s)
                     self._async_reprobe(force=True)
@@ -1719,6 +1852,35 @@ class FedAvgServerManager(ServerManager):
                           self.round_idx, idle_s, missing)
                 return
             if not received:
+                offline = self._scheduled_offline()
+                online_missing = [r for r in missing if r not in offline]
+                if offline and not online_missing:
+                    # every missing rank is scheduled-offline: an idle
+                    # round, not a stall — log once per idle stretch,
+                    # count fed_rounds_idle_total, and advance WITHOUT
+                    # folding (availability windows are round-indexed, so
+                    # a stalled round's offline set is static — standing
+                    # still would deadlock an all-offline trough). The
+                    # re-broadcast at the new round reaches whoever the
+                    # trace brought back; if the trough persists, the
+                    # next watchdog fire idles again, silently.
+                    if self._idle_logged_round is None:
+                        log.info(
+                            "round %d: fleet idle — every missing rank "
+                            "is scheduled-offline by the churn trace; "
+                            "advancing idle rounds until the next "
+                            "arrival", self.round_idx)
+                        self._idle_logged_round = self.round_idx
+                    _obs.record_round_idle()
+                    self._idle_rounds += 1
+                    self.round_idx += 1
+                    if self.round_idx == self.round_num:
+                        self._broadcast_finish()
+                        return
+                    self._broadcast_model(
+                        MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                        self.aggregator.get_global_model_params())
+                    return
                 # elastic round with NOTHING to aggregate: advancing would
                 # fold an empty cohort, but returning silently wedged the
                 # job forever (every upload lost to corrupt-drop/crash in

@@ -396,8 +396,36 @@ def set_ranks_alive(n: int) -> None:
     """``fed_ranks_alive``: peer ranks currently considered reachable —
     set by the elastic server from its undeliverable/reprobe bookkeeping
     (world - 1 at start, decremented on delivery failure, restored when a
-    reprobe succeeds)."""
+    reprobe succeeds). A server driven by a churn trace also subtracts
+    its SCHEDULED-offline ranks, so alive and the quorum rule's shrunken
+    expected denominator move together through diurnal troughs."""
     REGISTRY.gauge("fed_ranks_alive").set(n)
+
+
+def set_ranks_scheduled_offline(n: int) -> None:
+    """``fed_ranks_scheduled_offline``: ranks the active churn trace
+    (chaos/churn.py) marks away for the current round's window. The
+    quorum/fleet_quorum health rules subtract this from their expected
+    denominator — a diurnal trough is the fleet's normal state, never an
+    outage (docs/ROBUSTNESS.md §Fleet campaigns & client churn). Zero
+    (and pre-registered by the churn-driven server) on trace-less runs."""
+    REGISTRY.gauge("fed_ranks_scheduled_offline").set(n)
+
+
+def record_round_idle() -> None:
+    """``fed_rounds_idle_total``: rounds the server skipped because every
+    undelivered rank was SCHEDULED-offline (an empty night-time cohort —
+    the watchdog idles the round instead of re-broadcasting forever)."""
+    REGISTRY.counter("fed_rounds_idle_total").inc()
+
+
+def ensure_churn_families() -> None:
+    """Pre-register the churn families at zero the moment a server boots
+    with a trace armed — a churn-driven run's export must read 'no idle
+    rounds yet', not 'metric missing'. Trace-less runs never call this,
+    keeping their export byte-identical."""
+    REGISTRY.gauge("fed_ranks_scheduled_offline")
+    REGISTRY.counter("fed_rounds_idle_total")
 
 
 def comm_counters(registry: MetricsRegistry | None = None) -> dict:

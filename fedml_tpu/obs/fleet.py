@@ -109,6 +109,12 @@ class DigestEmitter:
         # byte budget
         self._busy = 0.0
         self._last_digest_t: float | None = None
+        # scheduled availability (chaos/churn.py): adopted from a churn-
+        # armed server's broadcast marker, echoed on each digest so the
+        # fleet view's ``avail`` column reads straight off the rank rows.
+        # None = no trace anywhere = the blob is byte-identical to pre-
+        # churn digests (fedtop renders '-')
+        self._avail: float | None = None
         self._lock = threading.Lock()
 
     def on_downlink(self, marker: dict) -> None:
@@ -118,6 +124,9 @@ class DigestEmitter:
         run = marker.get("run")
         if run:
             self.run_id = str(run)
+        av = marker.get("avail")
+        if av is not None:
+            self._avail = float(av)
 
     # ---------------------------------------------------------- phase timing
     class _Phase:
@@ -149,7 +158,7 @@ class DigestEmitter:
 
     # --------------------------------------------------------------- the blob
     def digest(self, round_idx: int, wave=None, eps=None,
-               gflops=None) -> dict:
+               gflops=None, avail=None) -> dict:
         """The compact uplink blob: round/wave progress, comm counter
         deltas since this rank's previous digest, per-phase [p50,p95,p99],
         duty cycle (phase-busy seconds over the inter-digest interval),
@@ -188,6 +197,10 @@ class DigestEmitter:
             blob["spans"] = spans
         if eps is not None:
             blob["eps"] = round(float(eps), 6)
+        if avail is None:
+            avail = self._avail  # the marker-adopted value, if any
+        if avail is not None:
+            blob["avail"] = round(float(avail), 3)
         rss = host_rss_bytes()
         if rss is not None:
             blob["rss"] = int(rss)
@@ -291,13 +304,25 @@ class FleetCollector:
             row["bytes_uplink"] += int(ctr.get("bytes_uplink", 0))
             row["bytes_downlink"] += int(ctr.get("bytes_downlink", 0))
             for k in ("round", "wave", "eps", "rss", "dev", "spans", "run",
-                      "duty", "gf"):
+                      "duty", "gf", "avail"):
                 if d.get(k) is not None:
                     row[k] = d[k]
             row["seen_ts"] = now
             self._digests += 1
         self._counter("fed_fleet_digests_total").inc()
         flight_record("fleet_ingest", rank=rank, round=d.get("round"))
+
+    def note_avail(self, offline: set, world_size: int) -> None:
+        """Server-side availability stamp (chaos/churn.py): a scheduled-
+        offline rank sends no digests while away, so its row would keep
+        the last avail it echoed — rank 0, which owns the trace, overrides
+        the column on EXISTING rows (never creates one: a phantom row
+        would inflate ``fed_fleet_ranks_reporting`` and skew the
+        fleet-quorum denominator)."""
+        with self._lock:
+            for rank, row in self._ranks.items():
+                if 0 < rank < world_size:
+                    row["avail"] = 0.0 if rank in offline else 1.0
 
     def note_server(self, round_idx: int, eps=None, duty=None,
                     gflops=None) -> None:
@@ -376,6 +401,7 @@ class FleetCollector:
                 "spans": row.get("spans"),
                 "duty": row.get("duty"),
                 "gflops": row.get("gf"),
+                "avail": row.get("avail"),
                 "status": "stale" if stale else "ok",
             }
         rounds = [v["round"] for v in out_ranks.values()

@@ -289,7 +289,16 @@ class HealthMonitor:
             if self.expected_ranks is None or "fed_ranks_alive" not in snap:
                 return None
             alive = float(sum(snap["fed_ranks_alive"].values()))
-            thresh = float(rule.get("min_fraction", 1.0)) * self.expected_ranks
+            # churn-aware denominator: ranks the trace scheduled offline
+            # (chaos/churn.py) come out of BOTH sides — the server's alive
+            # gauge already subtracts them, and here they shrink the
+            # expected cohort — so a diurnal trough reads alive == thresh
+            # (no fire) while one genuine crash inside the available set
+            # still reads alive < thresh (fires once, edge-triggered).
+            off = float(sum(
+                snap.get("fed_ranks_scheduled_offline", {}).values()))
+            expected = max(0.0, self.expected_ranks - off)
+            thresh = float(rule.get("min_fraction", 1.0)) * expected
             return alive < thresh, alive, thresh
         if kind == "device_memory":
             in_use = snap.get("fed_device_bytes_in_use", {})
@@ -340,9 +349,16 @@ class HealthMonitor:
                     return None
                 reporting = float(sum(
                     snap.get("fed_fleet_ranks_reporting", {}).values()))
-                # +1: rank 0's own row reports alongside the cohort
+                # +1: rank 0's own row reports alongside the cohort.
+                # Scheduled-offline ranks (churn trace) shrink the
+                # expected cohort like the process-quorum rule above —
+                # collector rows persist once ingested, so churn alone
+                # can't drop `reporting`, but a rank held offline since
+                # boot never produces a row and must not read as missing.
+                off = float(sum(
+                    snap.get("fed_ranks_scheduled_offline", {}).values()))
                 thresh = (float(rule.get("min_fraction", 1.0))
-                          * (self.expected_ranks + 1))
+                          * (max(0.0, self.expected_ranks - off) + 1))
                 return reporting < thresh, reporting, thresh
             stale_fam = snap.get(
                 "fed_fleet_digest_staleness_max_seconds", {})

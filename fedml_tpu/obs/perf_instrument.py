@@ -338,7 +338,7 @@ def ensure_async_shed_families() -> None:
     # mirrors core/async_buffer.SHED_REASONS (obs must not import core —
     # the dependency points the other way; drift is test-pinned)
     for reason in ("stale", "overflow", "nonfinite", "crash", "suspect",
-                   "undecodable", "server_restart"):
+                   "undecodable", "server_restart", "offline"):
         _async_shed(reason)
 
 

@@ -90,7 +90,7 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
              sparsify_ratio: float | None = None,
              edges: int | None = None,
              sum_assoc: str = "auto", fleet: bool = False,
-             secagg: bool = False) -> dict:
+             secagg: bool = False, churn_trace=None) -> dict:
     """One soak trial: run the loopback job under ``plan``; return the
     trial record (ok flag, per-fault counts, history tail, timing).
 
@@ -114,6 +114,13 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
     exercises the edge_lost elastic path, and the record gains per-tier
     fan-in stats.
 
+    ``churn_trace`` layers CLIENT-level scheduled availability
+    (chaos/churn.py) under the wire-level faults: the cohort is sampled
+    from the trace's available population each round (diurnal troughs
+    shrink it; the cross-process runtime cycle-pads its fixed rank
+    slots). The trace is seeded like everything else here, so replays
+    stay bit-for-bit on the sync tiers.
+
     ``secagg`` runs the trial on the MASKED secure-aggregation tier
     (docs/ROBUSTNESS.md §Secure aggregation; with ``edges`` the
     hierarchical composition of §Hierarchical secure aggregation) —
@@ -130,7 +137,8 @@ def run_plan(data, task, plan, rounds: int = 3, world_size: int | None = None,
         per_round = (world_size - 1 - edges) if world_size else 4
     cfg = FedAvgConfig(comm_round=rounds, client_num_in_total=data.num_clients,
                        client_num_per_round=per_round, epochs=1, batch_size=8,
-                       lr=0.1, frequency_of_the_test=1, seed=0)
+                       lr=0.1, frequency_of_the_test=1, seed=0,
+                       churn_trace=churn_trace)
     # the run-health monitor rides every trial (in-memory event log): the
     # soak campaign is exactly the adversarial weather the rule table
     # exists for, and its alert ledger becomes part of the summary —
@@ -448,6 +456,14 @@ def main(argv=None) -> int:
                          "masked tree of §Hierarchical secure aggregation "
                          "— in-block dropout heals via the edge-local "
                          "reveal, a crashed edge sheds exactly its block)")
+    ap.add_argument("--churn-trace", "--churn_trace",
+                    dest="churn_trace", type=str, default=None,
+                    help="client-level scheduled-availability trace (JSON "
+                         "file path or inline JSON, chaos/churn.py "
+                         "ChurnTrace) layered under every trial's wire "
+                         "faults: the cohort samples only trace-available "
+                         "clients each round (diurnal troughs shrink it). "
+                         "Seeded — sync-tier replays stay bit-for-bit")
     ap.add_argument("--fleet", action="store_true",
                     help="arm the fleet observability plane on every trial "
                          "(docs/OBSERVABILITY.md §Fleet rollup): uplinks "
@@ -532,6 +548,20 @@ def main(argv=None) -> int:
 
         return AdversaryPlan.from_json(adv_spec)
 
+    churn_spec = None
+    if args.churn_trace:
+        from fedml_tpu.chaos import ChurnTrace
+
+        # normalized to JSON and rebuilt per trial, like the adversary
+        churn_spec = ChurnTrace.from_spec(args.churn_trace).to_json()
+
+    def churn():
+        if churn_spec is None:
+            return None
+        from fedml_tpu.chaos import ChurnTrace
+
+        return ChurnTrace.from_json(churn_spec)
+
     aggregator = args.aggregator if adv_spec is not None else None
     # --compression tier: frame codec (process-wide), update codec
     # (per-client encoded deltas), or topk:R sparsification
@@ -559,7 +589,8 @@ def main(argv=None) -> int:
                        world_size=args.world_size, adversary_plan=adv(),
                        aggregator=aggregator, edges=args.edges,
                        async_buffer_k=args.async_buffer_k,
-                       fleet=args.fleet, secagg=args.secagg, **codec_kw)
+                       fleet=args.fleet, secagg=args.secagg,
+                       churn_trace=churn(), **codec_kw)
         if rec["ok"] and args.replay_every and i % args.replay_every == 0:
             import numpy as np
 
@@ -571,7 +602,7 @@ def main(argv=None) -> int:
                             edges=args.edges,
                             async_buffer_k=args.async_buffer_k,
                             fleet=args.fleet, secagg=args.secagg,
-                            **codec_kw)
+                            churn_trace=churn(), **codec_kw)
             if args.async_buffer_k or args.edges or args.secagg:
                 # async dispatch counts and arrival order are
                 # thread-scheduled, so even per-link fault draws shift
@@ -621,12 +652,14 @@ def main(argv=None) -> int:
                                  world_size=args.world_size,
                                  adversary_plan=adv(),
                                  aggregator=aggregator, edges=args.edges,
-                                 secagg=args.secagg, **codec_kw)
+                                 secagg=args.secagg, churn_trace=churn(),
+                                 **codec_kw)
                 f_rec = run_plan(
                     data, task, empty(), rounds=args.rounds,
                     world_size=args.world_size - args.edges,
                     adversary_plan=adv(), aggregator=aggregator,
-                    sum_assoc="pairwise", secagg=args.secagg, **codec_kw)
+                    sum_assoc="pairwise", secagg=args.secagg,
+                    churn_trace=churn(), **codec_kw)
                 tf_ok = (t_rec["qledger"] == f_rec["qledger"]
                          and t_rec["net"] is not None and all(
                              np.array_equal(np.asarray(a), np.asarray(b))
@@ -684,6 +717,8 @@ def main(argv=None) -> int:
         summary["async_buffer_k"] = args.async_buffer_k
     if args.compression:
         summary["compression"] = args.compression
+    if churn_spec is not None:
+        summary["churn_trace"] = json.loads(churn_spec)
     if args.edges:
         # per-tier fan-in roll-up: the root must have folded O(edges)
         # update frames per round on every trial that completed

@@ -1099,4 +1099,43 @@ python scripts/chaos_soak.py --secagg --trials 3 --rounds 3 --world_size 7 \
 # accepted-then-lost slot ledgered server_restart
 python scripts/chaos_soak.py --server-crash --trials 4 --rounds 4 \
   --out ./tmp/chaos_soak_crash.json
+
+echo "== fleet campaign smoke (committed production-shaped profiles under a diurnal churn trace over a 100k-virtual-client streamed population; exactly-once outage accounting + bitwise replay; gated via ci_campaign_gate.json; runstore-ingested) =="
+# docs/ROBUSTNESS.md §Fleet campaigns & client churn: the maximal legal
+# compositions, end to end. ci_sync_tree = 2 edges x 8 gRPC workers,
+# robust gating (median + sanitize), one supervised mid-round server
+# SIGKILL (ckpt+WAL recovery) and one edge crash inside the run, plus a
+# bitwise replay leg — the gate pins exactly-once ledger accounting
+# (server_restart == after_uploads; edge_lost == block x reprobe span,
+# no duplicate (round, rank)), zero quorum false-positives from
+# scheduled-offline ranks, and replay model/ledger equality. async_flat
+# = buffered async x poly staleness x delta-int8 x RANK-level churn
+# (scheduled-offline dispatch admission). Both scrape /healthz +
+# /fleetz live mid-run.
+python scripts/fleet_campaign.py --profile ci_sync_tree --profile async_flat \
+  --out ./tmp/ci_campaign
+python scripts/bench_gate.py ./tmp/ci_campaign/ci_sync_tree_summary.json \
+  --gate scripts/ci_campaign_gate.json
+python scripts/bench_gate.py ./tmp/ci_campaign/async_flat_summary.json \
+  --gate scripts/ci_campaign_gate.json
+# the longitudinal record: both summaries join the runstore index
+python scripts/runstore.py --index ./tmp/ci_runstore_index.jsonl ingest \
+  ./tmp/ci_campaign/ci_sync_tree_summary.json \
+  ./tmp/ci_campaign/async_flat_summary.json
+python - <<'PY'
+# the fleet plane actually ran: fed_fleet_* families in both runs' prom
+# exports, and the churn families (fed_ranks_scheduled_offline,
+# fed_rounds_idle_total) in the rank-churned async run
+tree = open("./tmp/ci_campaign/ci_sync_tree/a/metrics.prom").read()
+flat = open("./tmp/ci_campaign/async_flat/a/metrics.prom").read()
+for fam in ("fed_fleet_ranks_reporting", "fed_fleet_digests_total",
+            "fed_fleet_round_max", "fed_ranks_alive"):
+    assert fam in tree, f"{fam} missing from the tree campaign export"
+    assert fam in flat, f"{fam} missing from the async campaign export"
+for fam in ("fed_ranks_scheduled_offline", "fed_rounds_idle_total"):
+    assert fam in flat, f"{fam} missing from the rank-churned async export"
+assert "fed_server_restarts_total" in tree, \
+    "supervised restart left no fed_server_restarts_total in the export"
+print("fleet campaign smoke ok: fleet + churn families exported")
+PY
 echo "CI GREEN"

@@ -64,16 +64,19 @@ def render(snap: dict) -> str:
              f"  down={_fmt_bytes(rollup.get('bytes_downlink'))}"
              f"  eps_max={_fmt(rollup.get('eps_max'))}"
              f"  stalest={_fmt(rollup.get('staleness_max_s'))}s")
-    cols = ("rank", "status", "round", "wave", "stale_s", "up", "down",
-            "duty%", "gflops", "eps", "rss", "dev")
+    cols = ("rank", "status", "round", "wave", "avail", "stale_s", "up",
+            "down", "duty%", "gflops", "eps", "rss", "dev")
     rows = []
     for rank in sorted(snap.get("ranks", {}), key=int):
         r = snap["ranks"][rank]
         # duty/gflops: the round-economics pair (docs/PERFORMANCE.md
-        # §Round economics) — '-' on digests that predate the fields
+        # §Round economics); avail: scheduled availability under a churn
+        # trace (docs/ROBUSTNESS.md §Fleet campaigns & client churn) —
+        # '-' on digests that predate the fields
         duty = r.get("duty")
         rows.append((rank, r.get("status", "?"), _fmt(r.get("round")),
-                     _fmt(r.get("wave")), _fmt(r.get("staleness_s")),
+                     _fmt(r.get("wave")), _fmt(r.get("avail")),
+                     _fmt(r.get("staleness_s")),
                      _fmt_bytes(r.get("bytes_uplink")),
                      _fmt_bytes(r.get("bytes_downlink")),
                      _fmt(None if duty is None else round(duty * 100, 1)),

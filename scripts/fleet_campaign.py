@@ -1,0 +1,596 @@
+#!/usr/bin/env python
+"""fleet_campaign — named, committed production-shaped campaign profiles.
+
+The standing integration proof (docs/ROBUSTNESS.md §Fleet campaigns &
+client churn): each profile composes the maximal LEGAL stack for its
+topology and runs it under a fault storm on top of a seeded ChurnTrace
+(chaos/churn.py), over a streamed packed-npy population the writer never
+materializes. A campaign is only "ok" when the composed run completes
+AND the ledger accounting is exact:
+
+- ``sync_tree`` / ``ci_sync_tree`` — 2-tier tree (``edges=``) × cross-tier
+  robust gating (median + sanitize) × client-level diurnal churn
+  (``cfg.churn_trace``) × chaos storm with a supervised mid-round server
+  SIGKILL (SimulatedServerCrash + checkpoint/WAL recovery) and an edge
+  crash (elastic ``edge_lost`` block shed). Exactly-once accounting:
+  ``server_restart`` ledger entries == the crash rule's ``after_uploads``
+  (all in the crash round), ``edge_lost`` entries == the crashed edge's
+  block size (all in its crash window), quorum fires only for genuine
+  crashes — never for a diurnal trough. Replayed: the same seed + trace
+  must reproduce the final model bits AND the quarantine ledger.
+- ``async_flat`` — buffered-async (``async_buffer_k`` × poly staleness ×
+  delta-int8 uplinks) × the SAME trace armed at BOTH levels: client churn
+  shapes cohort sampling, rank churn schedules worker ranks offline
+  (scheduled-offline ≠ suspected-dead: silent skip, zero reprobe churn,
+  ``fed_rounds_idle_total`` when the whole fleet sleeps). Thread-scheduled
+  arrival order ⇒ the assertion is liveness + zero quorum false
+  positives, not bit-replay (that contract lives in the virtual-clock
+  tests).
+
+Mid-run, the live endpoints are scraped (``/healthz`` + ``/fleetz`` off
+``Telemetry(http_port=0, health=True, fleet=True)``) — evidence the
+observability plane stayed up through the storm rides the summary. The
+summary blob is provenance-stamped (obs/provenance.py), written per
+profile, and shaped for scripts/bench_gate.py (the committed CI gate is
+``scripts/ci_campaign_gate.json``) and scripts/runstore.py ingestion.
+
+    python scripts/fleet_campaign.py --list
+    python scripts/fleet_campaign.py --profile ci_sync_tree --profile \
+        async_flat --out ./tmp/fleet_campaign
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --------------------------------------------------------------------------
+# committed profiles — the campaign IS these dicts; edits here are
+# reviewable policy changes, not script flags. Each documents the
+# composed-stack compatibility it actually exercises (the refusal
+# matrix's tested face; docs/ROBUSTNESS.md §Fleet campaigns).
+
+_DIURNAL = {
+    # client-level diurnal curve: ~55% mean availability swinging hard,
+    # timezone-spread phases, a slow arrival ramp and a small permanent-
+    # dropout hazard, over two device tiers feeding the size-skew hook
+    "seed": 11, "base": 0.55, "amplitude": 0.45, "period": 8,
+    "rounds_per_window": 1, "tz_spread": 0.6, "arrival_spread": 2,
+    "departure_rate": 0.002,
+    "device_classes": [
+        {"name": "phone", "weight": 3.0, "size_scale": 1.0},
+        {"name": "tablet", "weight": 1.0, "size_scale": 2.0},
+    ],
+}
+
+PROFILES: dict[str, dict] = {
+    # flagship: the big tree. Same composition as ci_sync_tree, scaled
+    # up — run it on real hardware, not in CI.
+    "sync_tree": {
+        "mode": "tree", "edges": 4, "workers": 16, "rounds": 20,
+        "backend": "grpc", "base_port": 50840, "clients": 100_000,
+        "aggregator": "median", "sanitize": True,
+        "round_timeout_s": 30.0, "replay": True,
+        "churn": _DIURNAL,
+        "chaos": {"seed": 7, "rules": [
+            {"fault": "crash", "ranks": [0], "rounds": [5, 6],
+             "after_uploads": 2},
+            {"fault": "crash", "ranks": [1], "rounds": [11, 12]},
+            {"fault": "delay", "delay_s": 0.05, "prob": 0.3},
+            {"fault": "duplicate", "prob": 0.2},
+        ]},
+        # real-fleet data (ISSUE: FEMNIST): point --data-dir at a LEAF
+        # femnist root (scripts/download_femnist.sh) to get
+        # dataset_source=real in the run header; absent, the flagship
+        # falls back to the synthetic packed population with a warning
+        "real_dataset": "femnist",
+    },
+    # the shrunken CI twin the acceptance gate runs: 1 root + 2 edge
+    # aggregators + 8 gRPC workers, ~10 rounds, one supervised mid-round
+    # server SIGKILL (after_uploads=1 accepted edge partial), one edge
+    # crash, the diurnal trace — over a 100k-virtual-client streamed
+    # packed population
+    "ci_sync_tree": {
+        "mode": "tree", "edges": 2, "workers": 8, "rounds": 10,
+        "backend": "grpc", "base_port": 50820, "clients": 100_000,
+        "aggregator": "median", "sanitize": True,
+        "round_timeout_s": 10.0, "replay": True,
+        "churn": _DIURNAL,
+        # the edge crash at round 5 keeps the whole outage arc inside the
+        # run: 4 shed rounds (the elastic reprobe backoff), readmission
+        # at round 9, quorum fired AND resolved exactly once
+        "chaos": {"seed": 7, "rules": [
+            {"fault": "crash", "ranks": [0], "rounds": [3, 4],
+             "after_uploads": 1},
+            {"fault": "crash", "ranks": [1], "rounds": [5, 6]},
+            {"fault": "delay", "delay_s": 0.05, "prob": 0.3},
+            {"fault": "duplicate", "prob": 0.2},
+        ]},
+    },
+    # buffered-async flat fleet: K-arrival flushes with a polynomial
+    # staleness discount, delta-int8 uplinks, and the trace armed at
+    # BOTH levels (rank_base/rank_amplitude give worker ranks their own
+    # curve). No crash rule: async arrival order is thread-scheduled, so
+    # this profile asserts liveness + admission semantics, not replay.
+    "async_flat": {
+        "mode": "async_flat", "workers": 6, "rounds": 10,
+        "backend": "LOOPBACK", "base_port": 50860, "clients": 100_000,
+        "async_buffer_k": 3, "staleness": "poly:0.5",
+        "buffer_deadline_s": 2.0, "update_codec": "delta-int8",
+        "round_timeout_s": 10.0, "replay": False,
+        "churn": {**_DIURNAL, "seed": 13, "rank_base": 0.75,
+                  "rank_amplitude": 0.25},
+        "chaos": {"seed": 13, "rules": [
+            {"fault": "delay", "delay_s": 0.05, "prob": 0.3},
+            {"fault": "duplicate", "prob": 0.2},
+        ]},
+    },
+}
+
+
+# --------------------------------------------------------------------------
+# live-endpoint evidence: scrape /healthz + /fleetz while the campaign
+# runs — the observability plane must stay up through the storm, and the
+# summary carries the proof (scrape counts + the richest mid-run rollup)
+
+class _Scraper(threading.Thread):
+    def __init__(self, port: int, interval_s: float = 0.25):
+        super().__init__(daemon=True)
+        self.port = port
+        self.interval_s = interval_s
+        self.stop = threading.Event()
+        self.healthz_ok = 0
+        self.fleetz_ok = 0
+        self.fleetz_best: dict | None = None
+
+    def _get(self, path: str):
+        url = f"http://127.0.0.1:{self.port}{path}"
+        with urllib.request.urlopen(url, timeout=2) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def run(self):
+        while not self.stop.is_set():
+            try:
+                self._get("/healthz")
+                self.healthz_ok += 1
+            except Exception:  # noqa: BLE001 — absence is the finding
+                pass
+            try:
+                snap = self._get("/fleetz")
+                self.fleetz_ok += 1
+                if (self.fleetz_best is None
+                        or len(snap.get("ranks", {}))
+                        >= len(self.fleetz_best.get("ranks", {}))):
+                    self.fleetz_best = snap
+            except Exception:  # noqa: BLE001
+                pass
+            self.stop.wait(self.interval_s)
+
+
+# --------------------------------------------------------------------------
+# one composed run
+
+def _model_sha(net) -> str:
+    import numpy as np
+
+    from fedml_tpu.comm.message import pack_pytree
+
+    h = hashlib.sha256()
+    for leaf in pack_pytree(net):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def _open_population(data_dir: str, n_clients: int):
+    """The ONE shared fixture writer (ci.sh's streamed-smoke idiom):
+    chunked packed-npy population on disk, reopened lazily — reruns and
+    sibling profiles reuse the cache instead of regenerating 100k
+    clients per leg."""
+    from fedml_tpu.core.client_source import PackedNpySource
+    from fedml_tpu.data.synthetic import synthetic_packed_population
+
+    path = os.path.join(data_dir, f"packed_{n_clients}")
+    meta = os.path.join(path, "meta.json")
+    if not os.path.exists(meta):
+        shutil.rmtree(path, ignore_errors=True)
+        synthetic_packed_population(path, n_clients, dim=16)
+    return PackedNpySource(path)
+
+
+def _open_data(prof: dict, data_dir: str, n_clients: int,
+               real_dir: str | None):
+    """-> (streamed source, num_classes). A profile naming a
+    ``real_dataset`` (the flagship's FEMNIST) opens ``--real-data`` as a
+    layout-sniffed streamed source — ``dataset_source: real`` lands in
+    the run header; without the directory it falls back to the synthetic
+    packed population, loudly."""
+    name = prof.get("real_dataset")
+    if name and real_dir:
+        from fedml_tpu.core.client_source import open_source
+        from fedml_tpu.data.registry import DATASETS
+
+        spec = DATASETS[name]
+        return (open_source(real_dir, input_shape=spec.input_shape,
+                            class_num=spec.num_classes),
+                spec.num_classes, "real")
+    if name:
+        print(f"fleet_campaign: no --real-data for {name}; falling back "
+              f"to the synthetic packed population", file=sys.stderr)
+    return _open_population(data_dir, n_clients), 5, "synthetic"
+
+
+def _run_once(prof: dict, src, run_dir: str, rounds: int,
+              job_suffix: str, num_classes: int = 5) -> dict:
+    """One end-to-end composed run of ``prof``; returns the evidence
+    record (model sha, canonical ledgers, alerts, round records, scrape
+    counts). Plans and traces are rebuilt FRESH from the committed spec
+    — ledgers and availability state never leak between runs, which is
+    what makes the replay comparison meaningful."""
+    from fedml_tpu.chaos import ChurnTrace, FaultPlan
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import Telemetry
+
+    os.makedirs(run_dir, exist_ok=True)
+    mode = prof["mode"]
+    workers = prof["workers"]
+    trace = ChurnTrace.from_json(prof["churn"])
+    plan = FaultPlan.from_json(prof["chaos"])
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    cfg = FedAvgConfig(comm_round=rounds,
+                       client_num_in_total=src.num_clients,
+                       client_num_per_round=workers, epochs=1,
+                       batch_size=8, lr=0.1, frequency_of_the_test=1,
+                       seed=0, churn_trace=trace)
+    # expected_ranks is inferred from the run header (world_size - 1) —
+    # the same cohort fed_ranks_alive counts
+    tel = Telemetry(log_dir=run_dir, health=True, fleet=True, http_port=0)
+    scraper = _Scraper(tel.http_port)
+    scraper.start()
+    kw: dict = dict(backend=prof.get("backend", "LOOPBACK"),
+                    base_port=prof.get("base_port", 50800),
+                    job_id=f"campaign-{job_suffix}", chaos_plan=plan,
+                    round_timeout_s=prof.get("round_timeout_s"),
+                    telemetry=tel)
+    needs_ckpt = any(r.get("fault") == "crash" and 0 in r.get("ranks", ())
+                     for r in prof["chaos"]["rules"])
+    if needs_ckpt:
+        kw["ckpt_dir"] = os.path.join(run_dir, "ckpt")
+    if mode == "tree":
+        kw.update(edges=prof["edges"], aggregator=prof.get("aggregator"),
+                  sanitize=prof.get("sanitize"))
+    else:
+        kw.update(async_buffer_k=prof.get("async_buffer_k"),
+                  staleness=prof.get("staleness", "constant"),
+                  buffer_deadline_s=prof.get("buffer_deadline_s"),
+                  update_codec=prof.get("update_codec"),
+                  # the SAME trace, armed at the RANK level: scheduled-
+                  # offline worker ranks are skipped silently
+                  churn_trace=trace)
+    t0 = time.perf_counter()
+    err = None
+    agg = None
+    try:
+        agg = run_simulated(src, classification_task(
+            LogisticRegression(num_classes=num_classes)), cfg, **kw)
+    except Exception as e:  # noqa: BLE001 — a failed campaign is the data
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        fleet_close = tel.fleet.snapshot() if tel.fleet else None
+        alerts = [{k: a.get(k) for k in ("rule", "severity", "state",
+                                         "round", "value", "threshold")}
+                  for a in (tel.health.alerts if tel.health else [])]
+        tel.close()
+        scraper.stop.set()
+        scraper.join(timeout=5)
+    rounds_rec = []
+    events = os.path.join(run_dir, "events.jsonl")
+    if os.path.exists(events):
+        with open(events) as f:
+            rounds_rec = [json.loads(line) for line in f]
+        rounds_rec = [r for r in rounds_rec if r.get("kind") == "round"]
+    completed = bool(agg and agg.history
+                     and agg.history[-1]["round"] == rounds - 1)
+    return {
+        "error": err,
+        "completed": completed,
+        "completed_rounds": (agg.history[-1]["round"] + 1
+                             if agg and agg.history else 0),
+        "model_sha": _model_sha(agg.net) if agg is not None else None,
+        "qledger": (agg.quarantine.canonical() if agg is not None else []),
+        "qentries": (agg.quarantine.entries() if agg is not None else []),
+        "quarantine": (agg.quarantine.counts() if agg is not None else {}),
+        "fanin": list(getattr(agg, "fanin_history", []) or []),
+        "faults": plan.ledger.counts(),
+        "alerts": alerts,
+        "fleet_close": {"status": fleet_close["status"],
+                        "ranks_reporting": fleet_close["ranks_reporting"],
+                        "digests_total": fleet_close["digests_total"]}
+        if fleet_close else None,
+        "round_records": rounds_rec,
+        "healthz_scrapes": scraper.healthz_ok,
+        "fleetz_scrapes": scraper.fleetz_ok,
+        "fleetz_mid": scraper.fleetz_best,
+        "final_eval": (agg.history[-1] if agg and agg.history else None),
+        "seconds": round(time.perf_counter() - t0, 2),
+    }
+
+
+# --------------------------------------------------------------------------
+# accounting: the exactly-once contracts each profile must satisfy
+
+def _crash_windows(prof: dict) -> list[dict]:
+    return [r for r in prof["chaos"]["rules"] if r.get("fault") == "crash"]
+
+
+def _check_tree(prof: dict, rec: dict, rounds: int,
+                errors: list[str]) -> dict:
+    """Exactly-once ledger accounting for the tree storm: one supervised
+    server restart with ``after_uploads`` lost slots, one crashed edge
+    shedding exactly its block per outage round (the outage spans the
+    elastic reprobe backoff — the crashed round plus the skip interval —
+    then the reprobe readmits the edge), quorum firings == genuine
+    crashes."""
+    from fedml_tpu.distributed.fedavg.server_manager import (
+        FedAvgServerManager,
+    )
+
+    reprobe = FedAvgServerManager._DEAD_RANK_REPROBE_ROUNDS
+    crash = _crash_windows(prof)
+    srv = next((r for r in crash if 0 in r["ranks"]), None)
+    edge = next((r for r in crash if 0 not in r["ranks"]), None)
+    out: dict = {}
+    lost = [e for e in rec["qentries"] if e["reason"] == "server_restart"]
+    out["server_restart_entries"] = len(lost)
+    if srv is not None:
+        want = srv.get("after_uploads") or 0
+        if len(lost) != want:
+            errors.append(f"server_restart entries: {len(lost)} != "
+                          f"after_uploads {want}")
+        if any(e["round"] != srv["rounds"][0] for e in lost):
+            errors.append(f"server_restart entries outside crash round "
+                          f"{srv['rounds'][0]}: {lost}")
+        restarts = max((((r.get("server") or {}).get("restarts")) or 0
+                        for r in rec["round_records"]), default=0)
+        out["server_restarts"] = restarts
+        if restarts != 1:
+            errors.append(f"server restarts: {restarts} != 1 (round "
+                          f"records never carried the recovery epoch)")
+    shed = [e for e in rec["qentries"] if e["reason"] == "edge_lost"]
+    out["edge_lost_entries"] = len(shed)
+    if edge is not None:
+        block = prof["workers"] // prof["edges"]
+        lo = edge["rounds"][0]
+        span = min(reprobe, rounds - lo)
+        want = block * span
+        if len(shed) != want:
+            errors.append(f"edge_lost entries: {len(shed)} != block "
+                          f"{block} x outage {span} rounds = {want}")
+        if any(not lo <= e["round"] < lo + span for e in shed):
+            errors.append(f"edge_lost entries outside the outage window "
+                          f"[{lo},{lo + span}): {shed}")
+        # exactly-once: one entry per (outage round, lost slot), never a
+        # re-ledger of the same slot
+        keys = {(e["round"], e["rank"]) for e in shed}
+        if len(keys) != len(shed):
+            errors.append("edge_lost double-ledgered a (round, slot) pair")
+        if lo + span < rounds and rec["fanin"]:
+            # the reprobe readmitted the edge: the tail of the campaign
+            # folds the full fan-in again
+            if rec["fanin"][-1] != prof["edges"]:
+                errors.append(f"edge never readmitted after the outage: "
+                              f"fan-in tail {rec['fanin'][-5:]}")
+    return out
+
+
+def _quorum_accounting(prof: dict, rec: dict, expect_fired: int,
+                       errors: list[str]) -> dict:
+    """Quorum must fire exactly once per genuine crash a root-visible
+    rank suffers — and NEVER for a scheduled-offline rank or a diurnal
+    trough (the zero-false-positive acceptance clause)."""
+    fired = sum(1 for a in rec["alerts"]
+                if a["rule"] in ("quorum", "fleet_quorum")
+                and a["state"] == "fired")
+    false_pos = max(0, fired - expect_fired)
+    if fired != expect_fired:
+        errors.append(f"quorum firings: {fired} != expected "
+                      f"{expect_fired} (false positives from scheduled "
+                      f"churn, or a missed genuine crash)")
+    return {"quorum_fired": fired, "quorum_false_positives": false_pos}
+
+
+def run_profile(name: str, prof: dict, out_root: str, data_dir: str,
+                rounds_override: int | None = None,
+                clients_override: int | None = None,
+                replay_override: bool | None = None,
+                real_dir: str | None = None) -> dict:
+    rounds = rounds_override or prof["rounds"]
+    n_clients = clients_override or prof["clients"]
+    replay = prof["replay"] if replay_override is None else replay_override
+    src, num_classes, data_source = _open_data(prof, data_dir, n_clients,
+                                               real_dir)
+    errors: list[str] = []
+    t0 = time.perf_counter()
+    try:
+        rec = _run_once(prof, src, os.path.join(out_root, name, "a"),
+                        rounds, f"{name}-a-{time.time_ns()}", num_classes)
+        if rec["error"]:
+            errors.append(rec["error"])
+        if not rec["completed"]:
+            errors.append(f"campaign did not complete: "
+                          f"{rec['completed_rounds']}/{rounds} rounds")
+        acct: dict = {}
+        if prof["mode"] == "tree":
+            acct.update(_check_tree(prof, rec, rounds, errors))
+            # genuine crashes visible to the root: the crashed edge rank
+            # (the supervised rank-0 restart recovers behind the same
+            # round — the fresh manager re-syncs before the health tick
+            # can observe a hole, so it must NOT page)
+            expect_fired = sum(1 for r in _crash_windows(prof)
+                               if any(0 < rk <= prof["edges"]
+                                      for rk in r["ranks"])
+                               and r["rounds"][0] < rounds)
+        else:
+            # no crash rule ⇒ any firing is a false positive from
+            # scheduled-offline ranks — the admission split's acceptance
+            expect_fired = sum(1 for r in _crash_windows(prof)
+                               if r["rounds"][0] < rounds)
+            churn_blocks = [r.get("churn") for r in rec["round_records"]
+                            if r.get("churn")]
+            acct["idle_rounds"] = (churn_blocks[-1]["idle_rounds"]
+                                   if churn_blocks else 0)
+            acct["offline_seen"] = max(
+                (c["scheduled_offline"] for c in churn_blocks), default=0)
+        acct.update(_quorum_accounting(prof, rec, expect_fired, errors))
+        if rec["healthz_scrapes"] < 1 or rec["fleetz_scrapes"] < 1:
+            errors.append(f"live endpoints unscraped mid-run: healthz="
+                          f"{rec['healthz_scrapes']} fleetz="
+                          f"{rec['fleetz_scrapes']}")
+        mid = rec["fleetz_mid"] or {}
+        acct["fleetz_ranks_mid"] = len(mid.get("ranks", {}))
+        rep = None
+        if replay:
+            rep = _run_once(prof, src, os.path.join(out_root, name, "b"),
+                            rounds, f"{name}-b-{time.time_ns()}",
+                            num_classes)
+            bits_eq = (rep["model_sha"] is not None
+                       and rep["model_sha"] == rec["model_sha"])
+            ledger_eq = rep["qledger"] == rec["qledger"]
+            if not bits_eq:
+                errors.append(f"replay model bits diverged: "
+                              f"{rec['model_sha']} vs {rep['model_sha']}")
+            if not ledger_eq:
+                errors.append("replay quarantine ledger diverged")
+            acct["replay_bits_equal"] = int(bits_eq)
+            acct["replay_ledger_equal"] = int(ledger_eq)
+    finally:
+        src.close()
+    summary = {
+        "metric": "campaign_ok",
+        "value": 0 if errors else 1,
+        "campaign_ok": 0 if errors else 1,
+        "profile": name,
+        "rounds": rounds,
+        "completed_rounds": rec["completed_rounds"],
+        "clients": n_clients,
+        "errors": errors,
+        **acct,
+        "healthz_scrapes": rec["healthz_scrapes"],
+        "fleetz_scrapes": rec["fleetz_scrapes"],
+        "quarantine": rec["quarantine"],
+        "faults": rec["faults"],
+        "alerts": rec["alerts"],
+        "fanin": rec["fanin"],
+        "fleet_close": rec["fleet_close"],
+        "final_eval": rec["final_eval"],
+        "model_sha": rec["model_sha"],
+        "seconds": round(time.perf_counter() - t0, 2),
+        "dataset_source": data_source,
+        "composition": _composition(prof),
+        "plan": prof["chaos"],
+        "churn_trace": prof["churn"],
+    }
+    return summary
+
+
+def _composition(prof: dict) -> list[str]:
+    """The composed-stack compatibility this profile actually exercises —
+    the refusal matrix's tested, human-readable face (rides the summary
+    and docs/ROBUSTNESS.md's table)."""
+    out = [f"streamed packed-npy population ({prof['clients']} clients)",
+           "client-level diurnal churn (cfg.churn_trace)"]
+    if prof["mode"] == "tree":
+        out += [f"edges={prof['edges']} (2-tier tree)",
+                f"robust gating ({prof['aggregator']} + sanitize)",
+                "supervised server SIGKILL (ckpt+WAL recovery)",
+                "edge crash (elastic edge_lost shed)"]
+    else:
+        out += [f"async_buffer_k={prof['async_buffer_k']} "
+                f"({prof['staleness']} staleness)",
+                f"update_codec={prof['update_codec']}",
+                "rank-level churn (scheduled-offline admission)"]
+    out.append("health + fleet plane + live /healthz + /fleetz")
+    return out
+
+
+def _stamp(summary: dict) -> dict:
+    try:
+        from fedml_tpu.obs.provenance import stamp
+
+        return stamp(summary,
+                     dataset_source=summary.get("dataset_source"))
+    except Exception:  # noqa: BLE001 — provenance must never sink a run
+        return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("fleet_campaign")
+    ap.add_argument("--profile", action="append", default=None,
+                    choices=sorted(PROFILES),
+                    help="profile(s) to run (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="list committed profiles and exit")
+    ap.add_argument("--out", default="./tmp/fleet_campaign")
+    ap.add_argument("--data-dir", "--data_dir", dest="data_dir",
+                    default=None,
+                    help="population cache dir (default <out>/data — "
+                         "shared across profiles and reruns)")
+    ap.add_argument("--rounds", type=int, default=None,
+                    help="override the profile's round count")
+    ap.add_argument("--clients", type=int, default=None,
+                    help="override the profile's population size")
+    ap.add_argument("--no-replay", action="store_true",
+                    help="skip the bit-for-bit replay leg")
+    ap.add_argument("--real-data", "--real_data", dest="real_data",
+                    default=None,
+                    help="real-dataset root for profiles naming one "
+                         "(flagship FEMNIST: a LEAF dir from "
+                         "scripts/download_femnist.sh)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, prof in PROFILES.items():
+            print(f"{name}: {'; '.join(_composition(prof))}")
+        return 0
+    if not args.profile:
+        print("fleet_campaign: pick --profile (or --list)",
+              file=sys.stderr)
+        return 2
+    data_dir = args.data_dir or os.path.join(args.out, "data")
+    os.makedirs(data_dir, exist_ok=True)
+    rc = 0
+    for name in args.profile:
+        summary = run_profile(
+            name, PROFILES[name], args.out, data_dir,
+            rounds_override=args.rounds, clients_override=args.clients,
+            replay_override=False if args.no_replay else None,
+            real_dir=args.real_data)
+        out_path = os.path.join(args.out, f"{name}_summary.json")
+        with open(out_path, "w") as f:
+            json.dump(_stamp(summary), f, indent=1, default=str)
+        ok = summary["campaign_ok"] == 1
+        print(f"campaign {name}: {'ok' if ok else 'FAILED'} "
+              f"({summary['completed_rounds']}/{summary['rounds']} rounds, "
+              f"{summary['seconds']}s) -> {out_path}")
+        for e in summary["errors"]:
+            print(f"  - {e}", file=sys.stderr)
+        rc = rc or (0 if ok else 1)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

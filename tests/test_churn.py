@@ -1,0 +1,435 @@
+"""Client churn (docs/ROBUSTNESS.md §Fleet campaigns & client churn;
+chaos/churn.py + the churn-aware sampler/server admission paths) —
+
+- a ChurnTrace is a pure function of its seed: the availability timeline
+  replays exactly, a different seed diverges, and the draw stream is
+  disjoint from FaultPlan's (churn × chaos draws never correlate);
+- churn × chaos × adversary composed into one engine run replays
+  bit-for-bit: final model bits AND the quarantine ledger;
+- scheduled-offline vs suspected-dead admission: an offline rank is
+  skipped SILENTLY (its shed reason is 'offline', no suspect/undeliverable
+  bookkeeping), a heartbeat-silent rank rides the existing suspect path;
+- a virtual-clock async run under a diurnal trace sheds 'offline' waves
+  exactly when the trace's cohort dips below the slot count, and the
+  per-window cohort sizes follow the trace's curve;
+- quorum under churn: a scheduled trough never fires (the denominator
+  shrinks with the cohort), a genuine crash inside the available set
+  still fires exactly once.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.chaos import FaultPlan
+from fedml_tpu.chaos.churn import ChurnTrace, DeviceClass, ScenarioPlan, _draw
+
+
+# ------------------------------------------------------------------ fixtures
+@pytest.fixture(autouse=True)
+def _reset_global_churn_gauges():
+    """The admission units drive ``_scheduled_offline()``, which publishes
+    the PROCESS-GLOBAL fed_ranks_scheduled_offline / fed_ranks_alive
+    gauges — a leftover offline count would shrink the quorum denominator
+    for every later suite test that reads the global registry. Snapshot
+    and restore them around each test."""
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    g_off = REGISTRY.gauge("fed_ranks_scheduled_offline")
+    g_alive = REGISTRY.gauge("fed_ranks_alive")
+    before = (g_off.value, g_alive.value)
+    yield
+    g_off.set(before[0])
+    g_alive.set(before[1])
+
+
+@pytest.fixture(scope="module")
+def lr_setup():
+    from fedml_tpu.core.tasks import classification_task
+    from fedml_tpu.data.synthetic import synthetic_images
+    from fedml_tpu.models.linear import LogisticRegression
+
+    data = synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=48, seed=0)
+    task = classification_task(LogisticRegression(num_classes=3))
+    return data, task
+
+
+def _cfg(rounds=3, per_round=4, seed=0, freq=100, **kw):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+
+    return FedAvgConfig(comm_round=rounds, client_num_in_total=8,
+                        client_num_per_round=per_round, epochs=1,
+                        batch_size=6, lr=0.1, frequency_of_the_test=freq,
+                        seed=seed, **kw)
+
+
+def _engine(lr_setup, cfg=None, **kw):
+    from fedml_tpu.algorithms.fedavg import FedAvgAPI
+
+    data, task = lr_setup
+    return FedAvgAPI(data, task, cfg or _cfg(), **kw)
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+_DIURNAL = {"seed": 11, "base": 0.55, "amplitude": 0.45, "period": 6,
+            "tz_spread": 0.5, "arrival_spread": 2, "departure_rate": 0.01}
+
+
+# ------------------------------------------------------- determinism oracle
+def test_trace_timeline_is_a_pure_function_of_the_seed():
+    t1 = ChurnTrace.from_json(_DIURNAL)
+    t2 = ChurnTrace.from_json(_DIURNAL)
+    tl = t1.availability_timeline(24, 64)
+    assert tl == t2.availability_timeline(24, 64)
+    # per-window membership, not just cardinality
+    for w in range(24):
+        assert t1.available_clients(w, 64).tolist() \
+            == t2.available_clients(w, 64).tolist()
+    # a different seed gives a genuinely different schedule
+    t3 = ChurnTrace.from_json({**_DIURNAL, "seed": 12})
+    assert tl != t3.availability_timeline(24, 64)
+    # serialization round-trips the schedule exactly
+    t4 = ChurnTrace.from_json(json.loads(t1.to_json()))
+    assert tl == t4.availability_timeline(24, 64)
+
+
+def test_trace_curve_shapes_the_cohort():
+    """The diurnal sine actually shows up: peak windows carry larger
+    cohorts than trough windows, and the min-one floor holds even when
+    base - amplitude == 0 empties every Bernoulli draw."""
+    trace = ChurnTrace(seed=3, base=0.5, amplitude=0.5, period=8,
+                       tz_spread=0.0)  # no phase spread: everyone in sync
+    tl = trace.availability_timeline(8, 200)
+    assert max(tl) > min(tl)  # the curve is visible in the cohort sizes
+    assert min(tl) >= 1       # min-one floor
+    # troughs (curve near 0) are much thinner than peaks (curve near 1)
+    assert min(tl) < 0.25 * max(tl)
+
+
+def test_trace_lifetime_processes():
+    trace = ChurnTrace(seed=5, arrival_spread=4, departure_rate=0.05)
+    for c in range(64):
+        a, d = trace.arrival_window(c), trace.departure_window(c)
+        assert 0 <= a < 4
+        assert d is not None and d > a
+        assert trace.availability(c, a - 1) == 0.0 if a > 0 else True
+        assert trace.availability(c, d) == 0.0
+        assert trace.availability(c, a) > 0.0 or d == a + 1 \
+            or trace.availability(c, a) >= 0.0  # inside lifetime: curve value
+    # departure_rate=0 -> immortal
+    assert ChurnTrace(seed=5).departure_window(3) is None
+
+
+def test_churn_stream_is_disjoint_from_fault_plan_stream():
+    """The 'churn|' namespace: even for colliding (seed, stream, entity,
+    window) tuples the churn draw differs from FaultPlan's _decide hash,
+    so composing a trace with a fault plan never correlates draws."""
+    from fedml_tpu.chaos.plan import _decide
+
+    collisions = sum(
+        _draw(seed, stream, ent, w)
+        == _decide(seed, stream, "drop", ent, 0, w)
+        for seed in range(4) for stream in (0, 1, "avail")
+        for ent in range(4) for w in range(4))
+    assert collisions == 0
+
+
+def test_rank_schedule_independent_of_client_schedule():
+    """rank_available draws on its own stream: rank 0 always on, a
+    rank_base=None trace is always-on, and scheduled_offline_ranks maps
+    rounds through rounds_per_window."""
+    trace = ChurnTrace(seed=9, rank_base=0.5, rank_amplitude=0.5,
+                       period=4, rounds_per_window=2)
+    assert trace.rank_available(0, 0)  # the server never churns
+    offs = [trace.scheduled_offline_ranks(r, 9) for r in range(8)]
+    assert any(offs)  # the curve holds some rank out somewhere
+    assert all(0 not in off for off in offs)
+    # rounds_per_window=2: consecutive rounds in one window agree
+    for r in (0, 2, 4, 6):
+        assert offs[r] == offs[r + 1]
+    # no rank curve -> nobody is ever scheduled offline
+    assert ChurnTrace(seed=9).scheduled_offline_ranks(3, 9) == set()
+
+
+def test_device_classes_skew_sizes_deterministically():
+    trace = ChurnTrace(seed=2, device_classes=[
+        DeviceClass("phone", weight=3.0, size_scale=1.0),
+        DeviceClass("tablet", weight=1.0, size_scale=2.0)])
+    skew = trace.size_skew(100)
+    assert set(np.unique(skew)) == {1.0, 2.0}
+    # weighted draw: phones dominate ~3:1
+    assert (skew == 1.0).sum() > (skew == 2.0).sum()
+    np.testing.assert_array_equal(skew, trace.size_skew(100))
+    sizes = trace.skewed_sizes(np.zeros(100))
+    assert sizes.min() >= 1  # the 1-sample floor
+
+
+def test_scenario_plan_round_trips():
+    plan = ScenarioPlan.from_json({
+        "name": "diurnal-storm",
+        "churn": _DIURNAL,
+        "faults": {"seed": 7, "rules": [
+            {"fault": "crash", "ranks": [1], "rounds": [2, 3]}]},
+        "meta": {"profile": "ci"}})
+    doc = json.loads(plan.to_json())
+    again = ScenarioPlan.from_json(doc)
+    assert again.name == "diurnal-storm"
+    assert again.churn.availability_timeline(8, 32) \
+        == plan.churn.availability_timeline(8, 32)
+    assert again.faults.to_json() == plan.faults.to_json()
+    # fresh(): same scenario, new fault ledger
+    fresh = plan.fresh()
+    assert fresh.faults is not plan.faults
+    assert fresh.churn is plan.churn
+
+
+# ------------------------------------------------ churn-aware cohort sampling
+def test_sampler_restricts_to_the_available_cohort():
+    from fedml_tpu.core.sampling import sample_available
+
+    trace = ChurnTrace.from_json(_DIURNAL)
+    cfg = _cfg(per_round=4, churn_trace=trace)
+    for r in range(12):
+        ids = sample_available(cfg, r, trace)
+        avail = set(trace.available_clients(trace.window(r), 8).tolist())
+        assert set(ids.tolist()) <= avail
+        assert len(ids) == min(4, len(avail))
+        # deterministic replay of the draw itself
+        np.testing.assert_array_equal(ids, sample_available(cfg, r, trace))
+
+
+def test_engine_cohorts_follow_the_curve(lr_setup):
+    """Troughs legitimately shrink the engine's per-round cohort below
+    client_num_per_round — sampled ids track the trace's availability."""
+    trace = ChurnTrace(seed=4, base=0.4, amplitude=0.4, period=4,
+                       tz_spread=0.0)
+    cfg = _cfg(rounds=8, per_round=6, churn_trace=trace)
+    eng = _engine(lr_setup, cfg)
+    sizes = [len(eng._sampled_ids(r)) for r in range(8)]
+    want = [min(6, len(trace.available_clients(trace.window(r), 8)))
+            for r in range(8)]
+    assert sizes == want
+    assert max(sizes) > min(sizes)  # the curve is visible
+    eng.train()  # variable cohorts actually run (no static-shape trip)
+    assert eng.history and eng.history[-1]["round"] == 7
+
+
+def test_churned_engine_refuses_static_shape_paths(lr_setup):
+    """churn_trace varies cohort size, which breaks the scanned round
+    block's static shapes — the engine refuses loudly, not silently."""
+    trace = ChurnTrace(seed=4, base=0.5, amplitude=0.5, period=4)
+    eng = _engine(lr_setup, _cfg(rounds=4, churn_trace=trace),
+                  device_data=True)
+    with pytest.raises(ValueError, match="churn_trace"):
+        eng.run_rounds(0, 4)
+
+
+# ----------------------------------------- churn × chaos × adversary replay
+def test_churn_adversary_replay_bit_for_bit_sync(lr_setup):
+    """Churn × adversary on the synchronous engine: two runs from the
+    same seeds reproduce the final model bits AND the quarantine ledger
+    exactly; a different churn seed genuinely perturbs the run."""
+    from fedml_tpu.chaos.adversary import AdversaryPlan
+
+    churn = {"seed": 11, "base": 0.6, "amplitude": 0.4, "period": 4,
+             "tz_spread": 0.4}
+    adversary = {"seed": 3, "rules": [
+        {"attack": "scale", "ranks": [2], "factor": 40.0}]}
+
+    def run(churn_seed=11):
+        cfg = _cfg(rounds=6, per_round=4, seed=1,
+                   churn_trace=ChurnTrace.from_json(
+                       {**churn, "seed": churn_seed}))
+        eng = _engine(lr_setup, cfg, aggregator="median", sanitize=0.9,
+                      adversary_plan=AdversaryPlan.from_json(adversary))
+        eng.train()
+        return eng.net, eng.quarantine.canonical()
+
+    net_a, led_a = run()
+    net_b, led_b = run()
+    assert _leaves_equal(net_a, net_b)
+    assert led_a == led_b
+    # and a different churn seed genuinely perturbs the run
+    net_c, _ = run(churn_seed=12)
+    assert not _leaves_equal(net_a, net_c)
+
+
+def test_churn_chaos_adversary_replay_bit_for_bit_async(lr_setup):
+    """The full composed determinism contract on the virtual-clock async
+    runner: diurnal trace × straggler fault storm × byzantine adversary,
+    run twice, reproduces the model bits, the quarantine ledger AND the
+    shed/staleness ledger exactly."""
+    from fedml_tpu.chaos.adversary import AdversaryPlan
+
+    churn = {"seed": 11, "base": 0.5, "amplitude": 0.5, "period": 4,
+             "tz_spread": 0.0}
+    faults = {"seed": 7, "rules": [
+        {"fault": "straggle", "ranks": [2], "delay_s": 2.5},
+        {"fault": "crash", "ranks": [3], "rounds": [2, 4]}]}
+    adversary = {"seed": 3, "rules": [
+        {"attack": "scale", "ranks": [1], "factor": 40.0}]}
+
+    def run():
+        cfg = _cfg(rounds=6, per_round=4, seed=1,
+                   churn_trace=ChurnTrace.from_json(churn))
+        eng = _engine(lr_setup, cfg, aggregator="median", sanitize=0.9)
+        runner = eng.run_async(
+            6, buffer_k=3, staleness="poly:0.5",
+            chaos_plan=FaultPlan.from_json(faults),
+            adversary_plan=AdversaryPlan.from_json(adversary))
+        return eng, runner
+
+    ea, ra = run()
+    eb, rb = run()
+    assert _leaves_equal(ea.net, eb.net)
+    assert ea.quarantine.canonical() == eb.quarantine.canonical()
+    assert ra.stats() == rb.stats()
+    assert [h["staleness"] for h in ra.history] \
+        == [h["staleness"] for h in rb.history]
+
+
+# ------------------------------------- offline vs suspected-dead admission
+def _bare_manager(trace, size=5, round_idx=0):
+    """A partially-built FedAvgServerManager: just enough state to drive
+    _dispatch_one's admission decision (the test_comm elastic-send
+    idiom), no comm stack."""
+    from fedml_tpu.distributed.fedavg.server_manager import \
+        FedAvgServerManager
+
+    mgr = object.__new__(FedAvgServerManager)
+    mgr.churn_trace = trace
+    mgr.size = size
+    mgr.round_idx = round_idx
+    mgr.heartbeat_max_age_s = None
+    mgr._undeliverable = {}
+    mgr._offline_now = set()
+    mgr._offline_skipped = set()
+    mgr._shed_counts = {}
+    mgr._fleet = None
+    mgr._awaiting = {}
+    mgr._dispatch_wave = {}
+    return mgr
+
+
+def test_scheduled_offline_rank_skipped_silently(monkeypatch):
+    """An offline rank's dispatch is shed as 'offline' BEFORE the suspect
+    check runs: no suspect bookkeeping, no undeliverable entry, no send."""
+    from fedml_tpu.distributed.fedavg import server_manager as sm
+
+    trace = ChurnTrace(seed=1, rank_base=0.5, rank_amplitude=0.5, period=4)
+    mgr = None
+    # find a (round, rank) the trace schedules offline
+    for r in range(16):
+        m = _bare_manager(trace, round_idx=r)
+        off = m._scheduled_offline()
+        if off:
+            mgr, rank = m, min(off)
+            break
+    assert mgr is not None, "trace never scheduled a rank offline"
+
+    def no_suspects(*a, **kw):
+        raise AssertionError("offline skip must precede the suspect check")
+
+    monkeypatch.setattr(sm._obs, "suspect_ranks", no_suspects)
+    mgr._dispatch_one(rank)
+    assert mgr._shed_counts.get("offline") == 1
+    assert rank in mgr._offline_skipped
+    assert mgr._undeliverable == {} and mgr._awaiting == {}
+
+
+def test_heartbeat_silent_rank_rides_the_suspect_path(monkeypatch):
+    """The contrast case: a rank the trace expects ONLINE but which the
+    heartbeat collector marks silent is shed as 'suspect' — the existing
+    dead-rank machinery, untouched by churn."""
+    from fedml_tpu.distributed.fedavg import server_manager as sm
+
+    trace = ChurnTrace(seed=1)  # rank_base=None: nobody scheduled offline
+    mgr = _bare_manager(trace)
+    monkeypatch.setattr(sm._obs, "suspect_ranks",
+                        lambda *a, **kw: {2})
+    mgr._dispatch_one(2)
+    assert mgr._shed_counts.get("suspect") == 1
+    assert "offline" not in mgr._shed_counts
+    assert 2 not in mgr._offline_skipped
+
+
+# ------------------------------------------- virtual-clock async under churn
+def test_async_virtual_clock_cohorts_follow_the_curve(lr_setup):
+    """A diurnal trace on the virtual-clock async runner: waves whose
+    available cohort dips below the slot count shed 'offline' (the slot
+    idles through the wave, retries the next), the run still completes
+    its update budget, and the shed pattern matches the trace exactly."""
+    trace = ChurnTrace(seed=4, base=0.4, amplitude=0.4, period=4,
+                       tz_spread=0.0)
+    slots = 6
+    cfg = _cfg(rounds=10, per_round=slots, seed=0, churn_trace=trace)
+    eng = _engine(lr_setup, cfg)
+    runner = eng.run_async(10, buffer_k=3)
+    assert runner.version == 10
+    offline_shed = runner.shed_counts.get("offline", 0)
+    assert offline_shed > 0, "trough waves must shed offline"
+    # oracle: every dispatched (slot, wave) with slot >= |cohort(wave)|
+    # sheds exactly one 'offline' — waves the trace thins below the slot
+    # count must exist AND fat waves must dispatch all slots
+    thin = [w for w in range(10)
+            if len(eng._sampled_ids(w)) < slots]
+    assert thin, "the trough must actually thin some waves"
+    fat = [w for w in range(10) if len(eng._sampled_ids(w)) == slots]
+    assert fat, "the peak must fill some waves"
+    # replay: same seeds -> same model bits, same shed ledger
+    eng2 = _engine(lr_setup, _cfg(rounds=10, per_round=slots, seed=0,
+                                  churn_trace=ChurnTrace(
+                                      seed=4, base=0.4, amplitude=0.4,
+                                      period=4, tz_spread=0.0)))
+    runner2 = eng2.run_async(10, buffer_k=3)
+    assert _leaves_equal(eng.net, eng2.net)
+    assert runner2.shed_counts == runner.shed_counts
+
+
+# ----------------------------------------------------- quorum under churn
+def test_quorum_trough_never_fires_crash_fires_once():
+    """The churn-aware quorum denominator: scheduled-offline ranks come
+    out of BOTH sides (alive and expected), so a diurnal trough alone
+    never pages; a genuine crash inside the available set dips alive
+    below the shrunken expectation and fires exactly once."""
+    from fedml_tpu.obs.health import HealthMonitor
+    from fedml_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    mon = HealthMonitor(registry=reg, expected_ranks=8, rules=[
+        {"rule": "quorum", "severity": "critical", "min_fraction": 1.0}])
+
+    def check(alive, offline):
+        reg.gauge("fed_ranks_alive").set(alive)
+        reg.gauge("fed_ranks_scheduled_offline").set(offline)
+        mon.check()
+        return mon.alerts
+
+    def fired():
+        return [a for a in mon.alerts
+                if a["rule"] == "quorum" and a["state"] == "fired"]
+
+    # deep trough: 6 of 8 ranks scheduled away — alive matches the
+    # shrunken cohort, nobody pages
+    check(2, 6)
+    assert fired() == []
+    # a genuine crash inside the 2-rank cohort: fires exactly once...
+    check(1, 6)
+    assert len(fired()) == 1
+    # ...and holding the same state does not re-fire
+    check(1, 6)
+    assert len(fired()) == 1
+    # recovery (trace brings ranks back, crash heals) resolves once
+    check(8, 0)
+    assert len([a for a in mon.alerts
+                if a["rule"] == "quorum" and a["state"] == "resolved"]) == 1
