@@ -809,9 +809,14 @@ def _measure_fused_agg() -> None:
                                      "delta-int8"))
         return out
 
-    def leg(fused: bool) -> dict:
+    def leg(fused: bool, estimator: str | None = None) -> dict:
+        # the robust leg (PR-21): estimator legs run the two-phase verdict
+        # composition — stacked stages then runs the one-jit evidence →
+        # verdicts → survivor fold over the [K, ...] stack; fused emits
+        # per-arrival evidence rows and flushes the staged slots through
+        # the identical shared composition (robust_agg.verdict_flush)
         agg = FedAvgAggregator(data, task, cfg, worker_num=fan_in,
-                               fused_agg=fused,
+                               fused_agg=fused, aggregator=estimator,
                                sum_assoc="auto" if fused else "pairwise")
         flush_s, ingest_s, rss_deltas = [], [], []
         for r in range(rounds + 1):  # round 0 = warm (jit compiles)
@@ -872,6 +877,11 @@ def _measure_fused_agg() -> None:
     fused = leg(True)
     _mark(t0, f"fused leg: {fused['seconds_per_flush']}s/flush + "
               f"{fused['ingest_seconds_per_cohort']}s ingest")
+    stacked_med = leg(False, estimator="median")
+    _mark(t0, f"stacked median leg: "
+              f"{stacked_med['seconds_per_flush']}s/flush")
+    fused_med = leg(True, estimator="median")
+    _mark(t0, f"fused median leg: {fused_med['seconds_per_flush']}s/flush")
     rec = {
         "metric": "fedavg_fused_flush_speedup",
         "value": round(stacked["seconds_per_flush"]
@@ -887,6 +897,16 @@ def _measure_fused_agg() -> None:
         "fused_server_round_speedup": round(
             stacked["server_seconds_per_round"]
             / max(fused["server_seconds_per_round"], 1e-9), 2),
+        # the robust A/B (PR-21 universal ingest): fused×median's staged
+        # flush vs stacked×median's verdict flush at the same fan-in
+        "fused_robust_ab": {"stacked_median": stacked_med,
+                            "fused_median": fused_med},
+        "fused_robust_flush_speedup": round(
+            stacked_med["seconds_per_flush"]
+            / max(fused_med["seconds_per_flush"], 1e-9), 2),
+        "fused_robust_server_round_speedup": round(
+            stacked_med["server_seconds_per_round"]
+            / max(fused_med["server_seconds_per_round"], 1e-9), 2),
         "fused_ingest_rss_delta_bytes": fused["ingest_rss_delta_bytes"],
         "stacked_ingest_rss_delta_bytes": stacked["ingest_rss_delta_bytes"],
         "fused_stack_bytes": fused["stack_bytes"],

@@ -35,11 +35,9 @@ hold across jit boundaries:
 - the per-arrival decode replays the host decoders' exact f32 ops
   (``comm/delta._q8_leaf_decode`` / ``_sign_leaf_decode`` /
   ``apply_delta`` / ``sparse.topk_decode``) and the gate is the per-slot
-  half of ``sanitize_updates`` (``norm_mult=inf`` — the only gate the
-  fused fold supports: the norm-outlier rule is a cohort statistic
-  computed at flush, AFTER arrivals were already folded, so robust
-  estimators and armed sanitize keep the stacked route and are refused
-  loudly when fused is forced);
+  half of ``sanitize_updates`` (``norm_mult=inf``) — the only gate the
+  FOLD-AT-ARRIVAL path supports: the norm-outlier rule is a cohort
+  statistic computed at flush, AFTER arrivals were already folded;
 - the accumulator's LEVEL-1 combine compiles the identical
   ``c0*w0 + c1*w1`` expression ``pairwise_weighted_stats`` evaluates per
   aligned slot pair (XLA contracts that multiply+add to an fma — which is
@@ -47,6 +45,30 @@ hold across jit boundaries:
   uniform level-1 expressions are what make the fold reproducible pair by
   pair from a different jit);
 - levels >= 2 are plain adds of materialized partials on both routes.
+
+Robust estimators and armed sanitize ride the STAGED fused mode instead
+(docs/PERFORMANCE.md §Fused aggregation): the per-arrival jit
+(:func:`make_fused_robust_ingest`) decodes and emits the slot's evidence
+row — update norm, finite flag, count-sketch via
+``robust_agg.update_evidence``, whose ops are all per-row reductions so a
+``[1, ...]`` row is bitwise the stacked cohort's row — and the RAW
+densified update stays device-resident per slot (cohort verdicts need the
+full survivor set, so the fold can't happen at arrival; device-staged
+bytes ≈ the stacked route's stack bytes, but there is no host densify, no
+barrier H2D, and decode overlaps the wire wait). Flush runs ONE jit
+(:func:`make_fused_robust_flush`): stack the staged slots in sorted-slot
+order, concatenate the evidence rows, then the shared
+``robust_agg.verdict_flush`` — the very composition ``gated_aggregate``'s
+verdict branch calls — so fused×{median, trimmed_mean, krum, multi_krum,
+geometric_median, armed sanitize} is bitwise the stacked result, model
+bits AND reason codes, by construction.
+
+Sharded server state composes as a layout property (GSPMD,
+arXiv:2004.13336): a ``stage_fn`` pins each ingested slot's leaves to the
+partitioner's rule-table placement, so accumulator partials / staged
+slots already carry the sharded layout and XLA lowers the flush's folds
+into reduce-scatters landing in-place — no gather-then-reshard. Sharding
+moves bytes, not values; the bitwise contract is unchanged.
 
 Poison policy is inherited unchanged: a NaN scale decodes non-finite ON
 DEVICE and dies at the in-graph gate; structural garbage never reaches the
@@ -65,6 +87,8 @@ from fedml_tpu.core.robust_agg import (
     REASON_NONFINITE,
     REASON_OK,
     pairwise_finalize,
+    update_evidence,
+    verdict_flush,
 )
 
 FUSED_KINDS = ("dense", "delta", "delta-int8", "delta-sign1", "topk")
@@ -242,23 +266,126 @@ def make_fused_ingest(kind: str, meta):
     return ingest
 
 
+def make_fused_densify(kind: str, meta):
+    """Build the jitted arrival-side decode for the ASYNC fused path:
+    densify only, plus the door's finiteness verdict. The gate's global-
+    model replacement and the evidence row are deliberately NOT computed
+    here — they reference the FLUSH-time global model (the drain
+    re-ingests the dense leaves against it, exactly when the stacked
+    route gates its staged entries), while the buffer may outlive the
+    arrival-time broadcast. One scalar readback replaces the stacked
+    door's host ``isfinite`` pass over the full tree. Returns
+    ``fn(payload, scales, base_leaves) -> (dense_leaves, finite)``."""
+    if kind not in FUSED_KINDS:
+        raise ValueError(f"unknown fused payload kind {kind!r} "
+                         f"(one of {FUSED_KINDS})")
+
+    @jax.jit
+    def densify(payload, scales, base_leaves):
+        eff = _densify(kind, meta, payload, scales, base_leaves)
+        finite = jnp.ones((), bool)
+        for e in eff:
+            finite &= jnp.all(jnp.isfinite(e))
+        return eff, finite
+
+    return densify
+
+
+def make_fused_robust_ingest(kind: str, meta, sketch_dim: int):
+    """Build the jitted per-arrival composition for the STAGED (robust)
+    fused mode: decode → densify → evidence row. Returns
+    ``fn(payload, scales, base, global, w) -> (raw_leaves, evidence)``
+    where ``raw_leaves`` is the slot's densified update (RAW — the
+    verdict composition feeds raw slots into ``update_evidence`` and
+    ``apply_verdicts``, exactly like ``gated_aggregate``'s verdict
+    branch) and ``evidence`` is the slot's one-row PR-13 dict
+    (``{"norm", "finite", "sketch", "weight"}``, leading axis 1). Every
+    evidence op is a per-row reduction, so the row is bitwise the row the
+    stacked path computes for this slot inside the whole-cohort
+    ``update_evidence`` call (the same property the edge tier's
+    ``e2s_evidence`` frames rely on)."""
+    if kind not in FUSED_KINDS:
+        raise ValueError(f"unknown fused payload kind {kind!r} "
+                         f"(one of {FUSED_KINDS})")
+
+    @jax.jit
+    def ingest(payload, scales, base_leaves, global_leaves, w):
+        eff = _densify(kind, meta, payload, scales, base_leaves)
+        ev = update_evidence([e[None] for e in eff], list(global_leaves),
+                             jnp.asarray(w, jnp.float32)[None],
+                             sketch_dim=sketch_dim)
+        return eff, ev
+
+    return ingest
+
+
+def make_fused_robust_flush(verdict_fn, norm_mult: float | None = None,
+                            out_shardings=None):
+    """Build the one-jit flush for the STAGED fused mode: stack the
+    staged slots (sorted-slot order — the stacked route's compacted
+    layout), concatenate the per-arrival evidence rows, then the shared
+    ``robust_agg.verdict_flush`` (``evidence_verdicts`` →
+    ``apply_verdicts`` → canonical pairwise fold). Build it ONCE per
+    aggregator (it retraces per distinct realized K, like the stacked
+    gagg jit — warmup covers both).
+
+    ``out_shardings`` (mesh-sharded server state only): a
+    ``(leaf_shardings_list, rep, rep)`` pin so the new model lands in
+    the partitioner's rule-table placement — with staged slots already
+    carrying the sharded layout, XLA lowers the fold into
+    reduce-scatters; no gather-then-reshard round trip.
+
+    Returns ``fn(slot_leaves, slot_evidence, global_leaves) ->
+    (new_global_leaves, verdict_weights, reasons)``."""
+    def flush(slot_leaves, slot_evidence, global_leaves):
+        stacked = [jnp.stack(col) for col in zip(*slot_leaves)]
+        # every evidence field carries a leading slot axis of 1, so the
+        # cohort dict is a plain axis-0 concatenate per field
+        ev = {key: jnp.concatenate([e[key] for e in slot_evidence])
+              for key in ("norm", "finite", "weight", "sketch")}
+        return verdict_flush(stacked, list(global_leaves), ev, verdict_fn,
+                             norm_mult=norm_mult)
+
+    if out_shardings is None:
+        return jax.jit(flush)
+    return jax.jit(flush, out_shardings=out_shardings)
+
+
 class FusedRoundIngest:
     """One round's device-resident fused ingest state.
 
-    Slots are worker indices; arrivals push into the accumulator strictly
-    in SLOT order (a cursor: out-of-order arrivals pend device-resident
-    until every lower slot arrived or the flush skips the holes) — so the
-    fold is the canonical pairwise association over the COMPACTED sorted
-    arrival set, exactly the layout ``_aggregate_core`` stacks, and fused
-    ≡ stacked stays bitwise whatever order the wire delivered."""
+    PLAIN mode (``staged=False``): slots are worker indices; arrivals
+    push into the accumulator strictly in SLOT order (a cursor:
+    out-of-order arrivals pend device-resident until every lower slot
+    arrived or the flush skips the holes) — so the fold is the canonical
+    pairwise association over the COMPACTED sorted arrival set, exactly
+    the layout ``_aggregate_core`` stacks, and fused ≡ stacked stays
+    bitwise whatever order the wire delivered.
 
-    def __init__(self, global_leaves, meta):
+    STAGED mode (``staged=True`` — robust estimators / armed sanitize):
+    cohort verdicts need the full survivor set, so nothing folds at
+    arrival; each slot's RAW densified update and its evidence row stay
+    device-resident until :meth:`flush_robust` runs the one-jit verdict
+    composition. Peak memory is O(K) staged slots — the stacked route's
+    stack bytes, reported honestly as ``fed_agg_stack_bytes{mode=
+    fused_staged}`` — but there is no host densify and decode overlaps
+    the wire wait.
+
+    ``stage_fn`` (mesh-sharded server state only): applied to each
+    ingested slot's leaves, pinning them to the partitioner's rule-table
+    placement so the flush's folds lower into reduce-scatters."""
+
+    def __init__(self, global_leaves, meta, *, staged: bool = False,
+                 stage_fn=None):
         self._global = [jnp.asarray(v) for v in global_leaves]
         self._meta = meta
         zero = ([jnp.zeros(shape, dtype) for shape, dtype in meta],
                 jnp.zeros((), jnp.float32))
         self._acc = PairwiseAccumulator(lambda: zero)
         self._pending: dict[int, tuple] = {}
+        self._staged: dict[int, tuple] = {}  # staged mode: slot->(raw, ev)
+        self.staged_mode = bool(staged)
+        self._stage_fn = stage_fn
         self._reasons: dict[int, jax.Array] = {}
         self._cursor = 0
         self.slots: set[int] = set()
@@ -266,17 +393,44 @@ class FusedRoundIngest:
 
     def add(self, slot: int, ingest_fn, payload, scales, base_leaves,
             weight: float) -> None:
+        """Run the per-arrival jit for one upload and fold (plain) or
+        stage (staged mode) the result. ``ingest_fn`` is the matching
+        builder's product: :func:`make_fused_ingest` in plain mode,
+        :func:`make_fused_robust_ingest` in staged mode."""
         if slot in self.slots:
             # exactly-once folding: a chaos duplicate that survived the
             # upstream dedup gates must not double-count (the stacked
             # path's dict overwrite is idempotent for identical content)
             return
-        clean, w_out, reason = ingest_fn(
+        entry = ingest_fn(
             payload,
             jnp.zeros((0,), jnp.float32) if scales is None
             else jnp.asarray(scales, jnp.float32),
             self._global if base_leaves is None else list(base_leaves),
             self._global, jnp.float32(weight))
+        self.add_staged(slot, entry)
+
+    def add_staged(self, slot: int, entry) -> None:
+        """Fold/stage one PRE-INGESTED entry — the async drain path: the
+        arrival-time jit already ran (decode + gate/evidence with the
+        staleness-discounted weight, knowable at arrival because the
+        round index is static between flushes) and its result rode the
+        buffer, so the drain folds at the door with no decode burst.
+        Plain-mode entries are ``(clean_leaves, w_out, reason)``; staged
+        (robust) mode entries are ``(raw_leaves, evidence_row)``."""
+        if slot in self.slots:
+            return
+        if self.staged_mode:
+            raw, ev = entry
+            if self._stage_fn is not None:
+                raw = self._stage_fn(raw)
+            self.slots.add(slot)
+            self._staged[slot] = (raw, ev)
+            self.peak_terms = max(self.peak_terms, len(self._staged))
+            return
+        clean, w_out, reason = entry
+        if self._stage_fn is not None:
+            clean = self._stage_fn(clean)
         self.slots.add(slot)
         self._reasons[slot] = reason
         self._pending[slot] = (clean, w_out)
@@ -288,11 +442,12 @@ class FusedRoundIngest:
 
     def block_until_ready(self) -> None:
         """Synchronize on every live device node (counter partials +
-        pending out-of-order slots) — the measurement seam benches use to
-        separate ingest work from the flush without reaching into the
-        accumulator's internals."""
+        pending out-of-order slots + staged robust slots) — the
+        measurement seam benches use to separate ingest work from the
+        flush without reaching into the accumulator's internals."""
         for node in list(self._acc._levels.values()) \
-                + list(self._pending.values()):
+                + list(self._pending.values()) \
+                + list(self._staged.values()):
             jax.block_until_ready(node)
 
     def flush(self):
@@ -309,3 +464,65 @@ class FusedRoundIngest:
         new_leaves = _finalize(wsum, total, self._global)
         reasons = jnp.stack([self._reasons[s] for s in sorted(self.slots)])
         return new_leaves, reasons
+
+    def flush_robust(self, flush_fn):
+        """STAGED-mode flush: the ONE verdict jit (from
+        :func:`make_fused_robust_flush`) over the sorted staged slots —
+        the stacked route's compacted layout, so elastic rounds (only
+        some slots arrived) see the identical realized cohort. Returns
+        ``(new_global_leaves, verdict_weights, reasons)``; all-None when
+        nothing was staged."""
+        order = sorted(self._staged)
+        if not order:
+            return None, None, None
+        slot_leaves = [self._staged[s][0] for s in order]
+        slot_ev = [self._staged[s][1] for s in order]
+        return flush_fn(slot_leaves, slot_ev, self._global)
+
+    # ----------------------------------------------------- edge tier
+    def flush_block_partial(self, block_size: int):
+        """Edge-tier flush (plain mode): collapse the block WITHOUT the
+        final divide, filling missing locals with the global model at
+        zero weight AT POSITION — the ``_stack_block`` fill. A zero-
+        weight term folds as an exact-zero f32 product either way, and
+        holes must keep their aligned place for the block partial to be
+        the canonical tree's internal node (root combine ≡ flat fold).
+        Returns ``(wsum_leaves, total, reasons)``; ``reasons`` covers ALL
+        block positions (holes report OK, exactly like the stacked gate
+        does for zero-weight slots)."""
+        hole = (self._global, jnp.zeros((), jnp.float32))
+        for local in range(self._cursor, block_size):
+            self._acc.push(self._pending.pop(local, hole))
+        wsum, total = self._acc.merge()  # count == block_size, a power
+        ok = jnp.zeros((), jnp.int32)    # of two: merge pads nothing
+        reasons = jnp.stack([self._reasons.get(s, ok)
+                             for s in range(block_size)])
+        return wsum, total, reasons
+
+    def block_evidence(self, block_size: int, sketch_dim: int):
+        """Edge-tier evidence assembly (STAGED mode): the block's
+        ``[block_size, ...]`` evidence arrays from the per-arrival rows,
+        hole positions zero-filled — bitwise the rows the stacked edge's
+        ``update_evidence`` computes over the ``_stack_block`` fill (a
+        global-model slot's norm, sketch buckets and weight are all
+        exact ``+0.0``: ``g - g`` is ``+0.0`` for finite ``g`` and every
+        reduction preserves it; its finite flag is True)."""
+        zero_row = {"norm": jnp.zeros((1,), jnp.float32),
+                    "finite": jnp.ones((1,), bool),
+                    "sketch": jnp.zeros((1, max(sketch_dim, 0)),
+                                        jnp.float32),
+                    "weight": jnp.zeros((1,), jnp.float32)}
+        rows = [self._staged[s][1] if s in self._staged else zero_row
+                for s in range(block_size)]
+        return {key: jnp.concatenate([r[key] for r in rows])
+                for key in ("norm", "finite", "weight", "sketch")}
+
+    def block_stacked(self, block_size: int):
+        """Edge-tier verdict-receipt stack (STAGED mode): the block's
+        RAW ``[block_size, ...]`` leaves with the ``_stack_block`` hole
+        fill (global model at position), ready for the shared
+        ``apply_verdicts`` jit the stacked edge already runs."""
+        return [jnp.stack([self._staged[s][0][i]
+                           if s in self._staged else g
+                           for s in range(block_size)])
+                for i, g in enumerate(self._global)]

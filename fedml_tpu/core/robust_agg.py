@@ -650,6 +650,25 @@ def apply_verdicts(stacked, global_tree, vweights):
     return pairwise_weighted_stats(clean, vw)
 
 
+def verdict_flush(stacked, global_tree, evidence, verdict_fn,
+                  norm_mult: float | None = None):
+    """The flush half of the two-phase composition, defined ONCE:
+    ``evidence_verdicts`` -> ``apply_verdicts`` -> ``pairwise_finalize``
+    over PRECOMPUTED evidence rows. :func:`gated_aggregate` calls this
+    with evidence it just computed from the stacked cohort; the fused
+    ingest plane (core/fused_agg.py) calls it with evidence rows emitted
+    one arrival at a time (per-row reductions, so the rows are bitwise
+    the cohort's — see :func:`_slot_evidence`). Sharing the composition
+    is what makes fused×robust bitwise the stacked path by construction,
+    model bits AND reason codes, rather than by parallel implementations.
+
+    Returns ``(avg_tree, verdict_weights, reasons)``."""
+    vw, reasons = evidence_verdicts(evidence, verdict_fn,
+                                    norm_mult=norm_mult)
+    wsum, total = apply_verdicts(stacked, global_tree, vw)
+    return pairwise_finalize(wsum, total, global_tree), vw, reasons
+
+
 # ------------------------------------------------------------------ gate
 def _slot_evidence(stacked, global_tree):
     """Per-slot sanitation evidence over the full tree: ``(finite, norm)``
@@ -790,9 +809,8 @@ def gated_aggregate(stacked, global_tree, weights, robust_fn=None,
                              "it does not stack with robust_fn/pairwise")
         ev = update_evidence(stacked, global_tree, weights,
                              sketch_dim=sketch_dim)
-        vw, reasons = evidence_verdicts(ev, verdict_fn, norm_mult=norm_mult)
-        wsum, total = apply_verdicts(stacked, global_tree, vw)
-        return pairwise_finalize(wsum, total, global_tree), vw, reasons
+        return verdict_flush(stacked, global_tree, ev, verdict_fn,
+                             norm_mult=norm_mult)
     w = jnp.asarray(weights, jnp.float32)
     reasons = None
     agg_in = stacked

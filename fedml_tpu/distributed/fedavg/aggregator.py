@@ -40,12 +40,18 @@ at decode).
 
 Fused alternative (``fused_agg=True``, docs/PERFORMANCE.md §Fused
 aggregation): the decode→gate→sum chain moves on device — uploads stage
-as their raw quantized leaves, one jit per arrival densifies against the
-device-resident broadcast stash and folds into canonical pairwise
-partials (core/fused_agg.py), and the flush merges O(log fan-in)
-partials instead of stacking the cohort. Bitwise the
-``sum_assoc='pairwise'`` stacked route; robust estimators and the
-norm-outlier gate keep the stacked route (refused loudly under fused).
+as their raw quantized leaves and one jit per arrival densifies against
+the device-resident broadcast stash (core/fused_agg.py). Plain mean
+(gate disarmed) folds arrivals into canonical pairwise partials and the
+flush merges O(log fan-in) partials; robust estimators and the armed
+norm-outlier gate run the STAGED fused mode — per-arrival evidence rows,
+device-resident slots, one verdict-composition jit at flush
+(``robust_agg.verdict_flush``, the same composition ``gated_aggregate``
+runs). Both are bitwise the ``sum_assoc='pairwise'`` stacked route,
+model bits AND quarantine ledger; sharded server state, the async
+buffer, and edge tiers all compose (the one remaining refusal is
+host-representation aggregates — TurboAggregate keeps its own mod-p
+fused path).
 """
 
 from __future__ import annotations
@@ -143,43 +149,33 @@ class FedAvgAggregator:
             else DEFAULT_NORM_MULT if sanitize is True else float(sanitize))
         # Fused on-device aggregation (core/fused_agg.py, docs/
         # PERFORMANCE.md §Fused aggregation): uploads stage as their raw
-        # quantized leaves, one jit per arrival runs decode -> densify ->
-        # non-finite gate -> weighted term, and arrivals fold into the
-        # canonical pairwise partials — bitwise the stacked route under
-        # sum_assoc='pairwise', without per-client f32 trees on host or a
-        # [K, ...] device stack. The fold happens BEFORE the flush, so
-        # only the per-slot (non-finite) gate composes — cohort statistics
-        # (norm-outlier rule, robust estimators) keep the stacked route
-        # and are refused loudly here rather than silently skipped.
+        # quantized leaves and one jit per arrival runs decode -> densify
+        # against the device-resident broadcast stash — no per-client f32
+        # tree on host, decode overlapped with the wire wait. Plain mean
+        # (gate disarmed) folds arrivals into the canonical pairwise
+        # partials (O(log fan-in) live nodes); robust estimators and the
+        # armed norm-outlier gate can't fold at arrival (cohort verdicts
+        # need the full survivor set) so they run the STAGED fused mode:
+        # per-arrival evidence rows, device-resident slots, ONE verdict-
+        # composition jit at flush (robust_agg.verdict_flush — the same
+        # composition gated_aggregate's verdict branch runs, shared so the
+        # two cannot drift). Bitwise the stacked sum_assoc='pairwise'
+        # route either way, model bits AND ledger (test-enforced). The
+        # sole remaining refusal is host-representation aggregates
+        # (TurboAggregate ships its own mod-p fused path).
         if fused_agg:
             if not type(self)._stage_uploads_on_arrival:
                 raise ValueError(
                     f"{type(self).__name__} aggregates on the HOST "
                     "representation — fused_agg needs the device-staged "
                     "float path (run the stacked route)")
-            if aggregator is not None:
-                raise ValueError(
-                    "fused_agg folds arrivals into pairwise partials as "
-                    "they land — robust estimators need the full stacked "
-                    "cohort at flush; run aggregator= on the stacked "
-                    "route (fused_agg=False)")
-            if self._sanitize_mult is not None:
-                raise ValueError(
-                    "fused_agg supports the unconditional non-finite gate "
-                    "only: the norm-outlier rule is a cohort statistic "
-                    "(median of norms) computed at flush, after arrivals "
-                    "were already folded — run sanitize= on the stacked "
-                    "route (fused_agg=False)")
-            if shard_server_state:
-                raise ValueError(
-                    "fused_agg + shard_server_state is not wired: the "
-                    "fused ingest pins its own per-arrival jit "
-                    "composition — run the sharded server stacked")
             if sum_assoc == "auto":
                 # the fused fold IS the canonical pairwise association —
                 # there is no fused twin of the historical tensordot
                 sum_assoc = "pairwise"
         self.fused_agg = bool(fused_agg)
+        self._fused_staged = bool(fused_agg) and (
+            aggregator is not None or self._sanitize_mult is not None)
         self._fused = None  # FusedRoundIngest of the active round
         self._fused_ingest: dict[str, object] = {}
         self._last_flush: dict | None = None
@@ -230,11 +226,7 @@ class FedAvgAggregator:
         # bit-exact either way — the layout changes, the math does not.
         self._partitioner = None
         self._upload_shardings = None
-        if shard_server_state and sum_assoc == "pairwise":
-            raise ValueError(
-                "sum_assoc='pairwise' + shard_server_state is not wired: "
-                "the sharded aggregate pins its own jit composition — "
-                "run the pairwise comparison legs replicated")
+        self._rep_sharding = None
         if shard_server_state:
             devs = jax.local_devices()
             if len(devs) > 1:
@@ -278,10 +270,21 @@ class FedAvgAggregator:
                 # pass afterwards (resharding moves bits, never rounds, so
                 # parity is unaffected; weights/reason codes are tiny and
                 # naturally replicated)
+                # sum_assoc='pairwise' / the two-phase verdict composition
+                # compose as pure layout: the verdict branch returns
+                # before reshard_fn is consulted (its estimator reads
+                # evidence rows, not the stack), so the sharded layout
+                # comes from the staged inputs + these out_shardings — XLA
+                # lowers the survivor fold into reduce-scatters landing in
+                # the rule-table placement, no gather-then-reshard
                 rep = NamedSharding(mesh, P())
+                self._rep_sharding = rep
                 self._gagg = jax.jit(
                     partial(gated_aggregate, robust_fn=robust,
-                            norm_mult=mult, reshard_fn=reshard),
+                            norm_mult=mult, reshard_fn=reshard,
+                            verdict_fn=verdict_fn,
+                            pairwise=sum_assoc == "pairwise"
+                            and verdict_fn is None),
                     out_shardings=([sh for _, sh in self._upload_shardings],
                                    rep, rep))
             else:
@@ -297,6 +300,46 @@ class FedAvgAggregator:
                 jax.tree.leaves(self.net))
             self._fused_term_nbytes = _fused_mod.term_nbytes(
                 self._fused_meta)
+            # mesh-sharded server state: pin each ingested slot's leaves
+            # to the rule-table placement, so accumulator partials /
+            # staged slots already carry the sharded layout and the
+            # flush's folds lower into reduce-scatters (layout moves
+            # bytes, never values — the bitwise contract is unaffected)
+            self._fused_stage_fn = None
+            if self._upload_shardings is not None:
+                shardings = [sh for _, sh in self._upload_shardings]
+
+                def _pin(leaves, _sh=shardings):
+                    return [jax.device_put(v, s)
+                            for v, s in zip(leaves, _sh)]
+
+                self._fused_stage_fn = _pin
+            if self._fused_staged:
+                from fedml_tpu.core.robust_agg import (
+                    EVIDENCE_SKETCH_DIM,
+                    make_verdict_estimator,
+                )
+
+                # sketches feed distance-based estimators only; the armed-
+                # sanitize mean verdict reads none (ship zero-width rows)
+                self._fused_sketch_dim = (
+                    EVIDENCE_SKETCH_DIM if verdict_fn is not None else 0)
+                fvf = verdict_fn
+                if fvf is None:
+                    # armed sanitize without an estimator: the mean
+                    # verdict behind the armed gate IS sanitize_updates'
+                    # composition — gate weights are the sanitize weights
+                    # and apply_verdicts performs the identical global-
+                    # model replacement (bitwise, test-enforced)
+                    fvf = make_verdict_estimator("mean", n=worker_num)
+                out_sh = None
+                if self._upload_shardings is not None:
+                    out_sh = ([sh for _, sh in self._upload_shardings],
+                              self._rep_sharding, self._rep_sharding)
+                # built ONCE: the flush jit retraces per realized cohort
+                # size (like the stacked gagg), never per round
+                self._fused_flush = _fused_mod.make_fused_robust_flush(
+                    fvf, norm_mult=mult, out_shardings=out_sh)
         self._record_server_state_bytes()
 
     def _record_server_state_bytes(self, opt_state=()) -> None:
@@ -407,19 +450,37 @@ class FedAvgAggregator:
         admission rule and barrier bookkeeping as the stacked path."""
         if not self._admit_upload(index, round_idx):
             return
-        from fedml_tpu.core import fused_agg as _fused_mod
-
         if self._fused is None:
-            self._fused = _fused_mod.FusedRoundIngest(
-                jax.tree.leaves(self.net), self._fused_meta)
-        fn = self._fused_ingest.get(kind)
-        if fn is None:
-            fn = self._fused_ingest[kind] = _fused_mod.make_fused_ingest(
-                kind, self._fused_meta)
-        self._fused.add(index, fn, payload, scales, base_leaves,
-                        float(sample_num))
+            self._fused = self._make_fused_round()
+        self._fused.add(index, self._fused_ingest_fn(kind), payload,
+                        scales, base_leaves, float(sample_num))
         self.sample_num_dict[index] = sample_num
         self.flag_client_model_uploaded[index] = True
+
+    def _make_fused_round(self):
+        """Fresh per-round ingest state against the round's own global
+        model (staged mode for robust/armed-sanitize; the sharding pin
+        when the server plane is partitioned)."""
+        from fedml_tpu.core import fused_agg as _fused_mod
+
+        return _fused_mod.FusedRoundIngest(
+            jax.tree.leaves(self.net), self._fused_meta,
+            staged=self._fused_staged, stage_fn=self._fused_stage_fn)
+
+    def _fused_ingest_fn(self, kind: str):
+        """The per-kind arrival jit, built once and cached (plain
+        decode→gate fold, or decode→evidence in staged mode)."""
+        fn = self._fused_ingest.get(kind)
+        if fn is None:
+            from fedml_tpu.core import fused_agg as _fused_mod
+
+            if self._fused_staged:
+                fn = _fused_mod.make_fused_robust_ingest(
+                    kind, self._fused_meta, self._fused_sketch_dim)
+            else:
+                fn = _fused_mod.make_fused_ingest(kind, self._fused_meta)
+            self._fused_ingest[kind] = fn
+        return fn
 
     def load_buffered(self, entries, weights, discounts=None) -> None:
         """Populate the aggregation slots from an async buffer drain
@@ -435,19 +496,29 @@ class FedAvgAggregator:
         aside for aggregates that must REPLACE the sample-count half of
         the weight without losing the staleness half (the DP uniform
         average, fedavg_robust.py)."""
-        if self.fused_agg:
-            # the async ingest stages dense buffered entries — the server
-            # manager refuses the combination at construction; this is the
-            # belt-and-braces guard for direct callers
-            raise ValueError("fused_agg is wired for the synchronous "
-                             "barrier — async buffered flushes load dense "
-                             "staged entries (run the stacked route)")
         self.model_dict.clear()
         self.sample_num_dict.clear()
         self._async_meta = {}
         self._async_discounts = (None if discounts is None
                                  else {i: float(d)
                                        for i, d in enumerate(discounts)})
+        if self.fused_agg:
+            # fused async drain: entries arrive PRE-DENSIFIED (the server
+            # manager's arrival jit decoded them against the version-
+            # stamped device stash, overlapping the buffer fill), so the
+            # drain folds at the door — one dense ingest per slot against
+            # the CURRENT global with the staleness-discounted weight: no
+            # host densify, no decode burst under the flush lock. Gate /
+            # evidence run here, not at arrival, because the reference
+            # global for replacement is the flush-time model — exactly
+            # when the stacked route gates its staged entries.
+            self._fused = self._make_fused_round()
+            fn = self._fused_ingest_fn("dense")
+            for slot, (e, w) in enumerate(zip(entries, weights)):
+                self._fused.add(slot, fn, e.payload, None, None, float(w))
+                self.sample_num_dict[slot] = float(w)
+                self._async_meta[slot] = (int(e.rank), int(e.client))
+            return
         for slot, (e, w) in enumerate(zip(entries, weights)):
             self.model_dict[slot] = e.payload
             self.sample_num_dict[slot] = float(w)
@@ -481,9 +552,10 @@ class FedAvgAggregator:
 
     def _aggregate_fused(self):
         """The fused flush (docs/PERFORMANCE.md §Fused aggregation):
-        arrivals already decoded/gated/folded on device — merge the
-        pairwise partials, divide once, land the new global model. Bitwise
-        the stacked ``sum_assoc='pairwise'`` route over the same arrived
+        arrivals already decoded on device — plain mode merges the
+        pairwise partials and divides once; staged (robust) mode runs the
+        ONE verdict-composition jit over the staged slots. Bitwise the
+        stacked ``sum_assoc='pairwise'`` route over the same arrived
         slots, ledger included (test-enforced)."""
         t0 = time.perf_counter()
         fr, self._fused = self._fused, None
@@ -493,18 +565,38 @@ class FedAvgAggregator:
             self.sample_num_dict.clear()
             return
         slots = sorted(fr.slots)
-        avg_leaves, reasons_dev = fr.flush()
+        if fr.staged_mode:
+            avg_leaves, _vw, reasons_dev = fr.flush_robust(
+                self._fused_flush)
+            # memory honesty: staged slots are O(K), not O(log K) — the
+            # stacked route's stack bytes plus the evidence rows, under
+            # their own gauge mode so the budget pin can tell them apart
+            mode = "fused_staged"
+            stack_bytes = fr.peak_terms * (
+                self._fused_term_nbytes
+                + 4 * (self._fused_sketch_dim + 3))
+        else:
+            avg_leaves, reasons_dev = fr.flush()
+            mode = "fused"
+            stack_bytes = fr.peak_terms * self._fused_term_nbytes
         _perf.record_agg_bytes(self._state_placement,
                                self._model_nbytes * len(slots))
-        stack_bytes = fr.peak_terms * self._fused_term_nbytes
-        _perf.set_agg_stack_bytes("fused", stack_bytes)
+        _perf.set_agg_stack_bytes(mode, stack_bytes)
         reasons = np.asarray(reasons_dev)
         if reasons.any():
-            ids = self.client_sampling(self.current_round)
+            if self._async_meta is not None:
+                # async buffered flush: slots are arrival positions — the
+                # (rank, client) attribution rides the side table the
+                # server manager staged with the buffer entries
+                rank_l = [self._async_meta[s][0] for s in slots]
+                client_l = [self._async_meta[s][1] for s in slots]
+            else:
+                ids = self.client_sampling(self.current_round)
+                rank_l = [s + 1 for s in slots]
+                client_l = [int(ids[s]) for s in slots]
             self.quarantine.record_codes(
                 self.current_round, reasons,
-                clients=[int(ids[s]) for s in slots],
-                ranks=[s + 1 for s in slots])
+                clients=client_l, ranks=rank_l)
             if (reasons != REASON_OK).all():
                 log.warning("round %d: all %d uploads quarantined — "
                             "keeping the current global model",
@@ -515,8 +607,9 @@ class FedAvgAggregator:
         _perf.record_flush_seconds(flush_s)
         self._last_flush = {"fused": True, "flush_s": round(flush_s, 6),
                             "stack_bytes": int(stack_bytes)}
-        log.info("fused aggregate time cost: %.3fs (%d partials peak)",
-                 flush_s, fr.peak_terms)
+        log.info("fused aggregate time cost: %.3fs (%d %s peak)",
+                 flush_s, fr.peak_terms,
+                 "staged slots" if fr.staged_mode else "partials")
 
     def agg_record(self) -> dict:
         """The ``agg`` block the server manager rides on telemetry round

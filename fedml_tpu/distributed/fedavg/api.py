@@ -148,12 +148,20 @@ def run_simulated(
 
     ``fused_agg``: fused on-device server aggregation (docs/PERFORMANCE.md
     §Fused aggregation) — uploads stage as their raw quantized leaves and
-    one jit per arrival runs decode → densify → non-finite gate → pairwise
-    fold against the device-resident broadcast stash, so the server never
-    materializes per-client f32 trees on host and peak staging is O(log
-    fan-in) partials. Bitwise ``sum_assoc='pairwise'`` (which it implies);
-    robust estimators / armed ``sanitize`` / ``shard_server_state`` /
-    ``async_buffer_k`` keep the stacked route and are refused loudly."""
+    one jit per arrival runs decode → densify against the device-resident
+    broadcast stash, so the server never materializes per-client f32
+    trees on host. Plain runs fold-at-arrival (peak staging O(log
+    fan-in) partials); robust estimators and armed ``sanitize`` ride the
+    STAGED fused mode (per-arrival evidence rows, one verdict jit at
+    flush) and are BITWISE the stacked route, model bits and quarantine
+    ledger. Composes with ``shard_server_state`` (the flush jit's output
+    layout is the rule-table placement), ``async_buffer_k`` (arrivals
+    densify at the door, the drain folds with discounted weights) and
+    ``edges`` (the edge tier ingests per arrival; its uplink frames are
+    bit-identical to the stacked edge's). Bitwise
+    ``sum_assoc='pairwise'`` (which it implies). The one refusal left:
+    host-representation aggregates (TurboAggregate keeps its own mod-p
+    fused path)."""
     if edges:
         # hierarchical 2-tier topology (distributed/fedavg/hierarchy.py,
         # docs/ROBUSTNESS.md §Hierarchical tiers): 1 root + E edge
@@ -170,7 +178,6 @@ def run_simulated(
             "shard_server_state": shard_server_state or None,
             "heartbeat_max_age_s": heartbeat_max_age_s,
             "sum_assoc": None if sum_assoc == "auto" else sum_assoc,
-            "fused_agg": fused_agg or None,
         }
         bad = [k for k, v in unsupported.items() if v is not None]
         if bad:
@@ -200,7 +207,8 @@ def run_simulated(
             telemetry=telemetry, chaos_plan=chaos_plan,
             round_timeout_s=round_timeout_s, adversary_plan=adversary_plan,
             warmup=warmup, aggregator=aggregator,
-            aggregator_params=aggregator_params, sanitize=sanitize)
+            aggregator_params=aggregator_params, sanitize=sanitize,
+            fused_agg=fused_agg)
     size = cfg.client_num_per_round + 1
     kw = backend_kwargs(backend, job_id, base_port, broker_host, broker_port)
     from fedml_tpu import chaos as _chaos

@@ -319,7 +319,8 @@ class FedAvgEdgeManager(DistributedManager):
                  backend: str = "LOOPBACK",
                  round_timeout_s: float | None = None,
                  robust: bool = False,
-                 sketch_dim: int = EVIDENCE_SKETCH_DIM, **kw):
+                 sketch_dim: int = EVIDENCE_SKETCH_DIM,
+                 fused: bool = False, **kw):
         self.topology = topology
         self.edge_idx = rank - 1
         if not 0 <= self.edge_idx < topology.edges:
@@ -333,6 +334,16 @@ class FedAvgEdgeManager(DistributedManager):
         self._forwarded = False
         self._lock = threading.Lock()
         self._partial = jax.jit(edge_partial)
+        # fused on-device ingest at the edge tier (docs/PERFORMANCE.md
+        # §Fused aggregation): each child upload folds (plain) or stages
+        # with its evidence row (robust) in the per-arrival jit, so the
+        # block never materializes a host stack — the uplink frames are
+        # bit-identical to the stacked edge's (flush_block_partial /
+        # block_evidence replay the _stack_block hole fill at position)
+        self.fused = bool(fused)
+        self._fused_round = None     # rebuilt per downlink (new global)
+        self._fused_ingest = None    # jit, built once (static leaf meta)
+        self._sketch_dim = int(sketch_dim)
         # two-phase robust gating (module docstring): this edge forwards
         # EVIDENCE first, holds the staged uploads, and folds only the
         # survivors the root's verdict frame names
@@ -408,6 +419,23 @@ class FedAvgEdgeManager(DistributedManager):
             self._evidence_sent = False
             self._staged = None
             self._last_partial = None
+            if self.fused:
+                from fedml_tpu.core import fused_agg as _fused
+
+                glob = [jnp.asarray(g) for g in self._global]
+                if self._fused_ingest is None:
+                    meta = _fused._leaf_meta(glob)
+                    self._fused_meta = meta
+                    # edge uplinks are dense by protocol (the encoded-
+                    # uplink refusal below), so ONE jit covers every
+                    # child — built once, leaf meta is round-invariant
+                    self._fused_ingest = (
+                        _fused.make_fused_robust_ingest(
+                            "dense", meta, self._sketch_dim)
+                        if self.robust else
+                        _fused.make_fused_ingest("dense", meta))
+                self._fused_round = _fused.FusedRoundIngest(
+                    glob, self._fused_meta, staged=self.robust)
             # fleet marker: the edge REBUILDS worker frames, so the
             # enablement marker must be explicitly relayed (like every
             # other side-band key) or the workers never start digesting
@@ -480,9 +508,20 @@ class FedAvgEdgeManager(DistributedManager):
                     "encoded uplinks (top-k / delta / quantized) are not "
                     "wired through edge aggregators — run the flat "
                     "topology or the dense protocol")
-            self._uploads[local] = (
-                list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]),
-                float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]))
+            nsamp = float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES])
+            if self.fused:
+                # fold (plain) / stage+evidence (robust) on device at
+                # arrival; the host keeps only the (arrived, nsamp)
+                # bookkeeping the completion check and frame need
+                self._fused_round.add(
+                    local, self._fused_ingest,
+                    list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]),
+                    None, None, nsamp)
+                self._uploads[local] = (None, nsamp)
+            else:
+                self._uploads[local] = (
+                    list(msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS]),
+                    nsamp)
             if len(self._uploads) == len(self._slots):
                 if self.robust:
                     self._forward_evidence()
@@ -549,17 +588,34 @@ class FedAvgEdgeManager(DistributedManager):
     def _forward_partial(self) -> None:
         """Single-phase (no robust gating): local non-finite gate + the
         canonical pairwise partial over this block. Caller holds _lock."""
-        stacked, glob, weights = self._stack_block()
-        wsum, total, reasons = self._partial(stacked, glob, weights)
+        if self.fused:
+            # the per-arrival folds already happened; collapse with the
+            # _stack_block hole fill at position — bitwise the stacked
+            # edge's partial (zero-weight terms are exact f32 zeros)
+            wsum, total, reasons = self._fused_round.flush_block_partial(
+                len(self._slots))
+        else:
+            stacked, glob, weights = self._stack_block()
+            wsum, total, reasons = self._partial(stacked, glob, weights)
         self._send_partial_frame(wsum, total, reasons)
 
     def _forward_evidence(self) -> None:
         """Phase 1 of the two-phase protocol: per-slot sanitation evidence
         to the root; the staged uploads stay HERE until the verdict frame
         names the survivors. Caller holds _lock."""
-        stacked, glob, weights = self._stack_block()
-        self._staged = (stacked, glob)
-        ev = self._evidence_jit(stacked, glob, weights)
+        if self.fused:
+            # per-arrival rows assembled with zero-filled holes — bitwise
+            # the stacked edge's update_evidence over the _stack_block
+            # fill (a global-model slot's norm/sketch/weight are exact
+            # +0.0; finite True). The raw staged slots stay device-
+            # resident for phase 3 (block_stacked at verdict receipt).
+            ev = self._fused_round.block_evidence(len(self._slots),
+                                                  self._sketch_dim)
+            self._staged = ("fused", None)
+        else:
+            stacked, glob, weights = self._stack_block()
+            self._staged = (stacked, glob)
+            ev = self._evidence_jit(stacked, glob, weights)
         msg = Message(MyMessage.MSG_TYPE_E2S_SEND_EVIDENCE_TO_SERVER,
                       self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_EVIDENCE_NORM,
@@ -614,7 +670,11 @@ class FedAvgEdgeManager(DistributedManager):
                 np.float32)
             reasons = np.asarray(
                 msg_params[MyMessage.MSG_ARG_KEY_VERDICT_REASONS], np.int32)
-            stacked, glob = self._staged
+            if self.fused:
+                stacked = self._fused_round.block_stacked(len(self._slots))
+                glob = [jnp.asarray(g) for g in self._global]
+            else:
+                stacked, glob = self._staged
             wsum, total = self._apply_jit(stacked, glob, jnp.asarray(vw))
             self._staged = None
             self._send_partial_frame(wsum, total, reasons)
@@ -982,6 +1042,7 @@ def run_simulated_hierarchical(
     warmup: bool = False, aggregator: str | None = None,
     aggregator_params: dict | None = None,
     sanitize: bool | float | None = None,
+    fused_agg: bool = False,
 ) -> HierFedAvgAggregator:
     """The 2-tier analogue of ``run_simulated``: 1 root + E edges + W
     workers as threads over the loopback (or localhost-gRPC) backend.
@@ -1039,10 +1100,15 @@ def run_simulated_hierarchical(
         edge_timeout = (round_timeout_s / 2.0
                         if round_timeout_s is not None else None)
         edge_mgrs = [
+            # fused_agg is an EDGE-tier property in the tree: edges do
+            # the fan-in ingest (the root folds O(edges) partial frames,
+            # already cheap), and the fused block frames are bitwise the
+            # stacked edge's, so the root is none the wiser
             FedAvgEdgeManager(topo.edge_rank(e), topo, backend=backend,
                               round_timeout_s=edge_timeout,
                               robust=server.aggregator.robust_mode,
                               sketch_dim=server.aggregator.sketch_dim,
+                              fused=fused_agg,
                               **kw)
             for e in range(topo.edges)
         ]

@@ -113,6 +113,19 @@ class FedAvgServerManager(ServerManager):
         # the aggregator's per-arrival jit instead of a host numpy pass
         self._fused = bool(getattr(aggregator, "fused_agg", False))
         self._version_dev: dict[int, list] = {}
+        # per-kind arrival densify jits for the ASYNC fused path: an
+        # arrival only decodes against its version stash and answers the
+        # door's finiteness question (one scalar readback); the gate /
+        # evidence row waits for the drain, where the flush-time global
+        # is the replacement reference — exactly when the stacked route
+        # gates its staged entries
+        self._fused_densify: dict[str, object] = {}
+        # per-round fused per-arrival ingest seconds. These jits run in
+        # the window the server would otherwise spend blocked on the
+        # wire, but they are AGGREGATION work — goodput attributes them
+        # to agg_flush, not wire_wait (see _goodput_extra), so a fused
+        # A/B moves the right bucket.
+        self._gp_fused_ingest_s = 0.0
         # rank -> the version its last upload PROVED it holds (the upload's
         # round tag: a client can only have encoded against a broadcast it
         # decoded). Drives the delta-broadcast warm set — optimistic
@@ -140,11 +153,14 @@ class FedAvgServerManager(ServerManager):
         # the synchronous barrier, untouched.
         self._async = async_buffer_k is not None
         self._buffer = None
-        if self._async and self._fused:
-            raise ValueError(
-                "fused_agg is wired for the synchronous barrier — the "
-                "async ingest admits/stages dense buffered entries (run "
-                "async_buffer_k with the stacked route)")
+        # fused × async composes: arrivals densify on device against the
+        # version-stamped stash (_decode_upload_fused) and the buffered
+        # entry carries dense device leaves; the drain folds each entry
+        # at the door with its staleness-discounted weight (aggregator
+        # load_buffered fused branch). The staleness discount is known
+        # at arrival, but the GATE runs at drain against the flush-time
+        # global — bound-0 / K=cohort parity with the sync barrier holds
+        # bitwise (pinned in tests/test_async_buffer.py).
         if self._async and self.delta_broadcast:
             log.warning("delta_broadcast ignored in async buffered mode: "
                         "per-rank dispatch holds arbitrary versions, so "
@@ -665,6 +681,7 @@ class FedAvgServerManager(ServerManager):
 
             self._gp_bcast_start_t = _time.monotonic()
             self._gp_last_arrival_t = None
+        self._gp_fused_ingest_s = 0.0
         if self.wal is not None:
             # journal the round opening BEFORE any frame leaves: recovery
             # must know round r was in flight even if the crash lands
@@ -953,6 +970,88 @@ class FedAvgServerManager(ServerManager):
                         "(%s)", sender, e)
             return False
 
+    def _decode_upload_fused(self, msg_params, sender: int, version: int):
+        """Fused twin of ``_decode_upload`` for the ASYNC path: the same
+        structural validation as ``_stage_fused``, but the arrival jit
+        only densifies against the device-resident version stash and
+        answers the door's finiteness question — the gate/evidence row
+        waits for the drain, whose flush-time global is the replacement
+        reference (matching when the stacked route gates its staged
+        entries). Returns ``(dense_device_leaves, finite)`` — the drain
+        folds the leaves as kind='dense' whatever rode the wire — or
+        None when the payload is structurally undecodable (quarantined +
+        counted); raises on a never-broadcast base version."""
+        import numpy as np
+
+        from fedml_tpu.comm.delta import CorruptPayload, inflate_update
+        from fedml_tpu.core.fused_agg import make_fused_densify
+
+        has_sparse = MyMessage.MSG_ARG_KEY_SPARSE_IDX in msg_params
+        has_upd = MyMessage.MSG_ARG_KEY_UPDATE_CODEC in msg_params
+        base_dev = None
+        if has_sparse or has_upd:
+            base_dev = self._version_dev.get(int(version))
+            base = self._version_pack.get(int(version))
+            if base is None or base_dev is None:
+                raise RuntimeError(
+                    f"upload from rank {sender} is encoded against version "
+                    f"{version}, which was never broadcast (or predates "
+                    f"this server) — encoded uplinks require a versioned "
+                    f"base (stashed: {sorted(self._version_pack)})")
+
+        def _jit(kind):
+            fn = self._fused_densify.get(kind)
+            if fn is None:
+                fn = make_fused_densify(kind, self.aggregator._fused_meta)
+                self._fused_densify[kind] = fn
+            return fn
+
+        empty = None
+        try:
+            if not (has_sparse or has_upd):
+                leaves, finite = _jit("dense")(
+                    msg_params[MyMessage.MSG_ARG_KEY_MODEL_PARAMS],
+                    empty, [])
+            elif has_sparse:
+                idx = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_IDX]
+                val = msg_params[MyMessage.MSG_ARG_KEY_SPARSE_VAL]
+                if len(idx) != len(base) or len(val) != len(base):
+                    raise CorruptPayload(
+                        f"sparse payload has {len(idx)}/{len(val)} leaves, "
+                        f"model has {len(base)}")
+                for sel, t in zip(idx, base):
+                    sel = np.asarray(sel)
+                    # the device scatter silently drops out-of-bounds
+                    # indices where the host path raised IndexError —
+                    # validate so a bit-flipped index costs one upload
+                    if sel.size and np.issubdtype(
+                            np.asarray(t).dtype, np.floating) and (
+                            int(sel.max()) >= np.asarray(t).size
+                            or int(sel.min()) < 0):
+                        raise CorruptPayload(
+                            f"sparse index out of range for a "
+                            f"{np.asarray(t).size}-entry leaf")
+                leaves, finite = _jit("topk")(
+                    (list(idx), list(val)), empty, base_dev)
+            else:
+                codec = str(msg_params[MyMessage.MSG_ARG_KEY_UPDATE_CODEC])
+                raw, scales = inflate_update(
+                    msg_params[MyMessage.MSG_ARG_KEY_UPDATE_PAYLOAD],
+                    msg_params[MyMessage.MSG_ARG_KEY_UPDATE_SCALE],
+                    codec, base)
+                leaves, finite = _jit(codec)(raw, scales, base_dev)
+            # one scalar readback — the async door's admit/shed decision
+            # is host control flow either way (the stacked route pays a
+            # full host isfinite scan here)
+            return leaves, bool(finite)
+        except (ValueError, KeyError, TypeError, IndexError) as e:
+            self.aggregator.quarantine.record(
+                self.round_idx, sender, "undecodable")
+            _obs.record_update_rejected("undecodable")
+            log.warning("quarantining undecodable upload from rank %d "
+                        "(%s)", sender, e)
+            return None
+
     def send_init_msg(self):
         if self._async:
             # async boot: every rank gets wave-0 work individually (same
@@ -1114,14 +1213,32 @@ class FedAvgServerManager(ServerManager):
                         min(self._version_pack, default=None))
             self._dispatch_one(sender)
             return
-        wire_leaves = self._decode_upload(msg_params, sender,
-                                          trained_version)
-        if wire_leaves is None:
-            # undecodable payload: quarantined + counted by _decode_upload;
-            # the rank gets fresh work like any other consumed upload
-            self._record_shed("undecodable")
-            self._dispatch_one(sender)
-            return
+        staged_payload = None
+        if self._fused:
+            # fused arrival: densify on device against the version stash
+            # (kind-specific jit, cached) — host work is structural
+            # validation plus one scalar finiteness readback. The dense
+            # device leaves ride the buffer; the drain folds them at the
+            # door with the discounted weight (aggregator load_buffered).
+            t0 = _time.monotonic()
+            decoded = self._decode_upload_fused(msg_params, sender,
+                                                trained_version)
+            self._gp_fused_ingest_s += _time.monotonic() - t0
+            if decoded is None:
+                self._record_shed("undecodable")
+                self._dispatch_one(sender)
+                return
+            staged_payload, finite = decoded
+        else:
+            wire_leaves = self._decode_upload(msg_params, sender,
+                                              trained_version)
+            if wire_leaves is None:
+                # undecodable payload: quarantined + counted by
+                # _decode_upload; the rank gets fresh work like any other
+                # consumed upload
+                self._record_shed("undecodable")
+                self._dispatch_one(sender)
+                return
         # the work unit's client id: echoed from the dispatch frame (like
         # the wave) so the hot path never rebuilds the O(client_num_in_
         # total) seeded sampling permutation under _round_lock; the
@@ -1129,9 +1246,10 @@ class FedAvgServerManager(ServerManager):
         client = msg_params.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         client = (int(self.aggregator.client_sampling(wave)[sender - 1])
                   if client is None else int(client))
-        finite = all(np.isfinite(v).all() for v in wire_leaves
-                     if isinstance(v, np.ndarray)
-                     and np.issubdtype(v.dtype, np.floating))
+        if not self._fused:
+            finite = all(np.isfinite(v).all() for v in wire_leaves
+                         if isinstance(v, np.ndarray)
+                         and np.issubdtype(v.dtype, np.floating))
         if not finite:
             # PR-4 quarantine at the door: a non-finite arrival never
             # enters the buffer (norm outliers still gate at flush, where
@@ -1149,7 +1267,8 @@ class FedAvgServerManager(ServerManager):
         entry = BufferedUpdate(
             rank=sender, client=client,
             version=trained_version, wave=wave,
-            payload=self.aggregator._stage_upload(wire_leaves),
+            payload=(staged_payload if self._fused
+                     else self.aggregator._stage_upload(wire_leaves)),
             nsamp=float(msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES]),
             seq=wave * self.size + sender, t_arrival=now)
         for victim in self._buffer.add(entry):
@@ -1247,6 +1366,9 @@ class FedAvgServerManager(ServerManager):
                 self.aggregator.test_on_server_for_all_clients(version)
         finally:
             self.aggregator._async_meta = None
+            # per-flush goodput window: the NEXT flush's fused arrival
+            # jits start accumulating from zero
+            self._gp_fused_ingest_s = 0.0
         self._maybe_save()
         self.round_idx += 1
         self._bcast_pack = None  # repack lazily at the next dispatch
@@ -1617,9 +1739,16 @@ class FedAvgServerManager(ServerManager):
                 # gate → pairwise fold on device (no per-client f32 tree
                 # ever exists here). An undecodable payload still
                 # satisfies the barrier, exactly like the stacked path.
+                # The ingest seconds are aggregation work happening in
+                # the wire-wait window — accumulated here so goodput
+                # attributes them to agg_flush (_goodput_extra).
+                import time as _time
+
+                t0 = _time.monotonic()
                 ok = self._stage_fused(
                     msg_params, int(sender), int(msg_round),
                     msg_params[MyMessage.MSG_ARG_KEY_NUM_SAMPLES])
+                self._gp_fused_ingest_s += _time.monotonic() - t0
                 if not ok and (int(sender) - 1) in \
                         self.aggregator.flag_client_model_uploaded:
                     self.aggregator.flag_client_model_uploaded[
@@ -1695,10 +1824,16 @@ class FedAvgServerManager(ServerManager):
             arr = getattr(self, "_gp_last_arrival_t", None)
             wire_wait_s = (max(0.0, arr - bce)
                            if bce is not None and arr is not None else 0.0)
-        # NOTE: the fused flush_s rides inside the aggregate span, so the
-        # agg_flush bucket reads the span alone (no double count)
+        # fused attribution: the per-arrival ingest jits run while the
+        # server sits in the wire-wait window, but they are aggregation
+        # work — move their seconds from wire_wait into agg_flush so a
+        # fused A/B shifts the bucket that actually changed. The fused
+        # FLUSH latency already rides inside the aggregate span, so only
+        # the arrival-side seconds move (no double count).
+        ingest_s = getattr(self, "_gp_fused_ingest_s", 0.0)
+        wire_wait_s = max(0.0, wire_wait_s - ingest_s)
         buckets = _goodput.buckets_from_spans(
-            wall_s, spans, wire_wait_s=wire_wait_s)
+            wall_s, spans, wire_wait_s=wire_wait_s, flush_s=ingest_s)
         return {"goodput": _goodput.round_goodput(wall_s, buckets)}
 
     def _round_record_extra(self) -> dict:

@@ -345,16 +345,19 @@ def add_args(p: argparse.ArgumentParser):
                    help="fused on-device server aggregation (docs/"
                         "PERFORMANCE.md §Fused aggregation): uploads "
                         "stage as raw quantized leaves and one jit per "
-                        "arrival runs decode -> densify -> non-finite "
-                        "gate -> pairwise fold, so the server never "
-                        "materializes per-client f32 trees on host. "
-                        "Implies pairwise summation; refuses "
-                        "--aggregator / --shard_server_state / "
-                        "--async_buffer_k / dense --edges (those keep "
-                        "the stacked route). Under --algo turboaggregate "
-                        "it selects the device-resident mod-p fold for "
-                        "masked ingest (flat or --edges), bitwise equal "
-                        "to the host fold")
+                        "arrival runs decode -> densify against the "
+                        "device stash, so the server never materializes "
+                        "per-client f32 trees on host. Plain folds at "
+                        "arrival; --aggregator / armed --sanitize ride "
+                        "the staged fused mode (per-arrival evidence "
+                        "rows, one verdict jit at flush), bitwise the "
+                        "stacked route. Composes with "
+                        "--shard_server_state, --async_buffer_k and "
+                        "dense --edges; implies pairwise summation. "
+                        "Under --algo turboaggregate it selects the "
+                        "device-resident mod-p fold for masked ingest "
+                        "(flat or --edges), bitwise equal to the host "
+                        "fold")
     p.add_argument("--precision", type=str, default="f32",
                    choices=["f32", "bf16"],
                    help="client-compute precision policy (docs/"
@@ -496,7 +499,9 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                                               None)),
             ("--sum_assoc", None if getattr(args, "sum_assoc", "auto")
              == "auto" else args.sum_assoc),  # tree IS pairwise already
-            ("--fused_agg", getattr(args, "fused_agg", 0) or None),
+            # --fused_agg used to sit in this matrix; it is a composition
+            # now (edge ranks ingest per arrival; their uplink frames are
+            # bitwise the stacked edge's, so the root is unchanged)
         ) if v is not None]
         if incompatible:
             raise ValueError(f"--edges does not compose with "
@@ -533,7 +538,8 @@ def init_role(args, data, task, cfg, backend_kw, telemetry=None):
                 args.rank, topo, backend=backend,
                 round_timeout_s=(args.round_timeout_s / 2.0
                                  if args.round_timeout_s else None),
-                robust=bool(robust_agg_name), **backend_kw)
+                robust=bool(robust_agg_name),
+                fused=bool(getattr(args, "fused_agg", 0)), **backend_kw)
         local_spec = None
         if args.algo == "fedprox":
             from fedml_tpu.distributed.fedprox import prox_spec

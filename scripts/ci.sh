@@ -517,13 +517,39 @@ assert "fed_flush_seconds" in snap, sorted(snap)
 modes = snap.get("fed_agg_stack_bytes", {})
 assert any("mode=fused" in k for k in modes) and \
     any("mode=stacked" in k for k in modes), modes
+# PR-21 universal ingest: fused×median×delta-int8 under a 2-of-8
+# sign-flip adversary — the STAGED fused route (per-arrival evidence
+# rows, one verdict-composition flush jit) reproduces the stacked
+# pairwise verdict path: ledger bitwise, model within the delta-int8
+# fma ulp (lossless tiers are bitwise — tier-1 pins them), and the
+# median actually outvoted the flipped pair (finite, converged model)
+cfg8 = FedAvgConfig(comm_round=3, client_num_in_total=8,
+                    client_num_per_round=8, batch_size=6, lr=0.1,
+                    frequency_of_the_test=1)
+flip = lambda: AdversaryPlan.from_json(
+    {"seed": 2, "rules": [{"attack": "sign_flip", "ranks": [2, 5],
+                           "factor": 3.0}]})
+rs = run_simulated(data, task, cfg8, job_id="ci-fused-rob-s",
+                   sum_assoc="pairwise", aggregator="median",
+                   update_codec="delta-int8", adversary_plan=flip())
+rf = run_simulated(data, task, cfg8, job_id="ci-fused-rob-f",
+                   fused_agg=True, aggregator="median",
+                   update_codec="delta-int8", adversary_plan=flip())
+assert rf.quarantine.canonical() == rs.quarantine.canonical()
+for x, y in zip(pack_pytree(rs.net), pack_pytree(rf.net)):
+    assert float(np.max(np.abs(np.asarray(x) - np.asarray(y)))) < 1e-6
+assert all(np.isfinite(np.asarray(v)).all() for v in pack_pytree(rf.net))
+modes2 = REGISTRY.snapshot().get("fed_agg_stack_bytes", {})
+assert any("mode=fused_staged" in k for k in modes2), modes2
 print(f"fused-aggregation smoke ok: ledger {len(led)} entries equal, "
-      f"no host densify, stack bytes {modes}")
+      f"no host densify, fused×median ≡ stacked×median under 2-of-8 "
+      f"sign-flip, stack bytes {modes2}")
 PY
   # the committed FEDML_BENCH_FUSED A/B artifact must stay within spec
-  # (fused flush >= 2x stacked at fan-in 128, bf16+bucketed >= 2x f32
-  # rounds/s at 100k streamed clients, fused ingest RSS bounded)
-  python scripts/bench_gate.py BENCH_FUSED_r01.json \
+  # (fused flush >= 2x stacked at fan-in 128 — plain AND the robust
+  # fused×median leg, bf16+bucketed >= 2x f32 rounds/s at 100k streamed
+  # clients, fused ingest RSS bounded)
+  python scripts/bench_gate.py BENCH_FUSED_r02.json \
     --gate scripts/ci_fused_gate.json
   echo "== secure-aggregation + privacy smoke (masked == plain within tolerance; mid-run dropout recovers; fed_privacy_epsilon exported) =="
   # the masked secure-aggregation tier (docs/ROBUSTNESS.md §Secure

@@ -261,32 +261,30 @@ def test_inflate_update_structural_garbage_raises():
 
 
 def test_fused_refusals_are_loud():
+    """PR-21: the --fused_agg refusal matrix shrinks to ONE documented
+    cell — host-representation aggregates, whose ``aggregate()`` consumes
+    the host stack the fused plane exists to avoid (TurboAggregate keeps
+    its own mod-p fused path). Every former refusal is a composition
+    now: robust estimators / armed sanitize (staged fused mode),
+    shard_server_state (flush-layout property), async_buffer_k (densify
+    at the door, gate at drain), edges (fused edge-tier ingest)."""
     from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
-    from fedml_tpu.distributed.fedavg.server_manager import (
-        FedAvgServerManager,
-    )
+    from fedml_tpu.distributed.fedavg_robust import FedAvgRobustAggregator
 
     data, task, cfg = _data(), _task(), _cfg()
-    with pytest.raises(ValueError, match="stacked route"):
-        FedAvgAggregator(data, task, cfg, worker_num=4, fused_agg=True,
-                         aggregator="median")
-    with pytest.raises(ValueError, match="non-finite gate only"):
-        FedAvgAggregator(data, task, cfg, worker_num=4, fused_agg=True,
-                         sanitize=True)
-    with pytest.raises(ValueError, match="shard_server_state"):
-        FedAvgAggregator(data, task, cfg, worker_num=4, fused_agg=True,
-                         shard_server_state=True)
+    with pytest.raises(ValueError, match="HOST representation"):
+        FedAvgRobustAggregator(data, task, cfg, worker_num=4,
+                               fused_agg=True)
+    # the lifted rows construct — and stay on the fused route
     agg = FedAvgAggregator(data, task, cfg, worker_num=4, fused_agg=True)
     assert agg.sum_assoc == "pairwise"  # fused IS the canonical pairwise
-    with pytest.raises(ValueError, match="synchronous barrier"):
-        FedAvgServerManager(agg, rank=0, size=5, backend="LOOPBACK",
-                            async_buffer_k=2)
-    with pytest.raises(ValueError, match="synchronous barrier"):
-        agg.load_buffered([], [])
-    from fedml_tpu.distributed.fedavg import run_simulated
-
-    with pytest.raises(ValueError, match="does not compose"):
-        run_simulated(data, task, cfg, edges=2, fused_agg=True)
+    assert not agg._fused_staged       # plain keeps fold-at-arrival
+    for kw in ({"aggregator": "median"}, {"sanitize": True},
+               {"aggregator": "krum",
+                "aggregator_params": {"f": 1}}):
+        a = FedAvgAggregator(data, task, cfg, worker_num=6,
+                             fused_agg=True, **kw)
+        assert a.fused_agg and a._fused_staged
 
 
 def test_stacked_staging_stacks_without_transfers():
@@ -473,3 +471,33 @@ def test_fused_flush_metrics_exported():
         sorted(k for k in snap if k.startswith("fed_"))
     stack = snap.get("fed_agg_stack_bytes", {})
     assert any("mode=fused" in k for k in stack), stack
+
+
+def test_fused_staged_stack_bytes_budget():
+    """Memory honesty for the STAGED fused mode (PR-21,
+    docs/PERFORMANCE.md §Fused aggregation): robust gating keeps every
+    staged slot live until the verdict flush, so the device-staged bytes
+    are the stacked route's stack bytes PLUS the per-slot evidence rows —
+    O(K), not plain mode's O(log K) — exported under their own gauge mode
+    (``fed_agg_stack_bytes{mode=fused_staged}``) and pinned here to the
+    exact budget formula the aggregator reports."""
+    from fedml_tpu.distributed.fedavg import run_simulated
+    from fedml_tpu.obs.metrics import REGISTRY
+
+    data, task, cfg = _data(), _task(), _cfg()
+    agg = run_simulated(data, task, cfg, job_id="fb-staged-mem",
+                        fused_agg=True, aggregator="median")
+    snap = REGISTRY.snapshot()
+    stack = snap.get("fed_agg_stack_bytes", {})
+    staged = [v for k, v in stack.items() if "mode=fused_staged" in k]
+    assert staged, stack
+    K = cfg.client_num_per_round
+    budget = K * (agg._fused_term_nbytes
+                  + 4 * (agg._fused_sketch_dim + 3))
+    assert staged[0] == budget, (staged[0], budget)
+    # the staged premium over a stacked barrier is ONLY the evidence rows
+    # (norm + finite + weight + sketch floats per slot) — the tradeoff
+    # bought: no host densify, no barrier H2D burst, decode overlapped
+    # with the wire wait
+    assert staged[0] - K * agg._model_nbytes == \
+        K * 4 * (agg._fused_sketch_dim + 3)

@@ -1,0 +1,368 @@
+"""Universal fused ingest compositions (PR-21, docs/PERFORMANCE.md
+§Fused aggregation): the per-arrival on-device ingest plane composed with
+every other server-side mode, each leg bitwise its stacked
+``sum_assoc='pairwise'`` twin — model bits AND quarantine ledger.
+
+Contracts enforced here:
+
+- **fused × robust**: all six gated forms (median / trimmed_mean / krum /
+  multi_krum / geometric_median / armed sanitize) reproduce the stacked
+  two-phase verdict composition bit for bit across rounds, with a NaN
+  adversary dying at the gate and landing in BOTH ledgers identically;
+- **fused × shard_server_state**: the staged flush lands in the
+  rule-table placement (kernel genuinely partitioned) and all four
+  corners — {fused, stacked} x {sharded, replicated} — agree bitwise;
+- **chaos duplicate**: a re-delivered upload folds exactly once in the
+  STAGED fused mode (the plain-mode pin lives in test_fused_bf16.py);
+- **elastic partial**: a straggler hole under fused×robust equals the
+  stacked subset fold, flat AND through the edge tier (seeded crash);
+- **fused × async**: bound-0 / K=cohort buffered draining equals the
+  sync barrier, both fused and vs the stacked twin;
+- **fused × edges**: the edge-tier fused accumulator forwards frames
+  bitwise the stacked edge's, so tree ≡ flat survives composition;
+- **warmup**: the new fused_robust / sharded flush jit variants compile
+  through the persistent cache — a repeat drive performs ZERO fresh
+  compiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.core.tasks import classification_task
+from fedml_tpu.data.synthetic import synthetic_images
+from fedml_tpu.distributed.fedavg import run_simulated
+from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
+from fedml_tpu.models.linear import LogisticRegression
+
+
+def _data(seed=0):
+    return synthetic_images(num_clients=8, image_shape=(6, 6, 1),
+                            num_classes=3, samples_per_client=12,
+                            test_samples=24, seed=seed)
+
+
+def _task():
+    return classification_task(LogisticRegression(num_classes=3))
+
+
+def _cfg(**kw):
+    base = dict(comm_round=3, client_num_in_total=8, client_num_per_round=4,
+                batch_size=6, lr=0.1, frequency_of_the_test=100)
+    base.update(kw)
+    return FedAvgConfig(**base)
+
+
+def _leaves_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(a, b))
+
+
+def _nan_adv():
+    from fedml_tpu.chaos import AdversaryPlan
+
+    return AdversaryPlan.from_json(
+        {"seed": 1, "rules": [{"attack": "nan", "ranks": [2]}]})
+
+
+# --------------------------------------------------- aggregator-level drive
+def _make_uploads(shapes, rounds, workers, nan_at=(1, 2)):
+    """Deterministic upload tensors shared by both twins: small
+    perturbations of the seed global (so the armed gate sees comparable
+    norms), with one full-NaN leaf at ``nan_at`` = (round, slot)."""
+    ups = []
+    for rnd in range(rounds):
+        rs = np.random.RandomState(1000 * rnd + 7)
+        row = []
+        for i in range(workers):
+            leaves = [(0.05 * rs.randn(*s)).astype(np.float32)
+                      for s in shapes]
+            if (rnd, i) == nan_at:
+                leaves[0] = np.full_like(leaves[0], np.nan)
+            row.append(leaves)
+        ups.append(row)
+    return ups
+
+
+def _drive(data, task, uploads, *, fused, workers=6, arrive=None,
+           dup=False, **agg_kw):
+    """Drive ``len(uploads)`` rounds straight through the aggregator —
+    fused arrivals via add_fused_result (kind='dense'), stacked via
+    add_local_trained_result — and return (per-round model packs, agg)."""
+    cfg = _cfg(client_num_per_round=workers)
+    a = FedAvgAggregator(data, task, cfg, worker_num=workers,
+                         fused_agg=fused, sum_assoc="pairwise", **agg_kw)
+    packs = []
+    for rnd, row in enumerate(uploads):
+        a.begin_round(rnd)
+        slots = arrive(rnd) if arrive is not None else range(workers)
+        for i in slots:
+            reps = 2 if (dup and i == 0) else 1
+            for _ in range(reps):
+                if fused:
+                    a.add_fused_result(
+                        i, "dense", [jnp.asarray(x) for x in row[i]],
+                        None, 10 + i, rnd, None)
+                else:
+                    a.add_local_trained_result(
+                        i, [np.asarray(x) for x in row[i]], 10 + i, rnd)
+        packs.append([np.asarray(v) for v in a.aggregate()])
+    return packs, a
+
+
+@pytest.fixture(scope="module")
+def data():
+    return _data()
+
+
+@pytest.fixture(scope="module")
+def task():
+    return _task()
+
+
+@pytest.fixture(scope="module")
+def shapes(data, task):
+    a = FedAvgAggregator(data, task, _cfg(), worker_num=4)
+    return [np.shape(v) for v in pack_pytree(a.net)]
+
+
+# ------------------------------------------------------------ fused×robust
+SIX_FORMS = [
+    ("median", {}, {}),
+    ("trimmed_mean", {"trim": 0.2}, {}),
+    ("krum", {"f": 1}, {}),
+    ("multi_krum", {"f": 1, "m": 3}, {}),
+    ("geometric_median", {}, {}),
+    (None, None, {"sanitize": True}),  # armed sanitize, no estimator
+]
+
+
+@pytest.mark.parametrize("est,params,extra", SIX_FORMS,
+                         ids=[f[0] or "sanitize" for f in SIX_FORMS])
+def test_fused_robust_bitwise_with_nan_ledger(data, task, shapes, est,
+                                              params, extra):
+    """Every robust form in STAGED fused mode is bitwise the stacked
+    two-phase verdict composition, per round, and the NaN adversary's
+    ledger entries are identical (the ledger-equality half of the
+    universal-ingest contract)."""
+    ups = _make_uploads(shapes, rounds=3, workers=6)
+    kw = dict(extra)
+    if est is not None:
+        kw.update(aggregator=est, aggregator_params=params)
+    fp, fa = _drive(data, task, ups, fused=True, **kw)
+    sp, sa = _drive(data, task, ups, fused=False, **kw)
+    assert fa._fused_staged, "robust fused must run the staged mode"
+    for rnd, (x, y) in enumerate(zip(fp, sp)):
+        assert _leaves_equal(x, y), f"{est}: round {rnd} bits diverged"
+    led = fa.quarantine.canonical()
+    assert led == sa.quarantine.canonical()
+    assert any(e[2] == "nonfinite" for e in led), \
+        "NaN adversary never quarantined"
+
+
+# ------------------------------------------------------------- fused×shard
+def test_fused_sharded_four_corner_parity_with_ledger(data, task, shapes):
+    """{fused, stacked} x {sharded, replicated} under fused×median with a
+    NaN adversary: all four corners bitwise (model AND ledger), and the
+    sharded corners genuinely partition the kernel — the flush lands in
+    the rule-table placement, it is not a gather-then-reshard."""
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs >1 local device")
+    ups = _make_uploads(shapes, rounds=3, workers=6)
+    runs = {}
+    for name, kw in [
+        ("fused_sh", dict(fused=True, shard_server_state=True)),
+        ("fused_rep", dict(fused=True)),
+        ("stacked_sh", dict(fused=False, shard_server_state=True)),
+        ("stacked_rep", dict(fused=False)),
+    ]:
+        runs[name] = _drive(data, task, ups, aggregator="median", **kw)
+    ref_packs, ref_agg = runs["fused_sh"]
+    led = ref_agg.quarantine.canonical()
+    assert any(e[2] == "nonfinite" for e in led)
+    for name, (packs, agg) in runs.items():
+        for rnd, (x, y) in enumerate(zip(ref_packs, packs)):
+            assert _leaves_equal(x, y), f"{name}: round {rnd} diverged"
+        assert agg.quarantine.canonical() == led, name
+    sharded = runs["fused_sh"][1]
+    assert any(len(v.sharding.device_set) > 1
+               for v in jax.tree.leaves(sharded.net)), \
+        "sharded fused flush landed fully replicated"
+
+
+def test_fused_plain_sharded_parity(data, task, shapes):
+    """Plain fused (fold-at-arrival) under shard_server_state: the
+    accumulator partials carry the rule-table layout and the merged flush
+    equals the replicated fused run and the stacked sharded run."""
+    if len(jax.local_devices()) < 2:
+        pytest.skip("needs >1 local device")
+    ups = _make_uploads(shapes, rounds=2, workers=4, nan_at=(99, 99))
+    a, _ = _drive(data, task, ups, fused=True, workers=4,
+                  shard_server_state=True, sanitize=False)
+    b, _ = _drive(data, task, ups, fused=True, workers=4, sanitize=False)
+    c, _ = _drive(data, task, ups, fused=False, workers=4,
+                  shard_server_state=True, sanitize=False)
+    for x, y, z in zip(a, b, c):
+        assert _leaves_equal(x, y) and _leaves_equal(x, z)
+
+
+# --------------------------------------------------- chaos duplicate (staged)
+def test_fused_staged_duplicate_folds_exactly_once(data, task, shapes):
+    """A chaos-duplicated upload re-delivered into the SAME slot folds
+    exactly once in staged fused mode — the evidence row and the staged
+    leaves are slotted, not accumulated, so the re-delivery is a no-op
+    and the run stays bitwise the duplicate-free drive."""
+    ups = _make_uploads(shapes, rounds=2, workers=6)
+    a, _ = _drive(data, task, ups, fused=True, aggregator="median",
+                  dup=True)
+    b, _ = _drive(data, task, ups, fused=True, aggregator="median")
+    for rnd, (x, y) in enumerate(zip(a, b)):
+        assert _leaves_equal(x, y), f"round {rnd}: duplicate changed bits"
+
+
+# ------------------------------------------------- elastic partial (flat)
+def test_fused_robust_elastic_partial_flat(data, task, shapes):
+    """Straggler holes in the slot order under fused×median: the staged
+    flush folds exactly the arrived subset, bitwise the stacked twin over
+    the same subset — including the round where the NaN slot arrives."""
+    ups = _make_uploads(shapes, rounds=3, workers=6)
+    arrive = lambda rnd: [(0, 1, 2, 4), (1, 2, 3, 5), (0, 2, 3, 4, 5)][rnd]
+    fp, fa = _drive(data, task, ups, fused=True, aggregator="median",
+                    arrive=arrive)
+    sp, sa = _drive(data, task, ups, fused=False, aggregator="median",
+                    arrive=arrive)
+    for rnd, (x, y) in enumerate(zip(fp, sp)):
+        assert _leaves_equal(x, y), f"round {rnd} diverged"
+    led = fa.quarantine.canonical()
+    assert led == sa.quarantine.canonical()
+    assert any(e[2] == "nonfinite" for e in led)
+
+
+# --------------------------------------------- elastic partial (edge tier)
+@pytest.mark.slow
+def test_fused_robust_elastic_partial_tree(data, task):
+    """A seeded crash on edge rank 1 under fused×sanitize: the surviving
+    block degrades to an elastic partial and the fused tree run stays
+    bitwise the STACKED tree run — model bits, edge_lost ledger entries,
+    and fan-in history all identical through the crash window."""
+    from fedml_tpu.chaos import FaultPlan
+
+    crash = lambda: FaultPlan.from_json({"seed": 5, "rules": [
+        {"fault": "crash", "ranks": [1], "rounds": [1, 2]}]})
+    cfg = _cfg(comm_round=4)
+
+    def run(job, fused):
+        return run_simulated(data, task, cfg, job_id=job, edges=2,
+                             sanitize=True, fused_agg=fused,
+                             chaos_plan=crash(), round_timeout_s=1.5)
+
+    tree_f = run("fu-tree-f", True)
+    tree_s = run("fu-tree-s", False)
+    assert _leaves_equal(pack_pytree(tree_f.net), pack_pytree(tree_s.net))
+    led = tree_f.quarantine.canonical()
+    assert led == tree_s.quarantine.canonical()
+    assert any(e[2] == "edge_lost" for e in led), led
+    assert tree_f.fanin_history == tree_s.fanin_history
+    assert 1 in tree_f.fanin_history  # the crash window really was elastic
+
+
+# -------------------------------------------------------------- fused×async
+@pytest.mark.slow
+def test_fused_async_bound0_equals_sync_barrier(data, task):
+    """bound-0 / K=cohort async buffering under fused×median: arrivals
+    densify at the door against the version stash, the drain gates at
+    flush — bitwise the sync fused barrier AND the stacked pairwise
+    barrier (model + ledger + history). A persistent NaN adversary is
+    deliberately absent: BOTH async routes (stacked and fused alike)
+    quarantine non-finite arrivals at the door and never buffer them, so
+    the degenerate-parity claim is a clean-cohort contract — the fused
+    door's finiteness verdict itself is pinned by the drive tests above
+    and the shed accounting by tests/test_async_buffer.py."""
+    cfg = _cfg()
+    async_f = run_simulated(data, task, cfg, job_id="fu-async-f",
+                            fused_agg=True, aggregator="median",
+                            async_buffer_k=4, staleness="constant",
+                            staleness_bound=0)
+    sync_f = run_simulated(data, task, cfg, job_id="fu-sync-f",
+                           fused_agg=True, aggregator="median")
+    sync_s = run_simulated(data, task, cfg, job_id="fu-sync-s",
+                           sum_assoc="pairwise", aggregator="median")
+    assert _leaves_equal(pack_pytree(async_f.net), pack_pytree(sync_f.net))
+    assert _leaves_equal(pack_pytree(async_f.net), pack_pytree(sync_s.net))
+    assert async_f.quarantine.canonical() == sync_f.quarantine.canonical()
+    assert async_f.quarantine.canonical() == sync_s.quarantine.canonical()
+    assert async_f.history == sync_f.history
+
+
+# -------------------------------------------------------------- fused×edges
+@pytest.mark.slow
+def test_fused_edges_tree_equals_flat(data, task):
+    """The edge-tier fused accumulator: fused tree ≡ stacked tree ≡ flat
+    pairwise, plain AND robust (median + NaN adversary), model bits and
+    ledger — the tree ≡ flat contract survives the fused composition."""
+    cfg = _cfg(client_num_per_round=8)
+    for robust in (False, True):
+        kw = (dict(aggregator="median", adversary_plan=_nan_adv())
+              if robust else {})
+        tree_f = run_simulated(data, task, cfg,
+                               job_id=f"fu-etree-f{robust}", edges=2,
+                               fused_agg=True, **kw)
+        tree_s = run_simulated(data, task, cfg,
+                               job_id=f"fu-etree-s{robust}", edges=2, **kw)
+        flat = run_simulated(data, task, cfg,
+                             job_id=f"fu-eflat{robust}",
+                             sum_assoc="pairwise", **kw)
+        assert _leaves_equal(pack_pytree(tree_f.net),
+                             pack_pytree(tree_s.net))
+        assert _leaves_equal(pack_pytree(tree_f.net), pack_pytree(flat.net))
+        led = tree_f.quarantine.canonical()
+        assert led == tree_s.quarantine.canonical()
+        assert led == flat.quarantine.canonical()
+        if robust:
+            assert any(e[2] == "nonfinite" for e in led)
+
+
+# ------------------------------------------------------------------ warmup
+def test_warmup_fused_robust_and_sharded_zero_fresh_on_repeat(
+        data, task, shapes, tmp_path):
+    """The new fused_robust ingest/flush jits (and their sharded
+    variants) precompile through the persistent cache: a second identical
+    drive — fresh aggregator instances, so every jit retraces — performs
+    ZERO fresh compiles (every request is a cache hit)."""
+    from fedml_tpu.obs import perf_instrument as _perf
+
+    if not _perf.install():
+        pytest.skip("jax.monitoring unavailable")
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        ups = _make_uploads(shapes, rounds=1, workers=4, nan_at=(9, 9))
+
+        def once():
+            _drive(data, task, ups, fused=True, workers=4,
+                   aggregator="median")
+            if len(jax.local_devices()) > 1:
+                _drive(data, task, ups, fused=True, workers=4,
+                       aggregator="median", shard_server_state=True)
+
+        once()  # populate the cache (fresh compiles expected)
+        r0, m0, c0 = (_perf.cache_requests_total(),
+                      _perf.cache_misses_total(), _perf.compiles_total())
+        once()  # warm repeat
+        requests = int(_perf.cache_requests_total() - r0)
+        misses = int(_perf.cache_misses_total() - m0)
+        passes = int(_perf.compiles_total() - c0)
+        fresh = misses if requests else passes
+        assert fresh == 0, (requests, misses, passes)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_min)

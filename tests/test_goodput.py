@@ -509,3 +509,75 @@ def test_fedtop_duty_gflops_columns_hide_on_old_digests():
     assert "87.5" in out and "12.5" in out
     row2 = [ln for ln in out.splitlines() if ln.strip().startswith("2")][0]
     assert "-" in row2  # pre-PR digests render '-'
+
+
+# ------------------------------------------- fused ingest attribution (PR-21)
+def test_fused_ingest_seconds_move_wire_wait_into_agg_flush():
+    """The server's goodput block moves the per-arrival fused ingest-jit
+    seconds out of wire_wait (where the wall-clock window places them —
+    the jits run while the server waits on stragglers) into agg_flush
+    (what the seconds actually are: aggregation work). A stacked manager
+    (no ingest accumulator) is byte-identical to the pre-PR block."""
+    import types
+
+    from fedml_tpu.distributed.fedavg.server_manager import (
+        FedAvgServerManager,
+    )
+
+    spans = {"aggregate": 0.1}
+    fused = types.SimpleNamespace(_gp_fused_ingest_s=0.3)
+    g = FedAvgServerManager._goodput_extra(
+        fused, spans, wire_wait_s=0.5, wall_s=1.0)["goodput"]
+    assert g["buckets"]["wire_wait"] == pytest.approx(0.2)
+    assert g["buckets"]["agg_flush"] == pytest.approx(0.4)
+    stacked = types.SimpleNamespace()
+    g2 = FedAvgServerManager._goodput_extra(
+        stacked, spans, wire_wait_s=0.5, wall_s=1.0)["goodput"]
+    assert g2["buckets"]["wire_wait"] == pytest.approx(0.5)
+    assert g2["buckets"]["agg_flush"] == pytest.approx(0.1)
+    # attribution never goes negative when the window under-measures
+    clipped = types.SimpleNamespace(_gp_fused_ingest_s=0.9)
+    g3 = FedAvgServerManager._goodput_extra(
+        clipped, spans, wire_wait_s=0.5, wall_s=2.0)["goodput"]
+    assert g3["buckets"]["wire_wait"] == 0.0
+    assert g3["buckets"]["agg_flush"] == pytest.approx(1.0)
+
+
+def test_runstore_diff_names_agg_flush_for_fused_attribution(tmp_path):
+    """The forensic pin for the attribution fix: two run logs identical
+    except that the fused ingest seconds sit in wire_wait (pre-fix) vs
+    agg_flush (post-fix) — the run-store diff names agg_flush as THE
+    moved bucket, which is how a fused A/B reads in the index."""
+    from scripts import runstore
+
+    def rec(i, wire_wait, agg_flush):
+        wall = 0.02 + wire_wait + agg_flush
+        buckets = {b: 0.0 for b in goodput.BUCKETS}
+        buckets.update(compute=0.02, wire_wait=wire_wait,
+                       agg_flush=agg_flush)
+        return {"kind": "round", "round": i, "ts": 10.0 + 0.1 * i,
+                "goodput": {"wall_s": wall, "buckets": buckets,
+                            "duty": {b: v / wall
+                                     for b, v in buckets.items()}}}
+
+    def write(path, wire_wait, agg_flush):
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "run",
+                                "run": os.path.basename(path),
+                                "ts": 0.0}) + "\n")
+            for i in range(4):
+                f.write(json.dumps(rec(i, wire_wait, agg_flush)) + "\n")
+
+    pre, post = str(tmp_path / "pre.jsonl"), str(tmp_path / "post.jsonl")
+    # post-fix the ingest seconds land in agg_flush AND the flush itself
+    # got faster, so agg_flush is the strictly-largest mover
+    write(pre, wire_wait=0.050, agg_flush=0.004)   # ingest hidden in wait
+    write(post, wire_wait=0.012, agg_flush=0.048)  # ingest attributed
+    index = str(tmp_path / "index.jsonl")
+    assert runstore.main(["--index", index, "ingest", pre, post]) == 0
+    entries = runstore._load_index(index)
+    ea = runstore._resolve(entries, "pre.jsonl")
+    eb = runstore._resolve(entries, "post.jsonl")
+    lines, moved = runstore.diff_entries(ea, eb)
+    assert moved == "agg_flush", lines
+    assert any("moved bucket: agg_flush" in ln for ln in lines)

@@ -208,7 +208,7 @@ def test_hier_refuses_unsupported_modes(data, task):
         run_simulated(data, task, _cfg(), edges=2, sparsify_ratio=0.5)
 
 
-def test_flat_pairwise_sharded_refused_and_bogus_assoc(data, task):
+def test_flat_pairwise_sharded_builds_and_bogus_assoc(data, task):
     from fedml_tpu.distributed.fedavg.aggregator import FedAvgAggregator
 
     # pairwise + a robust estimator is now the two-phase composition
@@ -219,9 +219,12 @@ def test_flat_pairwise_sharded_refused_and_bogus_assoc(data, task):
     with pytest.raises(ValueError, match="sum_assoc"):
         FedAvgAggregator(data, task, _cfg(), worker_num=8,
                          sum_assoc="bogus")
-    with pytest.raises(ValueError, match="pairwise"):
-        FedAvgAggregator(data, task, _cfg(), worker_num=8,
-                         sum_assoc="pairwise", shard_server_state=True)
+    # PR-21: pairwise + shard_server_state is a composition too (the
+    # canonical fold is layout-agnostic; out_shardings pin the result) —
+    # it used to sit in the refusal matrix, now it must BUILD
+    agg = FedAvgAggregator(data, task, _cfg(), worker_num=8,
+                           sum_assoc="pairwise", shard_server_state=True)
+    assert agg.sum_assoc == "pairwise"
 
 
 # ----------------------------------------------- mesh satellite (standalone)
